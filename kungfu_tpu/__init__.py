@@ -1,0 +1,78 @@
+"""kungfu_tpu — a TPU-native adaptive distributed ML framework.
+
+A from-scratch rebuild of the capabilities of KungFu (Young768/KungFu) for
+TPU: distributed optimizers (sync SGD, synchronous model averaging, pair
+averaging, adaptive), a collective engine compiled to XLA over ICI/DCN
+meshes, elastic cluster membership with a config server, online monitoring
+(throughput, gradient noise scale), and a launcher.
+
+Where the reference runs a Go socket runtime under TF/Torch ops, this
+framework runs `jax.lax` collectives inside jitted, shard_mapped training
+steps — the communication schedule is compiled, not interpreted.
+"""
+from . import comm, plan
+from .comm import Session
+from .plan import Cluster, HostList, PeerID, PeerList, Strategy
+
+__version__ = "0.1.0"
+
+_default_session = None
+
+
+def _ensure_session() -> Session:
+    global _default_session
+    if _default_session is None:
+        _default_session = Session()
+    return _default_session
+
+
+def init(session: Session = None) -> Session:
+    """Initialise the default session (reference: kungfu_python_init,
+    srcs/cpp/src/python/init.cpp:10-41)."""
+    global _default_session
+    _default_session = session if session is not None else Session()
+    return _default_session
+
+
+def current_session() -> Session:
+    return _ensure_session()
+
+
+def current_rank() -> int:
+    """Rank of this controller process (reference:
+    srcs/python/kungfu/python/__init__.py current_rank)."""
+    import jax
+    return jax.process_index()
+
+
+def current_cluster_size() -> int:
+    """Number of peer lanes in the default session."""
+    return _ensure_session().size
+
+
+def current_local_rank() -> int:
+    import jax
+    return 0 if jax.process_count() == 1 else jax.process_index()
+
+
+def current_local_size() -> int:
+    import jax
+    return len(jax.local_devices())
+
+
+def run_barrier() -> None:
+    _ensure_session().barrier()
+
+
+def detached() -> bool:
+    """True when this peer was removed by a resize (see kungfu_tpu.elastic)."""
+    from .elastic import state as _es
+    return _es.is_detached()
+
+
+__all__ = [
+    "Session", "Cluster", "HostList", "PeerID", "PeerList", "Strategy",
+    "comm", "plan", "init", "current_session", "current_rank",
+    "current_cluster_size", "current_local_rank", "current_local_size",
+    "run_barrier", "detached",
+]

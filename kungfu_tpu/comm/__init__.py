@@ -1,0 +1,17 @@
+"""Compute-plane communication: meshes, collectives, sessions."""
+from .collectives import (all_gather, all_reduce, broadcast, graph_all_reduce,
+                          hierarchical_all_reduce, reduce_scatter,
+                          reduce_to_root, ring_exchange,
+                          striped_graph_all_reduce)
+from .mesh import (CHIP_AXIS, HOST_AXIS, PEER_AXIS, detect_hierarchy,
+                   flat_mesh, hierarchical_mesh, peer_sharding,
+                   replicated_sharding)
+from .session import Session, StrategyStat
+
+__all__ = [
+    "Session", "StrategyStat", "all_gather", "all_reduce", "broadcast",
+    "graph_all_reduce", "hierarchical_all_reduce", "reduce_scatter",
+    "reduce_to_root", "ring_exchange", "striped_graph_all_reduce",
+    "flat_mesh", "hierarchical_mesh", "detect_hierarchy", "peer_sharding",
+    "replicated_sharding", "PEER_AXIS", "HOST_AXIS", "CHIP_AXIS",
+]

@@ -1,0 +1,81 @@
+"""Device-mesh construction and TPU topology introspection.
+
+This replaces the reference's hand-built network topology layer: where
+KungFu chooses socket graphs over hosts (srcs/go/plan/topology.go), the TPU
+framework chooses a `jax.sharding.Mesh` and lets XLA route collectives over
+ICI/DCN.  Hierarchy (intra-host NCCL + inter-host TCP in the reference,
+srcs/cpp/src/nccl/controller.cpp:8-40) maps to a 2-level mesh
+``('host', 'chip')``: collectives over 'chip' ride ICI inside a slice,
+collectives over 'host' ride DCN.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PEER_AXIS = "kf_peers"      # flat data-parallel axis
+HOST_AXIS = "kf_host"       # inter-slice / DCN axis
+CHIP_AXIS = "kf_chip"       # intra-slice / ICI axis
+
+
+def flat_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              n: Optional[int] = None) -> Mesh:
+    """1-D mesh over ``n`` devices with the flat peer axis."""
+    ds = list(devices) if devices is not None else jax.devices()
+    if n is not None:
+        if n > len(ds):
+            raise ValueError(f"requested {n} devices, have {len(ds)}")
+        ds = ds[:n]
+    return Mesh(np.array(ds), (PEER_AXIS,))
+
+
+def hierarchical_mesh(num_hosts: int,
+                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D ``(host, chip)`` mesh.
+
+    On real multi-host TPU, devices are ordered host-major by jax, so a
+    reshape yields the correct ICI-inner layout (collectives over CHIP_AXIS
+    stay inside a host/slice).
+    """
+    ds = list(devices) if devices is not None else jax.devices()
+    if len(ds) % num_hosts != 0:
+        raise ValueError(f"{len(ds)} devices not divisible by {num_hosts} hosts")
+    arr = np.array(ds).reshape(num_hosts, len(ds) // num_hosts)
+    return Mesh(arr, (HOST_AXIS, CHIP_AXIS))
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def peer_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that gives each peer (device) its own slice along axis 0."""
+    return NamedSharding(mesh, P(mesh.axis_names))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def device_coords(d: jax.Device) -> Tuple[int, ...]:
+    """Physical ICI coordinates when available (TPU), else a 1-D index."""
+    c = getattr(d, "coords", None)
+    if c is not None:
+        return tuple(c)
+    return (d.id,)
+
+
+def detect_hierarchy(devices: Optional[Sequence[jax.Device]] = None) -> Tuple[int, int]:
+    """(num_hosts, chips_per_host) from device metadata.
+
+    Replaces the reference's hostfile/NIC discovery
+    (srcs/go/kungfu/runner/discovery.go:18-58) with accelerator metadata.
+    """
+    ds = list(devices) if devices is not None else jax.devices()
+    hosts = sorted({d.process_index for d in ds})
+    per = len(ds) // max(1, len(hosts))
+    return len(hosts), per

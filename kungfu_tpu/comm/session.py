@@ -1,0 +1,278 @@
+"""Collective session: the user-facing communication engine.
+
+Reference: srcs/go/kungfu/session/session.go — a Session is an immutable
+peer list plus strategy lists, executing named collective workspaces.  The
+TPU Session is an immutable device mesh plus a strategy, executing
+collectives either eagerly (host-driven, for control-plane and tests) or
+functionally inside the user's jitted step (the hot path).
+
+Eager collectives operate on *peer-stacked* arrays: leading axis = peer
+(device) lane, sharded over the mesh.  This is the TPU-native reading of
+"each worker owns a buffer": worker-local buffers become shards.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..plan.graph import Graph
+from ..plan.peer import PeerID, PeerList
+from ..plan.topology import (DEFAULT_STRATEGY, GraphPair, Strategy,
+                             auto_select, generate)
+from . import collectives as C
+from .mesh import PEER_AXIS, flat_mesh
+
+
+class StrategyStat:
+    """Per-strategy throughput accounting
+    (reference: srcs/go/kungfu/session/strategy.go:15-56)."""
+
+    def __init__(self) -> None:
+        self.accum_bytes = 0
+        self.accum_seconds = 0.0
+        self.count = 0
+        self.reference_rate: Optional[float] = None
+        self.suspended = False
+
+    def update(self, nbytes: int, seconds: float) -> None:
+        self.accum_bytes += nbytes
+        self.accum_seconds += seconds
+        self.count += 1
+
+    @property
+    def throughput(self) -> float:
+        if self.accum_seconds == 0:
+            return 0.0
+        return self.accum_bytes / self.accum_seconds
+
+    def snapshot_reference(self) -> None:
+        self.reference_rate = self.throughput
+
+    def reset_window(self) -> None:
+        self.accum_bytes = 0
+        self.accum_seconds = 0.0
+        self.count = 0
+
+
+class Session:
+    """One communication session over a fixed mesh + membership version."""
+
+    def __init__(self,
+                 peers: Optional[PeerList] = None,
+                 strategy: Strategy = Strategy.AUTO,
+                 mesh: Optional[Mesh] = None,
+                 version: int = 0):
+        if mesh is None:
+            n = len(peers) if peers else len(jax.devices())
+            mesh = flat_mesh(n=n)
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0] if len(mesh.axis_names) == 1 else PEER_AXIS
+        self.n = int(np.prod(mesh.devices.shape))
+        if peers is None:
+            peers = PeerList(PeerID("127.0.0.1", 31100 + i, i) for i in range(self.n))
+        if len(peers) != self.n:
+            raise ValueError(f"{len(peers)} peers vs {self.n} mesh devices")
+        self.peers = peers
+        self.version = version
+        self.requested_strategy = strategy
+        self.strategy = auto_select(peers) if strategy == Strategy.AUTO else strategy
+        self._pairs: List[GraphPair] = generate(self.strategy, peers)
+        self._stats: Dict[str, StrategyStat] = {}
+        self._fn_cache: Dict[tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ meta
+    def rank_of(self, p: PeerID) -> int:
+        return self.peers.rank(p)
+
+    @property
+    def size(self) -> int:
+        return self.n
+
+    @property
+    def graph_pairs(self) -> List[GraphPair]:
+        return self._pairs
+
+    # -------------------------------------------------- strategy adaptation
+    def set_strategy(self, strategy: Strategy) -> None:
+        """Swap the collective strategy (reference: adaptation.go
+        SetGlobalStrategy).  Safe between steps; triggers recompile of eager
+        kernels on next use."""
+        with self._lock:
+            self.requested_strategy = strategy
+            self.strategy = (auto_select(self.peers)
+                             if strategy == Strategy.AUTO else strategy)
+            self._pairs = generate(self.strategy, self.peers)
+            self._fn_cache.clear()
+
+    def set_tree(self, father: Sequence[int]) -> None:
+        """Install an explicit reduce forest — reference
+        SimpleSetGlobalStrategy(forest []int32) (adaptation.go:8-28), used by
+        the MST-from-latencies adaptation."""
+        g = Graph.from_forest_array(list(father))
+        with self._lock:
+            self.strategy = None  # custom
+            self._pairs = [GraphPair(g, g.reverse())]
+            self._fn_cache.clear()
+
+    # ------------------------------------------------------- eager execution
+    def _peer_spec(self) -> P:
+        return P(self.mesh.axis_names)
+
+    def _shard_fn(self, body: Callable, key: tuple) -> Callable:
+        with self._lock:
+            fn = self._fn_cache.get(key)
+            if fn is None:
+                fn = jax.jit(jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=self._peer_spec(), out_specs=self._peer_spec()))
+                self._fn_cache[key] = fn
+        return fn
+
+    def _run(self, name: str, x: jax.Array, body: Callable, key: tuple) -> jax.Array:
+        x = jnp.asarray(x)
+        if x.shape[0] != self.n:
+            raise ValueError(f"leading axis {x.shape[0]} != cluster size {self.n}")
+        fn = self._shard_fn(body, key + (x.shape, str(x.dtype)))
+        t0 = time.perf_counter()
+        out = fn(x)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        stat = self._stats.setdefault(name or "default", StrategyStat())
+        stat.update(x.nbytes, dt)
+        return out
+
+    def all_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
+        """Eager allreduce of a peer-stacked array (axis 0 = peers)."""
+        use_graph = self.strategy not in (Strategy.AUTO,) and self.strategy is not None \
+            and self.requested_strategy != Strategy.AUTO
+        if use_graph or self.strategy is None:
+            pairs = self._pairs
+            nm = name
+
+            def body(v):
+                flat = v.reshape(-1)
+                orig_dtype = flat.dtype
+                flat = flat.astype(jnp.float32) if not jnp.issubdtype(orig_dtype, jnp.floating) else flat
+                out = C.striped_graph_all_reduce(flat, pairs, self.axis,
+                                                 "SUM" if op == "MEAN" else op, nm)
+                if op == "MEAN":
+                    out = out / self.n
+                return out.astype(orig_dtype).reshape(v.shape)
+            key = ("graph_ar", op, name, id(pairs))
+        else:
+            def body(v):
+                return C.all_reduce(v, self.axis, op)
+            key = ("ar", op)
+        return self._run(name or "all_reduce", x, body, key)
+
+    def broadcast(self, x, root: int = 0, name: str = "") -> jax.Array:
+        def body(v):
+            return C.broadcast(v, self.axis, root)
+        return self._run(name or "broadcast", x, body, ("bcast", root))
+
+    def reduce(self, x, root: int = 0, op: str = "SUM", name: str = "") -> jax.Array:
+        def body(v):
+            return C.reduce_to_root(v, self.axis, root, op)
+        return self._run(name or "reduce", x, body, ("reduce", root, op))
+
+    def all_gather(self, x, name: str = "") -> jax.Array:
+        """Peer-stacked [n, ...] → [n, n, ...]: every lane sees all shards
+        (reference: allgather.go:17-45 direct full exchange)."""
+        def body(v):
+            g = C.all_gather(v, self.axis, axis=0, tiled=True)
+            return g[None]  # one full copy per lane
+        x = jnp.asarray(x)
+        fn = self._shard_fn(body, ("ag", x.shape, str(x.dtype)))
+        out = fn(x)
+        out.block_until_ready()
+        return out
+
+    def gather(self, x, root: int = 0, name: str = "") -> jax.Array:
+        """Gather shards to ``root`` lane; others zero-filled
+        (reference: session.go:185-207)."""
+        def body(v):
+            g = C.all_gather(v, self.axis, axis=0, tiled=True)[None]
+            idx = jax.lax.axis_index(self.axis)
+            return jnp.where(idx == root, g, jnp.zeros_like(g))
+        x = jnp.asarray(x)
+        fn = self._shard_fn(body, ("gather", root, x.shape, str(x.dtype)))
+        out = fn(x)
+        out.block_until_ready()
+        return out
+
+    # ------------------------------------------------------- barrier/consensus
+    def barrier(self) -> None:
+        """Rendezvous of all peers: a tiny allreduce, blocked on
+        (reference: session.go:98-109)."""
+        x = jnp.ones((self.n, 1), dtype=jnp.float32)
+        def body(v):
+            return C.all_reduce(v, self.axis, "SUM")
+        out = self._shard_fn(body, ("barrier",))(x)
+        out.block_until_ready()
+
+    def consensus(self, x) -> bool:
+        """True iff every peer lane holds bit-identical data.
+
+        Reference: allreduce-MIN vs allreduce-MAX equality check
+        (session.go:111-151) — the distributed race/divergence detector.
+        """
+        x = jnp.asarray(x)
+        if x.shape[0] != self.n:
+            raise ValueError("consensus input must be peer-stacked")
+        v = x.reshape(self.n, -1)
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(jnp.float32)
+
+        def body(t):
+            mn = C.all_reduce(t, self.axis, "MIN")
+            mx = C.all_reduce(t, self.axis, "MAX")
+            return jnp.all(mn == mx).astype(jnp.float32).reshape(1, 1) * jnp.ones((1, 1), v.dtype)
+
+        fn = self._shard_fn(body, ("consensus", v.shape, str(v.dtype)))
+        out = fn(v)
+        return bool(np.all(np.asarray(out) > 0))
+
+    def bytes_consensus(self, payload: bytes) -> bool:
+        """Consensus over an opaque byte string from *this* controller.
+
+        Single-controller meshes trivially agree; under multi-controller
+        (jax.distributed) each process contributes its digest lane.
+        """
+        import hashlib
+        digest = hashlib.sha256(payload).digest()[:16]
+        row = np.frombuffer(digest, dtype=np.uint8).astype(np.float32)
+        lanes = np.tile(row, (self.n, 1))
+        if jax.process_count() > 1:  # each process overwrites its own lanes
+            pi = jax.process_index()
+            lanes = lanes.copy()
+        return self.consensus(jnp.asarray(lanes))
+
+    # ------------------------------------------------------------ monitoring
+    def stats(self) -> Dict[str, StrategyStat]:
+        return dict(self._stats)
+
+    def calc_stats(self) -> Dict[str, float]:
+        """Throughput per named op window (reference:
+        adaptiveStrategies.go CalcStats)."""
+        return {k: s.throughput for k, s in self._stats.items()}
+
+    def log_stats(self) -> str:
+        lines = [f"{k}: {s.throughput / 1e9:.3f} GiB/s over {s.count} ops"
+                 for k, s in self._stats.items()]
+        return "\n".join(lines)
+
+    def check_interference(self, threshold: float = 0.8) -> bool:
+        """True when current throughput dropped below threshold × reference
+        rate (reference: adaptiveStrategies.go:61-121 CheckInterference)."""
+        for s in self._stats.values():
+            if s.reference_rate and s.throughput < threshold * s.reference_rate:
+                return True
+        return False

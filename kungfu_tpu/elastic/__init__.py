@@ -1,0 +1,4 @@
+"""Elastic cluster membership (config server, resize protocol, policies)."""
+from . import state
+
+__all__ = ["state"]

@@ -1,0 +1,27 @@
+"""Process-local elastic state flags.
+
+Reference: detached flag semantics — a peer whose rank disappeared from the
+cluster after a resize sees detached=true and stops training
+(srcs/go/kungfu/peer/peer.go:256-259).
+"""
+_detached = False
+_cluster_version = 0
+
+
+def is_detached() -> bool:
+    return _detached
+
+
+def set_detached(v: bool = True) -> None:
+    global _detached
+    _detached = v
+
+
+def cluster_version() -> int:
+    return _cluster_version
+
+
+def bump_cluster_version() -> int:
+    global _cluster_version
+    _cluster_version += 1
+    return _cluster_version

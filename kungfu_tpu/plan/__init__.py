@@ -1,0 +1,19 @@
+"""Cluster topology and membership model (reference: srcs/go/plan/)."""
+from .cluster import Cluster
+from .graph import Graph
+from .hostspec import DEFAULT_RUNNER_PORT, DEFAULT_WORKER_PORT, HostList, HostSpec
+from .partition import (DEFAULT_CHUNK_BYTES, Interval, chunk_partition,
+                        even_partition, stripe)
+from .peer import NetAddr, PeerID, PeerList
+from .topology import (DEFAULT_STRATEGY, GraphPair, Strategy, auto_select,
+                       binary_tree_pair, cross_host_pairs, generate,
+                       ring_pair, star_pair)
+
+__all__ = [
+    "Cluster", "Graph", "HostList", "HostSpec", "NetAddr", "PeerID",
+    "PeerList", "GraphPair", "Strategy", "DEFAULT_STRATEGY",
+    "DEFAULT_WORKER_PORT", "DEFAULT_RUNNER_PORT", "DEFAULT_CHUNK_BYTES",
+    "Interval", "auto_select", "binary_tree_pair", "chunk_partition",
+    "cross_host_pairs", "even_partition", "generate", "ring_pair",
+    "star_pair", "stripe",
+]

@@ -1,0 +1,87 @@
+"""Cluster model: the unit of elastic membership.
+
+Reference semantics: srcs/go/plan/cluster.go:10-113 — a Cluster is a pair
+(runners, workers); Resize(n) keeps a prefix of workers or grows one worker
+at a time onto hosts that still have runner capacity.  The JSON codec is the
+wire schema of the elastic config server.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import List, Optional
+
+from .hostspec import DEFAULT_WORKER_PORT, HostList
+from .peer import PeerID, PeerList
+
+
+@dataclasses.dataclass
+class Cluster:
+    runners: PeerList
+    workers: PeerList
+
+    # -- validation ---------------------------------------------------------
+    def validate(self) -> None:
+        """Every worker must live on a host that has a runner."""
+        runner_hosts = {r.host for r in self.runners}
+        for w in self.workers:
+            if w.host not in runner_hosts:
+                raise ValueError(f"worker {w} has no runner on its host")
+        if len(set(self.workers)) != len(self.workers):
+            raise ValueError("duplicate workers")
+
+    def size(self) -> int:
+        return len(self.workers)
+
+    # -- resize -------------------------------------------------------------
+    def resize(self, new_size: int) -> "Cluster":
+        """Shrink = keep worker prefix; grow = add workers one at a time on
+        the least-loaded runner host (reference: cluster.go Resize/growOne)."""
+        if new_size < 0:
+            raise ValueError("negative cluster size")
+        if new_size <= len(self.workers):
+            return Cluster(self.runners, self.workers[:new_size])
+        workers = list(self.workers)
+        while len(workers) < new_size:
+            workers.append(self._grow_one(workers))
+        return Cluster(self.runners, PeerList(workers))
+
+    def _grow_one(self, workers: List[PeerID]) -> PeerID:
+        load = {r.host: 0 for r in self.runners}
+        used_ports = {}
+        for w in workers:
+            load[w.host] = load.get(w.host, 0) + 1
+            used_ports.setdefault(w.host, set()).add(w.port)
+        if not load:
+            raise ValueError("cannot grow: no runners")
+        host = min(load, key=lambda h: (load[h], list(load).index(h)))
+        port = DEFAULT_WORKER_PORT
+        while port in used_ports.get(host, ()):  # next free slot on host
+            port += 1
+        return PeerID(host, port, port - DEFAULT_WORKER_PORT)
+
+    # -- codec (config-server wire schema) ----------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "runners": [str(r) for r in self.runners],
+                "workers": [f"{w.host}:{w.port}:{w.slot}" for w in self.workers],
+            }
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "Cluster":
+        d = json.loads(s)
+        return Cluster(
+            runners=PeerList(PeerID.parse(r) for r in d["runners"]),
+            workers=PeerList(PeerID.parse(w) for w in d["workers"]),
+        )
+
+    def digest(self) -> bytes:
+        """Stable digest for the consensus fence on cluster changes."""
+        return hashlib.sha256(self.to_json().encode()).digest()[:16]
+
+    @staticmethod
+    def from_hostlist(hl: HostList, np: int) -> "Cluster":
+        return Cluster(runners=hl.gen_runner_list(), workers=hl.gen_peer_list(np))

@@ -1,0 +1,148 @@
+"""Collective-topology graphs.
+
+Reference semantics: srcs/go/plan/graph/graph.go:29-147 — a directed graph
+over ranks with optional self-loops.  A collective strategy is a pair
+(reduce_graph, bcast_graph): data flows leaf→root along the reduce graph
+(nodes with a self-loop aggregate), then root→leaf along the broadcast
+graph.
+
+On TPU these graphs are *lowered to schedules of XLA collectives* (see
+kungfu_tpu.comm.graph_collectives) instead of driving a socket transport:
+each graph level becomes one `lax.ppermute` round plus an add/select, so any
+reference topology (star, rings, trees) compiles into a single XLA program.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+
+class Graph:
+    """Directed graph over ranks 0..n-1 with self-loop flags."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self._nexts: List[List[int]] = [[] for _ in range(n)]
+        self._prevs: List[List[int]] = [[] for _ in range(n)]
+        self._self_loop: List[bool] = [False] * n
+
+    # -- construction -------------------------------------------------------
+    def add_edge(self, a: int, b: int) -> None:
+        if a == b:
+            self._self_loop[a] = True
+            return
+        self._nexts[a].append(b)
+        self._prevs[b].append(a)
+
+    def add_self_loops(self) -> "Graph":
+        for i in range(self.n):
+            self._self_loop[i] = True
+        return self
+
+    # -- queries ------------------------------------------------------------
+    def nexts(self, i: int) -> List[int]:
+        return list(self._nexts[i])
+
+    def prevs(self, i: int) -> List[int]:
+        return list(self._prevs[i])
+
+    def has_self_loop(self, i: int) -> bool:
+        return self._self_loop[i]
+
+    def is_self_loop_only(self) -> bool:
+        return all(not nx for nx in self._nexts)
+
+    def edges(self) -> List[Tuple[int, int]]:
+        return [(a, b) for a in range(self.n) for b in self._nexts[a]]
+
+    # -- transforms ----------------------------------------------------------
+    def reverse(self) -> "Graph":
+        g = Graph(self.n)
+        g._self_loop = list(self._self_loop)
+        for a, b in self.edges():
+            g.add_edge(b, a)
+        return g
+
+    # -- codecs --------------------------------------------------------------
+    @staticmethod
+    def from_forest_array(father: Sequence[int]) -> "Graph":
+        """Decode a father-array forest: ``father[i] == i`` marks a root.
+
+        Edges point child→father (reduce direction); every node gets a
+        self-loop (it contributes its own data).
+        Reference: graph/graph.go FromForestArray.
+        """
+        n = len(father)
+        g = Graph(n)
+        roots = 0
+        for i, f in enumerate(father):
+            if not 0 <= f < n:
+                raise ValueError(f"father[{i}]={f} out of range")
+            g._self_loop[i] = True
+            if f == i:
+                roots += 1
+            else:
+                g.add_edge(i, f)
+        if roots == 0:
+            raise ValueError("forest has no root")
+        g._roots = roots  # type: ignore[attr-defined]
+        return g
+
+    def to_forest_array(self) -> List[int]:
+        """Inverse of from_forest_array for tree-shaped reduce graphs."""
+        father = list(range(self.n))
+        for a in range(self.n):
+            nx = self._nexts[a]
+            if len(nx) > 1:
+                raise ValueError("not a forest: node has multiple parents")
+            if nx:
+                father[a] = nx[0]
+        return father
+
+    def digest(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(bytes([self.n & 0xFF, (self.n >> 8) & 0xFF]))
+        for a, b in sorted(self.edges()):
+            h.update(a.to_bytes(4, "little") + b.to_bytes(4, "little"))
+        h.update(bytes(int(x) for x in self._self_loop))
+        return h.digest()[:16]
+
+    # -- scheduling ----------------------------------------------------------
+    def levels_toward_roots(self) -> List[List[Tuple[int, int]]]:
+        """Topological rounds of (src, dst) edges, leaves first.
+
+        Round k contains every edge whose source has had all its inputs
+        satisfied by rounds < k.  This is the ppermute schedule for the
+        reduce phase; reverse the graph first for the broadcast phase.
+        """
+        indeg = [len(self._prevs[i]) for i in range(self.n)]
+        pending: Dict[int, List[int]] = {i: list(self._prevs[i]) for i in range(self.n)}
+        ready = [i for i in range(self.n) if indeg[i] == 0]
+        done = [False] * self.n
+        rounds: List[List[Tuple[int, int]]] = []
+        emitted = set()
+        while True:
+            this_round: List[Tuple[int, int]] = []
+            newly_done = []
+            for i in range(self.n):
+                if not done[i] and indeg[i] == 0:
+                    newly_done.append(i)
+            if not newly_done:
+                break
+            for i in newly_done:
+                done[i] = True
+                for j in self._nexts[i]:
+                    if (i, j) not in emitted:
+                        this_round.append((i, j))
+                        emitted.add((i, j))
+                        indeg[j] -= 1
+            if this_round:
+                rounds.append(this_round)
+            if all(done):
+                break
+        if len(emitted) != len(self.edges()):
+            raise ValueError("graph has a cycle; no level schedule exists")
+        return rounds
+
+    def __repr__(self) -> str:
+        return f"Graph(n={self.n}, edges={self.edges()})"

@@ -1,0 +1,113 @@
+"""Host specifications and host lists.
+
+Reference semantics: srcs/go/plan/hostspec.go:15-90 — a host spec is
+``ip[:slots[:pubAddr]]``; a host list is a comma-separated sequence, also
+loadable from a hostfile.  ``slots`` here means TPU worker slots per host
+(one worker per host is the common TPU-VM arrangement, but multi-worker
+hosts are supported for CPU testing).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List
+
+from .peer import PeerID, PeerList
+
+DEFAULT_WORKER_PORT = 31100
+DEFAULT_RUNNER_PORT = 31000
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSpec:
+    """One host: internal address, worker slots, public address."""
+
+    host: str
+    slots: int = 1
+    public_addr: str = ""
+
+    def __post_init__(self):
+        if not self.public_addr:
+            object.__setattr__(self, "public_addr", self.host)
+        if self.slots < 0:
+            raise ValueError(f"negative slots on {self.host}")
+
+    @staticmethod
+    def parse(s: str) -> "HostSpec":
+        parts = s.split(":")
+        if len(parts) == 1:
+            return HostSpec(parts[0])
+        if len(parts) == 2:
+            return HostSpec(parts[0], int(parts[1]))
+        if len(parts) == 3:
+            return HostSpec(parts[0], int(parts[1]), parts[2])
+        raise ValueError(f"invalid host spec: {s!r}")
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.slots}:{self.public_addr}"
+
+
+class HostList:
+    """Ordered list of hosts with slot capacities."""
+
+    def __init__(self, specs: Iterable[HostSpec] = ()):  # noqa: D107
+        self._specs: List[HostSpec] = list(specs)
+
+    def __len__(self) -> int:
+        return len(self._specs)
+
+    def __iter__(self):
+        return iter(self._specs)
+
+    def __getitem__(self, i) -> HostSpec:
+        return self._specs[i]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, HostList) and self._specs == other._specs
+
+    @staticmethod
+    def parse(s: str) -> "HostList":
+        if not s:
+            return HostList()
+        return HostList(HostSpec.parse(t) for t in s.split(","))
+
+    @staticmethod
+    def parse_hostfile(text: str) -> "HostList":
+        """One ``ip slots=N`` or ``ip:slots`` entry per line; '#' comments."""
+        specs = []
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if " " in line:
+                host, rest = line.split(None, 1)
+                slots = 1
+                for kv in rest.split():
+                    if kv.startswith("slots="):
+                        slots = int(kv.split("=", 1)[1])
+                specs.append(HostSpec(host, slots))
+            else:
+                specs.append(HostSpec.parse(line))
+        return HostList(specs)
+
+    def cap(self) -> int:
+        return sum(h.slots for h in self._specs)
+
+    def gen_peer_list(self, np: int, base_port: int = DEFAULT_WORKER_PORT) -> PeerList:
+        """First ``np`` worker slots, filling each host before the next
+        (reference: srcs/go/plan/hostspec.go GenPeerList)."""
+        if np > self.cap():
+            raise ValueError(f"np={np} exceeds capacity {self.cap()}")
+        peers = []
+        for h in self._specs:
+            for slot in range(h.slots):
+                if len(peers) == np:
+                    return PeerList(peers)
+                peers.append(PeerID(h.host, base_port + slot, slot))
+        return PeerList(peers)
+
+    def gen_runner_list(self, port: int = DEFAULT_RUNNER_PORT) -> PeerList:
+        """One runner endpoint per host."""
+        return PeerList(PeerID(h.host, port, 0) for h in self._specs)
+
+    def to_string(self) -> str:
+        return ",".join(str(h) for h in self._specs)

@@ -1,0 +1,66 @@
+"""Buffer partitioning for chunked multi-strategy striping.
+
+Reference semantics: srcs/go/plan (EvenPartition intervals) and
+srcs/go/kungfu/session/session.go:288-317 — a workspace is split into
+~1 MiB chunks and chunks are striped across strategy graph-pairs by a hash
+of (name, chunk index).
+
+On TPU the analogue operates on flattened gradient pytrees: a fused
+gradient vector is split into intervals, each interval assigned a strategy;
+XLA compiles all stripes into one program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Sequence
+
+DEFAULT_CHUNK_BYTES = 1 << 20  # reference: session.go chunk size (1 MiB)
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    begin: int
+    end: int
+
+    @property
+    def size(self) -> int:
+        return self.end - self.begin
+
+
+def even_partition(total: int, k: int) -> List[Interval]:
+    """Split [0, total) into k near-equal intervals (reference EvenPartition)."""
+    if k <= 0:
+        raise ValueError("k must be positive")
+    out = []
+    base, rem = divmod(total, k)
+    begin = 0
+    for i in range(k):
+        size = base + (1 if i < rem else 0)
+        out.append(Interval(begin, begin + size))
+        begin += size
+    return out
+
+
+def chunk_partition(total: int, chunk_size: int = DEFAULT_CHUNK_BYTES) -> List[Interval]:
+    """Split [0, total) into ceil(total/chunk_size) chunks."""
+    if total == 0:
+        return [Interval(0, 0)]
+    k = -(-total // chunk_size)
+    return even_partition(total, k)
+
+
+def stripe(name: str, num_chunks: int, num_strategies: int, by_name: bool = True) -> List[int]:
+    """Assign each chunk a strategy index.
+
+    Reference: srcs/go/kungfu/session/shard.go:13-31 — hash of the op name
+    (stable across peers) plus chunk index, modulo strategy count.  All peers
+    must agree, so the hash uses only (name, index).
+    """
+    if num_strategies <= 0:
+        raise ValueError("need at least one strategy")
+    if by_name and name:
+        seed = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
+    else:
+        seed = 0
+    return [(seed + i) % num_strategies for i in range(num_chunks)]

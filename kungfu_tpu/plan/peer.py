@@ -1,0 +1,175 @@
+"""Peer identity and peer-list model.
+
+TPU-native rebuild of the reference cluster vocabulary
+(reference: srcs/go/plan/addr.go:10-60, srcs/go/plan/peerlist.go:39-178).
+
+A *peer* in the TPU framework is one worker process on one host; each peer
+owns a set of TPU chips (its local devices).  Unlike the reference — where a
+peer is the unit of collective communication — here the unit of compute-plane
+communication is the XLA device mesh, and peers exist for the control plane:
+membership, elasticity, launching, and monitoring.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import ipaddress
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def _ipv4_to_int(host: str) -> int:
+    return int(ipaddress.IPv4Address(host))
+
+
+def _int_to_ipv4(v: int) -> str:
+    return str(ipaddress.IPv4Address(v))
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class NetAddr:
+    """A host:port endpoint (reference: srcs/go/plan/addr.go:10-33)."""
+
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @staticmethod
+    def parse(s: str) -> "NetAddr":
+        host, port = s.rsplit(":", 1)
+        return NetAddr(host, int(port))
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class PeerID:
+    """Identity of one worker process (reference: srcs/go/plan/addr.go:35-60).
+
+    ``host:port`` uniquely identifies the process; ``slot`` is the index of
+    the worker on its host (maps to a local accelerator allocation).
+    """
+
+    host: str
+    port: int
+    slot: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def addr(self) -> NetAddr:
+        return NetAddr(self.host, self.port)
+
+    @staticmethod
+    def parse(s: str) -> "PeerID":
+        """Parse ``host:port[:slot]``."""
+        parts = s.split(":")
+        if len(parts) == 2:
+            return PeerID(parts[0], int(parts[1]))
+        if len(parts) == 3:
+            return PeerID(parts[0], int(parts[1]), int(parts[2]))
+        raise ValueError(f"invalid peer spec: {s!r}")
+
+
+class PeerList:
+    """Ordered list of peers; rank == index.
+
+    Reference semantics: srcs/go/plan/peerlist.go:39-178 (Rank, LocalRank,
+    HostCount, Diff, Intersection, PartitionByHost, On).
+    """
+
+    def __init__(self, peers: Iterable[PeerID] = ()):  # noqa: D107
+        self._peers: List[PeerID] = list(peers)
+
+    # -- container protocol -------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._peers)
+
+    def __iter__(self):
+        return iter(self._peers)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return PeerList(self._peers[i])
+        return self._peers[i]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PeerList) and self._peers == other._peers
+
+    def __repr__(self) -> str:
+        return f"PeerList([{', '.join(map(str, self._peers))}])"
+
+    # -- queries ------------------------------------------------------------
+    def rank(self, p: PeerID) -> int:
+        """Global rank of ``p``; raises ValueError if absent."""
+        return self._peers.index(p)
+
+    def contains(self, p: PeerID) -> bool:
+        return p in self._peers
+
+    def local_rank(self, p: PeerID) -> int:
+        """Rank of ``p`` among peers on the same host."""
+        r = 0
+        for q in self._peers:
+            if q == p:
+                return r
+            if q.host == p.host:
+                r += 1
+        raise ValueError(f"{p} not in peer list")
+
+    def local_size(self, p: PeerID) -> int:
+        return sum(1 for q in self._peers if q.host == p.host)
+
+    def host_count(self) -> int:
+        return len({q.host for q in self._peers})
+
+    def hosts(self) -> List[str]:
+        """Distinct hosts in first-appearance order."""
+        seen: Dict[str, None] = {}
+        for q in self._peers:
+            seen.setdefault(q.host, None)
+        return list(seen)
+
+    def partition_by_host(self) -> Dict[str, "PeerList"]:
+        out: Dict[str, PeerList] = {}
+        for q in self._peers:
+            out.setdefault(q.host, PeerList())._peers.append(q)
+        return out
+
+    def local_masters(self) -> "PeerList":
+        """First peer of each host (the intra-host root)."""
+        seen: Dict[str, PeerID] = {}
+        for q in self._peers:
+            seen.setdefault(q.host, q)
+        return PeerList(seen.values())
+
+    # -- set algebra (membership diffs drive elasticity) --------------------
+    def diff(self, other: "PeerList") -> "PeerList":
+        """Peers in self but not in other."""
+        o = set(other._peers)
+        return PeerList(p for p in self._peers if p not in o)
+
+    def intersection(self, other: "PeerList") -> "PeerList":
+        o = set(other._peers)
+        return PeerList(p for p in self._peers if p in o)
+
+    def disjoint(self, other: "PeerList") -> bool:
+        return not set(self._peers) & set(other._peers)
+
+    def on_host(self, host: str) -> "PeerList":
+        return PeerList(p for p in self._peers if p.host == host)
+
+    # -- codec --------------------------------------------------------------
+    def to_string(self) -> str:
+        return ",".join(f"{p.host}:{p.port}:{p.slot}" for p in self._peers)
+
+    @staticmethod
+    def parse(s: str) -> "PeerList":
+        if not s:
+            return PeerList()
+        return PeerList(PeerID.parse(t) for t in s.split(","))
+
+    def digest(self) -> bytes:
+        """Stable digest of membership; used for consensus fencing
+        (reference: srcs/go/plan/graph/graph.go DigestBytes analogue)."""
+        return hashlib.sha256(self.to_string().encode()).digest()[:16]

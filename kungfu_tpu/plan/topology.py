@@ -1,0 +1,193 @@
+"""Topology generators for every reference collective strategy.
+
+Reference semantics: srcs/go/plan/topology.go:17-160 and
+srcs/go/kungfu/base/strategy.go:10-23.  Each strategy yields one or more
+(reduce_graph, broadcast_graph) pairs; workloads are striped chunk-wise
+across the pairs (multi-root strategies spread root load).
+
+On TPU the graphs are compiled to ppermute schedules
+(kungfu_tpu.comm.graph_collectives) or — for the AUTO strategy — replaced
+entirely by XLA's native AllReduce, which already picks the optimal ICI
+topology.  The generators are retained for parity, for CPU-mesh testing,
+and for DCN-aware hierarchical composition.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .graph import Graph
+from .peer import PeerList
+
+
+class Strategy(enum.Enum):
+    """Reference: srcs/go/kungfu/base/strategy.go:10-21."""
+
+    STAR = "STAR"
+    MULTI_STAR = "MULTI_STAR"
+    RING = "RING"
+    CLIQUE = "CLIQUE"
+    TREE = "TREE"
+    BINARY_TREE = "BINARY_TREE"
+    BINARY_TREE_STAR = "BINARY_TREE_STAR"
+    MULTI_BINARY_TREE_STAR = "MULTI_BINARY_TREE_STAR"
+    AUTO = "AUTO"
+
+    @staticmethod
+    def parse(s: str) -> "Strategy":
+        try:
+            return Strategy[s.strip().upper().replace("-", "_")]
+        except KeyError:
+            raise ValueError(f"unknown strategy: {s!r}") from None
+
+
+DEFAULT_STRATEGY = Strategy.BINARY_TREE_STAR  # reference: strategy.go:23
+
+
+@dataclasses.dataclass
+class GraphPair:
+    reduce_graph: Graph
+    bcast_graph: Graph
+
+    def digest(self) -> bytes:
+        return bytes(a ^ b for a, b in zip(self.reduce_graph.digest(), self.bcast_graph.digest()))
+
+
+# -- primitive builders ------------------------------------------------------
+
+def star_pair(n: int, root: int = 0) -> GraphPair:
+    """Everyone sends to ``root``; root broadcasts back."""
+    r = Graph(n)
+    for i in range(n):
+        if i != root:
+            r.add_edge(i, root)
+    r.add_self_loops()
+    return GraphPair(r, r.reverse())
+
+
+def binary_tree_pair(n: int, ranks: Optional[Sequence[int]] = None) -> GraphPair:
+    """Complete binary tree: parent of position p is (p-1)//2.
+
+    ``ranks`` optionally maps tree positions to actual ranks (used to build
+    trees over local masters).
+    """
+    ranks = list(ranks) if ranks is not None else list(range(n))
+    m = len(ranks)
+    r = Graph(n)
+    for p in range(1, m):
+        r.add_edge(ranks[p], ranks[(p - 1) // 2])
+    for i in ranks:
+        r.add_edge(i, i)
+    return GraphPair(r, r.reverse())
+
+
+def ring_pair(n: int, start: int = 0) -> GraphPair:
+    """Pipeline chain start→start+1→…→start+n-1 (mod n); broadcast reversed.
+
+    Reference: topology.go:149-160 (circular ring pair).
+    """
+    r = Graph(n)
+    order = [(start + i) % n for i in range(n)]
+    for a, b in zip(order, order[1:]):
+        r.add_edge(a, b)
+    r.add_self_loops()
+    return GraphPair(r, r.reverse())
+
+
+# -- strategy generators -----------------------------------------------------
+
+def _local_master_star(peers: PeerList, masters_pair_builder) -> List[GraphPair]:
+    """Intra-host star onto each host's first peer + an inter-host graph over
+    the local masters (reference: topology.go:17-31, 76-105)."""
+    n = len(peers)
+    by_host = peers.partition_by_host()
+    masters = [peers.rank(pl[0]) for pl in by_host.values()]
+    r = Graph(n)
+    for pl in by_host.values():
+        root = peers.rank(pl[0])
+        for p in pl:
+            i = peers.rank(p)
+            if i != root:
+                r.add_edge(i, root)
+    inter = masters_pair_builder(n, masters)
+    for a, b in inter.reduce_graph.edges():
+        r.add_edge(a, b)
+    r.add_self_loops()
+    return [GraphPair(r, r.reverse())]
+
+
+def generate(strategy: Strategy, peers: PeerList) -> List[GraphPair]:
+    """Build the graph-pair list for ``strategy`` over ``peers``."""
+    n = len(peers)
+    if n == 0:
+        raise ValueError("empty peer list")
+    if strategy == Strategy.AUTO:
+        strategy = auto_select(peers)
+    if strategy == Strategy.STAR:
+        return [star_pair(n, 0)]
+    if strategy == Strategy.MULTI_STAR:
+        return [star_pair(n, root) for root in range(n)]
+    if strategy == Strategy.RING:
+        return [ring_pair(n, start) for start in range(n)]
+    if strategy == Strategy.CLIQUE:
+        return [star_pair(n, root) for root in range(n)]
+    if strategy == Strategy.TREE:
+        return _local_master_star(peers, lambda nn, ms: star_pair_over(nn, ms))
+    if strategy == Strategy.BINARY_TREE:
+        return [binary_tree_pair(n)]
+    if strategy == Strategy.BINARY_TREE_STAR:
+        return _local_master_star(peers, lambda nn, ms: binary_tree_pair(nn, ms))
+    if strategy == Strategy.MULTI_BINARY_TREE_STAR:
+        by_host = peers.partition_by_host()
+        pairs = []
+        width = min(len(pl) for pl in by_host.values())
+        for k in range(width):
+            masters = [peers.rank(pl[k]) for pl in by_host.values()]
+            nn = len(peers)
+            r = Graph(nn)
+            for pl in by_host.values():
+                root = peers.rank(pl[k])
+                for p in pl:
+                    i = peers.rank(p)
+                    if i != root:
+                        r.add_edge(i, root)
+            inter = binary_tree_pair(nn, masters)
+            for a, b in inter.reduce_graph.edges():
+                r.add_edge(a, b)
+            r.add_self_loops()
+            pairs.append(GraphPair(r, r.reverse()))
+        return pairs
+    raise ValueError(f"unhandled strategy {strategy}")
+
+
+def star_pair_over(n: int, ranks: Sequence[int]) -> GraphPair:
+    """Star over a subset of ranks, rooted at the first."""
+    r = Graph(n)
+    root = ranks[0]
+    for i in ranks[1:]:
+        r.add_edge(i, root)
+    for i in ranks:
+        r.add_edge(i, i)
+    return GraphPair(r, r.reverse())
+
+
+def auto_select(peers: PeerList) -> Strategy:
+    """Reference: srcs/go/kungfu/session/strategy.go:165-174 — single host →
+    STAR, multi host → BINARY_TREE_STAR."""
+    return Strategy.STAR if peers.host_count() == 1 else Strategy.BINARY_TREE_STAR
+
+
+def cross_host_pairs(peers: PeerList, strategy: Strategy = Strategy.RING) -> List[GraphPair]:
+    """Graphs over local masters only, for hierarchical (2-level) collectives
+    (reference: srcs/go/plan/subgraph/subgraph.go:5-31)."""
+    n = len(peers)
+    masters = [peers.rank(p) for p in peers.local_masters()]
+    if strategy == Strategy.RING:
+        r = Graph(n)
+        for a, b in zip(masters, masters[1:]):
+            r.add_edge(a, b)
+        for i in masters:
+            r.add_edge(i, i)
+        return [GraphPair(r, r.reverse())]
+    return [binary_tree_pair(n, masters)]

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initialises.
+
+Mirrors the reference's multi-node-without-a-cluster testing approach
+(reference: scripts/tests/run-integration-tests.sh runs N processes on
+127.0.0.1); here N virtual XLA CPU devices stand in for N TPU chips.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The preinstalled TPU plugin (axon) can override JAX_PLATFORMS; pin cpu.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    ds = jax.devices()
+    assert len(ds) >= 8, f"expected 8 virtual devices, got {len(ds)}"
+    return ds

@@ -1,0 +1,162 @@
+"""Unit tests for the cluster/topology model.
+
+Reference analogues: srcs/go/plan/{topology,peerlist,hostspec,cluster}_test.go.
+"""
+import pytest
+
+from kungfu_tpu.plan import (Cluster, Graph, HostList, HostSpec, PeerID,
+                             PeerList, Strategy, auto_select, chunk_partition,
+                             even_partition, generate, stripe)
+
+
+def peers_on(hosts):
+    ps = []
+    for h, k in hosts:
+        for s in range(k):
+            ps.append(PeerID(h, 31100 + s, s))
+    return PeerList(ps)
+
+
+class TestPeerList:
+    def test_rank_local_rank(self):
+        pl = peers_on([("10.0.0.1", 2), ("10.0.0.2", 2)])
+        assert len(pl) == 4
+        assert pl.rank(PeerID("10.0.0.2", 31100, 0)) == 2
+        assert pl.local_rank(PeerID("10.0.0.2", 31101, 1)) == 1
+        assert pl.host_count() == 2
+        assert pl.local_size(PeerID("10.0.0.1", 31100, 0)) == 2
+
+    def test_diff_intersection(self):
+        a = peers_on([("h1", 2), ("h2", 1)])
+        b = peers_on([("h1", 1), ("h3", 1)])
+        assert len(a.diff(b)) == 2
+        assert len(a.intersection(b)) == 1
+
+    def test_codec_roundtrip(self):
+        pl = peers_on([("h1", 3)])
+        assert PeerList.parse(pl.to_string()) == pl
+        assert pl.digest() == PeerList.parse(pl.to_string()).digest()
+
+    def test_local_masters(self):
+        pl = peers_on([("h1", 2), ("h2", 3)])
+        lm = pl.local_masters()
+        assert [p.host for p in lm] == ["h1", "h2"]
+
+
+class TestHostList:
+    def test_parse(self):
+        hl = HostList.parse("10.0.0.1:4,10.0.0.2:4:1.2.3.4")
+        assert hl.cap() == 8
+        assert hl[1].public_addr == "1.2.3.4"
+
+    def test_hostfile(self):
+        hl = HostList.parse_hostfile("# comment\nh1 slots=2\nh2:3\n\n")
+        assert hl.cap() == 5
+
+    def test_gen_peer_list(self):
+        hl = HostList.parse("h1:2,h2:2")
+        pl = hl.gen_peer_list(3)
+        assert [p.host for p in pl] == ["h1", "h1", "h2"]
+        with pytest.raises(ValueError):
+            hl.gen_peer_list(5)
+
+
+class TestCluster:
+    def test_resize_shrink_grow(self):
+        hl = HostList.parse("h1:4,h2:4")
+        c = Cluster.from_hostlist(hl, 4)
+        c.validate()
+        small = c.resize(2)
+        assert small.size() == 2
+        assert list(small.workers) == list(c.workers[:2])
+        big = c.resize(6)
+        assert big.size() == 6
+        big.validate()
+
+    def test_json_roundtrip(self):
+        c = Cluster.from_hostlist(HostList.parse("h1:2,h2:2"), 3)
+        c2 = Cluster.from_json(c.to_json())
+        assert c2.workers == c.workers
+        assert c2.digest() == c.digest()
+
+
+class TestGraph:
+    def test_forest_array_roundtrip(self):
+        g = Graph.from_forest_array([0, 0, 0, 1, 1])
+        assert g.has_self_loop(0)
+        assert sorted(g.prevs(0)) == [1, 2]
+        assert g.to_forest_array() == [0, 0, 0, 1, 1]
+
+    def test_reverse(self):
+        g = Graph(3)
+        g.add_edge(1, 0)
+        g.add_edge(2, 0)
+        r = g.reverse()
+        assert sorted(r.nexts(0)) == [1, 2]
+
+    def test_levels(self):
+        g = Graph.from_forest_array([0, 0, 0, 1, 1])
+        rounds = g.levels_toward_roots()
+        flat = [e for r in rounds for e in r]
+        assert set(flat) == {(1, 0), (2, 0), (3, 1), (4, 1)}
+        # leaves (3,4 → 1) and (2 → 0) can go first; (1 → 0) must come after
+        assert flat.index((3, 1)) < flat.index((1, 0))
+
+    def test_cycle_detection(self):
+        g = Graph(2)
+        g.add_edge(0, 1)
+        g.add_edge(1, 0)
+        with pytest.raises(ValueError):
+            g.levels_toward_roots()
+
+
+ALL_STRATEGIES = [s for s in Strategy if s != Strategy.AUTO]
+
+
+class TestTopology:
+    @pytest.mark.parametrize("strategy", ALL_STRATEGIES)
+    @pytest.mark.parametrize("spec", [[("h1", 1)], [("h1", 4)],
+                                      [("h1", 2), ("h2", 2)],
+                                      [("h1", 4), ("h2", 4)]])
+    def test_every_strategy_covers_all_ranks(self, strategy, spec):
+        peers = peers_on(spec)
+        n = len(peers)
+        pairs = generate(strategy, peers)
+        assert pairs
+        for gp in pairs:
+            # reduce graph must be a DAG reaching >=1 aggregation root
+            rounds = gp.reduce_graph.levels_toward_roots()
+            covered = {i for r in rounds for e in r for i in e}
+            roots = [i for i in range(n) if not gp.reduce_graph.nexts(i)]
+            assert roots, "reduce graph needs at least one root"
+            if n > 1:
+                assert covered == set(range(n))
+            # broadcast graph is the reverse
+            assert sorted(gp.bcast_graph.edges()) == sorted(
+                (b, a) for a, b in gp.reduce_graph.edges())
+
+    def test_auto_select(self):
+        assert auto_select(peers_on([("h1", 4)])) == Strategy.STAR
+        assert auto_select(peers_on([("h1", 2), ("h2", 2)])) == Strategy.BINARY_TREE_STAR
+
+    def test_strategy_parse(self):
+        assert Strategy.parse("binary-tree-star") == Strategy.BINARY_TREE_STAR
+        with pytest.raises(ValueError):
+            Strategy.parse("nope")
+
+
+class TestPartition:
+    def test_even_partition(self):
+        iv = even_partition(10, 3)
+        assert [i.size for i in iv] == [4, 3, 3]
+        assert iv[0].begin == 0 and iv[-1].end == 10
+
+    def test_chunks(self):
+        iv = chunk_partition(3 << 20, 1 << 20)
+        assert len(iv) == 3
+
+    def test_stripe_stable(self):
+        a = stripe("grad_1", 8, 3)
+        b = stripe("grad_1", 8, 3)
+        assert a == b
+        assert all(0 <= x < 3 for x in a)

@@ -1,0 +1,168 @@
+"""Collective correctness on an 8-device virtual mesh.
+
+Reference analogue: scripts/tests/run-integration-tests.sh — all strategies
+x all cluster sizes against fake agents, checking exact allreduce results
+(tests/cpp/integration/fake_trainer.hpp check()).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu.comm import Session, flat_mesh, hierarchical_mesh
+from kungfu_tpu.comm import collectives as C
+from kungfu_tpu.plan import PeerID, PeerList, Strategy
+
+
+def make_peers(n, hosts=1):
+    ps = []
+    per = n // hosts
+    for h in range(hosts):
+        for s in range(per):
+            ps.append(PeerID(f"10.0.0.{h+1}", 31100 + s, s))
+    return PeerList(ps)
+
+
+ALL_STRATEGIES = [s for s in Strategy if s != Strategy.AUTO]
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_auto_all_reduce_sum(n):
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.arange(n * 5, dtype=np.float32).reshape(n, 5)
+    out = np.asarray(sess.all_reduce(x))
+    want = np.tile(x.sum(axis=0), (n, 1))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("strategy", ALL_STRATEGIES)
+@pytest.mark.parametrize("n,hosts", [(2, 1), (4, 1), (4, 2), (8, 2), (8, 4)])
+def test_every_strategy_all_reduce(strategy, n, hosts):
+    sess = Session(peers=make_peers(n, hosts), strategy=strategy,
+                   mesh=flat_mesh(n=n))
+    x = np.arange(n * 37, dtype=np.float32).reshape(n, 37) * 0.5
+    out = np.asarray(sess.all_reduce(x, name="g1"))
+    want = np.tile(x.sum(axis=0), (n, 1))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,red", [("MIN", np.min), ("MAX", np.max),
+                                    ("PROD", np.prod)])
+def test_all_reduce_ops(op, red):
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.random.RandomState(0).rand(n, 7).astype(np.float32) + 0.5
+    out = np.asarray(sess.all_reduce(x, op=op))
+    want = np.tile(red(x, axis=0), (n, 1))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", [Strategy.RING, Strategy.BINARY_TREE_STAR])
+def test_graph_strategy_min_max(strategy):
+    n = 8
+    sess = Session(peers=make_peers(n, 2), strategy=strategy, mesh=flat_mesh(n=n))
+    x = np.random.RandomState(1).randn(n, 13).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(sess.all_reduce(x, op="MAX")),
+                               np.tile(x.max(axis=0), (n, 1)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sess.all_reduce(x, op="MIN")),
+                               np.tile(x.min(axis=0), (n, 1)), rtol=1e-6)
+
+
+def test_broadcast_and_reduce():
+    n = 8
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    out = np.asarray(sess.broadcast(x, root=2))
+    np.testing.assert_allclose(out, np.tile(x[2], (n, 1)))
+    r = np.asarray(sess.reduce(x, root=1))
+    np.testing.assert_allclose(r[1], x.sum(axis=0))
+    np.testing.assert_allclose(r[0], np.zeros(3))
+
+
+def test_all_gather_gather():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    ag = np.asarray(sess.all_gather(x))
+    assert ag.shape == (n, n, 2)
+    for lane in range(n):
+        np.testing.assert_allclose(ag[lane], x)
+    g = np.asarray(sess.gather(x, root=0))
+    np.testing.assert_allclose(g[0], x)
+    np.testing.assert_allclose(g[3], np.zeros_like(x))
+
+
+def test_barrier_and_consensus():
+    n = 8
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    sess.barrier()
+    same = np.tile(np.arange(5, dtype=np.float32), (n, 1))
+    assert sess.consensus(same)
+    diff = same.copy()
+    diff[3, 2] += 1
+    assert not sess.consensus(diff)
+    assert sess.bytes_consensus(b"cluster-digest")
+
+
+def test_set_tree():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    sess.set_tree([1, 1, 1, 2])  # custom forest rooted at 1
+    x = np.ones((n, 9), dtype=np.float32) * np.arange(1, n + 1)[:, None]
+    out = np.asarray(sess.all_reduce(x))
+    np.testing.assert_allclose(out, np.tile(x.sum(axis=0), (n, 1)), rtol=1e-6)
+
+
+def test_set_strategy_switch():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.ones((n, 4), dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(sess.all_reduce(x))[0], [n] * 4)
+    sess.set_strategy(Strategy.RING)
+    np.testing.assert_allclose(np.asarray(sess.all_reduce(x))[0], [n] * 4)
+    sess.set_strategy(Strategy.STAR)
+    np.testing.assert_allclose(np.asarray(sess.all_reduce(x))[0], [n] * 4)
+
+
+def test_hierarchical_all_reduce():
+    mesh = hierarchical_mesh(2)
+    import functools
+    from jax.sharding import PartitionSpec as P
+
+    def body(v):
+        return C.hierarchical_all_reduce(v, "kf_chip", "kf_host")
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=P(("kf_host", "kf_chip")),
+                               out_specs=P(("kf_host", "kf_chip"))))
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out, np.tile(x.sum(axis=0), (8, 1)))
+
+
+def test_ring_exchange():
+    n = 8
+    mesh = flat_mesh(n=n)
+    from jax.sharding import PartitionSpec as P
+
+    def body(v):
+        return C.ring_exchange(v, "kf_peers", shift=3, n=n)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("kf_peers"),
+                               out_specs=P("kf_peers")))
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out[:, 0], np.roll(np.arange(n), 3))
+
+
+def test_monitoring_stats():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.ones((n, 1024), dtype=np.float32)
+    for _ in range(3):
+        sess.all_reduce(x, name="g")
+    stats = sess.calc_stats()
+    assert stats["g"] > 0
+    assert "GiB/s" in sess.log_stats()
+    assert not sess.check_interference()
+    sess.stats()["g"].snapshot_reference()
