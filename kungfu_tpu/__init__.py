@@ -13,6 +13,9 @@ steps — the communication schedule is compiled, not interpreted.
 from . import comm, plan
 from .comm import Session
 from .plan import Cluster, HostList, PeerID, PeerList, Strategy
+from .training import (broadcast_variables, build_train_step,
+                       build_train_step_with_state, init_opt_state, lane,
+                       lane_mean, replicate)
 
 __version__ = "0.1.0"
 
@@ -74,5 +77,7 @@ __all__ = [
     "Session", "Cluster", "HostList", "PeerID", "PeerList", "Strategy",
     "comm", "plan", "init", "current_session", "current_rank",
     "current_cluster_size", "current_local_rank", "current_local_size",
-    "run_barrier", "detached",
+    "run_barrier", "detached", "broadcast_variables", "build_train_step",
+    "build_train_step_with_state", "init_opt_state", "lane", "lane_mean",
+    "replicate",
 ]

@@ -159,13 +159,12 @@ class Session:
 
             def body(v):
                 flat = v.reshape(-1)
-                orig_dtype = flat.dtype
-                flat = flat.astype(jnp.float32) if not jnp.issubdtype(orig_dtype, jnp.floating) else flat
+                # ints flow through ppermute/add natively — no lossy casts
                 out = C.striped_graph_all_reduce(flat, pairs, self.axis,
                                                  "SUM" if op == "MEAN" else op, nm)
                 if op == "MEAN":
                     out = out / self.n
-                return out.astype(orig_dtype).reshape(v.shape)
+                return out.astype(flat.dtype).reshape(v.shape)
             key = ("graph_ar", op, name, id(pairs))
         else:
             def body(v):
@@ -241,18 +240,23 @@ class Session:
         return bool(np.all(np.asarray(out) > 0))
 
     def bytes_consensus(self, payload: bytes) -> bool:
-        """Consensus over an opaque byte string from *this* controller.
+        """Consensus over an opaque byte string contributed by *this*
+        controller process (used to fence cluster changes).
 
-        Single-controller meshes trivially agree; under multi-controller
-        (jax.distributed) each process contributes its digest lane.
+        Multi-controller: every process allgathers its digest and compares
+        — the host-plane equivalent of the reference's allreduce-MIN/MAX
+        trick.  Single-controller: all lanes share one digest, so the check
+        degenerates to the compiled consensus (and is trivially true).
         """
         import hashlib
         digest = hashlib.sha256(payload).digest()[:16]
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            row = np.frombuffer(digest, dtype=np.uint8).astype(np.int32)
+            gathered = np.asarray(multihost_utils.process_allgather(row))
+            return bool((gathered == gathered[0]).all())
         row = np.frombuffer(digest, dtype=np.uint8).astype(np.float32)
         lanes = np.tile(row, (self.n, 1))
-        if jax.process_count() > 1:  # each process overwrites its own lanes
-            pi = jax.process_index()
-            lanes = lanes.copy()
         return self.consensus(jnp.asarray(lanes))
 
     # ------------------------------------------------------------ monitoring

@@ -1,4 +1,14 @@
 """Elastic cluster membership (config server, resize protocol, policies)."""
 from . import state
+from .config_server import ConfigServer, fetch_config, put_config
+from .dataset import ElasticDataShard
+from .policy import (BasePolicy, PolicyContext, PolicyRunner,
+                     ScheduledResizePolicy)
+from .schedule import Stage, StepSchedule
+from .trainer import ElasticTrainer
 
-__all__ = ["state"]
+__all__ = [
+    "state", "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
+    "BasePolicy", "PolicyContext", "PolicyRunner", "ScheduledResizePolicy",
+    "Stage", "StepSchedule", "ElasticDataShard",
+]

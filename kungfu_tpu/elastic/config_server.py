@@ -1,0 +1,149 @@
+"""Elastic config server — the REST control plane for cluster membership.
+
+Reference: srcs/go/kungfu/elastic/configserver/configserver.go:42-110 and
+the standalone binary (srcs/go/cmd/kungfu-config-server). Schema:
+
+- GET    /config  -> {"version": N, "cluster": {...}}   (404 when cleared)
+- PUT    /config  <- cluster JSON (validated; version++)
+- POST   /config  <- same as PUT (initial set)
+- DELETE /config  -> clears the config
+- GET    /stop    -> shuts the server down (TTL analogue)
+
+Runs in-process on a background thread (embeddable into the launcher the
+way kungfu-run embeds it via -builtin-config-port).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Optional, Tuple
+
+from ..plan.cluster import Cluster
+from ..utils.http import BackgroundHTTPServer
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.version = 0
+        self.cluster: Optional[Cluster] = None
+        self.history = []
+
+
+def _make_handler(state: _State, server_ref):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes = b"",
+                  ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.startswith("/stop"):
+                self._send(200, b'{"ok": true}')
+                server_ref.shutdown_async()
+                return
+            if self.path.startswith("/history"):
+                with state.lock:
+                    body = json.dumps(state.history).encode()
+                self._send(200, body)
+                return
+            with state.lock:
+                if state.cluster is None:
+                    self._send(404, b'{"error": "no config"}')
+                    return
+                body = json.dumps({
+                    "version": state.version,
+                    "cluster": json.loads(state.cluster.to_json()),
+                }).encode()
+            self._send(200, body)
+
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n)
+
+        def do_PUT(self):
+            raw = self._read_body()
+            try:
+                c = Cluster.from_json(raw.decode())
+                c.validate()
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
+                self._send(400, json.dumps({"error": str(e)}).encode())
+                return
+            with state.lock:
+                state.version += 1
+                state.cluster = c
+                state.history.append({"version": state.version,
+                                      "size": c.size()})
+                body = json.dumps({"version": state.version}).encode()
+            self._send(200, body)
+
+        do_POST = do_PUT
+
+        def do_DELETE(self):
+            with state.lock:
+                state.cluster = None
+            self._send(200, b'{"ok": true}')
+
+    return Handler
+
+
+class ConfigServer:
+    """In-process elastic config server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._state = _State()
+        self._server = BackgroundHTTPServer(
+            lambda srv: _make_handler(self._state, srv), host, port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._server.host}:{self._server.port}/config"
+
+    def start(self) -> "ConfigServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # -- direct (in-process) access used by the embedded mode ---------------
+    def put_cluster(self, cluster: Cluster) -> int:
+        cluster.validate()
+        with self._state.lock:
+            self._state.version += 1
+            self._state.cluster = cluster
+            self._state.history.append({"version": self._state.version,
+                                        "size": cluster.size()})
+            return self._state.version
+
+    def get_cluster(self) -> Tuple[int, Optional[Cluster]]:
+        with self._state.lock:
+            return self._state.version, self._state.cluster
+
+
+def fetch_config(url: str, timeout: float = 5.0) -> Tuple[int, Cluster]:
+    """GET the current (version, cluster) from a config server URL."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        d = json.loads(r.read().decode())
+    return d["version"], Cluster.from_json(json.dumps(d["cluster"]))
+
+
+def put_config(url: str, cluster: Cluster, timeout: float = 5.0) -> int:
+    import urllib.request
+    req = urllib.request.Request(url, data=cluster.to_json().encode(),
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())["version"]

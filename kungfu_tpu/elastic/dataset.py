@@ -1,0 +1,57 @@
+"""Elastic dataset sharding.
+
+Reference: srcs/python/kungfu/tensorflow/v1/datasets/adaptor.py:4-33 —
+BaseDatasetAdaptor skips already-consumed samples and shards the rest by
+(rank, cluster size); after every resize the shard assignment changes but
+global progress is preserved.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+
+class ElasticDataShard:
+    """Deterministic global-order sharding that survives resizes."""
+
+    def __init__(self, num_samples: int, seed: int = 0,
+                 shuffle_each_epoch: bool = True):
+        self.num_samples = num_samples
+        self.seed = seed
+        self.shuffle = shuffle_each_epoch
+
+    def _order(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.num_samples)
+        rng = np.random.RandomState(self.seed + epoch)
+        return rng.permutation(self.num_samples)
+
+    def batch_indices(self, trained_samples: int, global_batch: int
+                      ) -> np.ndarray:
+        """Indices of the next global batch given total progress.
+
+        All peers compute the same answer from the shared progress counter,
+        so a resize never skips or repeats samples.
+        """
+        epoch = trained_samples // self.num_samples
+        offset = trained_samples % self.num_samples
+        order = self._order(epoch)
+        if offset + global_batch <= self.num_samples:
+            return order[offset:offset + global_batch]
+        head = order[offset:]
+        tail = self._order(epoch + 1)[:global_batch - len(head)]
+        return np.concatenate([head, tail])
+
+    def local_slice(self, indices: np.ndarray, rank: int, size: int
+                    ) -> np.ndarray:
+        """This worker's share of a global batch.
+
+        The remainder when ``len(indices) % size != 0`` is spread over the
+        first ranks so no sample is dropped (the no-skip guarantee holds
+        for any global-batch/cluster-size combination).
+        """
+        per, rem = divmod(len(indices), size)
+        begin = rank * per + min(rank, rem)
+        end = begin + per + (1 if rank < rem else 0)
+        return indices[begin:end]

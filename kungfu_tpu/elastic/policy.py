@@ -1,0 +1,102 @@
+"""Training policies: callback-driven adaptation.
+
+Reference: srcs/python/kungfu/policy/base_policy.py:5-31 (BasePolicy with
+before/after train/epoch/step callbacks) and policy_hook.py:8-77 (the hook
+that drives policies with trained-sample accounting).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class BasePolicy:
+    """Subclass and override any of the callbacks."""
+
+    def before_train(self, ctx): ...
+    def after_train(self, ctx): ...
+    def before_epoch(self, ctx): ...
+    def after_epoch(self, ctx): ...
+    def before_step(self, ctx): ...
+    def after_step(self, ctx): ...
+
+
+class PolicyContext:
+    """What policies see/do: progress counters + cluster control."""
+
+    def __init__(self, trainer=None, total_samples: int = 0):
+        self.trainer = trainer
+        self.total_samples = total_samples
+        self.trained_samples = 0
+        self.epoch = 0
+        self.step = 0
+        self._requested_size: Optional[int] = None
+        self.stopped = False
+
+    # policy-visible controls -------------------------------------------------
+    def resize(self, new_size: int) -> None:
+        self._requested_size = new_size
+
+    def request_stop(self) -> None:
+        self.stopped = True
+
+    @property
+    def cluster_size(self) -> int:
+        return self.trainer.n if self.trainer else 1
+
+
+class PolicyRunner:
+    """Drives policies around an ElasticTrainer training loop
+    (reference: PolicyHook)."""
+
+    def __init__(self, policies: Sequence[BasePolicy], trainer,
+                 epoch_size: int, epochs: int):
+        self.policies = list(policies)
+        self.trainer = trainer
+        self.epoch_size = epoch_size
+        self.epochs = epochs
+        self.ctx = PolicyContext(trainer, total_samples=epoch_size * epochs)
+
+    def _fire(self, name: str) -> None:
+        for p in self.policies:
+            getattr(p, name)(self.ctx)
+        if self.ctx._requested_size is not None:
+            size = self.ctx._requested_size
+            self.ctx._requested_size = None
+            self.trainer.resize(size)
+
+    def run(self, batch_fn, steps_per_epoch: int) -> List[float]:
+        """batch_fn(trainer) -> global batch for the current cluster size."""
+        losses = []
+        self._fire("before_train")
+        for e in range(self.epochs):
+            self.ctx.epoch = e
+            self._fire("before_epoch")
+            for _ in range(steps_per_epoch):
+                self._fire("before_step")
+                if self.ctx.stopped:
+                    break
+                loss = self.trainer.step(batch_fn(self.trainer))
+                losses.append(loss)
+                self.ctx.step += 1
+                self.ctx.trained_samples = self.trainer.trained_samples
+                self._fire("after_step")
+            self._fire("after_epoch")
+            if self.ctx.stopped:
+                break
+        self._fire("after_train")
+        return losses
+
+
+class ScheduledResizePolicy(BasePolicy):
+    """Resize according to a StepSchedule — the reference's elastic test
+    driver (gen_schedule.py + KungfuStepBasedSchedule)."""
+
+    def __init__(self, schedule):
+        self.schedule = schedule
+
+    def before_step(self, ctx):
+        size = self.schedule.size_at(ctx.step)
+        if size is None or size == 0:
+            ctx.request_stop()
+        elif size != ctx.cluster_size:
+            ctx.resize(size)

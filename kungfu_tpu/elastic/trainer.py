@@ -1,0 +1,177 @@
+"""Elastic training: runtime cluster resize with state re-synchronisation.
+
+Reference protocol (srcs/go/kungfu/peer/peer.go:227-263 + experimental/
+hook/elastic.py:50-113): rank 0 proposes a resized cluster to the config
+server; all peers poll until consensus on the cluster digest; every peer
+rebuilds its session with a bumped version token; removed peers see
+``detached`` and stop; survivors sync progress (allreduce-max of trained
+samples) and broadcast model state to newcomers.
+
+TPU-native mapping: the "cluster" is the set of mesh lanes.  A resize tears
+down the mesh, re-lays replicas on the first ``n`` devices, and recompiles
+the step (XLA programs are fixed-shape — SURVEY §7 "hard parts").  Compiled
+steps are cached per size, so oscillating schedules (4→8→4…) recompile only
+once per distinct size.  Version tokens fence stale state exactly like the
+reference's connection tokens.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.mesh import flat_mesh
+from ..comm.session import Session
+from ..plan.cluster import Cluster
+from ..plan.peer import PeerID, PeerList
+from ..plan.topology import Strategy
+from ..training import build_train_step
+from . import state as _flags
+from .config_server import fetch_config
+
+
+def _restack(host_tree, n_new: int, mesh):
+    """Re-lay host replicas onto a new mesh: survivors keep their replica,
+    newcomers clone lane 0 (the reference's broadcast-from-rank-0 sync)."""
+    spec = P(mesh.axis_names)
+
+    def re(t):
+        t = np.asarray(t)
+        n_old = t.shape[0]
+        if n_new <= n_old:
+            out = t[:n_new]
+        else:
+            extra = np.broadcast_to(t[0:1], (n_new - n_old,) + t.shape[1:])
+            out = np.concatenate([t, extra], axis=0)
+        return jax.device_put(jnp.asarray(out), NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(re, host_tree)
+
+
+class ElasticTrainer:
+    """Drives elastic distributed training over a resizable mesh.
+
+    ``optimizer_factory(n)`` builds the optimizer for an ``n``-lane cluster
+    (pair averaging needs the static lane count).
+    """
+
+    def __init__(self,
+                 loss_fn: Callable,
+                 optimizer_factory: Callable[[int], optax.GradientTransformation],
+                 init_params,
+                 init_size: Optional[int] = None,
+                 config_server_url: Optional[str] = None,
+                 max_size: Optional[int] = None):
+        self.loss_fn = loss_fn
+        self.optimizer_factory = optimizer_factory
+        self.config_server_url = config_server_url
+        total = len(jax.devices())
+        self.max_size = max_size or total
+        self.n = init_size or total
+        self.version = 0          # local session/membership version
+        self.config_version = -1  # last applied config-server version
+        self.trained_samples = 0
+        self.step_count = 0
+        self._host_params = jax.tree_util.tree_map(
+            lambda t: np.broadcast_to(np.asarray(t)[None],
+                                      (self.n,) + np.asarray(t).shape).copy(),
+            init_params)
+        self._step_cache: Dict[int, Callable] = {}
+        self._install(self.n, fresh_opt=True)
+
+    # ------------------------------------------------------------------ core
+    def _install(self, n: int, fresh_opt: bool) -> None:
+        self.mesh = flat_mesh(n=n)
+        self.session = Session(mesh=self.mesh, version=self.version)
+        self.optimizer = self.optimizer_factory(n)
+        self.params = _restack(self._host_params, n, self.mesh)
+        if fresh_opt:
+            from ..training import init_opt_state
+            self.opt_state = init_opt_state(self.optimizer, self.params,
+                                            self.mesh)
+        if n not in self._step_cache:
+            self._step_cache[n] = build_train_step(self.loss_fn,
+                                                   self.optimizer, self.mesh,
+                                                   donate=False)
+        self._step = self._step_cache[n]
+        self.n = n
+
+    def step(self, global_batch) -> float:
+        """One training step; batch leading axis sharded over current lanes."""
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, global_batch)
+        self.step_count += 1
+        bs = jax.tree_util.tree_leaves(global_batch)[0].shape[0]
+        self.trained_samples += int(bs)
+        return float(np.asarray(loss)[0])
+
+    # ---------------------------------------------------------------- resize
+    def resize(self, new_size: int) -> bool:
+        """Apply a new cluster size; returns True when membership changed.
+
+        Follows the reference sequence: consensus fence → version bump →
+        session rebuild → state re-sync (survivor replicas kept, newcomer
+        lanes cloned from lane 0) → progress sync.
+        """
+        if new_size == self.n:
+            return False
+        if new_size > self.max_size:
+            raise ValueError(f"size {new_size} exceeds capacity {self.max_size}")
+        if new_size <= 0:
+            _flags.set_detached(True)
+            return True
+        # consensus fence on the proposal (trivially true single-controller,
+        # real check under multi-controller)
+        if not self.session.bytes_consensus(str(new_size).encode()):
+            raise RuntimeError("resize proposal diverged across peers")
+        self._host_params = jax.tree_util.tree_map(
+            lambda t: np.asarray(t), self.params)
+        host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
+                                          self.opt_state)
+        self.version += 1
+        _flags.bump_cluster_version()
+        self._install(new_size, fresh_opt=False)
+        self.opt_state = _restack(host_opt, new_size, self.mesh)
+        self.session.barrier()
+        return True
+
+    def resize_from_url(self, timeout: float = 30.0) -> Tuple[bool, bool]:
+        """Poll the config server and apply its cluster size.
+
+        Returns (changed, detached) like the reference's
+        resize_cluster_from_url op (ops/adapt.py:5-21).
+        """
+        if not self.config_server_url:
+            raise ValueError("no config server configured")
+        deadline = time.time() + timeout
+        while True:
+            try:
+                version, cluster = fetch_config(self.config_server_url)
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        if version == self.config_version:
+            return False, False  # already applied this server config
+        changed = self.resize(min(cluster.size(), self.max_size))
+        self.config_version = version
+        return changed, _flags.is_detached()
+
+    # ------------------------------------------------------------- state sync
+    def sync_progress(self) -> int:
+        """Allreduce-max of trained samples (reference: elastic.py:62-84
+        before_run sync); meaningful under multi-controller.  Uses exact
+        integer lanes — float32 would corrupt counters past 2^24 samples."""
+        x = np.full((self.n, 1), self.trained_samples, np.int64)
+        out = self.session.all_reduce(x, op="MAX")
+        self.trained_samples = int(np.asarray(out)[0, 0])
+        return self.trained_samples
+
+    def current_params(self, lane: int = 0):
+        return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
+                                      self.params)

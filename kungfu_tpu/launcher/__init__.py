@@ -1,0 +1,9 @@
+"""Launcher and elastic process control plane (reference: kungfu-run)."""
+from . import env
+from .cli import main
+from .job import ChipPool, Job
+from .proc import Proc, run_all
+from .watch import Watcher, watch_run
+
+__all__ = ["env", "main", "ChipPool", "Job", "Proc", "run_all", "Watcher",
+           "watch_run"]

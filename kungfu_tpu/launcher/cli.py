@@ -1,0 +1,109 @@
+"""kft-run — the launcher CLI.
+
+Reference: kungfu-run (srcs/go/cmd/kungfu-run/app/kungfu-run.go:19-120,
+flags at srcs/go/kungfu/runner/flags.go:29-102).  Usage:
+
+    python -m kungfu_tpu.launcher -np 4 python3 train.py
+    python -m kungfu_tpu.launcher -np 4 -w -builtin-config-port 9100 ...
+
+On a TPU pod, run one launcher per TPU-VM host with -H host specs; workers
+discover their chips from the env ABI.  A builtin config server makes this
+process the elastic control plane, like kungfu-run's -builtin-config-port.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ..elastic.config_server import ConfigServer, put_config
+from ..plan.cluster import Cluster
+from ..plan.hostspec import DEFAULT_RUNNER_PORT, HostList
+from ..plan.peer import PeerID
+from ..plan.topology import Strategy
+from .job import ChipPool, Job
+from .proc import run_all
+from .watch import watch_run
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kft-run", description="TPU-native elastic launcher")
+    p.add_argument("-np", type=int, default=1, help="total worker count")
+    p.add_argument("-H", dest="hosts", default="",
+                   help="host list, e.g. 10.0.0.1:4,10.0.0.2:4")
+    p.add_argument("-hostfile", default="", help="hostfile path")
+    p.add_argument("-self", dest="self_host", default="127.0.0.1",
+                   help="this runner's host address")
+    p.add_argument("-strategy", default="AUTO",
+                   help="allreduce strategy (STAR|RING|...|AUTO)")
+    p.add_argument("-w", "--watch", action="store_true",
+                   help="elastic watch mode")
+    p.add_argument("-config-server", default="",
+                   help="elastic config server URL")
+    p.add_argument("-builtin-config-port", type=int, default=0,
+                   help="embed a config server on this port")
+    p.add_argument("-port-range", default="31100-31199")
+    p.add_argument("-chips-per-host", type=int, default=0,
+                   help="size of the local chip pool (0 = no pinning)")
+    p.add_argument("-devices-per-worker", type=int, default=0,
+                   help="KFT_NUM_LOCAL_DEVICES for each worker")
+    p.add_argument("-logdir", default="", help="per-worker log directory")
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("prog", nargs=argparse.REMAINDER,
+                   help="worker command line")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.prog:
+        print("error: no worker command given", file=sys.stderr)
+        return 2
+    prog = args.prog
+    if prog and prog[0] == "--":
+        prog = prog[1:]
+
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hl = HostList.parse_hostfile(f.read())
+    elif args.hosts:
+        hl = HostList.parse(args.hosts)
+    else:
+        hl = HostList.parse(f"{args.self_host}:{max(args.np, 1)}")
+
+    cluster = Cluster.from_hostlist(hl, args.np)
+    cluster.validate()
+
+    config_url = args.config_server
+    server = None
+    if args.builtin_config_port or (args.watch and not config_url):
+        server = ConfigServer(port=args.builtin_config_port).start()
+        config_url = server.url
+        put_config(config_url, cluster)
+
+    job = Job(prog=prog[0], args=prog[1:],
+              strategy=Strategy.parse(args.strategy),
+              config_server=config_url or None,
+              log_dir=args.logdir or None,
+              num_local_devices=args.devices_per_worker or None)
+    parent = PeerID(args.self_host, DEFAULT_RUNNER_PORT)
+    pool = ChipPool(args.chips_per_host) if args.chips_per_host else None
+
+    try:
+        if args.watch:
+            return watch_run(job, args.self_host, parent, cluster, config_url,
+                             pool=pool)
+        procs = job.create_procs(cluster, args.self_host, parent, pool=pool)
+        if not procs:
+            print(f"no local workers on {args.self_host}", file=sys.stderr)
+            return 1
+        return run_all(procs)
+    finally:
+        if server:
+            server.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Worker environment ABI.
+
+Reference: the ``KUNGFU_*`` env-var schema that the runner writes and every
+worker parses (srcs/go/kungfu/job/job.go:31-49, env/config.go:24-56).
+The TPU framework uses a ``KFT_*`` namespace; singleton mode (no env set)
+runs standalone on all local devices, like the reference's
+``KUNGFU_SELF_SPEC``-unset mode (env/config.go:58-67).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from ..plan.peer import PeerID, PeerList
+from ..plan.topology import Strategy
+
+SELF_SPEC = "KFT_SELF_SPEC"
+INIT_PEERS = "KFT_INIT_PEERS"
+RUNNER_LIST = "KFT_RUNNER_LIST"
+CLUSTER_VERSION = "KFT_INIT_CLUSTER_VERSION"
+STRATEGY = "KFT_ALLREDUCE_STRATEGY"
+CONFIG_SERVER = "KFT_CONFIG_SERVER"
+PARENT_ID = "KFT_PARENT_ID"
+NUM_LOCAL_DEVICES = "KFT_NUM_LOCAL_DEVICES"
+CHIP_IDS = "KFT_VISIBLE_CHIPS"          # analogue of KUNGFU_CUDA_VISIBLE_DEVICES
+COORDINATOR = "KFT_COORDINATOR"          # jax.distributed coordinator addr
+
+# runtime feature toggles (reference: KUNGFU_CONFIG_*, config/config.go:41-67)
+ENABLE_MONITORING = "KFT_CONFIG_ENABLE_MONITORING"
+ENABLE_STALL_DETECTION = "KFT_CONFIG_ENABLE_STALL_DETECTION"
+MONITORING_PERIOD = "KFT_CONFIG_MONITORING_PERIOD_MS"
+LOG_LEVEL = "KFT_CONFIG_LOG_LEVEL"
+
+CONFIG_ENV_KEYS = [ENABLE_MONITORING, ENABLE_STALL_DETECTION,
+                   MONITORING_PERIOD, LOG_LEVEL]
+
+
+@dataclasses.dataclass
+class WorkerEnv:
+    self_spec: Optional[PeerID]
+    peers: PeerList
+    runners: PeerList
+    cluster_version: int
+    strategy: Strategy
+    config_server: Optional[str]
+    parent_id: Optional[str]
+    num_local_devices: Optional[int]
+    chip_ids: Optional[List[int]]
+    coordinator: Optional[str]
+
+    @property
+    def singleton(self) -> bool:
+        return self.self_spec is None
+
+    def rank(self) -> int:
+        if self.singleton:
+            return 0
+        return self.peers.rank(self.self_spec)
+
+    def size(self) -> int:
+        return max(1, len(self.peers))
+
+
+def from_env(environ: Optional[Dict[str, str]] = None) -> WorkerEnv:
+    e = environ if environ is not None else os.environ
+    spec = e.get(SELF_SPEC)
+    return WorkerEnv(
+        self_spec=PeerID.parse(spec) if spec else None,
+        peers=PeerList.parse(e.get(INIT_PEERS, "")),
+        runners=PeerList.parse(e.get(RUNNER_LIST, "")),
+        cluster_version=int(e.get(CLUSTER_VERSION, "0")),
+        strategy=Strategy.parse(e.get(STRATEGY, "AUTO")),
+        config_server=e.get(CONFIG_SERVER) or None,
+        parent_id=e.get(PARENT_ID) or None,
+        num_local_devices=(int(e[NUM_LOCAL_DEVICES])
+                           if e.get(NUM_LOCAL_DEVICES) else None),
+        chip_ids=([int(x) for x in e[CHIP_IDS].split(",")]
+                  if e.get(CHIP_IDS) else None),
+        coordinator=e.get(COORDINATOR) or None,
+    )
+
+
+def worker_env(self_peer: PeerID, peers: PeerList, runners: PeerList,
+               version: int, strategy: Strategy,
+               config_server: Optional[str], parent: PeerID,
+               chip_ids: Optional[List[int]] = None,
+               num_local_devices: Optional[int] = None) -> Dict[str, str]:
+    """Build the env block for one worker process
+    (reference: job.go:31-72 NewProc)."""
+    env = {
+        SELF_SPEC: f"{self_peer.host}:{self_peer.port}:{self_peer.slot}",
+        INIT_PEERS: peers.to_string(),
+        RUNNER_LIST: runners.to_string(),
+        CLUSTER_VERSION: str(version),
+        STRATEGY: strategy.value,
+    }
+    if config_server:
+        env[CONFIG_SERVER] = config_server
+    env[PARENT_ID] = str(parent)
+    if chip_ids is not None:
+        env[CHIP_IDS] = ",".join(map(str, chip_ids))
+    if num_local_devices is not None:
+        env[NUM_LOCAL_DEVICES] = str(num_local_devices)
+    # forward whitelisted runtime toggles (reference ConfigEnvKeys)
+    for k in CONFIG_ENV_KEYS:
+        if k in os.environ:
+            env[k] = os.environ[k]
+    # make the framework importable in workers even without installation
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_root + (os.pathsep + existing
+                                         if existing else ""))
+    return env

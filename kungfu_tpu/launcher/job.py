@@ -1,0 +1,73 @@
+"""Job model: map a cluster onto worker processes with chip allocation.
+
+Reference: srcs/go/kungfu/job/job.go (NewProc/CreateProcs building the
+worker env) and the GPUPool slot allocator (job/gpu_resource.go,
+runner/watch.go:46-54) — here a ChipPool handing out TPU chip indices via
+``KFT_VISIBLE_CHIPS``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from ..plan.cluster import Cluster
+from ..plan.peer import PeerID, PeerList
+from ..plan.topology import Strategy
+from . import env as E
+from .proc import Proc
+
+
+class ChipPool:
+    """Reusable pool of local accelerator slots."""
+
+    def __init__(self, n: int):
+        self._lock = threading.Lock()
+        self._free = list(range(n))
+
+    def get(self) -> Optional[int]:
+        with self._lock:
+            return self._free.pop(0) if self._free else None
+
+    def put(self, i: int) -> None:
+        with self._lock:
+            if i >= 0 and i not in self._free:
+                self._free.append(i)
+                self._free.sort()
+
+
+@dataclasses.dataclass
+class Job:
+    prog: str
+    args: List[str]
+    strategy: Strategy = Strategy.AUTO
+    config_server: Optional[str] = None
+    log_dir: Optional[str] = None
+    num_local_devices: Optional[int] = None  # per-worker device count
+
+    def new_proc(self, self_peer: PeerID, cluster: Cluster, version: int,
+                 parent: PeerID, chip_id: Optional[int] = None) -> Proc:
+        env = E.worker_env(
+            self_peer=self_peer, peers=cluster.workers,
+            runners=cluster.runners, version=version,
+            strategy=self.strategy, config_server=self.config_server,
+            parent=parent,
+            chip_ids=[chip_id] if chip_id is not None else None,
+            num_local_devices=self.num_local_devices)
+        rank = cluster.workers.rank(self_peer)
+        name = f"{rank}/{len(cluster.workers)}/{version}"
+        return Proc(name=name, args=[self.prog] + list(self.args), env=env,
+                    color_idx=rank, log_dir=self.log_dir)
+
+    def create_procs(self, cluster: Cluster, host: str, parent: PeerID,
+                     version: int = 0,
+                     pool: Optional[ChipPool] = None) -> List[Proc]:
+        """One proc per local worker on ``host``
+        (reference: job.go:75-83 CreateProcs)."""
+        procs = []
+        for w in cluster.workers:
+            if w.host != host:
+                continue
+            chip = pool.get() if pool else None
+            procs.append(self.new_proc(w, cluster, version, parent, chip))
+        return procs

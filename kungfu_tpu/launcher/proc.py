@@ -1,0 +1,119 @@
+"""Worker process management: spawn, tee, watch, kill.
+
+Reference: srcs/go/proc/proc.go (env-merged exec.Cmd) and
+srcs/go/utils/runner/local/local.go:20-93 (colored stdout/stderr
+redirection + per-proc log files).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
+
+
+def _color(i: int) -> str:
+    return f"\033[{_COLORS[i % len(_COLORS)]}m"
+
+
+class Proc:
+    """One worker subprocess with env merge and log tee."""
+
+    def __init__(self, name: str, args: List[str], env: Dict[str, str],
+                 color_idx: int = 0, log_dir: Optional[str] = None):
+        self.name = name
+        self.args = args
+        self.env = {**os.environ, **env}
+        self.color_idx = color_idx
+        self.log_dir = log_dir
+        self.popen: Optional[subprocess.Popen] = None
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        self.popen = subprocess.Popen(
+            self.args, env=self.env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, bufsize=1)
+        logf = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            logf = open(os.path.join(self.log_dir,
+                                     f"{self.name.replace('/', '-')}.log"),
+                        "w")
+        log_lock = threading.Lock()
+        open_streams = [2]
+        prefix = f"{_color(self.color_idx)}[{self.name}]\033[0m "
+
+        def tee(stream, out):
+            for line in stream:
+                out.write(prefix + line)
+                out.flush()
+                if logf:
+                    with log_lock:
+                        logf.write(line)
+                        logf.flush()
+            if logf:
+                with log_lock:
+                    open_streams[0] -= 1
+                    if open_streams[0] == 0:
+                        logf.close()
+
+        for stream, out in ((self.popen.stdout, sys.stdout),
+                            (self.popen.stderr, sys.stderr)):
+            t = threading.Thread(target=tee, args=(stream, out), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        assert self.popen is not None
+        return self.popen.wait(timeout)
+
+    def poll(self) -> Optional[int]:
+        assert self.popen is not None
+        return self.popen.poll()
+
+    def kill(self, grace: float = 3.0) -> None:
+        if self.popen is None or self.popen.poll() is not None:
+            return
+        self.popen.send_signal(signal.SIGTERM)
+        try:
+            self.popen.wait(grace)
+        except subprocess.TimeoutExpired:
+            self.popen.kill()
+            self.popen.wait()
+
+
+def run_all(procs: List[Proc], poll_interval: float = 0.2) -> int:
+    """Static launch: run all procs; on the first failure kill the rest
+    (reference: local.RunAll cancels all on first error)."""
+    import time
+    for p in procs:
+        p.start()
+    rc = 0
+    try:
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                code = p.poll()
+                if code is None:
+                    continue
+                pending.remove(p)
+                if code != 0:
+                    rc = code
+                    raise _FirstFailure()
+            time.sleep(poll_interval)
+    except _FirstFailure:
+        pass
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        for p in procs:
+            p.kill()
+    return rc
+
+
+class _FirstFailure(Exception):
+    pass

@@ -1,0 +1,153 @@
+"""Elastic watcher: reconcile local worker processes with cluster updates.
+
+Reference: srcs/go/kungfu/runner/watch.go:42-135 — the runner keeps a map
+of current local workers; on every Stage{version, cluster} update it diffs
+the local membership, kills removed workers, spawns added ones, and exits
+when the cluster drains.  Stage updates here come from polling the elastic
+config server (the reference's ConnControl TCP push is replaced by pull;
+TPU-VM preemption notices can inject updates the same way).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..plan.cluster import Cluster
+from ..plan.peer import PeerID
+from ..elastic.config_server import fetch_config
+from .job import ChipPool, Job
+from .proc import Proc
+
+
+class Watcher:
+    """Per-host process reconciler."""
+
+    def __init__(self, job: Job, host: str, parent: PeerID,
+                 pool: Optional[ChipPool] = None):
+        self.job = job
+        self.host = host
+        self.parent = parent
+        self.pool = pool
+        self.current: Dict[PeerID, Proc] = {}
+        self._chip_of: Dict[PeerID, int] = {}
+        self.version = -1
+        self.failed: Optional[int] = None
+        self._last_cluster: Optional[Cluster] = None
+        self._done: set = set()  # peers that exited cleanly this version
+        self._lock = threading.Lock()
+
+    def local_workers(self, cluster: Cluster) -> List[PeerID]:
+        return [w for w in cluster.workers if w.host == self.host]
+
+    def update(self, version: int, cluster: Cluster) -> None:
+        """Diff-and-reconcile (reference: watch.go:64-83)."""
+        with self._lock:
+            if version <= self.version:
+                return
+            want = set(self.local_workers(cluster))
+            have = set(self.current)
+            for peer in have - want:
+                self.current.pop(peer).kill()
+                chip = self._chip_of.pop(peer, None)
+                if chip is not None and self.pool:
+                    self.pool.put(chip)
+            self._done.clear()  # new membership version: everyone works again
+            for peer in sorted(want - have):
+                self._spawn(peer, cluster, version)
+            self.version = version
+            self._last_cluster = cluster
+
+    def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> bool:
+        """Spawn one worker; False when the chip pool is exhausted (the
+        spawn stays pending and retry_pending() re-attempts it)."""
+        chip = self.pool.get() if self.pool else None
+        if self.pool is not None and chip is None:
+            # refuse an unpinned spawn: it would contend with the workers
+            # already holding per-chip pins
+            import sys
+            print(f"[watcher] chip pool exhausted; deferring {peer}",
+                  file=sys.stderr)
+            return False
+        proc = self.job.new_proc(peer, cluster, version, self.parent, chip)
+        proc.start()
+        self.current[peer] = proc
+        if chip is not None:
+            self._chip_of[peer] = chip
+        return True
+
+    def retry_pending(self) -> None:
+        """Re-attempt spawns that were deferred on pool exhaustion."""
+        with self._lock:
+            if self._last_cluster is None:
+                return
+            want = set(self.local_workers(self._last_cluster))
+            for peer in sorted(want - set(self.current) - self._done):
+                self._spawn(peer, self._last_cluster, self.version)
+
+    def all_local_done(self) -> bool:
+        """True when this host had workers and every one exited cleanly."""
+        with self._lock:
+            if self._last_cluster is None:
+                return False
+            want = set(self.local_workers(self._last_cluster))
+            return bool(want) and want <= self._done
+
+    def reap(self) -> None:
+        """Collect exited workers; record failures."""
+        with self._lock:
+            for peer, proc in list(self.current.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                del self.current[peer]
+                chip = self._chip_of.pop(peer, None)
+                if chip is not None and self.pool:
+                    self.pool.put(chip)
+                if code != 0 and self.failed is None:
+                    self.failed = code
+                elif code == 0:
+                    self._done.add(peer)
+
+    def drain(self) -> None:
+        with self._lock:
+            for proc in self.current.values():
+                proc.kill()
+            self.current.clear()
+
+    def alive(self) -> int:
+        with self._lock:
+            return len(self.current)
+
+
+def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
+              config_url: Optional[str], poll_interval: float = 0.5,
+              pool: Optional[ChipPool] = None,
+              stop_when_empty: bool = True) -> int:
+    """Run the elastic watch loop until the *global* cluster drains or a
+    local worker fails (reference: watch.go:106-135 WatchRun).
+
+    A host whose local share is transiently zero keeps running — it may
+    receive workers on a later grow (the reference runner likewise only
+    exits when the whole cluster is gone).
+    """
+    w = Watcher(job, host, parent, pool)
+    w.update(0, initial)
+    global_size = initial.size()
+    while True:
+        w.reap()
+        w.retry_pending()
+        if w.failed is not None:
+            w.drain()
+            return w.failed
+        if config_url:
+            try:
+                version, cluster = fetch_config(config_url)
+                global_size = cluster.size()
+                w.update(version, cluster)
+            except Exception:
+                pass  # config server transient failure: keep current procs
+        if stop_when_empty and w.alive() == 0 and (
+                not config_url or global_size == 0 or w.all_local_done()):
+            return 0
+        time.sleep(poll_interval)

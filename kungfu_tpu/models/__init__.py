@@ -1,0 +1,11 @@
+"""Model zoo: benchmark and example models."""
+from .bert import BertEncoder, bert_base, bert_tiny
+from .fake_model import MODEL_SIZES, FakeModel
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152
+from .simple import VGG16, VGG19, MnistMLP, MnistSLP
+
+__all__ = [
+    "BertEncoder", "bert_base", "bert_tiny", "FakeModel", "MODEL_SIZES",
+    "ResNet", "ResNet50", "ResNet101", "ResNet152", "VGG16", "VGG19",
+    "MnistMLP", "MnistSLP",
+]

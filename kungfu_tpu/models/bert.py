@@ -1,0 +1,92 @@
+"""BERT-style transformer encoder.
+
+Reference analogue: the BERT gradient-size fixture used in allreduce
+benchmarks (tests/go/fakemodel/bert.go, v1/benchmarks/model_sizes.py).
+Written TPU-first: bf16 matmuls on the MXU, f32 layernorm/softmax
+accumulation, static shapes, fused QKV projection.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim)
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(head_dim)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores, -1e9)
+        probs = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[0], out.shape[1], d)
+        return nn.Dense(d, dtype=self.dtype, name="proj")(out)
+
+
+class EncoderLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = MultiHeadAttention(self.num_heads, self.dtype)(y, mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype)(y)
+        return x + y
+
+
+class BertEncoder(nn.Module):
+    """Pre-LN BERT encoder with an MLM head."""
+    vocab_size: int = 30522
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, token_ids, mask=None, train: bool = True):
+        b, s = token_ids.shape
+        tok = nn.Embed(self.vocab_size, self.hidden,
+                       dtype=self.dtype, name="tok_emb")(token_ids)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (self.max_len, self.hidden))
+        x = tok + pos[None, :s].astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = EncoderLayer(self.num_heads, self.mlp_dim, self.dtype)(x, mask)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                          name="mlm_head")(x)
+        return logits
+
+
+def bert_base(**kw):
+    return BertEncoder(**kw)
+
+
+def bert_tiny(**kw):
+    d = dict(vocab_size=1024, hidden=128, num_layers=2, num_heads=2,
+             mlp_dim=512, max_len=128)
+    d.update(kw)
+    return BertEncoder(**d)

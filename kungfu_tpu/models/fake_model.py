@@ -1,0 +1,98 @@
+"""Fake-model fixtures: named gradient-size tables for collective testing.
+
+Reference: tests/go/fakemodel/fakemodel.go:12-17 — gradient-size tables for
+resnet50-imagenet / vgg16-imagenet / slp-mnist / bert, with named double
+buffers standing in for real gradients.  These drive collective correctness
+and benchmark tests without running a real model.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+# Approximate per-tensor float32 gradient sizes (#elements), shaped like the
+# real models: ResNet-50 has 161 gradient tensors / ~25.5M params.
+
+
+def _resnet50_sizes() -> List[int]:
+    sizes: List[int] = []
+    sizes.append(7 * 7 * 3 * 64)          # stem conv
+    sizes += [64, 64]                      # stem BN
+    in_ch = 64
+    for stage, (blocks, f) in enumerate([(3, 64), (4, 128), (6, 256),
+                                         (3, 512)]):
+        for b in range(blocks):
+            sizes.append(1 * 1 * in_ch * f)
+            sizes += [f, f]
+            sizes.append(3 * 3 * f * f)
+            sizes += [f, f]
+            sizes.append(1 * 1 * f * f * 4)
+            sizes += [f * 4, f * 4]
+            if b == 0:
+                sizes.append(1 * 1 * in_ch * f * 4)
+                sizes += [f * 4, f * 4]
+            in_ch = f * 4
+    sizes.append(2048 * 1000)
+    sizes.append(1000)
+    return sizes
+
+
+def _vgg16_sizes() -> List[int]:
+    cfg = [(3, 64), (64, 64), (64, 128), (128, 128), (128, 256), (256, 256),
+           (256, 256), (256, 512), (512, 512), (512, 512), (512, 512),
+           (512, 512), (512, 512)]
+    sizes = []
+    for cin, cout in cfg:
+        sizes.append(3 * 3 * cin * cout)
+        sizes.append(cout)
+    sizes += [25088 * 4096, 4096, 4096 * 4096, 4096, 4096 * 1000, 1000]
+    return sizes
+
+
+def _bert_sizes() -> List[int]:
+    h, layers, mlp, vocab = 768, 12, 3072, 30522
+    sizes = [vocab * h, 512 * h]
+    for _ in range(layers):
+        sizes += [3 * h * h, 3 * h, h * h, h, h, h,
+                  h * mlp, mlp, mlp * h, h, h, h]
+    sizes += [h * vocab, vocab]
+    return sizes
+
+
+MODEL_SIZES: Dict[str, List[int]] = {
+    "resnet50-imagenet": _resnet50_sizes(),
+    "vgg16-imagenet": _vgg16_sizes(),
+    "bert": _bert_sizes(),
+    "slp-mnist": [784 * 10, 10],
+}
+
+
+class FakeModel:
+    """Named gradient buffers mimicking a model's gradient pytree
+    (reference: fakemodel.go named double buffers)."""
+
+    def __init__(self, name: str = "resnet50-imagenet", dtype=np.float32,
+                 seed: int = 0):
+        if name not in MODEL_SIZES:
+            raise KeyError(f"unknown fake model {name!r}; "
+                           f"have {sorted(MODEL_SIZES)}")
+        self.name = name
+        self.sizes = MODEL_SIZES[name]
+        rng = np.random.RandomState(seed)
+        self.grads = {
+            f"grad_{i:03d}": rng.randn(s).astype(dtype) * 0.01
+            for i, s in enumerate(self.sizes)
+        }
+
+    @property
+    def num_params(self) -> int:
+        return sum(self.sizes)
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.sizes)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(g.nbytes for g in self.grads.values())

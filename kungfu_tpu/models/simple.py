@@ -1,0 +1,64 @@
+"""Small models: MNIST SLP/MLP and VGG16.
+
+Reference analogues: the MNIST SLP used across examples and tests
+(examples/tf2_mnist_gradient_tape.py, tests/python/integration/
+test_mnist_slp.py) and the VGG16 benchmark fixture
+(tests/go/fakemodel/vgg16-imagenet.go).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistSLP(nn.Module):
+    """Single-layer perceptron: 784 -> 10 (the reference's smoke-test model)."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistMLP(nn.Module):
+    hidden: Sequence[int] = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGG(nn.Module):
+    """VGG-16/19 (conv config D/E), NHWC, bf16 matmuls."""
+    cfg: Sequence[Any] = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                          512, 512, 512, "M", 512, 512, 512, "M")
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+VGG16 = VGG
+VGG19 = partial(VGG, cfg=(64, 64, "M", 128, 128, "M", 256, 256, 256, 256,
+                          "M", 512, 512, 512, 512, "M", 512, 512, 512, 512,
+                          "M"))

@@ -1,0 +1,156 @@
+"""Online monitoring: byte counters, rates, and a /metrics endpoint.
+
+Reference: srcs/go/monitor/ — per-peer egress/ingress byte counters with
+rates over a period, served as plaintext Prometheus-style /metrics on
+worker port+10000 (monitor.go:58-104), feeding bandwidth-aware adaptation
+via GetEgressRates.
+
+TPU translation: socket bytes become *collective bytes* — for each eager
+collective the session records payload sizes; for compiled steps the
+per-step collective volume is estimated from the gradient byte count and
+the algorithm's cost model (ring allreduce moves 2(n-1)/n × bytes over
+ICI).  Rates come from a monotonic-clock window.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.http import BackgroundHTTPServer
+
+MONITOR_PORT_OFFSET = 10000  # reference: monitor starts at worker port+10000
+
+
+def allreduce_bytes_on_wire(payload_bytes: int, n: int,
+                            algorithm: str = "ring") -> int:
+    """Bytes each participant moves for one allreduce of ``payload_bytes``."""
+    if n <= 1:
+        return 0
+    if algorithm == "ring":
+        return int(2 * (n - 1) / n * payload_bytes)
+    if algorithm == "tree":
+        return 2 * payload_bytes
+    if algorithm == "star":
+        return 2 * payload_bytes
+    raise ValueError(f"unknown algorithm {algorithm}")
+
+
+class RateCounter:
+    """Accumulates bytes; reports rate over the sampling window."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._total = 0
+        self._window_start = time.monotonic()
+        self._window_bytes = 0
+        self._last_rate = 0.0
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._total += n
+            self._window_bytes += n
+
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def rate(self, min_window: float = 0.05) -> float:
+        """Bytes/sec since last rate() call (rolls the window)."""
+        with self._lock:
+            now = time.monotonic()
+            dt = now - self._window_start
+            if dt < min_window:
+                return self._last_rate
+            self._last_rate = self._window_bytes / dt
+            self._window_bytes = 0
+            self._window_start = now
+            return self._last_rate
+
+
+class Monitor:
+    """Per-target egress/ingress accounting (targets = peers or mesh axes)."""
+
+    def __init__(self) -> None:
+        self._egress: Dict[str, RateCounter] = {}
+        self._ingress: Dict[str, RateCounter] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, table: Dict[str, RateCounter], key: str) -> RateCounter:
+        with self._lock:
+            if key not in table:
+                table[key] = RateCounter()
+            return table[key]
+
+    def egress(self, nbytes: int, target: str = "ici") -> None:
+        self._get(self._egress, target).add(nbytes)
+
+    def ingress(self, nbytes: int, target: str = "ici") -> None:
+        self._get(self._ingress, target).add(nbytes)
+
+    def egress_rates(self) -> Dict[str, float]:
+        with self._lock:
+            keys = list(self._egress)
+        return {k: self._egress[k].rate() for k in keys}
+
+    def render_metrics(self) -> str:
+        """Prometheus-style plaintext (reference: monitor.go /metrics)."""
+        lines = []
+        with self._lock:
+            eg = dict(self._egress)
+            ig = dict(self._ingress)
+        for k, c in sorted(eg.items()):
+            lines.append(f'kungfu_tpu_egress_bytes_total{{target="{k}"}} {c.total()}')
+        for k, c in sorted(ig.items()):
+            lines.append(f'kungfu_tpu_ingress_bytes_total{{target="{k}"}} {c.total()}')
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """HTTP /metrics endpoint on a background thread."""
+
+    def __init__(self, monitor: Monitor, host: str = "127.0.0.1",
+                 port: int = 0):
+        mon = monitor
+
+        def factory(_srv):
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, fmt, *args):
+                    pass
+
+                def do_GET(self):
+                    if self.path.startswith("/metrics"):
+                        body = mon.render_metrics().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+            return Handler
+
+        self._server = BackgroundHTTPServer(factory, host, port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> "MetricsServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+_default_monitor: Optional[Monitor] = None
+
+
+def get_monitor() -> Monitor:
+    global _default_monitor
+    if _default_monitor is None:
+        _default_monitor = Monitor()
+    return _default_monitor

@@ -1,0 +1,92 @@
+"""Tensor-level ops: fusion, grouped collectives, peer info.
+
+Reference: srcs/python/kungfu/tensorflow/ops/ — fuse/defuse
+(__init__.py:29-46), group_all_reduce (collective.py:67-69), monitored
+allreduce, topology info ops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import collectives as C
+from ..comm.mesh import PEER_AXIS
+from ..plan.topology import GraphPair
+
+
+def fuse(tensors):
+    """Flatten a pytree into one flat vector per dtype + static spec.
+
+    Reference: ops/__init__.py fuse() — enables bucketed collectives
+    (nccl_fusion analogue).  Leaves are grouped by dtype (no silent
+    casting); each group becomes one large collective for XLA.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    shapes = [l.shape for l in leaves]
+    dtypes = [str(l.dtype) for l in leaves]
+    groups: dict = {}
+    for i, dt in enumerate(dtypes):
+        groups.setdefault(dt, []).append(i)
+    flat = {dt: jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+            for dt, idxs in groups.items()}
+    return flat, (treedef, shapes, dtypes, groups)
+
+
+def defuse(flat, spec):
+    """Inverse of fuse()."""
+    treedef, shapes, dtypes, groups = spec
+    leaves = [None] * len(shapes)
+    for dt, idxs in groups.items():
+        off = 0
+        vec = flat[dt]
+        for i in idxs:
+            size = int(np.prod(shapes[i])) if shapes[i] else 1
+            leaves[i] = vec[off:off + size].reshape(shapes[i])
+            off += size
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def group_all_reduce(tensors, axis_name: str = PEER_AXIS, op: str = "SUM"):
+    """Per-tensor allreduce of a pytree (reference group_all_reduce)."""
+    return C.all_reduce(tensors, axis_name, op)
+
+
+def fused_all_reduce(tensors, axis_name: str = PEER_AXIS, op: str = "SUM",
+                     pairs: Optional[Sequence[GraphPair]] = None,
+                     name: str = "fused"):
+    """Fuse a pytree, allreduce once (optionally along explicit graph
+    strategies with chunk striping), defuse."""
+    flat, spec = fuse(tensors)
+    if pairs:
+        red = {}
+        for dt, vec in flat.items():
+            r = C.striped_graph_all_reduce(vec, list(pairs), axis_name,
+                                           "SUM" if op == "MEAN" else op,
+                                           f"{name}/{dt}")
+            if op == "MEAN":
+                r = r / jax.lax.psum(1, axis_name)
+            red[dt] = r.astype(vec.dtype)
+    else:
+        red = C.all_reduce(flat, axis_name, op)
+    return defuse(red, spec)
+
+
+def monitored_all_reduce(tensor, axis_name: str = PEER_AXIS, op: str = "SUM"):
+    """Allreduce that also returns the bytes moved, for throughput
+    monitoring (reference: KungfuMonitoredAllReduce, collective.cpp)."""
+    out = C.all_reduce(tensor, axis_name, op)
+    nbytes = sum(l.size * l.dtype.itemsize
+                 for l in jax.tree_util.tree_leaves(tensor))
+    return out, nbytes
+
+
+def rank(axis_name: str = PEER_AXIS):
+    """In-step rank (reference: KungfuRank op)."""
+    return jax.lax.axis_index(axis_name)
+
+
+def cluster_size(axis_name: str = PEER_AXIS):
+    return jax.lax.psum(1, axis_name)

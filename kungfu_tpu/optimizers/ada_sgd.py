@@ -1,0 +1,79 @@
+"""Adaptive SGD: start with model averaging, switch to synchronous SGD.
+
+Reference: srcs/python/kungfu/tensorflow/optimizers/ada_sgd.py:12-83 — run
+SMA for the first ``change_step`` steps (robust during the noisy early
+phase), then switch to allreduce S-SGD (faster convergence later); at the
+switch the model is re-synchronised by broadcasting rank 0's parameters
+(reference AdaSGDHook re-broadcast).
+
+TPU note: both branches' collectives are computed unconditionally and
+selected — the predicate is replicated, and XLA requires a static
+collective schedule; the redundant collective is one psum of an
+already-needed operand, fused into the same program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..comm import collectives as C
+from ..comm.mesh import PEER_AXIS
+
+
+def adaptive_sgd(base: optax.GradientTransformation,
+                 change_step: int,
+                 alpha: float = 0.1,
+                 axis_name: str = PEER_AXIS,
+                 static_phase: str = None
+                 ) -> optax.GradientTransformation:
+    """AdaptiveSGDOptimizer equivalent.
+
+    ``static_phase``: None keeps both branches in one compiled program
+    (simple, but pays one redundant model-sized collective per step for the
+    whole run).  For long runs, rebuild the train step at the switch with
+    ``static_phase="sma"`` before and ``static_phase="sgd"`` after — the
+    framework's recompile-per-configuration pattern (see
+    ElasticTrainer._step_cache) makes this one extra compile, zero extra
+    collectives.
+    """
+    if static_phase == "sma":
+        from .sma import synchronous_averaging
+        return synchronous_averaging(base, alpha=alpha, axis_name=axis_name)
+    if static_phase == "sgd":
+        from .sync_sgd import synchronous_sgd
+        return synchronous_sgd(base, axis_name=axis_name)
+    if static_phase is not None:
+        raise ValueError(f"static_phase must be None|'sma'|'sgd', "
+                         f"got {static_phase!r}")
+
+    def init_fn(params):
+        return {"base": base.init(params), "step": jnp.zeros((), jnp.int32)}
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("adaptive_sgd requires params")
+        step = state["step"]
+        in_sma = step < change_step
+        at_switch = step == change_step
+
+        # S-SGD branch operand: gradient mean.
+        grad_mean = C.all_reduce(updates, axis_name, "MEAN")
+        # SMA branch operand: parameter mean.
+        param_avg = C.all_reduce(params, axis_name, "MEAN")
+
+        sma_grads = updates  # local gradients
+        chosen_grads = jax.tree_util.tree_map(
+            lambda g, m: jnp.where(in_sma, g, m), sma_grads, grad_mean)
+        local_updates, base_state = base.update(chosen_grads, state["base"], params)
+
+        # SMA pull term, zeroed after the switch; at the switch step, snap to
+        # the cluster average (the re-broadcast that keeps replicas identical).
+        pull = jax.tree_util.tree_map(
+            lambda a, p: jnp.where(in_sma, alpha * (a - p),
+                                   jnp.where(at_switch, a - p, 0.0)),
+            param_avg, params)
+        merged = jax.tree_util.tree_map(lambda u, d: u + d, local_updates, pull)
+        return merged, {"base": base_state, "step": step + 1}
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,114 @@
+"""Monitoring optimizers: gradient noise scale and gradient variance.
+
+Reference:
+- srcs/python/kungfu/tensorflow/optimizers/grad_noise_scale.py:12-88 and
+  the GNS formula in tensorflow/ops/monitor.py:6-17 /
+  ops/cpu/collective.cpp:212-258 (EMA-smoothed ratio).
+- srcs/python/kungfu/tensorflow/optimizers/grad_variance.py:9-75.
+
+Both wrap synchronous SGD: they consume the *local* gradient (small batch
+B) and the *averaged* gradient (effective batch n*B) that the allreduce
+already produces, so monitoring adds no extra collectives beyond one scalar
+psum.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..comm import collectives as C
+from ..comm.mesh import PEER_AXIS
+
+
+def _global_sq_norm(tree):
+    return sum(jnp.sum(jnp.square(l)) for l in jax.tree_util.tree_leaves(tree))
+
+
+class NoiseScaleState(NamedTuple):
+    base: optax.OptState
+    ema_s: jnp.ndarray       # EMA of gradient noise (S)
+    ema_g2: jnp.ndarray      # EMA of true-gradient squared norm (|G|^2)
+    noise_scale: jnp.ndarray
+    step: jnp.ndarray
+
+
+def gradient_noise_scale(base: optax.GradientTransformation,
+                         batch_size: int,
+                         axis_name: str = PEER_AXIS,
+                         ema_decay: float = 0.95
+                         ) -> optax.GradientTransformation:
+    """MonitorGradientNoiseScaleOptimizer equivalent.
+
+    Implements the simple-noise-scale estimator of An Empirical Model of
+    Large-Batch Training (the reference's formula): with B_small = B,
+    B_big = n*B,
+
+        |G|^2_est = (B_big * |g_big|^2 - B_small * |g_small|^2) / (B_big - B_small)
+        S_est     = (|g_small|^2 - |g_big|^2) / (1/B_small - 1/B_big)
+        noise_scale = EMA(S) / EMA(|G|^2)
+
+    The running noise scale is exposed in the optimizer state
+    (``state.noise_scale``) the way the reference exposes a TF variable.
+    """
+
+    def init_fn(params):
+        z = jnp.zeros((), jnp.float32)
+        return NoiseScaleState(base.init(params), z, z, z,
+                               jnp.zeros((), jnp.int32))
+
+    def update_fn(updates, state, params=None):
+        n = jax.lax.psum(1, axis_name)
+        g_mean = C.all_reduce(updates, axis_name, "MEAN")
+        b_small = jnp.asarray(batch_size, jnp.float32)
+        b_big = b_small * n
+        g2_small_local = _global_sq_norm(updates)
+        # average the per-peer local sqnorm so every lane agrees
+        g2_small = jax.lax.pmean(g2_small_local, axis_name)
+        g2_big = _global_sq_norm(g_mean)
+
+        denom = jnp.maximum(b_big - b_small, 1.0)
+        g2_est = (b_big * g2_big - b_small * g2_small) / denom
+        s_est = (g2_small - g2_big) / jnp.maximum(1.0 / b_small - 1.0 / b_big,
+                                                  1e-12)
+        d = jnp.asarray(ema_decay, jnp.float32)
+        first = state.step == 0
+        ema_s = jnp.where(first, s_est, d * state.ema_s + (1 - d) * s_est)
+        ema_g2 = jnp.where(first, g2_est, d * state.ema_g2 + (1 - d) * g2_est)
+        noise_scale = ema_s / jnp.where(jnp.abs(ema_g2) < 1e-30, 1e-30, ema_g2)
+
+        new_updates, base_state = base.update(g_mean, state.base, params)
+        return new_updates, NoiseScaleState(base_state, ema_s, ema_g2,
+                                            noise_scale, state.step + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class GradVarianceState(NamedTuple):
+    base: optax.OptState
+    variance: jnp.ndarray
+    step: jnp.ndarray
+
+
+def gradient_variance(base: optax.GradientTransformation,
+                      axis_name: str = PEER_AXIS
+                      ) -> optax.GradientTransformation:
+    """MonitorGradientVarianceOptimizer equivalent: cross-peer gradient
+    variance  E_i ||g_i||^2 - ||E_i g_i||^2, exposed in state.variance."""
+
+    def init_fn(params):
+        return GradVarianceState(base.init(params), jnp.zeros((), jnp.float32),
+                                 jnp.zeros((), jnp.int32))
+
+    def update_fn(updates, state, params=None):
+        g_mean = C.all_reduce(updates, axis_name, "MEAN")
+        e_norm2 = jax.lax.pmean(_global_sq_norm(updates), axis_name)
+        norm2_e = _global_sq_norm(g_mean)
+        variance = jnp.maximum(e_norm2 - norm2_e, 0.0)
+        new_updates, base_state = base.update(g_mean, state.base, params)
+        return new_updates, GradVarianceState(base_state, variance,
+                                              state.step + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,40 @@
+"""Synchronous model averaging (SMA / EA-SGD family).
+
+Reference: srcs/python/kungfu/tensorflow/optimizers/sma_sgd.py:9-74 — each
+step every peer pulls the cluster-average model and moves toward it:
+``v <- (1 - alpha) * v + alpha * avg(v)``, then applies its *local*
+gradient update.  Communication is over model parameters, not gradients,
+which tolerates much larger clusters before convergence degrades
+(reference README: SMA holds 75% ImageNet top-1 at 16 workers where S-SGD
+drops to 59%).
+"""
+from __future__ import annotations
+
+import jax
+import optax
+
+from ..comm import collectives as C
+from ..comm.mesh import PEER_AXIS
+
+
+def synchronous_averaging(base: optax.GradientTransformation,
+                          alpha: float = 0.1,
+                          axis_name: str = PEER_AXIS
+                          ) -> optax.GradientTransformation:
+    """SynchronousAveragingOptimizer equivalent.
+
+    The returned transformation's update requires ``params``.
+    """
+    def init_fn(params):
+        return base.init(params)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("synchronous_averaging requires params")
+        avg = C.all_reduce(params, axis_name, "MEAN")
+        pull = jax.tree_util.tree_map(lambda a, p: alpha * (a - p), avg, params)
+        local_updates, state = base.update(updates, state, params)
+        merged = jax.tree_util.tree_map(lambda u, d: u + d, local_updates, pull)
+        return merged, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

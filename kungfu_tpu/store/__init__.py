@@ -1,0 +1,134 @@
+"""In-memory blob store with versioned sliding-window GC.
+
+Reference: srcs/go/store/store.go:14-63 (size-conflict-checked KV) and
+versionedstore.go:7-61 (window of 3 versions serving the p2p model
+exchange).  In the TPU framework this backs asynchronous model exchange
+between *controller processes* (multi-host pair averaging) and checkpoint
+handoff; intra-mesh exchange uses collective_permute instead.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_WINDOW = 3  # reference: versionedstore.go windowSize
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+class Store:
+    """Flat KV of named byte/array blobs; create checks size conflicts."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._blobs: Dict[str, np.ndarray] = {}
+
+    def create(self, name: str, value) -> None:
+        arr = np.asarray(value)
+        with self._lock:
+            if name in self._blobs:
+                if self._blobs[name].nbytes != arr.nbytes:
+                    raise ConflictError(
+                        f"blob {name!r} exists with different size")
+                return
+            self._blobs[name] = arr.copy()
+
+    def set(self, name: str, value) -> None:
+        arr = np.asarray(value)
+        with self._lock:
+            old = self._blobs.get(name)
+            if old is not None and old.nbytes != arr.nbytes:
+                raise ConflictError(f"blob {name!r} size mismatch")
+            self._blobs[name] = arr.copy()
+
+    def get(self, name: str) -> np.ndarray:
+        with self._lock:
+            if name not in self._blobs:
+                raise KeyError(name)
+            return self._blobs[name].copy()
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._blobs
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._blobs)
+
+
+class VersionedStore:
+    """Versioned blobs with sliding-window garbage collection."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self._lock = threading.RLock()
+        self._window = window
+        self._versions: Dict[int, Store] = {}
+
+    def save(self, version: int, name: str, value) -> None:
+        with self._lock:
+            st = self._versions.get(version)
+            if st is None:
+                st = self._versions[version] = Store()
+                self._gc()
+            st.set(name, value)
+
+    def get(self, version: int, name: str) -> np.ndarray:
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(f"version {version} evicted or absent")
+            return self._versions[version].get(name)
+
+    def latest_version(self) -> Optional[int]:
+        with self._lock:
+            return max(self._versions) if self._versions else None
+
+    def get_latest(self, name: str) -> Tuple[int, np.ndarray]:
+        with self._lock:
+            for v in sorted(self._versions, reverse=True):
+                if self._versions[v].exists(name):
+                    return v, self._versions[v].get(name)
+            raise KeyError(name)
+
+    def versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._versions)
+
+    def _gc(self) -> None:
+        while len(self._versions) > self._window:
+            del self._versions[min(self._versions)]
+
+
+class ModelStore:
+    """Model-exchange facade over VersionedStore: save/request whole pytrees
+    (reference: Save/SaveVersion/Request/RequestRank, peer/p2p.go:16-35)."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self._vs = VersionedStore(window)
+        self._flat = Store()
+
+    def save(self, name: str, tree, version: Optional[int] = None) -> None:
+        import jax
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        for i, leaf in enumerate(leaves):
+            key = f"{name}/{i}"
+            if version is None:
+                self._flat.set(key, np.asarray(leaf))
+            else:
+                self._vs.save(version, key, np.asarray(leaf))
+
+    def request(self, name: str, template, version: Optional[int] = None):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        out = []
+        for i, leaf in enumerate(leaves):
+            key = f"{name}/{i}"
+            arr = (self._flat.get(key) if version is None
+                   else self._vs.get(version, key))
+            out.append(arr.reshape(np.asarray(leaf).shape))
+        return jax.tree_util.tree_unflatten(treedef, out)

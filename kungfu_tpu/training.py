@@ -1,0 +1,161 @@
+"""High-level distributed training: replicate, broadcast, build the step.
+
+The TPU-native reading of the reference's worker model: each mesh lane
+(device) owns a *model replica*, stored as a peer-stacked pytree — leading
+axis = lane, sharded over the mesh.  On each device this costs exactly one
+replica, like the reference's per-worker model.  Synchronous SGD keeps the
+replicas bit-identical (gradient allreduce); SMA / pair averaging let them
+diverge and mix them, exactly as the reference's worker-local models do.
+
+Reference analogues: optimizer wrapping (optimizers/core.py:6-72),
+BroadcastGlobalVariables initializer (initializer/__init__.py:13-100).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .comm import collectives as C
+from .comm.mesh import PEER_AXIS, flat_mesh
+
+
+def _stack_spec(mesh: Mesh) -> P:
+    return P(mesh.axis_names)
+
+
+def replicate(params, mesh: Optional[Mesh] = None):
+    """Stack one replica per lane and shard over the mesh."""
+    mesh = mesh or flat_mesh()
+    n = int(np.prod(mesh.devices.shape))
+    spec = _stack_spec(mesh)
+
+    def rep(t):
+        t = jnp.asarray(t)
+        stacked = jnp.broadcast_to(t[None], (n,) + t.shape)
+        return jax.device_put(stacked, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(rep, params)
+
+
+def lane(tree, i: int = 0):
+    """Extract one lane's replica (e.g. for eval / checkpointing)."""
+    return jax.tree_util.tree_map(lambda t: np.asarray(t)[i], tree)
+
+
+def lane_mean(tree):
+    """Average the replicas (useful after model-averaging training)."""
+    return jax.tree_util.tree_map(lambda t: np.asarray(t).mean(axis=0), tree)
+
+
+def broadcast_variables(stacked, mesh: Optional[Mesh] = None, root: int = 0):
+    """Overwrite every lane's replica with ``root``'s — the reference's
+    BroadcastGlobalVariables initial/post-resize sync."""
+    mesh = mesh or flat_mesh()
+    axis = mesh.axis_names[0]
+
+    def body(tree):
+        def bc(t):
+            v = t[0]  # this lane's replica
+            idx = jax.lax.axis_index(axis)
+            mask = (idx == root).astype(v.dtype)
+            return jax.lax.psum(v * mask, axis)[None]
+        return jax.tree_util.tree_map(bc, tree)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=_stack_spec(mesh),
+                               out_specs=_stack_spec(mesh)))
+    return fn(stacked)
+
+
+def build_train_step(loss_fn: Callable,
+                     optimizer: optax.GradientTransformation,
+                     mesh: Optional[Mesh] = None,
+                     donate: bool = True) -> Callable:
+    """Compile a distributed train step.
+
+    ``loss_fn(params, batch) -> scalar``.  The returned function has
+    signature ``step(stacked_params, stacked_opt_state, global_batch) ->
+    (stacked_params, stacked_opt_state, mean_loss)``; ``global_batch``'s
+    leading axis is sharded across lanes.  All collective communication
+    happens inside the optimizer's update and compiles into this one XLA
+    program.
+    """
+    mesh = mesh or flat_mesh()
+    axis = mesh.axis_names[0]
+    spec = _stack_spec(mesh)
+
+    def body(stacked_params, stacked_state, batch):
+        params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
+        state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, state = optimizer.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        mean_loss = jax.lax.pmean(loss, axis)
+        restack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return restack(params), restack(state), mean_loss.reshape(1)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec),
+                       out_specs=(spec, spec, spec))
+    jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+    jitted = jax.jit(sm, **jit_kwargs)
+
+    def step(stacked_params, stacked_state, global_batch):
+        p, s, losses = jitted(stacked_params, stacked_state, global_batch)
+        return p, s, losses
+    return step
+
+
+def build_train_step_with_state(loss_fn: Callable,
+                                optimizer: optax.GradientTransformation,
+                                mesh: Optional[Mesh] = None,
+                                sync_model_state: bool = True,
+                                donate: bool = True) -> Callable:
+    """Like build_train_step, for models with non-trained state (BatchNorm
+    running stats).  ``loss_fn(params, model_state, batch) -> (loss,
+    new_model_state)``.  When ``sync_model_state`` is set the new state is
+    cross-replica averaged each step (the reference broadcasts BN stats with
+    the rest of the variables on sync points)."""
+    mesh = mesh or flat_mesh()
+    axis = mesh.axis_names[0]
+    spec = _stack_spec(mesh)
+
+    def body(stacked_params, stacked_state, stacked_mstate, batch):
+        params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
+        state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
+        mstate = jax.tree_util.tree_map(lambda t: t[0], stacked_mstate)
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, mstate, batch)
+        updates, state = optimizer.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        if sync_model_state:
+            new_mstate = C.all_reduce(new_mstate, axis, "MEAN")
+        mean_loss = jax.lax.pmean(loss, axis)
+        restack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (restack(params), restack(state), restack(new_mstate),
+                mean_loss.reshape(1))
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(spec, spec, spec, spec),
+                       out_specs=(spec, spec, spec, spec))
+    jit_kwargs = {"donate_argnums": (0, 1, 2)} if donate else {}
+    return jax.jit(sm, **jit_kwargs)
+
+
+def init_opt_state(optimizer: optax.GradientTransformation, stacked_params,
+                   mesh: Optional[Mesh] = None):
+    """Per-lane optimizer state, stacked and sharded like the params."""
+    mesh = mesh or flat_mesh()
+    spec = _stack_spec(mesh)
+
+    def body(stacked):
+        params = jax.tree_util.tree_map(lambda t: t[0], stacked)
+        state = optimizer.init(params)
+        return jax.tree_util.tree_map(lambda t: jnp.asarray(t)[None], state)
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec))
+    return fn(stacked_params)

@@ -1,0 +1,4 @@
+"""Shared utilities."""
+from .http import BackgroundHTTPServer
+
+__all__ = ["BackgroundHTTPServer"]

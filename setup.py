@@ -1,0 +1,19 @@
+"""Package setup for kungfu_tpu (reference analogue: setup.py building the
+Go/C++ runtime + python wheel; here the runtime is jax/XLA + the optional
+native control-plane extension under kungfu_tpu/native)."""
+from setuptools import find_packages, setup
+
+setup(
+    name="kungfu-tpu",
+    version="0.1.0",
+    description="TPU-native adaptive distributed ML framework "
+                "(KungFu capabilities, jax/XLA architecture)",
+    packages=find_packages(include=["kungfu_tpu", "kungfu_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "optax", "numpy"],
+    entry_points={
+        "console_scripts": [
+            "kft-run = kungfu_tpu.launcher.cli:main",
+        ],
+    },
+)

@@ -1,0 +1,216 @@
+"""Elastic training tests.
+
+Reference analogues: tests/python/integration/test_tensorflow_resize.py
+(assert on `changed`), scripts/tests/run-elastic-test.sh (scripted
+schedules against a config server).
+"""
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kungfu_tpu.optimizers as kfopt
+from kungfu_tpu.elastic import (ConfigServer, ElasticDataShard,
+                                ElasticTrainer, PolicyRunner,
+                                ScheduledResizePolicy, StepSchedule,
+                                fetch_config, put_config)
+from kungfu_tpu.plan import Cluster, HostList
+
+
+def quad_loss(params, batch):
+    x, y = batch
+    return jnp.mean((x @ params["w"] - y) ** 2)
+
+
+def make_trainer(n=4, factory=None):
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(4, 1).astype(np.float32))}
+    factory = factory or (lambda n: kfopt.synchronous_sgd(optax.sgd(0.1)))
+    return ElasticTrainer(quad_loss, factory, params, init_size=n)
+
+
+def batch_for(trainer, bs_per=8, seed=None):
+    rng = np.random.RandomState(trainer.step_count if seed is None else seed)
+    n = trainer.n * bs_per
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32))
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestStepSchedule:
+    def test_parse_and_lookup(self):
+        s = StepSchedule.parse("4:10,8:10,2:5")
+        assert s.total_steps() == 25
+        assert s.size_at(0) == 4
+        assert s.size_at(10) == 8
+        assert s.size_at(24) == 2
+        assert s.size_at(25) is None
+        assert s.changes() == [(0, 4), (10, 8), (20, 2)]
+        assert StepSchedule.parse(s.to_string()).stages == s.stages
+
+
+class TestConfigServer:
+    def test_rest_protocol(self):
+        srv = ConfigServer().start()
+        try:
+            url = srv.url
+            # no config yet -> 404
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(url)
+            c = Cluster.from_hostlist(HostList.parse("h1:4,h2:4"), 4)
+            v = put_config(url, c)
+            assert v == 1
+            v2, got = fetch_config(url)
+            assert v2 == 1 and got.size() == 4
+            # resize via PUT
+            v3 = put_config(url, c.resize(6))
+            assert v3 == 2
+            _, got = fetch_config(url)
+            assert got.size() == 6
+            # invalid cluster rejected
+            req = urllib.request.Request(url, data=b'{"bad": 1}', method="PUT")
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(req)
+            # delete clears
+            req = urllib.request.Request(url, method="DELETE")
+            urllib.request.urlopen(req)
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(url)
+        finally:
+            srv.stop()
+
+
+class TestElasticTrainer:
+    def test_grow_and_shrink_preserves_training(self):
+        tr = make_trainer(n=2)
+        for _ in range(5):
+            tr.step(batch_for(tr))
+        l_before = tr.step(batch_for(tr))
+        w_before = tr.current_params()["w"].copy()
+        assert tr.resize(8)  # grow
+        w_after = tr.current_params()["w"]
+        np.testing.assert_allclose(w_before, w_after, rtol=1e-6)
+        # newcomer lanes cloned from lane 0
+        all_w = np.asarray(tr.params["w"])
+        for i in range(8):
+            np.testing.assert_allclose(all_w[i], w_before, rtol=1e-6)
+        for _ in range(10):
+            loss = tr.step(batch_for(tr))
+        assert loss < l_before
+        assert tr.resize(3)  # shrink
+        for _ in range(5):
+            loss2 = tr.step(batch_for(tr))
+        assert np.isfinite(loss2)
+        assert not tr.resize(3)  # no change -> False
+
+    def test_resize_from_url(self):
+        srv = ConfigServer().start()
+        try:
+            tr = make_trainer(n=4)
+            tr.config_server_url = srv.url
+            c = Cluster.from_hostlist(HostList.parse("127.0.0.1:8"), 6)
+            put_config(srv.url, c)
+            changed, detached = tr.resize_from_url()
+            assert changed and not detached
+            assert tr.n == 6
+            changed, _ = tr.resize_from_url()
+            assert not changed
+        finally:
+            srv.stop()
+
+    def test_step_cache_reused(self):
+        tr = make_trainer(n=4)
+        tr.step(batch_for(tr))
+        tr.resize(8)
+        tr.step(batch_for(tr))
+        tr.resize(4)  # back to cached size: no recompile
+        assert 4 in tr._step_cache and 8 in tr._step_cache
+        tr.step(batch_for(tr))
+
+    def test_trained_samples_accounting(self):
+        tr = make_trainer(n=4)
+        tr.step(batch_for(tr, bs_per=8))
+        assert tr.trained_samples == 32
+        assert tr.sync_progress() == 32
+
+
+class TestPolicies:
+    def test_scheduled_resize_policy(self):
+        tr = make_trainer(n=4)
+        sched = StepSchedule.parse("4:3,8:3,2:3")
+        runner = PolicyRunner([ScheduledResizePolicy(sched)], tr,
+                              epoch_size=64, epochs=1)
+        sizes = []
+        orig_step = tr.step
+
+        def spy(batch):
+            sizes.append(tr.n)
+            return orig_step(batch)
+        tr.step = spy
+        runner.run(batch_for, steps_per_epoch=9)
+        assert sizes == [4, 4, 4, 8, 8, 8, 2, 2, 2]
+
+    def test_schedule_stop(self):
+        tr = make_trainer(n=2)
+        sched = StepSchedule.parse("2:2,0:1")
+        runner = PolicyRunner([ScheduledResizePolicy(sched)], tr,
+                              epoch_size=16, epochs=1)
+        losses = runner.run(batch_for, steps_per_epoch=10)
+        assert len(losses) == 2
+
+
+class TestElasticDataShard:
+    def test_no_skip_no_repeat_across_resize(self):
+        shard = ElasticDataShard(num_samples=100, shuffle_each_epoch=False)
+        seen = []
+        progress = 0
+        for size, bs in [(4, 20), (8, 40), (2, 20), (4, 20)]:
+            idx = shard.batch_indices(progress, bs)
+            seen.extend(idx.tolist())
+            progress += bs
+        assert seen == list(range(100))
+
+    def test_local_slice_partition(self):
+        shard = ElasticDataShard(num_samples=64)
+        idx = shard.batch_indices(0, 32)
+        parts = [shard.local_slice(idx, r, 4) for r in range(4)]
+        joined = np.concatenate(parts)
+        np.testing.assert_array_equal(joined, idx)
+
+    def test_epoch_wraparound(self):
+        shard = ElasticDataShard(num_samples=10, shuffle_each_epoch=False)
+        idx = shard.batch_indices(8, 4)
+        assert idx.tolist() == [8, 9, 0, 1]
+
+
+class TestReviewRegressions:
+    def test_local_slice_no_drop_with_remainder(self):
+        shard = ElasticDataShard(num_samples=64)
+        idx = shard.batch_indices(0, 32)
+        parts = [shard.local_slice(idx, r, 3) for r in range(3)]
+        joined = np.concatenate(parts)
+        np.testing.assert_array_equal(np.sort(joined), np.sort(idx))
+        assert sum(len(p) for p in parts) == 32
+
+    def test_sync_progress_exact_past_2_24(self):
+        tr = make_trainer(n=2)
+        tr.trained_samples = (1 << 24) + 3  # would round under float32
+        assert tr.sync_progress() == (1 << 24) + 3
+
+    def test_resize_from_url_does_not_revert_local_resize(self):
+        srv = ConfigServer().start()
+        try:
+            tr = make_trainer(n=4)
+            tr.config_server_url = srv.url
+            put_config(srv.url, Cluster.from_hostlist(
+                HostList.parse("127.0.0.1:8"), 4))
+            tr.resize_from_url()
+            assert tr.n == 4
+            tr.resize(6)  # policy-driven local resize
+            changed, _ = tr.resize_from_url()  # same server version
+            assert not changed and tr.n == 6  # must NOT revert to 4
+        finally:
+            srv.stop()

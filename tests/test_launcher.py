@@ -1,0 +1,192 @@
+"""Launcher tests: env ABI, static run, elastic watch reconciliation.
+
+Reference analogues: srcs/go/kungfu/runner/{flags,peerspec}_test.go,
+srcs/go/proc/proc_test.go, and the watch-mode elastic cluster tests.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from kungfu_tpu.elastic import ConfigServer, put_config
+from kungfu_tpu.launcher import ChipPool, Job, Watcher, env as E
+from kungfu_tpu.launcher.cli import build_parser, main
+from kungfu_tpu.plan import Cluster, HostList, PeerID, Strategy
+
+
+class TestEnvABI:
+    def test_roundtrip(self):
+        hl = HostList.parse("127.0.0.1:4")
+        cluster = Cluster.from_hostlist(hl, 3)
+        w = cluster.workers[1]
+        env = E.worker_env(w, cluster.workers, cluster.runners, version=2,
+                           strategy=Strategy.RING,
+                           config_server="http://x/config",
+                           parent=PeerID("127.0.0.1", 31000),
+                           chip_ids=[1], num_local_devices=2)
+        we = E.from_env(env)
+        assert not we.singleton
+        assert we.rank() == 1
+        assert we.size() == 3
+        assert we.strategy == Strategy.RING
+        assert we.cluster_version == 2
+        assert we.chip_ids == [1]
+        assert we.num_local_devices == 2
+        assert we.config_server == "http://x/config"
+
+    def test_singleton_mode(self):
+        we = E.from_env({})
+        assert we.singleton
+        assert we.rank() == 0
+        assert we.size() == 1
+
+
+class TestChipPool:
+    def test_get_put(self):
+        p = ChipPool(2)
+        a, b = p.get(), p.get()
+        assert {a, b} == {0, 1}
+        assert p.get() is None
+        p.put(a)
+        assert p.get() == a
+
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    from kungfu_tpu.launcher import env as E
+    we = E.from_env()
+    print(f"rank={{we.rank()}} size={{we.size()}} v={{we.cluster_version}}")
+""").format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestStaticRun:
+    def test_np4_local(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER)
+        rc = main(["-np", "4", "--", sys.executable, str(script)])
+        assert rc == 0
+
+    def test_failure_propagates(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(3)")
+        rc = main(["-np", "2", "--", sys.executable, str(script)])
+        assert rc == 3
+
+
+class TestWatcher:
+    def _job(self, tmp_path, body="import time; time.sleep(30)"):
+        script = tmp_path / "w.py"
+        script.write_text(body)
+        return Job(prog=sys.executable, args=[str(script)])
+
+    def test_reconcile_grow_shrink(self, tmp_path):
+        job = self._job(tmp_path)
+        hl = HostList.parse("127.0.0.1:8")
+        parent = PeerID("127.0.0.1", 31000)
+        w = Watcher(job, "127.0.0.1", parent)
+        try:
+            w.update(0, Cluster.from_hostlist(hl, 2))
+            assert w.alive() == 2
+            w.update(1, Cluster.from_hostlist(hl, 5))
+            assert w.alive() == 5
+            w.update(2, Cluster.from_hostlist(hl, 1))
+            assert w.alive() == 1
+            # stale version ignored
+            w.update(1, Cluster.from_hostlist(hl, 5))
+            assert w.alive() == 1
+        finally:
+            w.drain()
+        assert w.alive() == 0
+
+    def test_reap_failure(self, tmp_path):
+        job = self._job(tmp_path, body="import sys; sys.exit(7)")
+        hl = HostList.parse("127.0.0.1:2")
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000))
+        w.update(0, Cluster.from_hostlist(hl, 2))
+        deadline = time.time() + 10
+        while w.failed is None and time.time() < deadline:
+            time.sleep(0.1)
+            w.reap()
+        assert w.failed == 7
+        w.drain()
+
+
+class TestEmbeddedConfigServer:
+    def test_watch_run_drains_on_zero_size(self, tmp_path):
+        # workers that exit cleanly when told; schedule shrinks to zero
+        script = tmp_path / "w.py"
+        script.write_text("import time; time.sleep(0.5)")
+        hl = HostList.parse("127.0.0.1:4")
+        cluster = Cluster.from_hostlist(hl, 2)
+        srv = ConfigServer().start()
+        try:
+            put_config(srv.url, cluster)
+            from kungfu_tpu.launcher.watch import watch_run
+            job = Job(prog=sys.executable, args=[str(script)],
+                      config_server=srv.url)
+            rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31000),
+                           cluster, srv.url, poll_interval=0.1)
+            assert rc == 0
+        finally:
+            srv.stop()
+
+
+class TestWatcherRegressions:
+    def test_transiently_drained_host_respawns_on_grow(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text("import time; time.sleep(30)")
+        job = Job(prog=sys.executable, args=[str(script)])
+        hl = HostList.parse("127.0.0.1:8")
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000))
+        try:
+            w.update(0, Cluster.from_hostlist(hl, 2))
+            w.update(1, Cluster.from_hostlist(hl, 0))  # drain this host
+            assert w.alive() == 0
+            w.update(2, Cluster.from_hostlist(hl, 3))  # grow again
+            assert w.alive() == 3
+        finally:
+            w.drain()
+
+    def test_chip_pool_deferred_spawn_retries(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text("import time; time.sleep(30)")
+        job = Job(prog=sys.executable, args=[str(script)])
+        hl = HostList.parse("127.0.0.1:8")
+        pool = ChipPool(2)
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000), pool)
+        try:
+            w.update(0, Cluster.from_hostlist(hl, 3))  # only 2 chips
+            assert w.alive() == 2
+            # free a chip by killing one worker
+            peer, proc = next(iter(w.current.items()))
+            proc.kill()
+            import time as _t
+            deadline = _t.time() + 10
+            while w.alive() > 1 and _t.time() < deadline:
+                _t.sleep(0.1)
+                w.reap()
+            w.reap()
+            w.retry_pending()  # deferred 3rd worker must now spawn
+            assert w.alive() == 2
+        finally:
+            w.drain()
+
+    def test_clean_exit_not_respawned(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text("pass")  # exits immediately, cleanly
+        job = Job(prog=sys.executable, args=[str(script)])
+        hl = HostList.parse("127.0.0.1:4")
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000))
+        w.update(0, Cluster.from_hostlist(hl, 2))
+        import time as _t
+        deadline = _t.time() + 10
+        while not w.all_local_done() and _t.time() < deadline:
+            _t.sleep(0.1)
+            w.reap()
+            w.retry_pending()
+        assert w.all_local_done()
+        assert w.alive() == 0

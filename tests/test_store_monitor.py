@@ -1,0 +1,80 @@
+"""Store and monitoring tests (reference: srcs/go/store, srcs/go/monitor)."""
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.monitor import (MetricsServer, Monitor, RateCounter,
+                                allreduce_bytes_on_wire)
+from kungfu_tpu.store import (ConflictError, ModelStore, Store,
+                              VersionedStore)
+
+
+class TestStore:
+    def test_create_get(self):
+        s = Store()
+        s.create("a", np.arange(4))
+        np.testing.assert_array_equal(s.get("a"), np.arange(4))
+        s.create("a", np.arange(4))  # idempotent same size
+        with pytest.raises(ConflictError):
+            s.create("a", np.arange(8))
+        with pytest.raises(KeyError):
+            s.get("missing")
+
+    def test_set_size_check(self):
+        s = Store()
+        s.set("x", np.zeros(3, np.float32))
+        with pytest.raises(ConflictError):
+            s.set("x", np.zeros(5, np.float32))
+
+
+class TestVersionedStore:
+    def test_window_gc(self):
+        vs = VersionedStore(window=3)
+        for v in range(5):
+            vs.save(v, "m", np.full(2, v))
+        assert vs.versions() == [2, 3, 4]
+        with pytest.raises(KeyError):
+            vs.get(0, "m")
+        np.testing.assert_array_equal(vs.get(3, "m"), [3, 3])
+        assert vs.latest_version() == 4
+        v, arr = vs.get_latest("m")
+        assert v == 4
+
+    def test_model_store_pytree(self):
+        ms = ModelStore()
+        tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": np.ones(3, np.float32)}
+        ms.save("model", tree, version=1)
+        got = ms.request("model", tree, version=1)
+        np.testing.assert_array_equal(got["w"], tree["w"])
+        np.testing.assert_array_equal(got["b"], tree["b"])
+
+
+class TestMonitor:
+    def test_cost_model(self):
+        assert allreduce_bytes_on_wire(1000, 1) == 0
+        assert allreduce_bytes_on_wire(1000, 4, "ring") == 1500
+        assert allreduce_bytes_on_wire(1000, 4, "tree") == 2000
+
+    def test_rate_counter(self):
+        c = RateCounter()
+        c.add(1000)
+        time.sleep(0.06)
+        r = c.rate()
+        assert r > 0
+        assert c.total() == 1000
+
+    def test_metrics_endpoint(self):
+        mon = Monitor()
+        mon.egress(12345, "dcn")
+        mon.ingress(999, "ici")
+        srv = MetricsServer(mon).start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics").read().decode()
+            assert 'kungfu_tpu_egress_bytes_total{target="dcn"} 12345' in body
+            assert 'kungfu_tpu_ingress_bytes_total{target="ici"} 999' in body
+        finally:
+            srv.stop()
