@@ -36,12 +36,16 @@ class ElasticDataShard:
         """
         epoch = trained_samples // self.num_samples
         offset = trained_samples % self.num_samples
-        order = self._order(epoch)
-        if offset + global_batch <= self.num_samples:
-            return order[offset:offset + global_batch]
-        head = order[offset:]
-        tail = self._order(epoch + 1)[:global_batch - len(head)]
-        return np.concatenate([head, tail])
+        parts = []
+        need = global_batch
+        while need > 0:  # a batch may span any number of epochs
+            order = self._order(epoch)
+            take = order[offset:offset + need]
+            parts.append(take)
+            need -= len(take)
+            epoch += 1
+            offset = 0
+        return np.concatenate(parts) if len(parts) > 1 else parts[0]
 
     def local_slice(self, indices: np.ndarray, rank: int, size: int
                     ) -> np.ndarray:

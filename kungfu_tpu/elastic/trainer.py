@@ -165,11 +165,21 @@ class ElasticTrainer:
     # ------------------------------------------------------------- state sync
     def sync_progress(self) -> int:
         """Allreduce-max of trained samples (reference: elastic.py:62-84
-        before_run sync); meaningful under multi-controller.  Uses exact
-        integer lanes — float32 would corrupt counters past 2^24 samples."""
-        x = np.full((self.n, 1), self.trained_samples, np.int64)
-        out = self.session.all_reduce(x, op="MAX")
-        self.trained_samples = int(np.asarray(out)[0, 0])
+        before_run sync); meaningful under multi-controller.
+
+        The counter crosses the collective as exact int32 words (jax
+        downcasts int64 to int32 without x64 mode, which would silently
+        wrap past 2^31 samples; float32 would corrupt past 2^24).  Two
+        max-rounds make the split lexicographically exact: first the high
+        word, then the low word restricted to holders of the winning high
+        word (elementwise max over both words at once could overshoot)."""
+        hi, lo = divmod(self.trained_samples, 1 << 31)
+        xhi = np.full((self.n, 1), hi, np.int32)
+        ghi = int(np.asarray(self.session.all_reduce(xhi, op="MAX"))[0, 0])
+        cand = lo if hi == ghi else -1
+        xlo = np.full((self.n, 1), cand, np.int32)
+        glo = int(np.asarray(self.session.all_reduce(xlo, op="MAX"))[0, 0])
+        self.trained_samples = (ghi << 31) + glo
         return self.trained_samples
 
     def current_params(self, lane: int = 0):

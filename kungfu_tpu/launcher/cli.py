@@ -44,7 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic config server URL")
     p.add_argument("-builtin-config-port", type=int, default=0,
                    help="embed a config server on this port")
-    p.add_argument("-port-range", default="31100-31199")
+    p.add_argument("-port-range", default="31100-31199",
+                   help="worker port range 'lo-hi' (reference: -port-range)")
     p.add_argument("-chips-per-host", type=int, default=0,
                    help="size of the local chip pool (0 = no pinning)")
     p.add_argument("-devices-per-worker", type=int, default=0,
@@ -73,8 +74,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         hl = HostList.parse(f"{args.self_host}:{max(args.np, 1)}")
 
-    cluster = Cluster.from_hostlist(hl, args.np)
+    try:
+        lo, hi = (int(x) for x in args.port_range.split("-"))
+    except ValueError:
+        print(f"error: bad -port-range {args.port_range!r}", file=sys.stderr)
+        return 2
+    cluster = Cluster.from_hostlist(hl, args.np, base_port=lo)
     cluster.validate()
+    if any(w.port > hi for w in cluster.workers):
+        print(f"error: -np {args.np} does not fit port range "
+              f"{args.port_range}", file=sys.stderr)
+        return 2
 
     config_url = args.config_server
     server = None

@@ -136,10 +136,10 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     global_size = initial.size()
     while True:
         w.reap()
-        w.retry_pending()
-        if w.failed is not None:
-            w.drain()
+        if w.failed is not None:  # check before retrying: a crashed worker
+            w.drain()             # must not be respawned on its way out
             return w.failed
+        w.retry_pending()
         if config_url:
             try:
                 version, cluster = fetch_config(config_url)

@@ -56,12 +56,18 @@ class RateCounter:
         with self._lock:
             return self._total
 
-    def rate(self, min_window: float = 0.05) -> float:
-        """Bytes/sec since last rate() call (rolls the window)."""
+    def rate(self, period: float = 1.0) -> float:
+        """Bytes/sec over the current sampling window.
+
+        Non-destructive for concurrent readers: the window only rolls
+        once it is at least ``period`` old, so a /metrics scrape and the
+        adaptation loop polling together both see the full rate
+        (reference: monitor.go computes rates on a fixed-period ticker).
+        """
         with self._lock:
             now = time.monotonic()
             dt = now - self._window_start
-            if dt < min_window:
+            if dt < period:
                 return self._last_rate
             self._last_rate = self._window_bytes / dt
             self._window_bytes = 0

@@ -1,0 +1,300 @@
+"""ctypes binding to the native C++ control-plane runtime (libkft_comm.so).
+
+Reference: the reference loads its Go/C++ runtime the same way — raw ctypes
+over a C ABI (srcs/python/kungfu/loader.py:11-14,
+srcs/python/kungfu/python/__init__.py:16-31).  pybind11 is not in the image,
+so the C ABI + ctypes is the binding layer here too.
+
+The native plane carries the *host-side* protocol between controller
+processes: barriers, consensus, host collectives over DCN, the p2p
+versioned model store, ping latencies, and egress monitoring.  Gradient
+and parameter traffic never touches it — that rides XLA collectives.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_NAME = "libkft_comm.so"
+
+# dtype/op/strategy enums — must match native/include/kft.h
+_DTYPES = {
+    np.dtype(np.uint8): 0, np.dtype(np.int8): 1, np.dtype(np.int16): 2,
+    np.dtype(np.int32): 3, np.dtype(np.int64): 4, np.dtype(np.float16): 5,
+    np.dtype(np.float32): 6, np.dtype(np.float64): 7,
+}
+OPS = {"SUM": 0, "MIN": 1, "MAX": 2, "PROD": 3}
+STRATEGIES = {"STAR": 0, "RING": 1, "BINARY_TREE": 2, "CLIQUE": 3, "AUTO": 4}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    return os.environ.get("KFT_NATIVE_LIB",
+                          os.path.join(_NATIVE_DIR, _LIB_NAME))
+
+
+def build(force: bool = False) -> str:
+    """Build libkft_comm.so with make (g++ is in the image)."""
+    path = lib_path()
+    if os.path.exists(path) and not force:
+        return path
+    subprocess.run(["make", "-C", _NATIVE_DIR] + (["-B"] if force else []),
+                   check=True, capture_output=True)
+    return path
+
+
+def available() -> bool:
+    try:
+        return _load() is not None
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = lib_path()
+        if not os.path.exists(path):
+            build()
+        lib = ctypes.CDLL(path)
+        c = ctypes.c_void_p
+        i32, i64, u32 = ctypes.c_int, ctypes.c_int64, ctypes.c_uint32
+        dbl, cstr = ctypes.c_double, ctypes.c_char_p
+        lib.kft_peer_new.restype = c
+        lib.kft_peer_new.argtypes = [i32, cstr, u32]
+        lib.kft_peer_start.argtypes = [c]
+        lib.kft_peer_stop.argtypes = [c]
+        lib.kft_peer_free.argtypes = [c]
+        for f in (lib.kft_rank, lib.kft_size):
+            f.argtypes = [c]
+            f.restype = i32
+        lib.kft_token.argtypes = [c]
+        lib.kft_token.restype = u32
+        lib.kft_reset_connections.argtypes = [c, u32]
+        lib.kft_barrier.argtypes = [c, cstr]
+        lib.kft_all_reduce.argtypes = [c, ctypes.c_void_p, ctypes.c_void_p,
+                                       i64, i32, i32, i32, cstr]
+        lib.kft_all_reduce_tree.argtypes = [
+            c, ctypes.c_void_p, ctypes.c_void_p, i64, i32, i32,
+            ctypes.POINTER(ctypes.c_int32), cstr]
+        lib.kft_broadcast.argtypes = [c, ctypes.c_void_p, i64, i32, cstr]
+        lib.kft_gather.argtypes = [c, ctypes.c_void_p, i64, ctypes.c_void_p,
+                                   i32, cstr]
+        lib.kft_all_gather.argtypes = [c, ctypes.c_void_p, i64,
+                                       ctypes.c_void_p, cstr]
+        lib.kft_consensus.argtypes = [c, ctypes.c_void_p, i64, cstr]
+        lib.kft_save.argtypes = [c, cstr, ctypes.c_void_p, i64, i64]
+        lib.kft_request.argtypes = [c, i32, cstr, ctypes.c_void_p, i64, i64]
+        lib.kft_egress_bytes.argtypes = [c, i32]
+        lib.kft_egress_bytes.restype = i64
+        lib.kft_egress_rate.argtypes = [c, i32]
+        lib.kft_egress_rate.restype = dbl
+        lib.kft_ping.argtypes = [c, i32, ctypes.POINTER(dbl)]
+        lib.kft_set_stall_threshold.argtypes = [c, dbl]
+        lib.kft_last_error.restype = cstr
+        _lib = lib
+        return _lib
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        err = _lib.kft_last_error().decode() if _lib else ""
+        raise NativeError(f"{what} failed: {err}")
+
+
+class NativePeer:
+    """One controller process in the host-plane cluster.
+
+    Reference analogue: kungfu::Peer (srcs/cpp/include/kungfu/peer.hpp) over
+    the Go runtime; here over the C++ runtime in /native.
+    """
+
+    def __init__(self, rank: int, peers: Sequence[str], token: int = 0):
+        lib = _load()
+        spec = ",".join(peers).encode()
+        self._lib = lib
+        self._h = lib.kft_peer_new(rank, spec, token)
+        if not self._h:
+            raise NativeError(
+                f"peer init failed: {lib.kft_last_error().decode()}")
+        self._started = False
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "NativePeer":
+        _check(self._lib.kft_peer_start(self._h), "start")
+        self._started = True
+        if os.environ.get("KFT_CONFIG_ENABLE_STALL_DETECTION", "") in (
+                "1", "true", "True"):
+            self.set_stall_threshold(30.0)
+        return self
+
+    def stop(self) -> None:
+        if self._h and self._started:
+            self._lib.kft_peer_stop(self._h)
+            self._started = False
+
+    def close(self) -> None:
+        self.stop()
+        if self._h:
+            self._lib.kft_peer_free(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativePeer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def rank(self) -> int:
+        return self._lib.kft_rank(self._h)
+
+    @property
+    def size(self) -> int:
+        return self._lib.kft_size(self._h)
+
+    @property
+    def token(self) -> int:
+        return self._lib.kft_token(self._h)
+
+    def reset_connections(self, token: int) -> None:
+        """Adopt a new cluster version; stale connections are fenced
+        (reference: peer.go updateTo / server.SetToken)."""
+        self._lib.kft_reset_connections(self._h, token)
+
+    # -------------------------------------------------------- collectives
+    def barrier(self, name: str = "barrier") -> None:
+        _check(self._lib.kft_barrier(self._h, name.encode()), "barrier")
+
+    def all_reduce(self, x: np.ndarray, op: str = "SUM",
+                   strategy: str = "AUTO", name: str = "allreduce"
+                   ) -> np.ndarray:
+        x = np.ascontiguousarray(x)
+        if x.dtype not in _DTYPES:
+            raise TypeError(f"unsupported dtype {x.dtype}")
+        out = np.empty_like(x)
+        _check(self._lib.kft_all_reduce(
+            self._h, x.ctypes.data, out.ctypes.data, x.size,
+            _DTYPES[x.dtype], OPS[op], STRATEGIES[strategy], name.encode()),
+            "all_reduce")
+        return out
+
+    def all_reduce_tree(self, x: np.ndarray, father: Sequence[int],
+                        op: str = "SUM", name: str = "allreduce"
+                        ) -> np.ndarray:
+        """Allreduce along an explicit reduce forest (father[i] == i marks
+        the root) — reference SimpleSetGlobalStrategy semantics."""
+        x = np.ascontiguousarray(x)
+        out = np.empty_like(x)
+        f = (ctypes.c_int32 * self.size)(*[int(v) for v in father])
+        _check(self._lib.kft_all_reduce_tree(
+            self._h, x.ctypes.data, out.ctypes.data, x.size,
+            _DTYPES[x.dtype], OPS[op], f, name.encode()), "all_reduce_tree")
+        return out
+
+    def broadcast(self, x: np.ndarray, root: int = 0,
+                  name: str = "bcast") -> np.ndarray:
+        out = np.ascontiguousarray(x).copy()
+        _check(self._lib.kft_broadcast(
+            self._h, out.ctypes.data, out.nbytes, root, name.encode()),
+            "broadcast")
+        return out
+
+    def gather(self, x: np.ndarray, root: int = 0,
+               name: str = "gather") -> Optional[np.ndarray]:
+        x = np.ascontiguousarray(x)
+        out = (np.empty((self.size,) + x.shape, x.dtype)
+               if self.rank == root else np.empty(0, x.dtype))
+        _check(self._lib.kft_gather(
+            self._h, x.ctypes.data, x.nbytes, out.ctypes.data, root,
+            name.encode()), "gather")
+        return out if self.rank == root else None
+
+    def all_gather(self, x: np.ndarray,
+                   name: str = "allgather") -> np.ndarray:
+        x = np.ascontiguousarray(x)
+        out = np.empty((self.size,) + x.shape, x.dtype)
+        _check(self._lib.kft_all_gather(
+            self._h, x.ctypes.data, x.nbytes, out.ctypes.data,
+            name.encode()), "all_gather")
+        return out
+
+    def consensus(self, payload: bytes, name: str = "consensus") -> bool:
+        """True iff every peer passed bit-identical bytes
+        (reference: BytesConsensus, session.go:111-151)."""
+        buf = np.frombuffer(payload, dtype=np.uint8).copy()
+        rc = self._lib.kft_consensus(self._h, buf.ctypes.data, buf.size,
+                                     name.encode())
+        if rc < 0:
+            _check(rc, "consensus")
+        return rc == 1
+
+    # ---------------------------------------------------------------- p2p
+    def save(self, name: str, x: np.ndarray, version: int = -1) -> None:
+        x = np.ascontiguousarray(x)
+        _check(self._lib.kft_save(self._h, name.encode(), x.ctypes.data,
+                                  x.nbytes, version), "save")
+
+    def request(self, target: int, name: str, like: np.ndarray,
+                version: int = -1) -> np.ndarray:
+        out = np.empty_like(np.ascontiguousarray(like))
+        _check(self._lib.kft_request(self._h, target, name.encode(),
+                                     out.ctypes.data, out.nbytes, version),
+               "request")
+        return out
+
+    # --------------------------------------------------------- monitoring
+    def egress_bytes(self, peer: int = -1) -> int:
+        return self._lib.kft_egress_bytes(self._h, peer)
+
+    def egress_rate(self, peer: int = -1) -> float:
+        return self._lib.kft_egress_rate(self._h, peer)
+
+    def ping(self, peer: int) -> float:
+        rtt = ctypes.c_double()
+        _check(self._lib.kft_ping(self._h, peer, ctypes.byref(rtt)), "ping")
+        return rtt.value
+
+    def peer_latencies(self) -> List[float]:
+        """RTT to every peer (reference: GetPeerLatencies,
+        session/monitoring.go:38-56)."""
+        return [self.ping(j) if j != self.rank else 0.0
+                for j in range(self.size)]
+
+    def set_stall_threshold(self, seconds: float) -> None:
+        self._lib.kft_set_stall_threshold(self._h, seconds)
+
+
+_default_peer: Optional[NativePeer] = None
+
+
+def default_peer() -> Optional[NativePeer]:
+    """NativePeer built from the KFT_* env ABI (one per worker process);
+    None in singleton mode."""
+    global _default_peer
+    if _default_peer is not None:
+        return _default_peer
+    from ..launcher import env as E
+    we = E.from_env()
+    if we.singleton or not len(we.peers):
+        return None
+    peers = [f"{p.host}:{p.port}" for p in we.peers]
+    _default_peer = NativePeer(we.rank(), peers,
+                               token=we.cluster_version).start()
+    return _default_peer

@@ -1,0 +1,145 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer models: blockwise online-softmax attention
+computed in VMEM, grid (batch, heads, q-blocks, k-blocks) with the k-block
+dimension innermost so the accumulator scratch carries across k-steps —
+the canonical TPU flash pattern (see /opt/skills/guides/pallas_guide.md,
+"Scratch Memory" + "Common Pitfalls").
+
+Inputs are [B, T, H, D].  The MXU sees [block_q, D] x [D, block_k] and
+[block_q, block_k] x [block_k, D] matmuls with
+``preferred_element_type=f32``; bf16 inputs are upcast per block.
+
+On CPU (tests, CI) the kernel runs with ``interpret=True``.  The backward
+pass recomputes attention densely via the reference path (ring attention
+— kungfu_tpu.parallel — is the memory-lean trainable path; this kernel
+targets single-chip inference/forward throughput).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # TPU lane width: scratch row-stat buffers are [bq, 128]
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *, causal, scale,
+               block_q, block_k, n_k):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+
+    # causal: skip k-blocks strictly above the diagonal
+    visible = True
+    if causal:
+        visible = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(visible)
+    def _attend():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m[:, :1]
+        s_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, s_max)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l[...] = jnp.broadcast_to(
+            corr * l[:, :1] + jnp.sum(p, axis=1, keepdims=True), l.shape)
+        m[...] = jnp.broadcast_to(m_new, m.shape)
+        acc[...] = acc[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        o_ref[0, :, 0, :] = (acc[...] /
+                             jnp.maximum(l[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    if T % block_q or Tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({T}, {Tk}) must divide block sizes "
+            f"({block_q}, {block_k})")
+    n_q, n_k = T // block_q, Tk // block_k
+    scale = 1.0 / np.sqrt(D)
+
+    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, D),
+                         lambda b, h, iq, ik: (b, iq, h, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, iq, ik: (b, ik, h, 0)),
+            pl.BlockSpec((1, block_k, 1, D),
+                         lambda b, h, iq, ik: (b, ik, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, D),
+                               lambda b, h, iq, ik: (b, iq, h, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """Pallas flash attention, [B, T, H, D] → [B, T, H, D]."""
+    return _flash_forward(q, k, v, causal, block_q, block_k,
+                          _auto_interpret())
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k):
+    out = _flash_forward(q, k, v, causal, block_q, block_k,
+                         _auto_interpret())
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, res, g):
+    # dense recompute backward; ring attention is the memory-lean path
+    from ..parallel.ring_attention import reference_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        functools.partial(reference_attention, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

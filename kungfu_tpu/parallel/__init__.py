@@ -1,0 +1,30 @@
+"""Parallelism strategies beyond data parallelism.
+
+The reference (Young768/KungFu) is a data-parallel framework — TP/PP/SP/EP
+are outside its envelope (see SURVEY.md §2.4).  On TPU these axes are
+natural extensions of the same mesh substrate, so this package provides
+them as first-class citizens:
+
+- :mod:`ring_attention` — sequence/context parallelism for long sequences
+  via a ``ppermute`` ring with online-softmax accumulation (blockwise ring
+  attention), plus Ulysses-style all-to-all head parallelism.
+- :mod:`fsdp` — ZeRO-style parameter/optimizer sharding built on
+  ``psum_scatter`` + ``all_gather``.
+- :mod:`tensor` — tensor-parallel layer helpers (column/row sharded
+  matmuls with compiled collectives).
+"""
+from .ring_attention import (make_ring_attention, make_ulysses_attention,
+                             reference_attention, ring_attention,
+                             ulysses_attention)
+from .fsdp import (fsdp_all_gather_params, fsdp_grad_sync, make_fsdp_step,
+                   shard_pytree_spec)
+from .tensor import column_parallel, row_parallel
+
+SEQ_AXIS = "sp"
+
+__all__ = [
+    "SEQ_AXIS", "ring_attention", "ulysses_attention",
+    "make_ring_attention", "make_ulysses_attention", "reference_attention",
+    "fsdp_all_gather_params", "fsdp_grad_sync", "make_fsdp_step",
+    "shard_pytree_spec", "column_parallel", "row_parallel",
+]

@@ -1,0 +1,112 @@
+"""FSDP/ZeRO-style sharded data parallelism.
+
+Parameters, gradients, and optimizer state live sharded over the mesh
+axis as one flat vector shard per device; each step all-gathers the
+parameters (bandwidth = one ring pass over ICI), computes local
+gradients, reduce-scatters them (``psum_scatter``), and updates only the
+local shard — ZeRO-3 semantics expressed as three XLA collectives that
+the compiler overlaps with compute.
+
+Extension beyond the reference framework (pure-DP; SURVEY.md §2.4): same
+Session/mesh substrate, one more way to lay out the state.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FSDP_AXIS = "fsdp"
+
+
+def shard_pytree_spec(mesh: Mesh, axis: str = FSDP_AXIS) -> NamedSharding:
+    """Sharding for the flat parameter vector: 1/n per device."""
+    return NamedSharding(mesh, P(axis))
+
+
+def _pad_to(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    pad = (-x.shape[0]) % multiple
+    return jnp.pad(x, (0, pad)) if pad else x
+
+
+def fsdp_grad_sync(flat_grads, axis_name: str):
+    """Mean-reduce-scatter of a flat gradient vector (ZeRO grad sync)."""
+    n = lax.axis_size(axis_name)
+    return lax.psum_scatter(flat_grads, axis_name, scatter_dimension=0,
+                            tiled=True) / n
+
+
+def fsdp_all_gather_params(param_shard, axis_name: str):
+    """Reassemble the full flat parameter vector from shards."""
+    return lax.all_gather(param_shard, axis_name, axis=0, tiled=True)
+
+
+def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
+                   axis: str = FSDP_AXIS
+                   ) -> Tuple[Callable, Callable]:
+    """Build ``(init, make_step)`` for fully-sharded training.
+
+    ``loss_fn(params, batch) -> scalar``; ``optimizer`` is any optax
+    gradient transformation.  Usage::
+
+        init, make_step = make_fsdp_step(loss_fn, opt, mesh)
+        param_shard, opt_state, meta = init(params)
+        step = make_step(meta)
+        param_shard, opt_state, loss = step(param_shard, opt_state, batch)
+
+    The batch must be sharded over the same axis (leading dim).
+    """
+    n = int(np.prod([mesh.shape[a] for a in (axis,)]))
+
+    def _state_specs(local_size: int, dtype):
+        """Per-leaf specs: leaves mirroring the local param shard are
+        sharded over ``axis``; scalar bookkeeping (Adam's count, …) is
+        replicated."""
+        shapes = jax.eval_shape(optimizer.init,
+                                jax.ShapeDtypeStruct((local_size,), dtype))
+        return jax.tree_util.tree_map(
+            lambda s: P(axis) if (getattr(s, "ndim", 0) == 1 and
+                                  s.shape[0] == local_size) else P(),
+            shapes)
+
+    def init(params):
+        flat, unravel = ravel_pytree(params)
+        size = flat.shape[0]
+        flat = _pad_to(flat, n)
+        local = flat.shape[0] // n
+        specs = _state_specs(local, flat.dtype)
+        sharding = shard_pytree_spec(mesh, axis)
+        flat = jax.device_put(flat, sharding)
+
+        opt_state = jax.jit(jax.shard_map(
+            optimizer.init, mesh=mesh, in_specs=P(axis),
+            out_specs=specs))(flat)
+        return flat, opt_state, (unravel, size, specs)
+
+    def make_step(meta):
+        unravel, size, specs = meta
+
+        def body(param_shard, opt_state, batch):
+            full = fsdp_all_gather_params(param_shard, axis)
+            params = unravel(full[:size])
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            gflat = _pad_to(ravel_pytree(grads)[0], n)
+            gshard = fsdp_grad_sync(gflat, axis)
+            updates, new_opt = optimizer.update(gshard, opt_state,
+                                                param_shard)
+            new_param = param_shard + updates
+            return new_param, new_opt, lax.pmean(loss, axis)
+
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(axis), specs, P(axis)),
+            out_specs=(P(axis), specs, P()))
+        return jax.jit(fn)
+
+    return init, make_step

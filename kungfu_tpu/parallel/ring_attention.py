@@ -1,0 +1,156 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context scaling on TPU.  Sequences are sharded over a mesh axis; the
+two classic schedules are provided:
+
+- **Ring attention**: KV shards circulate around the ring via
+  ``lax.ppermute`` while each device accumulates its queries' attention
+  over every chunk with the online-softmax (flash) recurrence.  Peak
+  memory is O(T/n) per device and the ppermute overlaps with the block
+  compute inside one XLA program over ICI.
+- **Ulysses**: ``lax.all_to_all`` re-shards from sequence-sharded to
+  head-sharded, runs dense local attention, and re-shards back.  Cheaper
+  for moderate sequence lengths when heads >= ring size.
+
+The reference framework has no sequence axis (SURVEY.md §5 "long-context:
+absent") — this is a TPU-native extension, not reference parity; it rides
+the same mesh/collective substrate as the DP engine.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, acc, m, l, bias):
+    """One online-softmax accumulation step (flash recurrence).
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; acc: [B, Tq, H, D];
+    m, l: [B, Tq, H] running max / normalizer; bias: [Tq, Tk] additive.
+    """
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = s + bias[None, None, :, :]
+    s_max = jnp.max(s, axis=-1)                      # [B, H, Tq]
+    m_new = jnp.maximum(m, s_max.transpose(0, 2, 1))  # [B, Tq, H]
+    p = jnp.exp(s - m_new.transpose(0, 2, 1)[:, :, :, None])  # [B,H,Tq,Tk]
+    corr = jnp.exp(m - m_new)                        # [B, Tq, H]
+    l_new = corr * l + jnp.sum(p, axis=-1).transpose(0, 2, 1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_new = acc * corr[:, :, :, None] + pv
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Blockwise ring attention over sequence shards.
+
+    Must run inside ``shard_map`` over ``axis_name``.  All of q, k, v are
+    the local sequence shard ``[B, T_local, H, D]``; the global sequence is
+    the concatenation over ranks in rank order.  Returns the local output
+    shard ``[B, T_local, H, D]``.
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    T = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = rank * T + jnp.arange(T)                 # global query positions
+
+    qf = q.astype(jnp.float32)
+    # init derived from qf so the carry is axis-varying under shard_map
+    acc = qf * 0.0
+    m = qf[..., 0] * 0.0 + NEG_INF
+    l = qf[..., 0] * 0.0
+
+    def body(step, carry):
+        acc, m, l, kc, vc = carry
+        # current chunk originated at rank - step (mod n)
+        src = (rank - step + n) % n
+        k_pos = src * T + jnp.arange(T)
+        if causal:
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)
+        else:
+            bias = jnp.zeros((T, T), jnp.float32)
+        acc, m, l = _block_attend(qf, kc.astype(jnp.float32),
+                                  vc.astype(jnp.float32), acc, m, l, bias)
+        # rotate KV around the ring (skippable on the last step, but a
+        # static ppermute inside scan keeps the schedule uniform)
+        kc = lax.ppermute(kc, axis_name, perm=perm)
+        vc = lax.ppermute(vc, axis_name, perm=perm)
+        return acc, m, l, kc, vc
+
+    acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc, m, l, k, v))
+    # causal: every query row has attended at least its own position → l > 0
+    out = acc / jnp.maximum(l, 1e-30)[:, :, :, None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """All-to-all (Ulysses/DeepSpeed-style) sequence parallelism.
+
+    Inside ``shard_map``: re-shard [B, T/n, H, D] → [B, T, H/n, D] with one
+    ``all_to_all``, run dense local attention on full sequences for the
+    local head group, then re-shard back.  Requires H % n == 0.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(f"heads {q.shape[2]} not divisible by ring {n}")
+
+    def to_heads(x):   # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):     # [B, T, H/n, D] -> [B, T/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = reference_attention(qh, kh, vh, causal=causal)
+    return to_seq(out)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Dense softmax attention — the correctness oracle and the local
+    kernel inside Ulysses.  [B, T, H, D] layout."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[2], s.shape[3]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _seq_specs(axis: str):
+    return P(None, axis, None, None)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "sp",
+                        causal: bool = False):
+    """Jitted [B, T, H, D] attention with T sharded over ``mesh[axis]``."""
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(_seq_specs(axis),) * 3,
+        out_specs=_seq_specs(axis))
+    return jax.jit(fn)
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = "sp",
+                           causal: bool = False):
+    """Jitted [B, T, H, D] attention, Ulysses schedule."""
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(_seq_specs(axis),) * 3,
+        out_specs=_seq_specs(axis))
+    return jax.jit(fn)

@@ -1,0 +1,36 @@
+"""Tensor-parallel building blocks (Megatron-style column/row sharding).
+
+Inside ``shard_map`` over a ``tp`` mesh axis:
+
+- ``column_parallel``: weight sharded on the output feature dim — the
+  matmul needs no communication; activations come out feature-sharded.
+- ``row_parallel``: weight sharded on the input feature dim — one
+  ``psum`` completes the contraction and restores replicated activations.
+
+The canonical MLP block is ``column_parallel`` → activation →
+``row_parallel`` → one psum total, which XLA overlaps with the second
+matmul over ICI.  Extension beyond the reference framework (SURVEY.md
+§2.4: TP absent there).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel(x, w_local, b_local=None):
+    """x: [..., d_in] replicated; w_local: [d_in, d_out/n].
+    Returns [..., d_out/n] feature-sharded activations; no collective."""
+    y = jnp.dot(x, w_local)
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel(x_local, w_local, axis_name: str, b=None):
+    """x_local: [..., d_in/n] feature-sharded; w_local: [d_in/n, d_out].
+    One psum over ``axis_name`` restores replicated [..., d_out]."""
+    y = lax.psum(jnp.dot(x_local, w_local), axis_name)
+    if b is not None:
+        y = y + b
+    return y

@@ -83,5 +83,7 @@ class Cluster:
         return hashlib.sha256(self.to_json().encode()).digest()[:16]
 
     @staticmethod
-    def from_hostlist(hl: HostList, np: int) -> "Cluster":
-        return Cluster(runners=hl.gen_runner_list(), workers=hl.gen_peer_list(np))
+    def from_hostlist(hl: HostList, np: int,
+                      base_port: int = DEFAULT_WORKER_PORT) -> "Cluster":
+        return Cluster(runners=hl.gen_runner_list(),
+                       workers=hl.gen_peer_list(np, base_port=base_port))

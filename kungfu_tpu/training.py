@@ -58,12 +58,9 @@ def broadcast_variables(stacked, mesh: Optional[Mesh] = None, root: int = 0):
     axis = mesh.axis_names[0]
 
     def body(tree):
-        def bc(t):
-            v = t[0]  # this lane's replica
-            idx = jax.lax.axis_index(axis)
-            mask = (idx == root).astype(v.dtype)
-            return jax.lax.psum(v * mask, axis)[None]
-        return jax.tree_util.tree_map(bc, tree)
+        # one masked psum per leaf — the collective lives in comm.collectives
+        return jax.tree_util.tree_map(
+            lambda t: C.broadcast(t[0], axis, root)[None], tree)
 
     fn = jax.jit(jax.shard_map(body, mesh=mesh,
                                in_specs=_stack_spec(mesh),

@@ -1,0 +1,118 @@
+/* kft — native control-plane runtime for kungfu_tpu.
+ *
+ * C ABI consumed by Python via ctypes (no pybind11 in the image).
+ *
+ * Role: the host-side communication plane between controller processes —
+ * membership fencing, barriers, consensus, host collectives over DCN, the
+ * p2p model store for asynchronous training, and traffic monitoring.  The
+ * compute plane (gradients, parameters) rides XLA collectives over ICI and
+ * never touches this library.
+ *
+ * Reference parity (behavior, not code): the Go runtime of KungFu —
+ * srcs/go/rchannel/ (framed TCP transport, connection classes, token
+ * fencing), srcs/go/kungfu/session/ (graph collectives, consensus),
+ * srcs/go/store/ (versioned blob store), srcs/go/monitor/ (egress rates),
+ * srcs/go/libkungfu-comm/ (C ABI surface).
+ */
+#ifndef KFT_H
+#define KFT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+    KFT_U8 = 0,
+    KFT_I8 = 1,
+    KFT_I16 = 2,
+    KFT_I32 = 3,
+    KFT_I64 = 4,
+    KFT_F16 = 5,
+    KFT_F32 = 6,
+    KFT_F64 = 7,
+} kft_dtype;
+
+typedef enum {
+    KFT_SUM = 0,
+    KFT_MIN = 1,
+    KFT_MAX = 2,
+    KFT_PROD = 3,
+} kft_op;
+
+/* Host-plane collective strategies (subset of the reference's 8 graph
+ * strategies that is meaningful for a control plane; the compute plane's
+ * topology belongs to XLA). */
+typedef enum {
+    KFT_STRAT_STAR = 0,
+    KFT_STRAT_RING = 1,
+    KFT_STRAT_BINARY_TREE = 2,
+    KFT_STRAT_CLIQUE = 3,
+    KFT_STRAT_AUTO = 4,
+} kft_strategy;
+
+typedef struct kft_peer kft_peer;
+
+/* peers_csv: "host:port,host:port,..." — rank indexes this list.
+ * token: cluster version used to fence stale connections. */
+kft_peer *kft_peer_new(int rank, const char *peers_csv, uint32_t token);
+int kft_peer_start(kft_peer *);  /* bind+listen, start service threads */
+void kft_peer_stop(kft_peer *);  /* close sockets, join threads */
+void kft_peer_free(kft_peer *);
+
+int kft_rank(const kft_peer *);
+int kft_size(const kft_peer *);
+uint32_t kft_token(const kft_peer *);
+
+/* Elastic fencing: drop all outbound connections and adopt a new cluster
+ * version; later inbound connections with a stale token are rejected. */
+int kft_reset_connections(kft_peer *, uint32_t token);
+
+/* ---- collectives (blocking; name disambiguates concurrent ops) ---- */
+int kft_barrier(kft_peer *, const char *name);
+int kft_all_reduce(kft_peer *, const void *sendbuf, void *recvbuf,
+                   int64_t count, kft_dtype dtype, kft_op op,
+                   kft_strategy strategy, const char *name);
+/* Explicit reduce forest: father[i] == i marks a root
+ * (reference: SimpleSetGlobalStrategy / AllReduceWith). */
+int kft_all_reduce_tree(kft_peer *, const void *sendbuf, void *recvbuf,
+                        int64_t count, kft_dtype dtype, kft_op op,
+                        const int32_t *father, const char *name);
+int kft_broadcast(kft_peer *, void *buf, int64_t nbytes, int root,
+                  const char *name);
+int kft_gather(kft_peer *, const void *sendbuf, int64_t nbytes,
+               void *recvbuf /* size*nbytes, root only */, int root,
+               const char *name);
+int kft_all_gather(kft_peer *, const void *sendbuf, int64_t nbytes,
+                   void *recvbuf /* size*nbytes */, const char *name);
+/* 1 = all peers hold bit-identical buffers, 0 = divergence, <0 = error.
+ * (reference: allreduce-MIN vs allreduce-MAX equality, session.go:111-151) */
+int kft_consensus(kft_peer *, const void *buf, int64_t nbytes,
+                  const char *name);
+
+/* ---- p2p versioned model store (reference: srcs/go/store/) ---- */
+int kft_save(kft_peer *, const char *name, const void *buf, int64_t nbytes,
+             int64_t version); /* version < 0: unversioned slot */
+/* Fetch blob `name` from peer `target` into buf (exact size match
+ * required); version < 0 means latest. */
+int kft_request(kft_peer *, int target, const char *name, void *buf,
+                int64_t nbytes, int64_t version);
+
+/* ---- monitoring (reference: srcs/go/monitor/) ---- */
+int64_t kft_egress_bytes(const kft_peer *, int peer /* -1: total */);
+double kft_egress_rate(const kft_peer *, int peer /* -1: total */);
+int kft_ping(kft_peer *, int peer, double *rtt_ms);
+/* Log any op pending longer than `seconds` (reference: InstallStallDetector);
+ * seconds <= 0 disables. */
+void kft_set_stall_threshold(kft_peer *, double seconds);
+
+/* Message of the last error on this thread ("" if none). */
+const char *kft_last_error(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* KFT_H */

@@ -1,0 +1,298 @@
+// Internal C++ types for the kft runtime. Not part of the public ABI.
+#ifndef KFT_INTERNAL_H
+#define KFT_INTERNAL_H
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "../include/kft.h"
+
+namespace kft {
+
+using Clock = std::chrono::steady_clock;
+using Bytes = std::vector<uint8_t>;
+
+void set_error(const std::string &msg);
+
+// ---------------------------------------------------------------- message
+// Frame layout (little-endian, own design; role mirrors the reference's
+// name-framed messages in srcs/go/rchannel/connection/message.go):
+//   magic u32 | cls u8 | flags u8 | pad u16 | token u32 |
+//   name_len u32 | body_len u64 | name bytes | body bytes
+enum MsgClass : uint8_t {
+    CLS_HELLO = 0,
+    CLS_PING = 1,
+    CLS_CONTROL = 2,
+    CLS_COLLECTIVE = 3,
+    CLS_P2P = 4,
+};
+
+enum MsgFlags : uint8_t {
+    FLAG_RESPONSE = 1 << 0,
+    FLAG_FAILED = 1 << 1,
+    FLAG_SAVE = 1 << 2,  // CLS_P2P: save request (else: fetch request)
+};
+
+constexpr uint32_t MSG_MAGIC = 0x4B465431;  // "KFT1"
+constexpr uint64_t MAX_BODY = uint64_t(1) << 34;  // 16 GiB sanity bound
+
+struct Msg {
+    uint8_t cls = 0;
+    uint8_t flags = 0;
+    uint32_t token = 0;
+    std::string name;
+    Bytes body;
+};
+
+// Blocking full-buffer socket IO; false on EOF/error.
+bool write_all(int fd, const void *buf, size_t n);
+bool read_all(int fd, void *buf, size_t n);
+bool send_msg(int fd, const Msg &m);
+bool recv_msg(int fd, Msg *m);
+
+// ------------------------------------------------------------------ queue
+template <typename T>
+class WaitQueue {
+  public:
+    void push(T v) {
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            q_.push_back(std::move(v));
+        }
+        cv_.notify_one();
+    }
+    // false on timeout or close.
+    bool pop(T *out, double timeout_s) {
+        std::unique_lock<std::mutex> g(mu_);
+        auto pred = [&] { return closed_ || !q_.empty(); };
+        if (timeout_s <= 0) {
+            cv_.wait(g, pred);
+        } else if (!cv_.wait_for(
+                       g, std::chrono::duration<double>(timeout_s), pred)) {
+            return false;
+        }
+        if (q_.empty()) return false;  // closed
+        *out = std::move(q_.front());
+        q_.pop_front();
+        return true;
+    }
+    void close() {
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            closed_ = true;
+        }
+        cv_.notify_all();
+    }
+
+  private:
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::deque<T> q_;
+    bool closed_ = false;
+};
+
+// --------------------------------------------------------------- endpoint
+// Rendezvous for named collective messages keyed by (src rank, name)
+// (reference: CollectiveEndpoint waitQ/recvQ, handler/collective.go:10-41).
+class CollectiveEndpoint {
+  public:
+    void push(int src, const std::string &name, Bytes body) {
+        queue_for(src, name)->push(std::move(body));
+    }
+    bool recv(int src, const std::string &name, Bytes *out,
+              double timeout_s) {
+        return queue_for(src, name)->pop(out, timeout_s);
+    }
+    void close_all() {
+        std::lock_guard<std::mutex> g(mu_);
+        for (auto &kv : queues_) kv.second->close();
+    }
+
+  private:
+    using Key = std::pair<int, std::string>;
+    std::shared_ptr<WaitQueue<Bytes>> queue_for(int src,
+                                                const std::string &name) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto &q = queues_[{src, name}];
+        if (!q) q = std::make_shared<WaitQueue<Bytes>>();
+        return q;
+    }
+    std::mutex mu_;
+    std::map<Key, std::shared_ptr<WaitQueue<Bytes>>> queues_;
+};
+
+// ------------------------------------------------------------------ store
+// Versioned blob store with sliding-window GC
+// (reference: srcs/go/store/versionedstore.go:7-61, window = 3).
+class BlobStore {
+  public:
+    explicit BlobStore(int window = 3) : window_(window) {}
+
+    // Returns false on size conflict with an existing same-version blob.
+    bool save(const std::string &name, int64_t version, const void *data,
+              size_t n) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto &versions = blobs_[name];
+        auto it = versions.find(version);
+        if (it != versions.end() && it->second.size() != n) return false;
+        versions[version].assign(static_cast<const uint8_t *>(data),
+                                 static_cast<const uint8_t *>(data) + n);
+        // GC: keep the `window_` highest versions (unversioned slot -1 kept).
+        while (window_ > 0) {
+            int64_t lo = versions.begin()->first;
+            if (lo < 0 || static_cast<int>(versions.size()) <=
+                              window_ + (versions.count(-1) ? 1 : 0))
+                break;
+            versions.erase(versions.begin());
+        }
+        return true;
+    }
+
+    // version < 0: latest. Returns false if absent.
+    bool load(const std::string &name, int64_t version, Bytes *out) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = blobs_.find(name);
+        if (it == blobs_.end() || it->second.empty()) return false;
+        auto &versions = it->second;
+        if (version < 0) {
+            *out = versions.rbegin()->second;
+            return true;
+        }
+        auto vi = versions.find(version);
+        if (vi == versions.end()) return false;
+        *out = vi->second;
+        return true;
+    }
+
+  private:
+    std::mutex mu_;
+    int window_;
+    std::map<std::string, std::map<int64_t, Bytes>> blobs_;
+};
+
+// ---------------------------------------------------------------- monitor
+// Egress byte counters + windowed rates
+// (reference: srcs/go/monitor/counters.go, rate over a ticker period).
+class EgressMonitor {
+  public:
+    explicit EgressMonitor(int npeers)
+        : counters_(npeers), snap_bytes_(npeers, 0), snap_rate_(npeers, 0.0),
+          snap_time_(Clock::now()) {
+        for (auto &c : counters_) c.store(0);
+    }
+    void add(int peer, int64_t n) {
+        if (peer >= 0 && peer < static_cast<int>(counters_.size()))
+            counters_[peer].fetch_add(n, std::memory_order_relaxed);
+    }
+    int64_t bytes(int peer) const {
+        if (peer < 0) {
+            int64_t t = 0;
+            for (auto &c : counters_) t += c.load(std::memory_order_relaxed);
+            return t;
+        }
+        if (peer >= static_cast<int>(counters_.size())) return 0;
+        return counters_[peer].load(std::memory_order_relaxed);
+    }
+    // Called periodically by the service thread.
+    void tick() {
+        std::lock_guard<std::mutex> g(mu_);
+        auto now = Clock::now();
+        double dt = std::chrono::duration<double>(now - snap_time_).count();
+        if (dt <= 0) return;
+        for (size_t i = 0; i < counters_.size(); i++) {
+            int64_t cur = counters_[i].load(std::memory_order_relaxed);
+            snap_rate_[i] = double(cur - snap_bytes_[i]) / dt;
+            snap_bytes_[i] = cur;
+        }
+        snap_time_ = now;
+    }
+    double rate(int peer) const {
+        std::lock_guard<std::mutex> g(mu_);
+        if (peer < 0) {
+            double t = 0;
+            for (double r : snap_rate_) t += r;
+            return t;
+        }
+        if (peer >= static_cast<int>(snap_rate_.size())) return 0.0;
+        return snap_rate_[peer];
+    }
+
+  private:
+    std::vector<std::atomic<int64_t>> counters_;
+    mutable std::mutex mu_;
+    std::vector<int64_t> snap_bytes_;
+    std::vector<double> snap_rate_;
+    Clock::time_point snap_time_;
+};
+
+// Ops pending longer than a threshold get logged
+// (reference: utils.InstallStallDetector).
+class StallTracker {
+  public:
+    struct Scope {
+        StallTracker *t;
+        uint64_t id;
+        ~Scope() { t->finish(id); }
+    };
+    Scope begin(const std::string &what) {
+        std::lock_guard<std::mutex> g(mu_);
+        uint64_t id = next_++;
+        pending_[id] = {what, Clock::now(), false};
+        return Scope{this, id};
+    }
+    void finish(uint64_t id) {
+        std::lock_guard<std::mutex> g(mu_);
+        pending_.erase(id);
+    }
+    void set_threshold(double s) { threshold_.store(s); }
+    void check(int self_rank);  // logs stalled ops to stderr
+
+  private:
+    struct Entry {
+        std::string what;
+        Clock::time_point start;
+        bool reported;
+    };
+    std::mutex mu_;
+    uint64_t next_ = 0;
+    std::map<uint64_t, Entry> pending_;
+    std::atomic<double> threshold_{0.0};
+};
+
+// ------------------------------------------------------------- connection
+struct Conn {
+    int fd = -1;
+    int remote_rank = -1;
+    std::mutex write_mu;    // one frame at a time
+    std::mutex request_mu;  // serialize request/response round trips
+    WaitQueue<Msg> responses;
+    std::thread reader;
+    std::atomic<bool> alive{true};
+};
+
+struct PeerAddr {
+    std::string host;
+    int port;
+};
+
+// ------------------------------------------------------------ dtype utils
+size_t dtype_size(kft_dtype dt);
+// recv = reduce(recv, incoming) elementwise, in place
+// (reference: std_transform_2, srcs/go/kungfu/base/op.cpp:22-40).
+void reduce_inplace(void *acc, const void *in, int64_t count, kft_dtype dt,
+                    kft_op op);
+
+}  // namespace kft
+
+#endif  // KFT_INTERNAL_H

@@ -1,0 +1,846 @@
+// Peer: server + connection pool + host-plane collectives + p2p store.
+//
+// Behavioral reference (not a translation): srcs/go/kungfu/peer/peer.go,
+// srcs/go/kungfu/session/session.go, srcs/go/rchannel/.  Dedicated reader
+// threads drain every connection, so blocking sends can never deadlock a
+// collective round — the property the reference gets from goroutines.
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <sstream>
+
+#include "internal.h"
+
+namespace kft {
+
+const std::string &last_error();
+
+static double env_double(const char *key, double dflt) {
+    const char *v = std::getenv(key);
+    return v ? std::atof(v) : dflt;
+}
+
+static int env_int(const char *key, int dflt) {
+    const char *v = std::getenv(key);
+    return v ? std::atoi(v) : dflt;
+}
+
+class Peer {
+  public:
+    Peer(int rank, std::vector<PeerAddr> peers, uint32_t token)
+        : rank_(rank), peers_(std::move(peers)), token_(token),
+          monitor_(int(peers_.size())),
+          recv_timeout_(env_double("KFT_RECV_TIMEOUT_S", 120.0)),
+          conn_retries_(env_int("KFT_CONN_RETRIES", 150)),
+          conn_retry_ms_(env_int("KFT_CONN_RETRY_MS", 200)) {}
+
+    ~Peer() { stop(); }
+
+    int rank() const { return rank_; }
+    int size() const { return int(peers_.size()); }
+    uint32_t token() const { return token_.load(); }
+
+    bool start() {
+        listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (listen_fd_ < 0) {
+            set_error("socket() failed");
+            return false;
+        }
+        int one = 1;
+        ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = INADDR_ANY;
+        addr.sin_port = htons(uint16_t(peers_[rank_].port));
+        if (::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
+                   sizeof(addr)) != 0 ||
+            ::listen(listen_fd_, 128) != 0) {
+            set_error("bind/listen failed on port " +
+                      std::to_string(peers_[rank_].port));
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+            return false;
+        }
+        running_ = true;
+        accept_thread_ = std::thread([this] { accept_loop(); });
+        service_thread_ = std::thread([this] { service_loop(); });
+        return true;
+    }
+
+    void stop() {
+        if (!running_.exchange(false)) return;
+        if (listen_fd_ >= 0) {
+            ::shutdown(listen_fd_, SHUT_RDWR);
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+        }
+        endpoint_.close_all();
+        {
+            std::lock_guard<std::mutex> g(conns_mu_);
+            for (auto &kv : out_conns_) close_conn(kv.second);
+            for (auto &c : in_conns_) close_conn(c);
+        }
+        if (accept_thread_.joinable()) accept_thread_.join();
+        if (service_thread_.joinable()) service_thread_.join();
+        {
+            std::lock_guard<std::mutex> g(conns_mu_);
+            for (auto &kv : out_conns_)
+                if (kv.second->reader.joinable()) kv.second->reader.join();
+            for (auto &c : in_conns_)
+                if (c->reader.joinable()) c->reader.join();
+            for (auto &c : graveyard_) {
+                close_conn(c);
+                if (c->reader.joinable()) c->reader.join();
+            }
+            out_conns_.clear();
+            in_conns_.clear();
+            graveyard_.clear();
+        }
+    }
+
+    // Elastic fencing: adopt new version, drop outbound pool
+    // (reference: router.ResetConnections + server.SetToken, peer.go:144-166).
+    void reset_connections(uint32_t token) {
+        token_.store(token);
+        std::lock_guard<std::mutex> g(conns_mu_);
+        for (auto &kv : out_conns_) close_conn(kv.second);
+        for (auto &kv : out_conns_)
+            if (kv.second->reader.joinable()) kv.second->reader.join();
+        out_conns_.clear();
+    }
+
+    // -------------------------------------------------------- collectives
+    bool all_reduce_tree(const void *send, void *recv, int64_t count,
+                         kft_dtype dt, kft_op op,
+                         const std::vector<int32_t> &father,
+                         const std::string &name) {
+        auto scope = stalls_.begin("all_reduce:" + name);
+        size_t nbytes = size_t(count) * dtype_size(dt);
+        std::memcpy(recv, send, nbytes);
+        if (size() == 1) return true;
+        std::vector<int> children;
+        for (int j = 0; j < size(); j++)
+            if (j != rank_ && father[j] == rank_) children.push_back(j);
+        // reduce phase: leaves → root
+        Bytes incoming;
+        for (int c : children) {
+            if (!recv_named(c, name + "|r", &incoming)) return false;
+            if (incoming.size() != nbytes) {
+                set_error("all_reduce size mismatch from child");
+                return false;
+            }
+            reduce_inplace(recv, incoming.data(), count, dt, op);
+        }
+        if (father[rank_] != rank_) {
+            if (!send_named(father[rank_], name + "|r", recv, nbytes))
+                return false;
+            if (!recv_named(father[rank_], name + "|b", &incoming))
+                return false;
+            std::memcpy(recv, incoming.data(), nbytes);
+        }
+        for (int c : children)
+            if (!send_named(c, name + "|b", recv, nbytes)) return false;
+        return true;
+    }
+
+    bool all_reduce_ring(const void *send, void *recv, int64_t count,
+                         kft_dtype dt, kft_op op, const std::string &name) {
+        auto scope = stalls_.begin("ring_all_reduce:" + name);
+        int n = size();
+        size_t esz = dtype_size(dt);
+        std::memcpy(recv, send, size_t(count) * esz);
+        if (n == 1) return true;
+        // chunk boundaries (even partition of the element range)
+        std::vector<int64_t> begin(n + 1);
+        for (int i = 0; i <= n; i++) begin[i] = count * i / n;
+        auto chunk = [&](int i) {
+            return static_cast<uint8_t *>(recv) + begin[i] * esz;
+        };
+        auto chunk_bytes = [&](int i) {
+            return size_t(begin[i + 1] - begin[i]) * esz;
+        };
+        int next = (rank_ + 1) % n, prev = (rank_ + n - 1) % n;
+        Bytes incoming;
+        // reduce-scatter: after n-1 steps rank owns the full reduction of
+        // chunk (rank+1) % n
+        for (int s = 0; s < n - 1; s++) {
+            int send_idx = (rank_ - s + n) % n;
+            int recv_idx = (rank_ - s - 1 + n) % n;
+            if (!send_named(next, name + "|rs" + std::to_string(s),
+                            chunk(send_idx), chunk_bytes(send_idx)))
+                return false;
+            if (!recv_named(prev, name + "|rs" + std::to_string(s),
+                            &incoming))
+                return false;
+            reduce_inplace(chunk(recv_idx), incoming.data(),
+                           begin[recv_idx + 1] - begin[recv_idx], dt, op);
+        }
+        // allgather: circulate the finished chunks
+        for (int s = 0; s < n - 1; s++) {
+            int send_idx = (rank_ + 1 - s + n) % n;
+            int recv_idx = (rank_ - s + n) % n;
+            if (!send_named(next, name + "|ag" + std::to_string(s),
+                            chunk(send_idx), chunk_bytes(send_idx)))
+                return false;
+            if (!recv_named(prev, name + "|ag" + std::to_string(s),
+                            &incoming))
+                return false;
+            std::memcpy(chunk(recv_idx), incoming.data(), incoming.size());
+        }
+        return true;
+    }
+
+    // Full exchange; deterministic rank-order fold (reference clique).
+    bool all_reduce_clique(const void *send, void *recv, int64_t count,
+                           kft_dtype dt, kft_op op, const std::string &name) {
+        auto scope = stalls_.begin("clique_all_reduce:" + name);
+        size_t nbytes = size_t(count) * dtype_size(dt);
+        int n = size();
+        if (n == 1) {
+            std::memcpy(recv, send, nbytes);
+            return true;
+        }
+        for (int j = 0; j < n; j++)
+            if (j != rank_ && !send_named(j, name + "|x", send, nbytes))
+                return false;
+        std::vector<Bytes> bufs(n);
+        for (int j = 0; j < n; j++) {
+            if (j == rank_) continue;
+            if (!recv_named(j, name + "|x", &bufs[j])) return false;
+        }
+        std::memcpy(recv, send, nbytes);
+        Bytes own(static_cast<const uint8_t *>(send),
+                  static_cast<const uint8_t *>(send) + nbytes);
+        // fold in rank order starting from rank 0 for determinism
+        std::memcpy(recv, rank_ == 0 ? own.data() : bufs[0].data(), nbytes);
+        for (int j = 1; j < n; j++) {
+            const uint8_t *src = (j == rank_) ? own.data() : bufs[j].data();
+            reduce_inplace(recv, src, count, dt, op);
+        }
+        return true;
+    }
+
+    bool all_reduce(const void *send, void *recv, int64_t count, kft_dtype dt,
+                    kft_op op, kft_strategy strat, const std::string &name) {
+        size_t nbytes = size_t(count) * dtype_size(dt);
+        if (strat == KFT_STRAT_AUTO)
+            strat = (nbytes >= (1u << 20) && size() > 2) ? KFT_STRAT_RING
+                                                         : KFT_STRAT_BINARY_TREE;
+        switch (strat) {
+            case KFT_STRAT_RING:
+                return all_reduce_ring(send, recv, count, dt, op, name);
+            case KFT_STRAT_CLIQUE:
+                return all_reduce_clique(send, recv, count, dt, op, name);
+            case KFT_STRAT_STAR: {
+                std::vector<int32_t> father(size(), 0);
+                return all_reduce_tree(send, recv, count, dt, op, father,
+                                       name);
+            }
+            case KFT_STRAT_BINARY_TREE:
+            default: {
+                std::vector<int32_t> father(size());
+                for (int i = 0; i < size(); i++)
+                    father[i] = i == 0 ? 0 : (i - 1) / 2;
+                return all_reduce_tree(send, recv, count, dt, op, father,
+                                       name);
+            }
+        }
+    }
+
+    bool broadcast(void *buf, int64_t nbytes, int root,
+                   const std::string &name) {
+        auto scope = stalls_.begin("broadcast:" + name);
+        int n = size();
+        if (n == 1) return true;
+        // binary tree rooted at `root` via virtual-rank rotation
+        int v = (rank_ - root + n) % n;
+        int vfather = (v - 1) / 2;
+        int father = (vfather + root) % n;
+        Bytes incoming;
+        if (v != 0) {
+            if (!recv_named(father, name + "|b", &incoming)) return false;
+            if (int64_t(incoming.size()) != nbytes) {
+                set_error("broadcast size mismatch");
+                return false;
+            }
+            std::memcpy(buf, incoming.data(), size_t(nbytes));
+        }
+        for (int vc : {2 * v + 1, 2 * v + 2}) {
+            if (vc >= n) continue;
+            int child = (vc + root) % n;
+            if (!send_named(child, name + "|b", buf, size_t(nbytes)))
+                return false;
+        }
+        return true;
+    }
+
+    bool gather(const void *send, int64_t nbytes, void *recv, int root,
+                const std::string &name) {
+        auto scope = stalls_.begin("gather:" + name);
+        if (rank_ != root)
+            return size() == 1 ||
+                   send_named(root, name + "|g", send, size_t(nbytes));
+        Bytes incoming;
+        for (int j = 0; j < size(); j++) {
+            uint8_t *dst = static_cast<uint8_t *>(recv) + j * nbytes;
+            if (j == rank_) {
+                std::memcpy(dst, send, size_t(nbytes));
+                continue;
+            }
+            if (!recv_named(j, name + "|g", &incoming)) return false;
+            if (int64_t(incoming.size()) != nbytes) {
+                set_error("gather size mismatch");
+                return false;
+            }
+            std::memcpy(dst, incoming.data(), size_t(nbytes));
+        }
+        return true;
+    }
+
+    // Direct full exchange (reference: allgather.go:17-45).
+    bool all_gather(const void *send, int64_t nbytes, void *recv,
+                    const std::string &name) {
+        auto scope = stalls_.begin("all_gather:" + name);
+        int n = size();
+        for (int j = 0; j < n; j++)
+            if (j != rank_ && !send_named(j, name + "|ag", send,
+                                          size_t(nbytes)))
+                return false;
+        Bytes incoming;
+        for (int j = 0; j < n; j++) {
+            uint8_t *dst = static_cast<uint8_t *>(recv) + j * nbytes;
+            if (j == rank_) {
+                std::memcpy(dst, send, size_t(nbytes));
+                continue;
+            }
+            if (!recv_named(j, name + "|ag", &incoming)) return false;
+            if (int64_t(incoming.size()) != nbytes) {
+                set_error("all_gather size mismatch");
+                return false;
+            }
+            std::memcpy(dst, incoming.data(), size_t(nbytes));
+        }
+        return true;
+    }
+
+    int consensus(const void *buf, int64_t nbytes, const std::string &name) {
+        // allreduce-MIN vs allreduce-MAX bit equality, then agreement on the
+        // local verdicts (reference: session.go:111-151 BytesConsensus).
+        Bytes mn(static_cast<size_t>(nbytes));
+        Bytes mx(static_cast<size_t>(nbytes));
+        if (!all_reduce(buf, mn.data(), nbytes, KFT_U8, KFT_MIN,
+                        KFT_STRAT_BINARY_TREE, name + "|cmin"))
+            return -1;
+        if (!all_reduce(buf, mx.data(), nbytes, KFT_U8, KFT_MAX,
+                        KFT_STRAT_BINARY_TREE, name + "|cmax"))
+            return -1;
+        uint8_t eq = std::memcmp(mn.data(), mx.data(), size_t(nbytes)) == 0;
+        uint8_t all_eq = 0;
+        if (!all_reduce(&eq, &all_eq, 1, KFT_U8, KFT_MIN,
+                        KFT_STRAT_BINARY_TREE, name + "|ceq"))
+            return -1;
+        return all_eq ? 1 : 0;
+    }
+
+    bool barrier(const std::string &name) {
+        uint8_t a = 1, b = 0;
+        return all_reduce(&a, &b, 1, KFT_U8, KFT_SUM, KFT_STRAT_BINARY_TREE,
+                          name);
+    }
+
+    // ---------------------------------------------------------------- p2p
+    bool save(const std::string &name, const void *buf, int64_t nbytes,
+              int64_t version) {
+        if (!store_.save(name, version, buf, size_t(nbytes))) {
+            set_error("store size conflict for " + name);
+            return false;
+        }
+        return true;
+    }
+
+    bool request(int target, const std::string &name, void *buf,
+                 int64_t nbytes, int64_t version) {
+        auto scope = stalls_.begin("request:" + name);
+        if (target == rank_) {
+            Bytes out;
+            if (!store_.load(name, version, &out)) {
+                set_error("blob not found: " + name);
+                return false;
+            }
+            if (int64_t(out.size()) != nbytes) {
+                set_error("blob size mismatch: " + name);
+                return false;
+            }
+            std::memcpy(buf, out.data(), out.size());
+            return true;
+        }
+        auto conn = get_conn(target, CLS_P2P);
+        if (!conn) return false;
+        Msg req;
+        req.cls = CLS_P2P;
+        req.token = token_.load();
+        req.name = name;
+        req.body.resize(8);
+        std::memcpy(req.body.data(), &version, 8);
+        std::lock_guard<std::mutex> rg(conn->request_mu);
+        {
+            std::lock_guard<std::mutex> wg(conn->write_mu);
+            if (!send_msg(conn->fd, req)) {
+                set_error("p2p send failed");
+                drop_conn(target, CLS_P2P);
+                return false;
+            }
+        }
+        monitor_.add(target, int64_t(req.body.size() + req.name.size()));
+        Msg resp;
+        if (!conn->responses.pop(&resp, recv_timeout_)) {
+            set_error("p2p response timeout for " + name);
+            return false;
+        }
+        if (resp.flags & FLAG_FAILED) {
+            set_error("peer has no blob " + name);
+            return false;
+        }
+        if (int64_t(resp.body.size()) != nbytes) {
+            set_error("p2p size mismatch for " + name);
+            return false;
+        }
+        std::memcpy(buf, resp.body.data(), resp.body.size());
+        return true;
+    }
+
+    bool ping(int target, double *rtt_ms) {
+        if (target == rank_) {
+            *rtt_ms = 0.0;
+            return true;
+        }
+        auto conn = get_conn(target, CLS_PING);
+        if (!conn) return false;
+        Msg m;
+        m.cls = CLS_PING;
+        m.token = token_.load();
+        m.name = "ping";
+        std::lock_guard<std::mutex> rg(conn->request_mu);
+        auto t0 = Clock::now();
+        {
+            std::lock_guard<std::mutex> wg(conn->write_mu);
+            if (!send_msg(conn->fd, m)) {
+                drop_conn(target, CLS_PING);
+                set_error("ping send failed");
+                return false;
+            }
+        }
+        Msg resp;
+        if (!conn->responses.pop(&resp, recv_timeout_)) {
+            set_error("ping timeout");
+            return false;
+        }
+        *rtt_ms =
+            std::chrono::duration<double, std::milli>(Clock::now() - t0)
+                .count();
+        return true;
+    }
+
+    EgressMonitor &monitor() { return monitor_; }
+    const EgressMonitor &monitor() const { return monitor_; }
+    StallTracker &stalls() { return stalls_; }
+
+  private:
+    // ------------------------------------------------------------- server
+    void accept_loop() {
+        while (running_) {
+            int fd = ::accept(listen_fd_, nullptr, nullptr);
+            if (fd < 0) break;
+            auto conn = std::make_shared<Conn>();
+            conn->fd = fd;
+            {
+                std::lock_guard<std::mutex> g(conns_mu_);
+                if (!running_) {
+                    ::close(fd);
+                    return;
+                }
+                in_conns_.push_back(conn);
+            }
+            // handshake runs inside the tracked reader thread so stop() can
+            // always unblock (shutdown fd) and join it
+            conn->reader = std::thread([this, conn] {
+                if (handshake_in(conn)) reader_loop(conn);
+                conn->alive = false;
+                conn->responses.close();
+                ::close(conn->fd);
+            });
+        }
+    }
+
+    bool handshake_in(const std::shared_ptr<Conn> &conn) {
+        int one = 1;
+        ::setsockopt(conn->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        Msg hello;
+        if (!recv_msg(conn->fd, &hello) || hello.cls != CLS_HELLO ||
+            hello.body.size() < 4)
+            return false;
+        Msg ack;
+        ack.cls = CLS_HELLO;
+        ack.flags = FLAG_RESPONSE;
+        ack.token = token_.load();
+        // version-token fencing (reference: connection.go:77-87)
+        if (hello.token != token_.load()) {
+            ack.flags |= FLAG_FAILED;
+            send_msg(conn->fd, ack);
+            return false;
+        }
+        int32_t remote;
+        std::memcpy(&remote, hello.body.data(), 4);
+        conn->remote_rank = remote;
+        ack.body.resize(4);
+        std::memcpy(ack.body.data(), &rank_, 4);
+        return send_msg(conn->fd, ack);
+    }
+
+    void reader_loop(std::shared_ptr<Conn> conn) {
+        Msg m;
+        while (conn->alive && recv_msg(conn->fd, &m)) {
+            if (m.flags & FLAG_RESPONSE) {
+                conn->responses.push(std::move(m));
+                m = Msg();
+                continue;
+            }
+            switch (m.cls) {
+                case CLS_COLLECTIVE:
+                    endpoint_.push(conn->remote_rank, m.name,
+                                   std::move(m.body));
+                    break;
+                case CLS_PING: {
+                    Msg r;
+                    r.cls = CLS_PING;
+                    r.flags = FLAG_RESPONSE;
+                    r.token = token_.load();
+                    std::lock_guard<std::mutex> wg(conn->write_mu);
+                    send_msg(conn->fd, r);
+                    break;
+                }
+                case CLS_P2P: {
+                    Msg r;
+                    r.cls = CLS_P2P;
+                    r.flags = FLAG_RESPONSE;
+                    r.token = token_.load();
+                    r.name = m.name;
+                    if (m.flags & FLAG_SAVE) {
+                        int64_t ver;
+                        std::memcpy(&ver, m.body.data(), 8);
+                        if (!store_.save(m.name, ver, m.body.data() + 8,
+                                         m.body.size() - 8))
+                            r.flags |= FLAG_FAILED;
+                    } else {
+                        int64_t ver;
+                        std::memcpy(&ver, m.body.data(), 8);
+                        Bytes out;
+                        if (store_.load(m.name, ver, &out))
+                            r.body = std::move(out);
+                        else
+                            r.flags |= FLAG_FAILED;
+                    }
+                    std::lock_guard<std::mutex> wg(conn->write_mu);
+                    send_msg(conn->fd, r);
+                    break;
+                }
+                case CLS_CONTROL:
+                    if (m.name == "token" && m.body.size() >= 4) {
+                        uint32_t t;
+                        std::memcpy(&t, m.body.data(), 4);
+                        token_.store(t);
+                    }
+                    break;
+                default:
+                    break;
+            }
+            m = Msg();
+        }
+        conn->alive = false;
+        conn->responses.close();
+    }
+
+    // Outbound reader threads also own their fd close (dial() path).
+    void outbound_reader(std::shared_ptr<Conn> conn) {
+        reader_loop(conn);
+        ::close(conn->fd);
+    }
+
+    void service_loop() {
+        int i = 0;
+        while (running_) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(200));
+            if (++i % 5 == 0) {  // ~1s period (reference monitor ticker)
+                monitor_.tick();
+                stalls_.check(rank_);
+            }
+        }
+    }
+
+    // ------------------------------------------------------------- client
+    static void close_conn(const std::shared_ptr<Conn> &c) {
+        c->alive = false;
+        if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
+    }
+
+    void drop_conn(int dest, int cls) {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        auto it = out_conns_.find({dest, cls});
+        if (it != out_conns_.end()) {
+            close_conn(it->second);
+            graveyard_.push_back(it->second);  // joined at stop()
+            out_conns_.erase(it);
+        }
+    }
+
+    std::shared_ptr<Conn> get_conn(int dest, int cls) {
+        {
+            std::lock_guard<std::mutex> g(conns_mu_);
+            auto it = out_conns_.find({dest, cls});
+            if (it != out_conns_.end() && it->second->alive)
+                return it->second;
+        }
+        auto conn = dial(dest, cls);
+        if (!conn) return nullptr;
+        std::lock_guard<std::mutex> g(conns_mu_);
+        auto &slot = out_conns_[{dest, cls}];
+        if (slot && slot->alive) {  // raced; keep the existing one
+            close_conn(conn);
+            if (conn->reader.joinable()) conn->reader.detach();
+            return slot;
+        }
+        slot = conn;
+        return slot;
+    }
+
+    std::shared_ptr<Conn> dial(int dest, int cls) {
+        const PeerAddr &pa = peers_[dest];
+        // retry loop (reference: ConnRetryCount 500 x 200ms wait-peer-up)
+        for (int attempt = 0; attempt < conn_retries_; attempt++) {
+            if (!running_) break;
+            int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+            if (fd < 0) break;
+            sockaddr_in addr{};
+            addr.sin_family = AF_INET;
+            addr.sin_port = htons(uint16_t(pa.port));
+            if (::inet_pton(AF_INET, pa.host.c_str(), &addr.sin_addr) != 1) {
+                hostent *he = ::gethostbyname(pa.host.c_str());
+                if (!he) {
+                    ::close(fd);
+                    set_error("cannot resolve " + pa.host);
+                    return nullptr;
+                }
+                std::memcpy(&addr.sin_addr, he->h_addr, 4);
+            }
+            if (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
+                          sizeof(addr)) == 0) {
+                int one = 1;
+                ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+                Msg hello;
+                hello.cls = CLS_HELLO;
+                hello.token = token_.load();
+                hello.name = "hello";
+                hello.body.resize(8);
+                std::memcpy(hello.body.data(), &rank_, 4);
+                int32_t c32 = cls;
+                std::memcpy(hello.body.data() + 4, &c32, 4);
+                Msg ack;
+                if (send_msg(fd, hello) && recv_msg(fd, &ack) &&
+                    !(ack.flags & FLAG_FAILED)) {
+                    auto conn = std::make_shared<Conn>();
+                    conn->fd = fd;
+                    conn->remote_rank = dest;
+                    conn->reader =
+                        std::thread([this, conn] { outbound_reader(conn); });
+                    return conn;
+                }
+                ::close(fd);
+                if (ack.flags & FLAG_FAILED) {
+                    set_error("connection rejected by peer " +
+                              std::to_string(dest) + " (stale token)");
+                    return nullptr;
+                }
+            } else {
+                ::close(fd);
+            }
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(conn_retry_ms_));
+        }
+        set_error("cannot connect to peer " + std::to_string(dest) + " (" +
+                  pa.host + ":" + std::to_string(pa.port) + ")");
+        return nullptr;
+    }
+
+    bool send_named(int dest, const std::string &name, const void *data,
+                    size_t nbytes) {
+        auto conn = get_conn(dest, CLS_COLLECTIVE);
+        if (!conn) return false;
+        Msg m;
+        m.cls = CLS_COLLECTIVE;
+        m.token = token_.load();
+        m.name = name;
+        m.body.assign(static_cast<const uint8_t *>(data),
+                      static_cast<const uint8_t *>(data) + nbytes);
+        std::lock_guard<std::mutex> wg(conn->write_mu);
+        if (!send_msg(conn->fd, m)) {
+            set_error("send to peer " + std::to_string(dest) + " failed");
+            drop_conn(dest, CLS_COLLECTIVE);
+            return false;
+        }
+        monitor_.add(dest, int64_t(nbytes));
+        return true;
+    }
+
+    bool recv_named(int src, const std::string &name, Bytes *out) {
+        if (!endpoint_.recv(src, name, out, recv_timeout_)) {
+            set_error("recv timeout: " + name + " from peer " +
+                      std::to_string(src));
+            return false;
+        }
+        return true;
+    }
+
+    int rank_;
+    std::vector<PeerAddr> peers_;
+    std::atomic<uint32_t> token_;
+    std::atomic<bool> running_{false};
+    int listen_fd_ = -1;
+    std::thread accept_thread_, service_thread_;
+    CollectiveEndpoint endpoint_;
+    BlobStore store_;
+    EgressMonitor monitor_;
+    StallTracker stalls_;
+    std::mutex conns_mu_;
+    std::map<std::pair<int, int>, std::shared_ptr<Conn>> out_conns_;
+    std::vector<std::shared_ptr<Conn>> in_conns_;
+    std::vector<std::shared_ptr<Conn>> graveyard_;
+    double recv_timeout_;
+    int conn_retries_;
+    int conn_retry_ms_;
+};
+
+}  // namespace kft
+
+// ------------------------------------------------------------------ C ABI
+
+using kft::Peer;
+
+struct kft_peer {
+    Peer impl;
+};
+
+extern "C" {
+
+kft_peer *kft_peer_new(int rank, const char *peers_csv, uint32_t token) {
+    std::vector<kft::PeerAddr> peers;
+    std::stringstream ss(peers_csv ? peers_csv : "");
+    std::string item;
+    while (std::getline(ss, item, ',')) {
+        auto pos = item.rfind(':');
+        if (pos == std::string::npos) {
+            kft::set_error("bad peer spec: " + item);
+            return nullptr;
+        }
+        peers.push_back({item.substr(0, pos),
+                         std::atoi(item.c_str() + pos + 1)});
+    }
+    if (peers.empty() || rank < 0 || rank >= int(peers.size())) {
+        kft::set_error("bad rank/peer list");
+        return nullptr;
+    }
+    return new kft_peer{Peer(rank, std::move(peers), token)};
+}
+
+int kft_peer_start(kft_peer *p) { return p->impl.start() ? 0 : -1; }
+void kft_peer_stop(kft_peer *p) { p->impl.stop(); }
+void kft_peer_free(kft_peer *p) { delete p; }
+int kft_rank(const kft_peer *p) { return p->impl.rank(); }
+int kft_size(const kft_peer *p) { return p->impl.size(); }
+uint32_t kft_token(const kft_peer *p) { return p->impl.token(); }
+
+int kft_reset_connections(kft_peer *p, uint32_t token) {
+    p->impl.reset_connections(token);
+    return 0;
+}
+
+int kft_barrier(kft_peer *p, const char *name) {
+    return p->impl.barrier(name ? name : "barrier") ? 0 : -1;
+}
+
+int kft_all_reduce(kft_peer *p, const void *s, void *r, int64_t count,
+                   kft_dtype dt, kft_op op, kft_strategy strat,
+                   const char *name) {
+    return p->impl.all_reduce(s, r, count, dt, op, strat,
+                              name ? name : "allreduce")
+               ? 0
+               : -1;
+}
+
+int kft_all_reduce_tree(kft_peer *p, const void *s, void *r, int64_t count,
+                        kft_dtype dt, kft_op op, const int32_t *father,
+                        const char *name) {
+    std::vector<int32_t> f(father, father + p->impl.size());
+    return p->impl.all_reduce_tree(s, r, count, dt, op, f,
+                                   name ? name : "allreduce")
+               ? 0
+               : -1;
+}
+
+int kft_broadcast(kft_peer *p, void *buf, int64_t nbytes, int root,
+                  const char *name) {
+    return p->impl.broadcast(buf, nbytes, root, name ? name : "bcast") ? 0
+                                                                       : -1;
+}
+
+int kft_gather(kft_peer *p, const void *s, int64_t nbytes, void *r, int root,
+               const char *name) {
+    return p->impl.gather(s, nbytes, r, root, name ? name : "gather") ? 0
+                                                                      : -1;
+}
+
+int kft_all_gather(kft_peer *p, const void *s, int64_t nbytes, void *r,
+                   const char *name) {
+    return p->impl.all_gather(s, nbytes, r, name ? name : "allgather") ? 0
+                                                                       : -1;
+}
+
+int kft_consensus(kft_peer *p, const void *buf, int64_t nbytes,
+                  const char *name) {
+    return p->impl.consensus(buf, nbytes, name ? name : "consensus");
+}
+
+int kft_save(kft_peer *p, const char *name, const void *buf, int64_t nbytes,
+             int64_t version) {
+    return p->impl.save(name, buf, nbytes, version) ? 0 : -1;
+}
+
+int kft_request(kft_peer *p, int target, const char *name, void *buf,
+                int64_t nbytes, int64_t version) {
+    return p->impl.request(target, name, buf, nbytes, version) ? 0 : -1;
+}
+
+int64_t kft_egress_bytes(const kft_peer *p, int peer) {
+    return p->impl.monitor().bytes(peer);
+}
+
+double kft_egress_rate(const kft_peer *p, int peer) {
+    return p->impl.monitor().rate(peer);
+}
+
+int kft_ping(kft_peer *p, int peer, double *rtt_ms) {
+    return p->impl.ping(peer, rtt_ms) ? 0 : -1;
+}
+
+void kft_set_stall_threshold(kft_peer *p, double seconds) {
+    p->impl.stalls().set_threshold(seconds);
+}
+
+const char *kft_last_error(void) { return kft::last_error().c_str(); }
+
+}  // extern "C"

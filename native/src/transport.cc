@@ -1,0 +1,233 @@
+// Socket IO, message framing, and reduce kernels for the kft runtime.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdio>
+
+#include "internal.h"
+
+namespace kft {
+
+static thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+const std::string &last_error() { return g_last_error; }
+
+bool write_all(int fd, const void *buf, size_t n) {
+    const char *p = static_cast<const char *>(buf);
+    while (n > 0) {
+        ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (k <= 0) return false;
+        p += k;
+        n -= size_t(k);
+    }
+    return true;
+}
+
+bool read_all(int fd, void *buf, size_t n) {
+    char *p = static_cast<char *>(buf);
+    while (n > 0) {
+        ssize_t k = ::recv(fd, p, n, 0);
+        if (k <= 0) return false;
+        p += k;
+        n -= size_t(k);
+    }
+    return true;
+}
+
+#pragma pack(push, 1)
+struct WireHeader {
+    uint32_t magic;
+    uint8_t cls;
+    uint8_t flags;
+    uint16_t pad;
+    uint32_t token;
+    uint32_t name_len;
+    uint64_t body_len;
+};
+#pragma pack(pop)
+static_assert(sizeof(WireHeader) == 24, "wire header layout");
+
+bool send_msg(int fd, const Msg &m) {
+    WireHeader h{MSG_MAGIC, m.cls, m.flags, 0, m.token,
+                 uint32_t(m.name.size()), uint64_t(m.body.size())};
+    if (!write_all(fd, &h, sizeof(h))) return false;
+    if (!m.name.empty() && !write_all(fd, m.name.data(), m.name.size()))
+        return false;
+    if (!m.body.empty() && !write_all(fd, m.body.data(), m.body.size()))
+        return false;
+    return true;
+}
+
+bool recv_msg(int fd, Msg *m) {
+    WireHeader h;
+    if (!read_all(fd, &h, sizeof(h))) return false;
+    if (h.magic != MSG_MAGIC || h.name_len > 4096 || h.body_len > MAX_BODY)
+        return false;
+    m->cls = h.cls;
+    m->flags = h.flags;
+    m->token = h.token;
+    m->name.resize(h.name_len);
+    if (h.name_len && !read_all(fd, &m->name[0], h.name_len)) return false;
+    m->body.resize(h.body_len);
+    if (h.body_len && !read_all(fd, m->body.data(), h.body_len)) return false;
+    return true;
+}
+
+// -------------------------------------------------------------- reductions
+
+size_t dtype_size(kft_dtype dt) {
+    switch (dt) {
+        case KFT_U8:
+        case KFT_I8:
+            return 1;
+        case KFT_I16:
+        case KFT_F16:
+            return 2;
+        case KFT_I32:
+        case KFT_F32:
+            return 4;
+        case KFT_I64:
+        case KFT_F64:
+            return 8;
+    }
+    return 0;
+}
+
+static float f16_to_f32(uint16_t h) {
+    uint32_t sign = uint32_t(h & 0x8000) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t man = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (man == 0) {
+            bits = sign;
+        } else {  // subnormal
+            exp = 127 - 15 + 1;
+            while (!(man & 0x400)) {
+                man <<= 1;
+                exp--;
+            }
+            man &= 0x3FF;
+            bits = sign | (exp << 23) | (man << 13);
+        }
+    } else if (exp == 0x1F) {
+        bits = sign | 0x7F800000 | (man << 13);
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+static uint16_t f32_to_f16(float f) {
+    uint32_t bits;
+    std::memcpy(&bits, &f, 4);
+    uint16_t sign = uint16_t((bits >> 16) & 0x8000);
+    int32_t exp = int32_t((bits >> 23) & 0xFF) - 127 + 15;
+    uint32_t man = bits & 0x7FFFFF;
+    if (exp >= 0x1F) return uint16_t(sign | 0x7C00);  // inf/overflow
+    if (exp <= 0) {
+        if (exp < -10) return sign;  // underflow to zero
+        man |= 0x800000;
+        uint32_t shift = uint32_t(14 - exp);
+        return uint16_t(sign | (man >> shift));
+    }
+    return uint16_t(sign | (uint32_t(exp) << 10) | (man >> 13));
+}
+
+template <typename T>
+static void reduce_loop(T *acc, const T *in, int64_t n, kft_op op) {
+    switch (op) {
+        case KFT_SUM:
+            for (int64_t i = 0; i < n; i++) acc[i] = T(acc[i] + in[i]);
+            break;
+        case KFT_MIN:
+            for (int64_t i = 0; i < n; i++)
+                acc[i] = in[i] < acc[i] ? in[i] : acc[i];
+            break;
+        case KFT_MAX:
+            for (int64_t i = 0; i < n; i++)
+                acc[i] = in[i] > acc[i] ? in[i] : acc[i];
+            break;
+        case KFT_PROD:
+            for (int64_t i = 0; i < n; i++) acc[i] = T(acc[i] * in[i]);
+            break;
+    }
+}
+
+static void reduce_f16(uint16_t *acc, const uint16_t *in, int64_t n,
+                       kft_op op) {
+    for (int64_t i = 0; i < n; i++) {
+        float a = f16_to_f32(acc[i]), b = f16_to_f32(in[i]), r = 0;
+        switch (op) {
+            case KFT_SUM: r = a + b; break;
+            case KFT_MIN: r = b < a ? b : a; break;
+            case KFT_MAX: r = b > a ? b : a; break;
+            case KFT_PROD: r = a * b; break;
+        }
+        acc[i] = f32_to_f16(r);
+    }
+}
+
+void reduce_inplace(void *acc, const void *in, int64_t count, kft_dtype dt,
+                    kft_op op) {
+    switch (dt) {
+        case KFT_U8:
+            reduce_loop(static_cast<uint8_t *>(acc),
+                        static_cast<const uint8_t *>(in), count, op);
+            break;
+        case KFT_I8:
+            reduce_loop(static_cast<int8_t *>(acc),
+                        static_cast<const int8_t *>(in), count, op);
+            break;
+        case KFT_I16:
+            reduce_loop(static_cast<int16_t *>(acc),
+                        static_cast<const int16_t *>(in), count, op);
+            break;
+        case KFT_I32:
+            reduce_loop(static_cast<int32_t *>(acc),
+                        static_cast<const int32_t *>(in), count, op);
+            break;
+        case KFT_I64:
+            reduce_loop(static_cast<int64_t *>(acc),
+                        static_cast<const int64_t *>(in), count, op);
+            break;
+        case KFT_F16:
+            reduce_f16(static_cast<uint16_t *>(acc),
+                       static_cast<const uint16_t *>(in), count, op);
+            break;
+        case KFT_F32:
+            reduce_loop(static_cast<float *>(acc),
+                        static_cast<const float *>(in), count, op);
+            break;
+        case KFT_F64:
+            reduce_loop(static_cast<double *>(acc),
+                        static_cast<const double *>(in), count, op);
+            break;
+    }
+}
+
+void StallTracker::check(int self_rank) {
+    double th = threshold_.load();
+    if (th <= 0) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto now = Clock::now();
+    for (auto &kv : pending_) {
+        double age =
+            std::chrono::duration<double>(now - kv.second.start).count();
+        if (age > th && !kv.second.reported) {
+            std::fprintf(stderr,
+                         "[kft:%d] STALL: op %s pending for %.1fs\n",
+                         self_rank, kv.second.what.c_str(), age);
+            kv.second.reported = true;
+        }
+    }
+}
+
+}  // namespace kft

@@ -62,9 +62,11 @@ class TestMonitor:
         c = RateCounter()
         c.add(1000)
         time.sleep(0.06)
-        r = c.rate()
+        r = c.rate(period=0.05)
         assert r > 0
         assert c.total() == 1000
+        # within the same window concurrent readers see the same value
+        assert c.rate(period=10.0) == r
 
     def test_metrics_endpoint(self):
         mon = Monitor()
