@@ -1,0 +1,46 @@
+"""Torch data-parallel training over the native runtime.
+
+Reference: examples/torch_mnist.py-style usage of
+kungfu.torch.SynchronousSGDOptimizer.  Launch N worker processes:
+
+    python -m kungfu_tpu.launcher -np 4 python examples/torch_sync_sgd.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import torch
+
+import kungfu_tpu.torch as kft
+
+
+def main():
+    rank, size = kft.current_rank(), kft.current_cluster_size()
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(32, 64), torch.nn.ReLU(), torch.nn.Linear(64, 10))
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    if size > 1:
+        opt = kft.SynchronousSGDOptimizer(opt, model.named_parameters())
+        kft.broadcast_parameters(model.state_dict())
+
+    rng = np.random.RandomState(1000 + rank)  # each worker: its own shard
+    w_true = np.random.RandomState(7).randn(32, 10).astype(np.float32)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    for step in range(50):
+        x = rng.randn(64, 32).astype(np.float32)
+        y = (x @ w_true).argmax(axis=1)
+        opt.zero_grad()
+        loss = loss_fn(model(torch.from_numpy(x)), torch.from_numpy(y))
+        loss.backward()
+        opt.step()   # grafted: allreduce-avg of grads, then SGD
+        if rank == 0 and step % 10 == 0:
+            print(f"step {step:2d} loss={float(loss):.4f}")
+    if rank == 0:
+        print(f"done on {size} workers")
+
+
+if __name__ == "__main__":
+    main()

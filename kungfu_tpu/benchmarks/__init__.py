@@ -1,0 +1,41 @@
+"""Benchmark harnesses (reference: srcs/python/kungfu/tensorflow/v1/benchmarks/).
+
+``python -m kungfu_tpu.benchmarks`` is the synthetic allreduce microbench;
+``show_size`` / ``show_rate`` mirror the reference's human-readable units
+(v1/helpers/utils.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple
+
+Ki = 1024
+Mi = Ki * Ki
+Gi = Mi * Ki
+
+
+def show_size(s: float) -> str:
+    if s > Gi:
+        return "%.2fGi" % (float(s) / Gi)
+    if s > Mi:
+        return "%.2fMi" % (float(s) / Mi)
+    if s > Ki:
+        return "%.2fKi" % (float(s) / Ki)
+    return "%d" % s
+
+
+def show_rate(size: float, duration: float) -> str:
+    r = size / duration
+    if r < Ki:
+        return "%.2fB/s" % r
+    if r < Mi:
+        return "%.2fKiB/s" % (r / Ki)
+    if r < Gi:
+        return "%.2fMiB/s" % (r / Mi)
+    return "%.2fGiB/s" % (r / Gi)
+
+
+def measure(f: Callable) -> Tuple[float, object]:
+    t0 = time.perf_counter()
+    out = f()
+    return time.perf_counter() - t0, out

@@ -1,0 +1,33 @@
+"""Embedded launcher entry (reference: kungfu.cmd.run(), which invokes the
+Go launcher compiled into the shared library — cmd/__init__.py:4-6,
+libkungfu-comm/cmds.go:12-16).  Here the launcher is Python, so embedding
+is a direct call:
+
+    import kungfu_tpu.cmd
+    kungfu_tpu.cmd.run(["-np", "4", "python", "train.py"])
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    """Run the kft-run launcher in-process with the given CLI args."""
+    from .launcher.cli import main
+    return main(list(argv) if argv is not None else sys.argv[1:])
+
+
+def config_server(argv: Optional[List[str]] = None) -> int:
+    from .elastic.config_server import main
+    return main(argv)
+
+
+def distribute(argv: Optional[List[str]] = None) -> int:
+    from .launcher.distribute import main
+    return main(argv)
+
+
+def rrun(argv: Optional[List[str]] = None) -> int:
+    from .launcher.rrun import main
+    return main(argv)

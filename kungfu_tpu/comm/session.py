@@ -122,6 +122,18 @@ class Session:
             self._pairs = [GraphPair(g, g.reverse())]
             self._fn_cache.clear()
 
+    def adapt_tree_from_latencies(self, latency_matrix, root: int = 0) -> List[int]:
+        """Install the minimum-latency spanning tree as the collective
+        topology.  ``latency_matrix[i, j]`` = peer ``i``'s measured latency
+        to peer ``j`` (e.g. rows all-gathered from the native runtime's
+        ``peer_latencies``).  Reference loop: get_peer_latencies →
+        global_minimum_spanning_tree → set_tree (ops/__init__.py:49-70,
+        adaptation.go:8-28).  Returns the father array installed."""
+        from ..plan.mst import tree_from_latencies
+        father = tree_from_latencies(latency_matrix, root=root)
+        self.set_tree(father)
+        return father
+
     # ------------------------------------------------------- eager execution
     def _peer_spec(self) -> P:
         return P(self.mesh.axis_names)

@@ -147,3 +147,52 @@ def put_config(url: str, cluster: Cluster, timeout: float = 5.0) -> int:
                                  method="PUT")
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode())["version"]
+
+
+def main(argv=None) -> int:
+    """Standalone config server (reference: kungfu-config-server binary,
+    srcs/go/cmd/kungfu-config-server/kungfu-config-server.go:28-64 — port,
+    TTL auto-shutdown, /stop endpoint).
+
+        python -m kungfu_tpu.elastic.config_server -port 9100 -ttl 120
+        python -m kungfu_tpu.elastic.config_server -port 9100 -H 10.0.0.1:4 -np 4
+    """
+    import argparse
+    import time
+
+    from ..plan.hostspec import HostList
+
+    p = argparse.ArgumentParser(prog="kft-config-server")
+    p.add_argument("-port", type=int, default=9100)
+    p.add_argument("-host", default="0.0.0.0")
+    p.add_argument("-ttl", type=float, default=0.0,
+                   help="seconds before auto-shutdown (0 = run forever)")
+    p.add_argument("-H", dest="hosts", default="",
+                   help="optional initial host list")
+    p.add_argument("-np", type=int, default=0,
+                   help="initial worker count (with -H)")
+    args = p.parse_args(argv)
+
+    srv = ConfigServer(host=args.host, port=args.port).start()
+    if args.hosts and args.np:
+        hl = HostList.parse(args.hosts)
+        srv.put_cluster(Cluster.from_hostlist(hl, args.np))
+    print(f"config server listening on {srv.url}"
+          + (f" (ttl {args.ttl}s)" if args.ttl else ""), flush=True)
+    try:
+        deadline = time.time() + args.ttl if args.ttl else None
+        while srv._server.is_running():
+            if deadline and time.time() > deadline:
+                print("ttl expired; shutting down")
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

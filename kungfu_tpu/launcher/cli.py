@@ -72,7 +72,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.hosts:
         hl = HostList.parse(args.hosts)
     else:
-        hl = HostList.parse(f"{args.self_host}:{max(args.np, 1)}")
+        # no explicit hosts: discover a TPU pod from the libtpu env, else
+        # run everything on this machine.  Single-host "pods" (libtpu sets
+        # TPU_WORKER_HOSTNAMES=localhost even on one VM) stay on the local
+        # path so host naming matches what users PUT to the config server.
+        from .discovery import discover_tpu_pod
+        pod = discover_tpu_pod()
+        if pod is not None and pod.num_hosts > 1:
+            hl = pod.hosts
+            if args.self_host == "127.0.0.1":
+                args.self_host = pod.self_host
+        else:
+            hl = HostList.parse(f"{args.self_host}:{max(args.np, 1)}")
 
     try:
         lo, hi = (int(x) for x in args.port_range.split("-"))

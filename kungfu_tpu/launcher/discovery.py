@@ -1,0 +1,126 @@
+"""Platform discovery: TPU-pod topology and self-IP inference.
+
+Reference: srcs/go/platforms/modelarts/modelarts.go:15-50 (cloud peer-list
+discovery from env) and srcs/go/kungfu/runner/discovery.go:18-58 (NIC-based
+self-IPv4 inference).
+
+TPU-native: on Cloud TPU VMs the libtpu runtime publishes pod topology via
+environment variables — ``TPU_WORKER_HOSTNAMES`` (comma-separated host
+list), ``TPU_WORKER_ID`` (this host's index), and chip counts via
+``TPU_CHIPS_PER_HOST_BOUNDS`` / ``TPU_ACCELERATOR_TYPE``.  That replaces
+the reference's per-cloud env schema; GCE metadata-server lookups are
+deliberately avoided (works in air-gapped runs, no egress needed).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+from typing import Dict, Optional
+
+from ..plan.hostspec import HostList, HostSpec
+from ..plan.peer import PeerID, PeerList
+
+TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+TPU_WORKER_ID = "TPU_WORKER_ID"
+TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+
+# accelerator type -> chips per host (v4/v5 standard hosts have 4)
+_CHIPS_PER_HOST_DEFAULT = 4
+
+
+@dataclasses.dataclass
+class PodInfo:
+    """Discovered pod topology (reference: modelarts.ContainerInfo)."""
+    self_index: int
+    hosts: HostList
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def self_host(self) -> str:
+        return self.hosts[self.self_index].host
+
+    def worker_list(self, workers_per_host: int = 1,
+                    base_port: int = 0) -> PeerList:
+        from ..plan.hostspec import DEFAULT_WORKER_PORT
+        port = base_port or DEFAULT_WORKER_PORT
+        return PeerList(PeerID(h.host, port + s, s)
+                        for h in self.hosts for s in range(workers_per_host))
+
+
+def chips_per_host(environ: Optional[Dict[str, str]] = None) -> int:
+    """Chips on this host, from the bounds string ``x,y,z`` (product) or
+    the accelerator-type default."""
+    e = environ if environ is not None else os.environ
+    bounds = e.get(TPU_CHIPS_PER_HOST_BOUNDS, "")
+    if bounds:
+        n = 1
+        for part in bounds.split(","):
+            n *= int(part)
+        return n
+    return _CHIPS_PER_HOST_DEFAULT
+
+
+def discover_tpu_pod(environ: Optional[Dict[str, str]] = None
+                     ) -> Optional[PodInfo]:
+    """Pod topology from the libtpu env, or None when not on a TPU pod
+    (single-VM and CPU runs).  Mirrors modelarts.ParseEnv's contract:
+    self index + full peer list."""
+    e = environ if environ is not None else os.environ
+    hostnames = e.get(TPU_WORKER_HOSTNAMES, "")
+    if not hostnames:
+        return None
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    idx = int(e.get(TPU_WORKER_ID, "0"))
+    if len(hosts) == 1 and idx == 1:  # modelarts.go:43-46 quirk, kept
+        idx = 0
+    if not 0 <= idx < len(hosts):
+        raise ValueError(
+            f"{TPU_WORKER_ID}={idx} out of range for {len(hosts)} hosts")
+    slots = chips_per_host(e)
+    return PodInfo(self_index=idx,
+                   hosts=HostList([HostSpec(h, slots) for h in hosts]))
+
+
+def infer_self_ipv4(explicit: str = "", nic: str = "",
+                    probe_addr: str = "8.8.8.8") -> str:
+    """Best-effort self-IP (reference InferSelfIPv4, discovery.go:18-26):
+    explicit wins; then the NIC's address; then a connected-UDP probe (no
+    packets are sent); finally 127.0.0.1."""
+    if explicit:
+        return explicit
+    if nic:
+        ip = _nic_ipv4(nic)
+        if ip:
+            return ip
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((probe_addr, 80))  # routes, sends nothing
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def _nic_ipv4(nic: str) -> Optional[str]:
+    """IPv4 bound to a named interface, via /sys + ip-less getifaddrs
+    fallback (psutil is not a dependency)."""
+    try:
+        import fcntl
+        import struct
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            packed = struct.pack("256s", nic.encode()[:255])
+            # SIOCGIFADDR
+            out = fcntl.ioctl(s.fileno(), 0x8915, packed)
+            return socket.inet_ntoa(out[20:24])
+        finally:
+            s.close()
+    except (OSError, ImportError):
+        return None

@@ -1,0 +1,39 @@
+"""kft-distribute — run one command on every host via ssh.
+
+Reference: srcs/go/cmd/kungfu-distribute/kungfu-distribute.go.
+
+    python -m kungfu_tpu.launcher.distribute -H a:1,b:1 -- hostname
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from ..plan.hostspec import HostList
+from .remote import distribute
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="kft-distribute")
+    p.add_argument("-H", dest="hosts", default="127.0.0.1:1",
+                   help="comma separated <ip>:<slots>[:<public addr>]")
+    p.add_argument("-u", "--user", default="", help="ssh user")
+    p.add_argument("-logdir", default="", help="per-task log directory")
+    p.add_argument("prog", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    prog = [a for a in args.prog if a != "--"]
+    if not prog:
+        p.error("missing program")
+    hosts = HostList.parse(args.hosts)
+    t0 = time.time()
+    rc = distribute(hosts, prog, user=args.user,
+                    log_dir=args.logdir or None)
+    print(f"kft-distribute `{' '.join(prog)}` took {time.time() - t0:.1f}s",
+          file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Remote launch over ssh: `distribute` fan-out and `rrun` static jobs.
+
+Reference: srcs/go/cmd/kungfu-distribute/kungfu-distribute.go:51-90 (run
+one command on every host of -H via ssh, colored/tee'd output, fail-fast)
+and srcs/go/cmd/kungfu-rrun/rrun.go:19-43 +
+srcs/go/utils/runner/remote/remote.go (static multi-host job: one ssh
+session per worker carrying the worker env).
+
+The ssh binary is configurable via ``KFT_SSH`` (used by tests to swap in a
+local shim; also how users select e.g. ``gcloud compute tpus tpu-vm ssh``
+wrappers for TPU pods).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Dict, List, Optional
+
+from ..plan.hostspec import HostList
+from ..plan.peer import PeerID
+from ..plan.topology import Strategy
+from . import env as E
+from .proc import Proc, run_all
+
+SSH_ENV = "KFT_SSH"
+
+
+def _ssh_argv(host: str, user: str, remote_cmd: str) -> List[str]:
+    ssh = os.environ.get(SSH_ENV, "ssh")
+    target = f"{user}@{host}" if user else host
+    return shlex.split(ssh) + [target, remote_cmd]
+
+
+def _remote_script(args: List[str], env: Optional[Dict[str, str]] = None) -> str:
+    """Single shell line: ``env K=V ... prog args`` (reference
+    proc.Script)."""
+    parts = []
+    if env:
+        parts.append("env")
+        parts += [f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())]
+    parts += [shlex.quote(a) for a in args]
+    return " ".join(parts)
+
+
+def distribute(hosts: HostList, args: List[str], user: str = "",
+               log_dir: Optional[str] = None) -> int:
+    """Run ``args`` once on every host, in parallel; non-zero exit of any
+    task kills the rest (reference kungfu-distribute)."""
+    procs = []
+    for i, h in enumerate(hosts):
+        target = h.public_addr or h.host
+        procs.append(Proc(name=target, args=_ssh_argv(target, user,
+                                                      _remote_script(args)),
+                          env={}, color_idx=i, log_dir=log_dir))
+    return run_all(procs)
+
+
+def remote_run_static(hosts: HostList, np: int, args: List[str],
+                      user: str = "",
+                      strategy: Strategy = Strategy.AUTO,
+                      config_server: Optional[str] = None,
+                      log_dir: Optional[str] = None,
+                      base_port: Optional[int] = None) -> int:
+    """Static multi-host job: one ssh session per worker, each carrying the
+    full KFT_* worker env (reference kungfu-rrun / RunStaticKungFuJob).
+
+    Unlike `distribute`, every worker gets a distinct peer identity, so the
+    N processes form one cluster across hosts."""
+    kw = {"base_port": base_port} if base_port else {}
+    peers = hosts.gen_peer_list(np, **kw)
+    runners = hosts.gen_runner_list()
+    procs = []
+    for rank, w in enumerate(peers):
+        env = E.worker_env(
+            self_peer=w, peers=peers, runners=runners, version=0,
+            strategy=strategy, config_server=config_server,
+            parent=PeerID(host=w.host, port=runners[0].port, slot=0))
+        # PYTHONPATH points at this machine's checkout; the remote host may
+        # have its own installation — drop it and trust the remote env.
+        env.pop("PYTHONPATH", None)
+        target = None
+        for h in hosts:
+            if h.host == w.host:
+                target = h.public_addr or h.host
+        assert target is not None
+        name = f"{target}:{rank}"
+        procs.append(Proc(name=name,
+                          args=_ssh_argv(target, user,
+                                         _remote_script(args, env)),
+                          env={}, color_idx=rank, log_dir=log_dir))
+    return run_all(procs)

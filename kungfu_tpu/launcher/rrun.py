@@ -1,0 +1,41 @@
+"""kft-rrun — static remote multi-host job over ssh.
+
+Reference: srcs/go/cmd/kungfu-rrun/rrun.go.
+
+    python -m kungfu_tpu.launcher.rrun -np 4 -H a:2,b:2 -- python3 train.py
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..plan.hostspec import HostList
+from ..plan.topology import Strategy
+from .remote import remote_run_static
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="kft-rrun")
+    p.add_argument("-np", type=int, default=1, help="total worker count")
+    p.add_argument("-H", dest="hosts", default="127.0.0.1:1",
+                   help="comma separated <ip>:<slots>[:<public addr>]")
+    p.add_argument("-u", "--user", default="", help="ssh user")
+    p.add_argument("-strategy", default="AUTO")
+    p.add_argument("-config-server", default="")
+    p.add_argument("-logdir", default="")
+    p.add_argument("prog", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    prog = [a for a in args.prog if a != "--"]
+    if not prog:
+        p.error("missing program")
+    hosts = HostList.parse(args.hosts)
+    return remote_run_static(
+        hosts, args.np, prog, user=args.user,
+        strategy=Strategy.parse(args.strategy),
+        config_server=args.config_server or None,
+        log_dir=args.logdir or None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

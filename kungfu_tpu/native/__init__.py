@@ -201,6 +201,11 @@ class NativePeer:
         """Allreduce along an explicit reduce forest (father[i] == i marks
         the root) — reference SimpleSetGlobalStrategy semantics."""
         x = np.ascontiguousarray(x)
+        if x.dtype not in _DTYPES:
+            raise TypeError(f"unsupported dtype {x.dtype}")
+        if len(father) != self.size:
+            raise ValueError(
+                f"father array has {len(father)} entries, need {self.size}")
         out = np.empty_like(x)
         f = (ctypes.c_int32 * self.size)(*[int(v) for v in father])
         _check(self._lib.kft_all_reduce_tree(
@@ -280,8 +285,27 @@ class NativePeer:
     def set_stall_threshold(self, seconds: float) -> None:
         self._lib.kft_set_stall_threshold(self._h, seconds)
 
+    # ------------------------------------------------------ adaptation
+    def mst_tree(self, root: int = 0) -> List[int]:
+        """Measure latencies, all-gather the matrix, return the MST father
+        array (reference: global_minimum_spanning_tree op,
+        ops/cpu/topology.cpp:118-152 + ops/__init__.py:58-70).  Feed the
+        result to ``all_reduce_tree`` to ride the lowest-latency topology."""
+        from ..plan.mst import tree_from_latencies
+        row = np.asarray(self.peer_latencies(), dtype=np.float64)
+        matrix = self.all_gather(row, name="mst:latencies")
+        matrix = matrix.reshape(self.size, self.size)
+        return tree_from_latencies(matrix, root=root)
+
 
 _default_peer: Optional[NativePeer] = None
+
+
+def use_peer(p: Optional[NativePeer]) -> None:
+    """Install an explicitly-constructed peer as the process default (for
+    embedding the runtime without the KFT_* env ABI, e.g. tests)."""
+    global _default_peer
+    _default_peer = p
 
 
 def default_peer() -> Optional[NativePeer]:

@@ -90,3 +90,14 @@ def rank(axis_name: str = PEER_AXIS):
 
 def cluster_size(axis_name: str = PEER_AXIS):
     return jax.lax.psum(1, axis_name)
+
+
+def peer_info(axis_name: str = PEER_AXIS):
+    """(rank, cluster_size) pair (reference: KungfuGetPeerInfo,
+    ops/cpu/topology.cpp:53-80)."""
+    return rank(axis_name), cluster_size(axis_name)
+
+
+from .state import (Counter, CounterState, EmaState,  # noqa: E402,F401
+                    ExponentialMovingAverage, counter_init, counter_update,
+                    ema_init, ema_update)

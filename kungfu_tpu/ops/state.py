@@ -1,0 +1,93 @@
+"""Stateful helper ops: counter and exponential moving average.
+
+Reference: TF stateful kernels ``KungfuCounter`` / ``KungfuExponentialMovingAverage``
+(srcs/cpp/src/tensorflow/ops/cpu/state.cpp:6-78, EMA recurrence
+srcs/cpp/include/kungfu/utils/ema.hpp:19-28) and wrappers
+srcs/python/kungfu/tensorflow/ops/state.py.
+
+TPU-first design: instead of hidden kernel state (which XLA cannot trace),
+these are explicit carried-state transforms — ``init() -> state`` plus a
+pure ``update(state, ...) -> (out, state)`` that composes with ``jit`` /
+``lax.scan``.  Small host-side wrapper classes are provided for eager,
+step-loop use (schedules, hooks) where carried state is noise.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CounterState", "counter_init", "counter_update", "Counter",
+    "EmaState", "ema_init", "ema_update", "ExponentialMovingAverage",
+]
+
+
+class CounterState(NamedTuple):
+    count: jax.Array  # int32 scalar
+
+
+def counter_init(init: int = 0) -> CounterState:
+    return CounterState(count=jnp.asarray(init, jnp.int32))
+
+
+def counter_update(state: CounterState, incr: int = 1
+                   ) -> Tuple[jax.Array, CounterState]:
+    """Returns the *current* count, then advances — the reference op yields
+    ``init`` on its first execution (state.cpp:31-41)."""
+    return state.count, CounterState(count=state.count + jnp.int32(incr))
+
+
+class EmaState(NamedTuple):
+    initialized: jax.Array  # bool scalar
+    value: jax.Array        # float scalar (or array)
+
+
+def ema_init(like=0.0) -> EmaState:
+    v = jnp.asarray(like, jnp.float32)
+    return EmaState(initialized=jnp.asarray(False), value=jnp.zeros_like(v))
+
+
+def ema_update(state: EmaState, x, alpha: float = 0.9
+               ) -> Tuple[jax.Array, EmaState]:
+    """First sample seeds the average; afterwards
+    ``v <- alpha * v + (1 - alpha) * x`` (ema.hpp:19-28)."""
+    x = jnp.asarray(x, state.value.dtype)
+    new = jnp.where(state.initialized,
+                    alpha * state.value + (1.0 - alpha) * x,
+                    x)
+    return new, EmaState(initialized=jnp.asarray(True), value=new)
+
+
+class Counter:
+    """Eager host-side counter matching the reference op's call pattern:
+    each call returns the current value then increments."""
+
+    def __init__(self, init: int = 0, incr: int = 1):
+        self._count = int(init)
+        self._incr = int(incr)
+
+    def __call__(self) -> int:
+        c = self._count
+        self._count += self._incr
+        return c
+
+
+class ExponentialMovingAverage:
+    """Eager host-side EMA (float), same recurrence as the jit version."""
+
+    def __init__(self, alpha: float = 0.9):
+        self._alpha = float(alpha)
+        self._value: float | None = None
+
+    def __call__(self, x: float) -> float:
+        if self._value is None:
+            self._value = float(x)
+        else:
+            self._value = self._alpha * self._value + (1 - self._alpha) * float(x)
+        return self._value
+
+    @property
+    def value(self):
+        return self._value

@@ -4,6 +4,8 @@ from .graph import Graph
 from .hostspec import DEFAULT_RUNNER_PORT, DEFAULT_WORKER_PORT, HostList, HostSpec
 from .partition import (DEFAULT_CHUNK_BYTES, Interval, chunk_partition,
                         even_partition, stripe)
+from .mst import (RoundRobin, edges_to_father, minimum_spanning_tree,
+                  neighbour_mask, tree_from_latencies)
 from .peer import NetAddr, PeerID, PeerList
 from .topology import (DEFAULT_STRATEGY, GraphPair, Strategy, auto_select,
                        binary_tree_pair, cross_host_pairs, generate,
@@ -15,5 +17,6 @@ __all__ = [
     "DEFAULT_WORKER_PORT", "DEFAULT_RUNNER_PORT", "DEFAULT_CHUNK_BYTES",
     "Interval", "auto_select", "binary_tree_pair", "chunk_partition",
     "cross_host_pairs", "even_partition", "generate", "ring_pair",
-    "star_pair", "stripe",
+    "star_pair", "stripe", "minimum_spanning_tree", "edges_to_father",
+    "neighbour_mask", "RoundRobin", "tree_from_latencies",
 ]

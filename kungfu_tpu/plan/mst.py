@@ -1,0 +1,143 @@
+"""Latency-driven topology adaptation: minimum spanning tree + peer masks.
+
+Reference semantics: srcs/cpp/include/kungfu/mst.hpp:10-59 (Prim's MST over
+a symmetrized peer-latency matrix), srcs/cpp/src/tensorflow/ops/cpu/
+topology.cpp:118-231 (MinimumSpanningTree / GetNeighbourMask / RoundRobin
+ops) and srcs/python/kungfu/tensorflow/ops/__init__.py:49-83 wrappers.
+
+On TPU this is pure control-plane work: latencies come from the host-side
+native runtime (ping RTTs over the control transport), the MST is computed
+on host with numpy, and the resulting father-array forest is installed into
+the collective Session via ``set_tree`` — the XLA data plane then compiles
+the new reduce/broadcast schedule.  Nothing here runs inside jit.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "minimum_spanning_tree",
+    "edges_to_father",
+    "neighbour_mask",
+    "RoundRobin",
+    "tree_from_latencies",
+]
+
+
+def minimum_spanning_tree(weights: np.ndarray, seed: int = 0
+                          ) -> List[Tuple[int, int]]:
+    """Prim's MST over an ``(n, n)`` weight matrix.
+
+    Weights are symmetrized as ``(w[i,j] + w[j,i]) / 2`` (each peer only
+    measures its own outbound latency; the true link cost is the average of
+    both directions).  Returns ``n - 1`` edges ``(u, v)`` where ``u`` is the
+    vertex already in the tree — so each edge reads "``v`` hangs off ``u``".
+    """
+    w = np.asarray(weights, dtype=np.float64)
+    n = w.shape[0]
+    if w.shape != (n, n):
+        raise ValueError(f"weights must be square, got {w.shape}")
+    if not 0 <= seed < n:
+        raise ValueError(f"seed {seed} out of range for n={n}")
+    sym = (w + w.T) / 2.0
+
+    in_tree = np.zeros(n, dtype=bool)
+    in_tree[seed] = True
+    best = sym[seed].copy()
+    from_v = np.full(n, seed, dtype=np.int64)
+
+    edges: List[Tuple[int, int]] = []
+    for _ in range(n - 1):
+        cand = np.where(~in_tree, best, np.inf)
+        k = int(np.argmin(cand))
+        in_tree[k] = True
+        edges.append((int(from_v[k]), k))
+        better = ~in_tree & (sym[k] < best)
+        best[better] = sym[k][better]
+        from_v[better] = k
+    return edges
+
+
+def edges_to_father(edges: Sequence[Tuple[int, int]], n: int,
+                    root: int = 0) -> List[int]:
+    """Orient MST edges away from ``root`` → father array for ``set_tree``.
+
+    ``father[root] == root``; every other vertex points at its parent on the
+    path to the root.  This is the encoding the runtime's explicit-forest
+    collectives consume (reference: graph.go FromForestArray /
+    SimpleSetGlobalStrategy's ``forest []int32``).
+    """
+    adj: List[List[int]] = [[] for _ in range(n)]
+    for u, v in edges:
+        adj[u].append(v)
+        adj[v].append(u)
+    father = list(range(n))
+    seen = [False] * n
+    stack = [root]
+    seen[root] = True
+    while stack:
+        u = stack.pop()
+        for v in adj[u]:
+            if not seen[v]:
+                seen[v] = True
+                father[v] = u
+                stack.append(v)
+    if not all(seen):
+        missing = [i for i, s in enumerate(seen) if not s]
+        raise ValueError(f"edges do not span all vertices; unreached={missing}")
+    return father
+
+
+def neighbour_mask(edges: Sequence[Tuple[int, int]], n: int,
+                   rank: int) -> np.ndarray:
+    """Boolean mask of ``rank``'s direct neighbours in the tree.
+
+    Used by pair-averaging peer selection to prefer topologically-close
+    peers (reference GetNeighbourMask, topology.cpp:154-194).
+    """
+    mask = np.zeros(n, dtype=bool)
+    for u, v in edges:
+        if u == rank:
+            mask[v] = True
+        elif v == rank:
+            mask[u] = True
+    return mask
+
+
+class RoundRobin:
+    """Cyclic chooser over a boolean mask (reference RoundRobin op,
+    topology.cpp:196-228).  Returns -1 when the mask is all-false."""
+
+    def __init__(self) -> None:
+        self._pos = 0
+
+    def __call__(self, mask: Sequence[bool]) -> int:
+        n = len(mask)
+        if n == 0:
+            return -1
+        for i in range(n):
+            idx = (self._pos + i) % n
+            if mask[idx]:
+                self._pos = (idx + 1) % n
+                return idx
+        return -1
+
+
+def tree_from_latencies(latency_matrix: np.ndarray,
+                        root: int = 0,
+                        seed: Optional[int] = None) -> List[int]:
+    """Full pipeline: latency matrix → MST → father array.
+
+    ``latency_matrix[i, j]`` = latency peer ``i`` measured to peer ``j``
+    (rows gathered from every peer's ``get_peer_latencies``).  The result
+    feeds ``Session.set_tree`` so subsequent allreduces ride the
+    lowest-latency spanning tree — the reference's adaptive-topology loop
+    (ops/__init__.py:58-70 + SimpleSetGlobalStrategy).
+    """
+    if seed is None:
+        seed = root
+    n = np.asarray(latency_matrix).shape[0]
+    edges = minimum_spanning_tree(latency_matrix, seed=seed)
+    return edges_to_father(edges, n, root=root)

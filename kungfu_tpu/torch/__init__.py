@@ -1,0 +1,47 @@
+"""PyTorch bridge: data-parallel training over the native control-plane
+runtime.
+
+Reference: srcs/python/kungfu/torch/ — ``SynchronousSGDOptimizer`` grafts a
+``sync_gradients`` allreduce onto ``optimizer.step()``
+(optimizers/sync_sgd.py:6-33), ``broadcast_parameters(state_dict)``
+(ops/collective.py:40-46), dtype-keyed op dispatch with feature detection
+(ops/clib.py:12-36).
+
+TPU-native context: the jax/XLA path is the compute plane; this bridge
+serves torch-side host workloads (CPU data/preprocessing models, reference
+parity) by running collectives over the same C++ runtime
+(kungfu_tpu.native) the control plane uses — torch CPU tensors are
+zero-copy numpy views, reduced in place.  It exceeds the reference bridge
+(f32 + SUM only) with f16/f32/f64/i32/i64 and SUM/AVG/MIN/MAX/PROD.
+"""
+from .ops import (all_gather, all_reduce_fn, broadcast_parameters,
+                  dtype_supported, inplace_all_reduce_op,
+                  inplace_broadcast_op)
+from .optimizers import SynchronousSGDOptimizer, PairAveragingOptimizer
+
+
+def current_rank() -> int:
+    from .. import native
+    p = native.default_peer()
+    return 0 if p is None else p.rank
+
+
+def current_cluster_size() -> int:
+    from .. import native
+    p = native.default_peer()
+    return 1 if p is None else p.size
+
+
+def run_barrier() -> None:
+    from .. import native
+    p = native.default_peer()
+    if p is not None:
+        p.barrier()
+
+
+__all__ = [
+    "SynchronousSGDOptimizer", "PairAveragingOptimizer",
+    "broadcast_parameters", "all_gather", "all_reduce_fn",
+    "inplace_all_reduce_op", "inplace_broadcast_op", "dtype_supported",
+    "current_rank", "current_cluster_size", "run_barrier",
+]

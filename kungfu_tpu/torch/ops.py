@@ -1,0 +1,114 @@
+"""Torch collective ops over the native runtime.
+
+Reference: srcs/python/kungfu/torch/ops/collective.py + clib.py — a
+dtype-keyed dispatch table over tensor types.  Here every supported CPU
+tensor shares memory with a numpy view, so collectives reduce in place
+without copies beyond the wire."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# torch dtype -> numpy dtype the native runtime can reduce
+_SUPPORTED: Optional[Dict] = None
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _supported() -> Dict:
+    global _SUPPORTED
+    if _SUPPORTED is None:
+        torch = _torch()
+        _SUPPORTED = {
+            torch.float16: np.float16,
+            torch.float32: np.float32,
+            torch.float64: np.float64,
+            torch.int32: np.int32,
+            torch.int64: np.int64,
+            torch.uint8: np.uint8,
+        }
+    return _SUPPORTED
+
+
+def dtype_supported(t) -> bool:
+    return t.dtype in _supported() and t.device.type == "cpu"
+
+
+def _peer():
+    from .. import native
+    p = native.default_peer()
+    if p is None:
+        raise RuntimeError(
+            "no native peer: run under the launcher "
+            "(python -m kungfu_tpu.launcher -np N ...) for torch collectives")
+    return p
+
+
+def _view(x) -> np.ndarray:
+    """Flat numpy view sharing memory with a contiguous CPU tensor."""
+    if x.device.type != "cpu":
+        raise TypeError(f"torch bridge supports CPU tensors, got {x.device}")
+    if x.dtype not in _supported():
+        raise TypeError(f"unsupported dtype {x.dtype}")
+    return x.detach().view(-1).numpy()
+
+
+def _inplace(x, fn) -> None:
+    """Run ``fn(flat_view)`` in place, round-tripping through a contiguous
+    staging tensor when ``x`` itself is not contiguous."""
+    t = x if x.is_contiguous() else x.detach().contiguous()
+    fn(_view(t))
+    if t is not x:
+        with _torch().no_grad():
+            x.copy_(t.view_as(x))
+
+
+def inplace_all_reduce_op(x, op: str = "sum", name: str = "") -> None:
+    """Allreduce ``x`` in place.  ``op``: sum/avg/min/max/prod; ``avg`` is
+    sum followed by division by cluster size (sync-SGD gradient mean)."""
+    p = _peer()
+    kf_op = "SUM" if op.lower() in ("sum", "avg") else op.upper()
+
+    def run(v):
+        out = p.all_reduce(v, op=kf_op, name=name or "torch:ar")
+        if op.lower() == "avg":
+            out = (out / p.size).astype(v.dtype)
+        np.copyto(v, out)
+    _inplace(x, run)
+
+
+def all_reduce_fn(x, op: str = "sum", name: str = ""):
+    y = x.clone()
+    inplace_all_reduce_op(y, op=op, name=name)
+    return y
+
+
+def inplace_broadcast_op(x, root: int = 0, name: str = "") -> None:
+    p = _peer()
+
+    def run(v):
+        np.copyto(v, p.broadcast(v, root=root, name=name or "torch:bc"))
+    _inplace(x, run)
+
+
+def broadcast_parameters(state_dict, root: int = 0) -> None:
+    """Broadcast every tensor in a ``state_dict`` from ``root`` (reference:
+    ops/collective.py:40-46).  Non-tensor entries are ignored."""
+    torch = _torch()
+    for name, value in state_dict.items():
+        if isinstance(value, torch.Tensor) and value.numel() > 0:
+            inplace_broadcast_op(value, root=root, name=f"bcast:{name}")
+
+
+def all_gather(x, name: str = ""):
+    """Gather ``x`` from all peers → stacked tensor with a leading peer
+    axis (reference: ops/collective.py:49-53)."""
+    torch = _torch()
+    p = _peer()
+    v = _view(x if x.is_contiguous() else x.detach().contiguous())
+    out = p.all_gather(v, name=name or "torch:ag")
+    return torch.from_numpy(out.reshape((p.size,) + tuple(x.shape)).copy())

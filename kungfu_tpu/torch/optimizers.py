@@ -1,0 +1,94 @@
+"""Torch distributed optimizers.
+
+Reference: srcs/python/kungfu/torch/optimizers/sync_sgd.py:6-33 — the
+wrapped optimizer's class is dynamically subclassed so ``step()`` first
+synchronizes gradients; user code keeps its optimizer type.  The reference
+only ships sync-SGD for torch; ``PairAveragingOptimizer`` extends the
+bridge with the AD-PSGD scheme (reference TF version:
+optimizers/async_sgd.py:78-142) over the native p2p model store.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .ops import _peer, _view, inplace_all_reduce_op, inplace_broadcast_op
+
+
+class _SynchronousSGD:
+    def sync_gradients(self):
+        for name, p in self._kf_named_parameters:
+            if p.requires_grad and p.grad is not None:
+                inplace_all_reduce_op(p.grad, op=self._kf_op,
+                                      name=f"grad:{name}")
+
+    def step(self, closure=None):
+        self.sync_gradients()
+        return super(self.__class__, self).step(closure)
+
+
+def SynchronousSGDOptimizer(optimizer, named_parameters, op: str = "avg"):
+    """Graft gradient synchronization onto any ``torch.optim.Optimizer``.
+
+    ``op="avg"`` averages gradients across peers (equivalent to the TF
+    sync-SGD's grad-sum ÷ np, sync_sgd.py:58-109); ``op="sum"`` matches the
+    raw reference torch default."""
+    clazz = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                 dict(_SynchronousSGD.__dict__))
+    optimizer.__class__ = clazz
+    optimizer._kf_named_parameters = list(named_parameters)
+    optimizer._kf_op = op
+    return optimizer
+
+
+class _PairAveraging:
+    def _kf_params(self):
+        for name, p in self._kf_named_parameters:
+            if p.requires_grad:
+                yield name, p
+
+    def _save_model(self):
+        peer = _peer()
+        for name, p in self._kf_params():
+            peer.save(f"param:{name}", np.ascontiguousarray(_view(p)))
+
+    def step(self, closure=None):
+        peer = _peer()
+        if not self._kf_initialized:
+            # step-0: align all peers then seed the store (async_sgd.py:96-117)
+            for _, p in self._kf_params():
+                inplace_broadcast_op(p, root=0)
+            self._save_model()
+            peer.barrier(name="pair-avg-init")
+            self._kf_initialized = True
+        out = super(self.__class__, self).step(closure)
+        n = peer.size
+        if n > 1:
+            target = self._kf_select(n, peer.rank)
+            import torch
+            with torch.no_grad():
+                for name, p in self._kf_params():
+                    v = _view(p if p.is_contiguous() else p.contiguous())
+                    other = peer.request(target, f"param:{name}", v)
+                    avg = ((v + other) * 0.5).astype(v.dtype)
+                    p.copy_(torch.from_numpy(avg).view_as(p))
+        self._save_model()
+        return out
+
+    def _kf_select(self, n: int, rank: int) -> int:
+        # random other peer (reference SelectionStrategy 'random')
+        t = int(self._kf_rng.randint(0, n - 1))
+        return t if t < rank else t + 1
+
+
+def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
+    """AD-PSGD: after each local step, average parameters with one randomly
+    chosen peer via the p2p store (request + 0.5-average + save)."""
+    clazz = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                 dict(_PairAveraging.__dict__))
+    optimizer.__class__ = clazz
+    optimizer._kf_named_parameters = list(named_parameters)
+    optimizer._kf_initialized = False
+    optimizer._kf_rng = np.random.RandomState(seed)
+    return optimizer

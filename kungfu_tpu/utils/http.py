@@ -37,3 +37,6 @@ class BackgroundHTTPServer:
     def shutdown_async(self) -> None:
         """Shut down from inside a request handler without deadlocking."""
         threading.Thread(target=self._http.shutdown, daemon=True).start()
+
+    def is_running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()

@@ -148,13 +148,16 @@ class BlobStore {
         if (it != versions.end() && it->second.size() != n) return false;
         versions[version].assign(static_cast<const uint8_t *>(data),
                                  static_cast<const uint8_t *>(data) + n);
-        // GC: keep the `window_` highest versions (unversioned slot -1 kept).
+        // GC: keep the `window_` highest versions; the unversioned slot -1
+        // is pinned and does not count against the window.
         while (window_ > 0) {
-            int64_t lo = versions.begin()->first;
-            if (lo < 0 || static_cast<int>(versions.size()) <=
-                              window_ + (versions.count(-1) ? 1 : 0))
+            auto first = versions.lower_bound(0);  // skip the pinned -1 slot
+            size_t versioned =
+                versions.size() - (versions.count(-1) ? 1 : 0);
+            if (first == versions.end() ||
+                static_cast<int>(versioned) <= window_)
                 break;
-            versions.erase(versions.begin());
+            versions.erase(first);
         }
         return true;
     }

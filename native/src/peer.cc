@@ -465,6 +465,16 @@ class Peer {
                     ::close(fd);
                     return;
                 }
+                // prune inbound conns whose reader already exited, so churn
+                // from elastic reconnects does not accumulate dead Conns
+                for (auto it = in_conns_.begin(); it != in_conns_.end();) {
+                    if (!(*it)->alive) {
+                        if ((*it)->reader.joinable()) (*it)->reader.join();
+                        it = in_conns_.erase(it);
+                    } else {
+                        ++it;
+                    }
+                }
                 in_conns_.push_back(conn);
             }
             // handshake runs inside the tracked reader thread so stop() can
@@ -531,6 +541,12 @@ class Peer {
                     r.flags = FLAG_RESPONSE;
                     r.token = token_.load();
                     r.name = m.name;
+                    if (m.body.size() < 8) {  // version header is mandatory
+                        r.flags |= FLAG_FAILED;
+                        std::lock_guard<std::mutex> wg(conn->write_mu);
+                        send_msg(conn->fd, r);
+                        break;
+                    }
                     if (m.flags & FLAG_SAVE) {
                         int64_t ver;
                         std::memcpy(&ver, m.body.data(), 8);
@@ -612,15 +628,17 @@ class Peer {
         auto &slot = out_conns_[{dest, cls}];
         if (slot && slot->alive) {  // raced; keep the existing one
             close_conn(conn);
-            if (conn->reader.joinable()) conn->reader.detach();
+            graveyard_.push_back(conn);  // reader exits on closed fd; joined at stop()
             return slot;
         }
+        if (slot) graveyard_.push_back(slot);  // dead conn: thread still joinable
         slot = conn;
         return slot;
     }
 
     std::shared_ptr<Conn> dial(int dest, int cls) {
         const PeerAddr &pa = peers_[dest];
+        bool rejected = false;
         // retry loop (reference: ConnRetryCount 500 x 200ms wait-peer-up)
         for (int attempt = 0; attempt < conn_retries_; attempt++) {
             if (!running_) break;
@@ -662,9 +680,10 @@ class Peer {
                 }
                 ::close(fd);
                 if (ack.flags & FLAG_FAILED) {
-                    set_error("connection rejected by peer " +
-                              std::to_string(dest) + " (stale token)");
-                    return nullptr;
+                    // token skew is transient during a membership change
+                    // (peers adopt the new token asynchronously) — keep
+                    // retrying; only exhaustion is terminal
+                    rejected = true;
                 }
             } else {
                 ::close(fd);
@@ -672,8 +691,12 @@ class Peer {
             std::this_thread::sleep_for(
                 std::chrono::milliseconds(conn_retry_ms_));
         }
-        set_error("cannot connect to peer " + std::to_string(dest) + " (" +
-                  pa.host + ":" + std::to_string(pa.port) + ")");
+        if (rejected)
+            set_error("connection rejected by peer " + std::to_string(dest) +
+                      " (stale token)");
+        else
+            set_error("cannot connect to peer " + std::to_string(dest) +
+                      " (" + pa.host + ":" + std::to_string(pa.port) + ")");
         return nullptr;
     }
 

@@ -1,0 +1,56 @@
+"""Synthetic allreduce benchmark CLI (reference: v1/benchmarks/__main__.py)."""
+import subprocess
+import sys
+
+import pytest
+
+from kungfu_tpu.benchmarks import show_rate, show_size
+from kungfu_tpu.benchmarks.__main__ import main as bench_main
+
+
+def test_show_size_units():
+    assert show_size(100) == "100"
+    assert show_size(2048) == "2.00Ki"
+    assert show_size(3 * 1024 * 1024) == "3.00Mi"
+    assert show_size(5 * 1024 ** 3) == "5.00Gi"
+
+
+def test_show_rate_units():
+    assert show_rate(1024 ** 2, 1.0) == "1.00MiB/s"
+    assert show_rate(10, 1.0) == "10.00B/s"
+
+
+def test_xla_bench_emits_result_line(capsys):
+    bench_main(["--model", "SLP", "--method", "XLA",
+                "--steps", "2", "--warmup-steps", "1"])
+    out = capsys.readouterr().out
+    assert "RESULT: " in out
+    assert '"method":"XLA"' in out
+    assert '"np":' in out
+
+
+def test_hier_bench_fused(capsys):
+    bench_main(["--model", "SLP", "--method", "HIER", "--hosts", "2",
+                "--devices", "4", "--fuse",
+                "--steps", "1", "--warmup-steps", "0"])
+    out = capsys.readouterr().out
+    assert "RESULT: " in out and '"fuse":true' in out
+
+
+def test_max_count_truncates(capsys):
+    bench_main(["--model", "ResNet50", "--method", "XLA", "--max-count", "3",
+                "--steps", "1", "--warmup-steps", "0"])
+    out = capsys.readouterr().out
+    assert "all reduce 3 tensors" in out
+
+
+def test_native_bench_via_launcher():
+    from kungfu_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    cmd = [sys.executable, "-m", "kungfu_tpu.launcher", "-q", "-np", "2",
+           sys.executable, "-m", "kungfu_tpu.benchmarks", "--model", "SLP",
+           "--method", "NATIVE", "--steps", "1", "--warmup-steps", "0"]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "RESULT: " in out.stdout

@@ -1,0 +1,42 @@
+"""Standalone config-server CLI (reference: kungfu-config-server binary)."""
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def test_ttl_auto_shutdown_and_initial_config():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
+         "-port", "0", "-host", "127.0.0.1", "-ttl", "5",
+         "-H", "127.0.0.1:4", "-np", "2"],
+        cwd=REPO, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "listening on" in line
+        url = line.split("listening on ")[1].split()[0]
+        with urllib.request.urlopen(url, timeout=5) as r:
+            d = json.loads(r.read().decode())
+        assert d["version"] == 1
+        assert len(d["cluster"]["workers"]) == 2
+        # /stop ends it well before the ttl
+        stop = url.rsplit("/", 1)[0] + "/stop"
+        with urllib.request.urlopen(stop, timeout=5) as r:
+            assert json.loads(r.read().decode())["ok"]
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_cmd_embedded_entrypoints():
+    import kungfu_tpu.cmd as cmd
+    # run the embedded launcher on a trivial one-worker job
+    rc = cmd.run(["-q", "-np", "1", sys.executable, "-c", "print('ok')"])
+    assert rc == 0

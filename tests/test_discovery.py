@@ -1,0 +1,69 @@
+"""TPU pod / self-IP discovery (reference: platforms/modelarts,
+runner/discovery.go)."""
+import pytest
+
+from kungfu_tpu.launcher.discovery import (chips_per_host, discover_tpu_pod,
+                                           infer_self_ipv4)
+
+
+def test_no_pod_env_returns_none():
+    assert discover_tpu_pod({}) is None
+
+
+def test_pod_discovery_from_env():
+    env = {
+        "TPU_WORKER_HOSTNAMES": "t1v-n-0, t1v-n-1, t1v-n-2",
+        "TPU_WORKER_ID": "1",
+        "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+    }
+    pod = discover_tpu_pod(env)
+    assert pod is not None
+    assert pod.num_hosts == 3
+    assert pod.self_index == 1
+    assert pod.self_host == "t1v-n-1"
+    assert all(h.slots == 4 for h in pod.hosts)
+    workers = pod.worker_list(workers_per_host=2)
+    assert len(workers) == 6
+
+
+def test_chips_per_host_default_and_bounds():
+    assert chips_per_host({}) == 4
+    assert chips_per_host({"TPU_CHIPS_PER_HOST_BOUNDS": "2,2,2"}) == 8
+
+
+def test_single_host_idx_quirk():
+    pod = discover_tpu_pod({"TPU_WORKER_HOSTNAMES": "only",
+                            "TPU_WORKER_ID": "1"})
+    assert pod.self_index == 0
+
+
+def test_out_of_range_worker_id_raises():
+    with pytest.raises(ValueError):
+        discover_tpu_pod({"TPU_WORKER_HOSTNAMES": "a,b",
+                          "TPU_WORKER_ID": "5"})
+
+
+def test_infer_self_ipv4_explicit_wins():
+    assert infer_self_ipv4("10.1.2.3") == "10.1.2.3"
+
+
+def test_single_host_pod_does_not_rename_launcher_hosts(monkeypatch, capsys):
+    """libtpu sets TPU_WORKER_HOSTNAMES=localhost even on one VM; the
+    launcher must stay on the 127.0.0.1 local path so config-server PUTs
+    using 127.0.0.1 keep matching (regression: single-host discovery made
+    watch-mode resizes kill every worker)."""
+    import sys as _sys
+    from kungfu_tpu.launcher.cli import main
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    rc = main(["-q", "-np", "1", _sys.executable, "-c",
+               "import os; print('SPEC', os.environ['KFT_SELF_SPEC'])"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SPEC 127.0.0.1:" in out
+
+
+def test_infer_self_ipv4_fallback_is_valid_ip():
+    import socket
+    ip = infer_self_ipv4()
+    socket.inet_aton(ip)  # parses

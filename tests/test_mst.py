@@ -1,0 +1,126 @@
+"""MST-from-latencies topology adaptation (reference: mst.hpp,
+ops/cpu/topology.cpp MinimumSpanningTree/GetNeighbourMask/RoundRobin)."""
+import numpy as np
+import pytest
+
+from kungfu_tpu.plan.mst import (RoundRobin, edges_to_father,
+                                 minimum_spanning_tree, neighbour_mask,
+                                 tree_from_latencies)
+
+
+def _mst_weight(edges, w):
+    sym = (w + w.T) / 2.0
+    return sum(sym[u, v] for u, v in edges)
+
+
+def test_mst_line_topology():
+    # latencies grow with rank distance -> MST must be the chain 0-1-2-3
+    n = 4
+    w = np.abs(np.subtract.outer(np.arange(n), np.arange(n))).astype(float)
+    edges = minimum_spanning_tree(w)
+    assert len(edges) == n - 1
+    assert sorted(tuple(sorted(e)) for e in edges) == [(0, 1), (1, 2), (2, 3)]
+
+
+def test_mst_star_topology():
+    # peer 0 is close to everyone; others far apart -> star around 0
+    n = 5
+    w = np.full((n, n), 100.0)
+    np.fill_diagonal(w, 0.0)
+    w[0, :] = 1.0
+    w[:, 0] = 1.0
+    edges = minimum_spanning_tree(w)
+    assert sorted(tuple(sorted(e)) for e in edges) == [(0, i) for i in range(1, n)]
+
+
+def test_mst_is_minimum_vs_bruteforce():
+    rng = np.random.RandomState(7)
+    n = 6
+    w = rng.rand(n, n) * 10
+    edges = minimum_spanning_tree(w)
+    got = _mst_weight(edges, w)
+    # brute force over all spanning trees via Prufer sequences
+    import itertools
+    best = np.inf
+    for seq in itertools.product(range(n), repeat=n - 2):
+        # decode Prufer sequence
+        degree = [1] * n
+        for x in seq:
+            degree[x] += 1
+        tree = []
+        seq_list = list(seq)
+        leaves = sorted(i for i in range(n) if degree[i] == 1)
+        import heapq
+        heapq.heapify(leaves)
+        for x in seq_list:
+            leaf = heapq.heappop(leaves)
+            tree.append((leaf, x))
+            degree[x] -= 1
+            if degree[x] == 1:
+                heapq.heappush(leaves, x)
+        u = heapq.heappop(leaves)
+        v = heapq.heappop(leaves)
+        tree.append((u, v))
+        best = min(best, _mst_weight(tree, w))
+    assert got == pytest.approx(best)
+
+
+def test_mst_uses_symmetrized_weights():
+    # asymmetric link: mean decides
+    w = np.array([[0.0, 1.0, 50.0],
+                  [9.0, 0.0, 2.0],
+                  [50.0, 2.0, 0.0]])
+    edges = minimum_spanning_tree(w)
+    # sym(0,1)=5, sym(1,2)=2, sym(0,2)=50 -> edges {0-1, 1-2}
+    assert sorted(tuple(sorted(e)) for e in edges) == [(0, 1), (1, 2)]
+
+
+def test_edges_to_father_roots_at_requested_rank():
+    edges = [(0, 1), (1, 2), (2, 3)]
+    father = edges_to_father(edges, 4, root=0)
+    assert father == [0, 0, 1, 2]
+    father2 = edges_to_father(edges, 4, root=2)
+    assert father2[2] == 2 and father2[3] == 2 and father2[1] == 2 and father2[0] == 1
+
+
+def test_edges_to_father_rejects_disconnected():
+    with pytest.raises(ValueError):
+        edges_to_father([(0, 1)], 4, root=0)
+
+
+def test_neighbour_mask():
+    edges = [(0, 1), (1, 2), (2, 3)]
+    assert neighbour_mask(edges, 4, 1).tolist() == [True, False, True, False]
+    assert neighbour_mask(edges, 4, 0).tolist() == [False, True, False, False]
+
+
+def test_round_robin_cycles_through_mask():
+    rr = RoundRobin()
+    mask = [False, True, False, True]
+    picks = [rr(mask) for _ in range(4)]
+    assert picks == [1, 3, 1, 3]
+    assert rr([False, False]) == -1
+    assert rr([]) == -1
+
+
+def test_tree_from_latencies_end_to_end():
+    n = 4
+    w = np.abs(np.subtract.outer(np.arange(n), np.arange(n))).astype(float)
+    father = tree_from_latencies(w, root=0)
+    assert father == [0, 0, 1, 2]
+
+
+def test_session_adapt_tree_from_latencies():
+    import jax
+    from kungfu_tpu.comm.mesh import flat_mesh
+    from kungfu_tpu.comm.session import Session
+
+    n = min(4, len(jax.devices()))
+    sess = Session(mesh=flat_mesh(n=n))
+    w = np.abs(np.subtract.outer(np.arange(n), np.arange(n))).astype(float)
+    father = sess.adapt_tree_from_latencies(w)
+    assert father[0] == 0
+    # allreduce over the installed tree still sums correctly
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    out = np.asarray(sess.all_reduce(x))
+    np.testing.assert_allclose(out, np.full((n, 1), x.sum()), rtol=1e-6)

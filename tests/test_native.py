@@ -149,6 +149,20 @@ def _w_p2p(rank, peers, q):
             p.barrier(name="gc")
             with pytest.raises(native.NativeError):
                 p.request(target, "model", model, version=1)
+            # an unversioned save must not pin versioned blobs (GC keeps
+            # sliding even with the -1 slot present)
+            p.save("pinned", model)  # unversioned
+            for v in range(10, 16):
+                p.save("pinned", model + v, version=v)
+            p.barrier(name="gc2")
+            with pytest.raises(native.NativeError):
+                p.request(target, "pinned", model, version=10)
+            got = p.request(target, "pinned", model, version=15)
+            np.testing.assert_allclose(
+                got, np.arange(100, dtype=np.float32) + target * 1000 + 15)
+            # father-array validation
+            with pytest.raises(ValueError):
+                p.all_reduce_tree(model, [0] * (n + 1))
             # monitoring: egress counted, ping works
             assert p.egress_bytes() > 0
             rtt = p.ping(target)
@@ -161,12 +175,15 @@ def _w_p2p(rank, peers, q):
         q.put((rank, f"ERROR {type(e).__name__}: {e}"))
 
 
-def _w_fence(rank, peers, q):
+def _w_fence(rank, peers, q, healed):
     """Version-token fencing: peers on different tokens cannot talk
-    (reference: connection.go:77-87)."""
+    (reference: connection.go:77-87).  Stale-token rejection is retried
+    (token adoption is asynchronous during a resize), so rejection only
+    surfaces after the retry budget; `healed` gates the heal phase so
+    worker 1 doesn't burn its budget while worker 0 is still fenced."""
     from kungfu_tpu.native import NativePeer
     try:
-        os.environ["KFT_CONN_RETRIES"] = "3"
+        os.environ["KFT_CONN_RETRIES"] = "20"
         os.environ["KFT_CONN_RETRY_MS"] = "50"
         os.environ["KFT_RECV_TIMEOUT_S"] = "20"
         with NativePeer(rank, peers, token=rank) as p:  # mismatched tokens
@@ -178,9 +195,30 @@ def _w_fence(rank, peers, q):
                     return
                 except native.NativeError:
                     pass
-            # re-align on token 7 → cluster works again
-            p.reset_connections(7)
+                # re-align on token 7 → cluster works again
+                p.reset_connections(7)
+                healed.set()
+            else:
+                assert healed.wait(timeout=60)
+                p.reset_connections(7)
             p.barrier(name="fence-heal")
+            q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def _w_mst(rank, peers, q):
+    """MST adaptation: measure latencies → all-gather → tree → allreduce."""
+    from kungfu_tpu.native import NativePeer
+    try:
+        n = len(peers)
+        with NativePeer(rank, peers) as p:
+            father = p.mst_tree(root=0)
+            assert len(father) == n and father[0] == 0
+            got = p.all_reduce_tree(np.full(8, rank + 1, np.float32), father,
+                                    op="SUM", name="mst-ar")
+            np.testing.assert_allclose(got, np.full(8, n * (n + 1) / 2))
+            p.barrier(name="pre-exit")
             q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover
         q.put((rank, f"ERROR {type(e).__name__}: {e}"))
@@ -210,8 +248,13 @@ def test_p2p_store_and_monitoring():
 
 
 def test_token_fencing():
-    _spawn(_w_fence, 2)
+    healed = mp.get_context("spawn").Event()
+    _spawn(_w_fence, 2, healed)
 
 
 def test_single_peer_degenerate():
     _spawn(_w_suite, 1)
+
+
+def test_mst_adaptation():
+    _spawn(_w_mst, 4)

@@ -1,0 +1,177 @@
+"""Torch bridge tests (reference: tests/python/integration/test_torch_ops.py
++ torch optimizer semantics, srcs/python/kungfu/torch/)."""
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import native  # noqa: E402
+
+torch = pytest.importorskip("torch")
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(target, n, *extra):
+    ports = _free_ports(n)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(r, peers, q) + extra)
+             for r in range(n)]
+    for p in procs:
+        p.start()
+    for _ in range(n):
+        r, val = q.get(timeout=180)
+        if isinstance(val, str) and val.startswith("ERROR"):
+            for p in procs:
+                p.terminate()
+            raise AssertionError(f"worker {r}: {val}")
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+
+
+def _with_peer(rank, peers):
+    from kungfu_tpu.native import NativePeer
+    p = NativePeer(rank, peers).start()
+    native.use_peer(p)
+    return p
+
+
+def _w_ops(rank, peers, q):
+    import torch
+    try:
+        p = _with_peer(rank, peers)
+        n = len(peers)
+        import kungfu_tpu.torch as kft
+
+        # inplace allreduce avg + sum, several dtypes
+        x = torch.full((5,), float(rank + 1), dtype=torch.float32)
+        kft.inplace_all_reduce_op(x, op="avg")
+        want = sum(r + 1 for r in range(n)) / n
+        assert torch.allclose(x, torch.full((5,), want))
+        ix = torch.arange(4, dtype=torch.int64) + rank
+        kft.inplace_all_reduce_op(ix, op="sum")
+        want_i = sum(np.arange(4) + r for r in range(n))
+        assert ix.numpy().tolist() == want_i.tolist()
+        # non-contiguous tensor round trip
+        m = torch.zeros(4, 4, dtype=torch.float32)
+        col = m.t()[1]  # non-contiguous view
+        col += rank + 1
+        kft.inplace_all_reduce_op(col, op="sum")
+        assert torch.allclose(m[:, 1],
+                              torch.full((4,), float(n * (n + 1) / 2)))
+        assert torch.allclose(m[:, 0], torch.zeros(4))
+        # broadcast_parameters
+        sd = {"w": torch.full((3,), float(rank)),
+              "b": torch.full((2,), float(rank) * 10)}
+        kft.broadcast_parameters(sd)
+        assert torch.allclose(sd["w"], torch.zeros(3))
+        # all_gather
+        ag = kft.all_gather(torch.full((2,), float(rank)))
+        assert ag.shape == (n, 2)
+        assert [float(ag[r, 0]) for r in range(n)] == [float(r) for r in range(n)]
+        p.barrier(name="pre-exit")
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def _w_syncsgd(rank, peers, q):
+    import torch
+    try:
+        p = _with_peer(rank, peers)
+        n = len(peers)
+        import kungfu_tpu.torch as kft
+
+        torch.manual_seed(0)  # same init everywhere
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = kft.SynchronousSGDOptimizer(opt, model.named_parameters())
+        # each rank trains on a different batch; sync-SGD must keep params equal
+        rng = np.random.RandomState(100 + rank)
+        for _ in range(3):
+            xb = torch.from_numpy(rng.randn(8, 4).astype(np.float32))
+            yb = torch.from_numpy(rng.randn(8, 2).astype(np.float32))
+            opt.zero_grad()
+            loss = ((model(xb) - yb) ** 2).mean()
+            loss.backward()
+            opt.step()
+        flat = torch.cat([q_.detach().reshape(-1)
+                          for q_ in model.parameters()]).numpy()
+        gathered = p.all_gather(flat.astype(np.float64), name="check")
+        gathered = gathered.reshape(n, -1)
+        for r in range(1, n):
+            np.testing.assert_allclose(gathered[r], gathered[0],
+                                       rtol=1e-5, atol=1e-6)
+        # and it is a real torch.optim.SGD still
+        assert isinstance(opt, torch.optim.SGD)
+        p.barrier(name="pre-exit")
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def _w_pairavg(rank, peers, q):
+    import torch
+    try:
+        p = _with_peer(rank, peers)
+        import kungfu_tpu.torch as kft
+
+        torch.manual_seed(rank)  # deliberately different init
+        model = torch.nn.Linear(3, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        opt = kft.PairAveragingOptimizer(opt, model.named_parameters(),
+                                         seed=rank)
+        rng = np.random.RandomState(rank)
+        for _ in range(3):
+            xb = torch.from_numpy(rng.randn(6, 3).astype(np.float32))
+            yb = torch.from_numpy(rng.randn(6, 2).astype(np.float32))
+            opt.zero_grad()
+            ((model(xb) - yb) ** 2).mean().backward()
+            opt.step()
+        for prm in model.parameters():
+            assert torch.isfinite(prm).all()
+        p.barrier(name="pre-exit")
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def test_torch_collectives_np3():
+    _spawn(_w_ops, 3)
+
+
+def test_torch_sync_sgd_keeps_replicas_identical():
+    _spawn(_w_syncsgd, 2)
+
+
+def test_torch_pair_averaging_runs():
+    _spawn(_w_pairavg, 2)
+
+
+def test_singleton_rank_size():
+    import kungfu_tpu.torch as kft
+    native.use_peer(None)
+    assert kft.current_rank() == 0
+    assert kft.current_cluster_size() == 1
+    kft.run_barrier()  # no-op
