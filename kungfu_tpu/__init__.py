@@ -41,29 +41,58 @@ def current_session() -> Session:
     return _ensure_session()
 
 
+def _worker_env():
+    from .launcher import env as E
+    return E.from_env()
+
+
 def current_rank() -> int:
-    """Rank of this controller process (reference:
-    srcs/python/kungfu/python/__init__.py current_rank)."""
+    """Rank of this worker (reference:
+    srcs/python/kungfu/python/__init__.py current_rank).
+
+    Launcher-spawned workers read the KFT_* env ABI; otherwise falls back
+    to the jax process index (multi-host) / 0 (singleton)."""
+    we = _worker_env()
+    if not we.singleton:
+        return we.rank()
     import jax
     return jax.process_index()
 
 
 def current_cluster_size() -> int:
-    """Number of peer lanes in the default session."""
+    """Number of workers in the cluster: the KFT_* env ABI when launched
+    by kungfu_tpu.launcher, else the default session's lane count."""
+    we = _worker_env()
+    if not we.singleton:
+        return we.size()
     return _ensure_session().size
 
 
 def current_local_rank() -> int:
+    we = _worker_env()
+    if not we.singleton:
+        return we.peers.local_rank(we.self_spec)
     import jax
     return 0 if jax.process_count() == 1 else jax.process_index()
 
 
 def current_local_size() -> int:
+    we = _worker_env()
+    if not we.singleton:
+        return we.peers.local_size(we.self_spec)
     import jax
     return len(jax.local_devices())
 
 
 def run_barrier() -> None:
+    """Cluster-wide barrier.  Launcher-spawned workers rendezvous over the
+    native host runtime; singleton mode barriers the local session's lanes
+    (reference: run_barrier, python/__init__.py:66-69)."""
+    from . import native as _native
+    p = _native.default_peer()
+    if p is not None:
+        p.barrier()
+        return
     _ensure_session().barrier()
 
 

@@ -2,8 +2,8 @@
 
 The reference benchmarks KungFu with ResNet-50/ImageNet throughput
 (README.md:203-209, model fixtures in tests/go/fakemodel/resnet50-imagenet.go).
-This is an idiomatic TPU implementation: NHWC layout, bf16-friendly, BN in
-f32, channels sized for the MXU's 128-lane tiling.
+This is an idiomatic TPU implementation: NHWC layout, bf16 end-to-end
+(BN stats reduced in f32), channels sized for the MXU's 128-lane tiling.
 """
 from __future__ import annotations
 
@@ -50,8 +50,12 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        # BN computes in the model dtype (keeps activations bf16 end-to-end —
+        # fp32 norms double the HBM traffic between convs); flax still
+        # reduces the batch statistics in f32 (force_float32_reductions) and
+        # stores running stats as f32, so no stability is lost.
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
         if self.small_inputs:
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)

@@ -70,6 +70,31 @@ class TestStaticRun:
         rc = main(["-np", "4", "--", sys.executable, str(script)])
         assert rc == 0
 
+    def test_top_level_api_reads_env_abi(self):
+        """kungfu_tpu.current_rank()/current_cluster_size() in a
+        launcher-spawned worker must reflect the KFT_* env ABI, not the
+        (single-process) jax view."""
+        hl = HostList.parse("127.0.0.1:4")
+        cluster = Cluster.from_hostlist(hl, 3)
+        env = E.worker_env(cluster.workers[2], cluster.workers,
+                           cluster.runners, version=0,
+                           strategy=Strategy.AUTO, config_server=None,
+                           parent=PeerID("127.0.0.1", 31000))
+        import kungfu_tpu as kft
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            assert kft.current_rank() == 2
+            assert kft.current_cluster_size() == 3
+            assert kft.current_local_rank() == 2
+            assert kft.current_local_size() == 3
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
     def test_failure_propagates(self, tmp_path):
         script = tmp_path / "bad.py"
         script.write_text("import sys; sys.exit(3)")
