@@ -13,15 +13,14 @@ benchmarks against; BASELINE.json north star: match or beat per-chip).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
-import os
 import sys
 import time
 
 import jax
 
-# honor an explicit CPU request even when a TPU plugin is installed
-if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+from kungfu_tpu.utils.platform import pin_cpu_if_requested
+
+pin_cpu_if_requested()
 
 import jax.numpy as jnp
 import numpy as np

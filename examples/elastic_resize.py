@@ -16,8 +16,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+from kungfu_tpu.utils.platform import pin_cpu_if_requested
+
+pin_cpu_if_requested()
 
 import jax.numpy as jnp
 import numpy as np

@@ -126,11 +126,9 @@ def _bench_native(args, sizes):
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.method in ("XLA", "HIER") and \
-            os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        # the preinstalled TPU plugin can override JAX_PLATFORMS; pin cpu
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    if args.method in ("XLA", "HIER"):
+        from ..utils.platform import pin_cpu_if_requested
+        pin_cpu_if_requested()
     sizes = _sizes_for(args)
     tot_size = sum(sizes) * 4  # f32 bytes
 

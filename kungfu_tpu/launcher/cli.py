@@ -77,7 +77,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # TPU_WORKER_HOSTNAMES=localhost even on one VM) stay on the local
         # path so host naming matches what users PUT to the config server.
         from .discovery import discover_tpu_pod
-        pod = discover_tpu_pod()
+        try:
+            pod = discover_tpu_pod()
+        except ValueError as e:
+            # stale/malformed libtpu env (e.g. TPU_WORKER_ID out of range)
+            # is an input error, reported like every other one
+            print(f"error: bad TPU pod environment: {e}", file=sys.stderr)
+            return 2
         if pod is not None and pod.num_hosts > 1:
             hl = pod.hosts
             if args.self_host == "127.0.0.1":

@@ -16,7 +16,17 @@ import numpy as np
 from .ops import _peer, _view, inplace_all_reduce_op, inplace_broadcast_op
 
 
-class _SynchronousSGD:
+def SynchronousSGDOptimizer(optimizer, named_parameters, op: str = "avg"):
+    """Graft gradient synchronization onto any ``torch.optim.Optimizer``.
+
+    ``op="avg"`` averages gradients across peers (equivalent to the TF
+    sync-SGD's grad-sum ÷ np, sync_sgd.py:58-109); ``op="sum"`` matches the
+    raw reference torch default."""
+    # the base class is captured here rather than resolved via
+    # super(self.__class__, ...), which would recurse if the optimizer is
+    # wrapped twice or its grafted class subclassed again
+    base = optimizer.__class__
+
     def sync_gradients(self):
         for name, p in self._kf_named_parameters:
             if p.requires_grad and p.grad is not None:
@@ -25,24 +35,21 @@ class _SynchronousSGD:
 
     def step(self, closure=None):
         self.sync_gradients()
-        return super(self.__class__, self).step(closure)
+        return base.step(self, closure)
 
-
-def SynchronousSGDOptimizer(optimizer, named_parameters, op: str = "avg"):
-    """Graft gradient synchronization onto any ``torch.optim.Optimizer``.
-
-    ``op="avg"`` averages gradients across peers (equivalent to the TF
-    sync-SGD's grad-sum ÷ np, sync_sgd.py:58-109); ``op="sum"`` matches the
-    raw reference torch default."""
-    clazz = type(optimizer.__class__.__name__, (optimizer.__class__,),
-                 dict(_SynchronousSGD.__dict__))
+    clazz = type(base.__name__, (base,),
+                 {"sync_gradients": sync_gradients, "step": step})
     optimizer.__class__ = clazz
     optimizer._kf_named_parameters = list(named_parameters)
     optimizer._kf_op = op
     return optimizer
 
 
-class _PairAveraging:
+def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
+    """AD-PSGD: after each local step, average parameters with one randomly
+    chosen peer via the p2p store (request + 0.5-average + save)."""
+    base = optimizer.__class__
+
     def _kf_params(self):
         for name, p in self._kf_named_parameters:
             if p.requires_grad:
@@ -51,7 +58,13 @@ class _PairAveraging:
     def _save_model(self):
         peer = _peer()
         for name, p in self._kf_params():
-            peer.save(f"param:{name}", np.ascontiguousarray(_view(p)))
+            v = _view(p if p.is_contiguous() else p.contiguous())
+            peer.save(f"param:{name}", np.ascontiguousarray(v))
+
+    def _kf_select(self, n: int, rank: int) -> int:
+        # random other peer (reference SelectionStrategy 'random')
+        t = int(self._kf_rng.randint(0, n - 1))
+        return t if t < rank else t + 1
 
     def step(self, closure=None):
         peer = _peer()
@@ -62,7 +75,7 @@ class _PairAveraging:
             self._save_model()
             peer.barrier(name="pair-avg-init")
             self._kf_initialized = True
-        out = super(self.__class__, self).step(closure)
+        out = base.step(self, closure)
         n = peer.size
         if n > 1:
             target = self._kf_select(n, peer.rank)
@@ -76,17 +89,9 @@ class _PairAveraging:
         self._save_model()
         return out
 
-    def _kf_select(self, n: int, rank: int) -> int:
-        # random other peer (reference SelectionStrategy 'random')
-        t = int(self._kf_rng.randint(0, n - 1))
-        return t if t < rank else t + 1
-
-
-def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
-    """AD-PSGD: after each local step, average parameters with one randomly
-    chosen peer via the p2p store (request + 0.5-average + save)."""
-    clazz = type(optimizer.__class__.__name__, (optimizer.__class__,),
-                 dict(_PairAveraging.__dict__))
+    clazz = type(base.__name__, (base,),
+                 {"_kf_params": _kf_params, "_save_model": _save_model,
+                  "_kf_select": _kf_select, "step": step})
     optimizer.__class__ = clazz
     optimizer._kf_named_parameters = list(named_parameters)
     optimizer._kf_initialized = False

@@ -1,0 +1,19 @@
+"""Platform selection helpers for entry points."""
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_if_requested() -> None:
+    """Honor an explicit ``JAX_PLATFORMS=cpu`` request even when a TPU
+    plugin is installed.
+
+    Some TPU plugins override the ``JAX_PLATFORMS`` env var at import time,
+    so scripts that must run on CPU (virtual-device dry runs, CI) also have
+    to pin the jax config.  Call after ``import jax``, before any device
+    use.  Honors "cpu" anywhere in the list (e.g. ``cpu,tpu`` keeps the
+    plugin's priority semantics and is left alone).
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")

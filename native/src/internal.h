@@ -282,6 +282,10 @@ struct Conn {
     WaitQueue<Msg> responses;
     std::thread reader;
     std::atomic<bool> alive{true};
+    // set by the reader thread on exit: join is then guaranteed not to
+    // block, so dead conns can be pruned opportunistically (alive=false
+    // alone only means the conn was closed, not that the thread is gone)
+    std::atomic<bool> reader_done{false};
 };
 
 struct PeerAddr {

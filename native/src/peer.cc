@@ -468,7 +468,7 @@ class Peer {
                 // prune inbound conns whose reader already exited, so churn
                 // from elastic reconnects does not accumulate dead Conns
                 for (auto it = in_conns_.begin(); it != in_conns_.end();) {
-                    if (!(*it)->alive) {
+                    if ((*it)->reader_done) {
                         if ((*it)->reader.joinable()) (*it)->reader.join();
                         it = in_conns_.erase(it);
                     } else {
@@ -484,6 +484,7 @@ class Peer {
                 conn->alive = false;
                 conn->responses.close();
                 ::close(conn->fd);
+                conn->reader_done = true;
             });
         }
     }
@@ -586,6 +587,7 @@ class Peer {
     void outbound_reader(std::shared_ptr<Conn> conn) {
         reader_loop(conn);
         ::close(conn->fd);
+        conn->reader_done = true;
     }
 
     void service_loop() {
@@ -605,12 +607,28 @@ class Peer {
         if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);
     }
 
+    // Park a dead outbound conn until its reader thread can be joined.
+    // Prunes previously-parked conns whose readers have exited first, so a
+    // long-lived elastic peer with churny sends does not accumulate
+    // unjoined threads for its whole lifetime.  conns_mu_ must be held.
+    void bury(const std::shared_ptr<Conn> &c) {
+        for (auto it = graveyard_.begin(); it != graveyard_.end();) {
+            if ((*it)->reader_done) {
+                if ((*it)->reader.joinable()) (*it)->reader.join();
+                it = graveyard_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+        graveyard_.push_back(c);
+    }
+
     void drop_conn(int dest, int cls) {
         std::lock_guard<std::mutex> g(conns_mu_);
         auto it = out_conns_.find({dest, cls});
         if (it != out_conns_.end()) {
             close_conn(it->second);
-            graveyard_.push_back(it->second);  // joined at stop()
+            bury(it->second);  // remainder joined at stop()
             out_conns_.erase(it);
         }
     }
@@ -628,20 +646,21 @@ class Peer {
         auto &slot = out_conns_[{dest, cls}];
         if (slot && slot->alive) {  // raced; keep the existing one
             close_conn(conn);
-            graveyard_.push_back(conn);  // reader exits on closed fd; joined at stop()
+            bury(conn);  // reader exits on closed fd
             return slot;
         }
-        if (slot) graveyard_.push_back(slot);  // dead conn: thread still joinable
+        if (slot) bury(slot);  // dead conn: thread still joinable
         slot = conn;
         return slot;
     }
 
     std::shared_ptr<Conn> dial(int dest, int cls) {
         const PeerAddr &pa = peers_[dest];
-        bool rejected = false;
+        bool rejected = false;  // whether the LAST attempt was a token reject
         // retry loop (reference: ConnRetryCount 500 x 200ms wait-peer-up)
         for (int attempt = 0; attempt < conn_retries_; attempt++) {
             if (!running_) break;
+            rejected = false;
             int fd = ::socket(AF_INET, SOCK_STREAM, 0);
             if (fd < 0) break;
             sockaddr_in addr{};

@@ -169,6 +169,48 @@ def test_torch_pair_averaging_runs():
     _spawn(_w_pairavg, 2)
 
 
+def test_double_wrap_does_not_recurse():
+    """Wrapping an already-wrapped optimizer (or composing the two wrappers)
+    must not make step() recurse into itself — the grafted step binds its
+    base class at wrap time, not via self.__class__."""
+    import kungfu_tpu.torch as kft
+    port = _free_ports(1)[0]
+    p = _with_peer(0, [f"127.0.0.1:{port}"])
+    try:
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = kft.SynchronousSGDOptimizer(opt, model.named_parameters())
+        opt = kft.SynchronousSGDOptimizer(opt, model.named_parameters())
+        xb = torch.zeros(3, 4)
+        loss = ((model(xb) - torch.ones(3, 2)) ** 2).mean()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()  # would hit RecursionError with super(self.__class__, ...)
+        assert isinstance(opt, torch.optim.SGD)
+    finally:
+        native.use_peer(None)
+        p.close()
+
+
+def test_pair_averaging_non_contiguous_param():
+    """AD-PSGD must handle non-contiguous parameters (e.g. transposed /
+    tied weights) in both the step-0 store seed and the averaging path."""
+    import kungfu_tpu.torch as kft
+    port = _free_ports(1)[0]
+    p = _with_peer(0, [f"127.0.0.1:{port}"])
+    try:
+        w = torch.nn.Parameter(torch.zeros(4, 6).t())  # non-contiguous
+        assert not w.is_contiguous()
+        opt = torch.optim.SGD([w], lr=0.1)
+        opt = kft.PairAveragingOptimizer(opt, [("w", w)])
+        (w.sum()).backward()
+        opt.step()  # crashes in _save_model without the contiguous fallback
+    finally:
+        native.use_peer(None)
+        p.close()
+
+
 def test_singleton_rank_size():
     import kungfu_tpu.torch as kft
     native.use_peer(None)
