@@ -46,12 +46,23 @@ def _worker_env():
     return E.from_env()
 
 
+def _live_peer():
+    """The already-running native peer, if any — the live cluster view
+    (tracks elastic resizes and explicit native.use_peer installs), which
+    the static KFT_* env cannot."""
+    from . import native as _native
+    return _native.installed_peer()
+
+
 def current_rank() -> int:
     """Rank of this worker (reference:
     srcs/python/kungfu/python/__init__.py current_rank).
 
-    Launcher-spawned workers read the KFT_* env ABI; otherwise falls back
-    to the jax process index (multi-host) / 0 (singleton)."""
+    Priority: live native peer → KFT_* env ABI (launcher-spawned worker)
+    → jax process index (multi-host) / 0 (singleton)."""
+    p = _live_peer()
+    if p is not None:
+        return p.rank
     we = _worker_env()
     if not we.singleton:
         return we.rank()
@@ -60,8 +71,11 @@ def current_rank() -> int:
 
 
 def current_cluster_size() -> int:
-    """Number of workers in the cluster: the KFT_* env ABI when launched
-    by kungfu_tpu.launcher, else the default session's lane count."""
+    """Number of workers in the cluster: live native peer first, then the
+    KFT_* env ABI, else the default session's lane count."""
+    p = _live_peer()
+    if p is not None:
+        return p.size
     we = _worker_env()
     if not we.singleton:
         return we.size()

@@ -1,11 +1,13 @@
 """Model zoo: benchmark and example models."""
 from .bert import BertEncoder, bert_base, bert_tiny
 from .fake_model import MODEL_SIZES, FakeModel
+from .gpt import GPTConfig
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152
 from .simple import VGG16, VGG19, MnistMLP, MnistSLP
 
 __all__ = [
     "BertEncoder", "bert_base", "bert_tiny", "FakeModel", "MODEL_SIZES",
+    "GPTConfig",
     "ResNet", "ResNet50", "ResNet101", "ResNet152", "VGG16", "VGG19",
     "MnistMLP", "MnistSLP",
 ]

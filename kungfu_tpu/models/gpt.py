@@ -1,0 +1,213 @@
+"""GPT-style causal transformer LM, designed for composable 3D parallelism.
+
+The reference framework is data-parallel only (SURVEY.md §2.4); this model
+family is the TPU-native extension that composes every parallel axis this
+framework provides in one train step:
+
+- **dp** — batch data parallelism (the reference's envelope),
+- **sp** — sequence/context parallelism: ring attention (`lax.ppermute`
+  KV rotation) or Ulysses (`all_to_all` head re-sharding),
+- **tp** — Megatron-style tensor parallelism: attention heads and MLP
+  features column/row-sharded, vocab-sharded LM head with a parallel
+  softmax cross-entropy (max/psum over the tp axis).
+
+TPU-first choices: bias-free blocks (all FLOPs are large matmuls for the
+MXU; it also makes the gradient-sync rule uniform — every parameter's
+local gradient is a *partial* sum, so replicated params psum over
+(dp, sp, tp) and tp-sharded params over (dp, sp)); bf16 activations with
+f32 layernorms/softmax; static shapes and unrolled layer loop for XLA.
+
+Functions here are pure and run either unsharded (oracle) or inside
+``shard_map`` with the axis names passed in (see
+kungfu_tpu/parallel/threed.py for the mesh/step builder).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring_attention import (reference_attention, ring_attention,
+                                       ulysses_attention)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads != 0:
+            raise ValueError(f"d_model {self.d_model} not divisible by "
+                             f"n_heads {self.n_heads}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
+    """f32 parameter pytree.  Layout chosen so tensor-parallel sharding is
+    a plain leading/trailing-axis split: q/k/v ``[D, H, Dh]`` (shard H),
+    attention out ``[H, Dh, D]`` (shard H), MLP in ``[D, F]`` / out
+    ``[F, D]`` (shard F), LM head ``[D, V]`` (shard V)."""
+    D, H, Dh, F, V = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                      cfg.vocab_size)
+    k = iter(jax.random.split(rng, 4 + 6 * cfg.n_layers))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in))
+
+    layers: List[Dict] = []
+    for _ in range(cfg.n_layers):
+        layers.append({
+            "ln1": jnp.ones((D,), jnp.float32),
+            "wq": dense(next(k), (D, H, Dh), D),
+            "wk": dense(next(k), (D, H, Dh), D),
+            "wv": dense(next(k), (D, H, Dh), D),
+            "wo": dense(next(k), (H, Dh, D), D),
+            "ln2": jnp.ones((D,), jnp.float32),
+            "wi": dense(next(k), (D, F), D),
+            "wm": dense(next(k), (F, D), F),
+        })
+    return {
+        "wte": dense(next(k), (V, D), D),
+        "wpe": dense(next(k), (cfg.max_seq, D), D) * 0.1,
+        "layers": layers,
+        "lnf": jnp.ones((D,), jnp.float32),
+        "lm_head": dense(next(k), (D, V), D),
+    }
+
+
+def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
+    """PartitionSpec pytree matching :func:`init_params`.
+
+    ``tp=None`` replicates everything (pure dp/sp)."""
+    t = tp
+
+    def layer_specs():
+        return {
+            "ln1": P(),
+            "wq": P(None, t, None),
+            "wk": P(None, t, None),
+            "wv": P(None, t, None),
+            "wo": P(t, None, None),
+            "ln2": P(),
+            "wi": P(None, t),
+            "wm": P(t, None),
+        }
+    return {
+        "wte": P(),
+        "wpe": P(),
+        "layers": [layer_specs() for _ in range(cfg.n_layers)],
+        "lnf": P(),
+        "lm_head": P(None, t),
+    }
+
+
+def _rms_norm(x, scale, eps=1e-5):
+    """RMS layernorm in f32 (bias-free)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def forward_local(params, tokens, cfg: GPTConfig, *,
+                  tp_axis: Optional[str] = None,
+                  sp_axis: Optional[str] = None,
+                  attn: str = "auto"):
+    """Causal LM forward on this device's shard.
+
+    ``tokens``: [B_local, T_local] int32.  With ``sp_axis`` the global
+    sequence is the rank-order concatenation of shards; with ``tp_axis``
+    the head/feature dims hold the local slice and the returned logits are
+    vocab-sharded ``[B_local, T_local, V/tp]``.
+
+    ``attn``: "ring" | "ulysses" (both need ``sp_axis``) | "dense";
+    "auto" = ring when sequence-parallel else dense.
+    """
+    if attn == "auto":
+        attn = "ring" if sp_axis else "dense"
+    T = tokens.shape[1]
+    offset = lax.axis_index(sp_axis) * T if sp_axis else 0
+    pos = offset + jnp.arange(T)
+
+    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["ln1"])
+        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cfg.dtype))
+        kk = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cfg.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cfg.dtype))
+        if attn == "ring":
+            o = ring_attention(q, kk, v, sp_axis, causal=True)
+        elif attn == "ulysses":
+            o = ulysses_attention(q, kk, v, sp_axis, causal=True)
+        else:
+            o = reference_attention(q, kk, v, causal=True)
+        o = jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(cfg.dtype))
+        if tp_axis:
+            o = lax.psum(o, tp_axis)
+        x = x + o
+        h = _rms_norm(x, layer["ln2"])
+        u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
+        m = u @ layer["wm"].astype(cfg.dtype)
+        if tp_axis:
+            m = lax.psum(m, tp_axis)
+        x = x + m
+
+    x = _rms_norm(x, params["lnf"])
+    # f32 logits: the parallel cross-entropy reduces over the vocab shard
+    return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                      params["lm_head"])
+
+
+def parallel_cross_entropy(logits_local, targets, *,
+                           tp_axis: Optional[str] = None):
+    """Token NLL with vocab-sharded logits.
+
+    ``logits_local``: [B, T, V_local] f32; ``targets``: [B, T] *global*
+    vocab ids.  The softmax normalizer and the target logit are assembled
+    with one pmax + two psums over ``tp_axis`` — logits are never
+    all-gathered (Megatron-style parallel cross-entropy).
+    """
+    v_local = logits_local.shape[-1]
+    # the max is a numerical-stability shift that cancels in the result;
+    # computing it on stop_gradient'ed logits keeps the exact softmax
+    # gradient and keeps pmax (no differentiation rule) off the grad path
+    m = jnp.max(lax.stop_gradient(logits_local), axis=-1)
+    if tp_axis:
+        m = lax.pmax(m, tp_axis)
+    denom = jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1)
+    lo = lax.axis_index(tp_axis) * v_local if tp_axis else 0
+    local_t = targets - lo
+    in_range = (local_t >= 0) & (local_t < v_local)
+    safe = jnp.clip(local_t, 0, v_local - 1)
+    picked = jnp.take_along_axis(logits_local, safe[..., None], -1)[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    if tp_axis:
+        denom = lax.psum(denom, tp_axis)
+        picked = lax.psum(picked, tp_axis)
+    return m + jnp.log(denom) - picked  # [B, T]
+
+
+def forward(params, tokens, cfg: GPTConfig):
+    """Unsharded single-device forward → full logits (the oracle)."""
+    return forward_local(params, tokens, cfg)
+
+
+def loss_fn(params, tokens, targets, cfg: GPTConfig):
+    """Unsharded mean token NLL (the oracle)."""
+    logits = forward(params, tokens, cfg)
+    return parallel_cross_entropy(logits, targets).mean()

@@ -308,6 +308,12 @@ def use_peer(p: Optional[NativePeer]) -> None:
     _default_peer = p
 
 
+def installed_peer() -> Optional[NativePeer]:
+    """The live peer if one was already created/installed; never builds
+    one (cheap to call from identity queries like current_rank)."""
+    return _default_peer
+
+
 def default_peer() -> Optional[NativePeer]:
     """NativePeer built from the KFT_* env ABI (one per worker process);
     None in singleton mode."""

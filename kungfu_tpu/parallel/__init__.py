@@ -12,6 +12,9 @@ them as first-class citizens:
   ``psum_scatter`` + ``all_gather``.
 - :mod:`tensor` — tensor-parallel layer helpers (column/row sharded
   matmuls with compiled collectives).
+- :mod:`threed` — composed dp x sp x tp training for the GPT model family
+  (imported lazily — ``import kungfu_tpu.parallel.threed`` — because it
+  depends on :mod:`kungfu_tpu.models`).
 """
 from .ring_attention import (make_ring_attention, make_ulysses_attention,
                              reference_attention, ring_attention,

@@ -58,8 +58,9 @@ def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
     def _save_model(self):
         peer = _peer()
         for name, p in self._kf_params():
-            v = _view(p if p.is_contiguous() else p.contiguous())
-            peer.save(f"param:{name}", np.ascontiguousarray(v))
+            # contiguity is guaranteed here; save() re-checks internally
+            peer.save(f"param:{name}",
+                      _view(p if p.is_contiguous() else p.contiguous()))
 
     def _kf_select(self, n: int, rank: int) -> int:
         # random other peer (reference SelectionStrategy 'random')

@@ -11,8 +11,9 @@ def pin_cpu_if_requested() -> None:
     Some TPU plugins override the ``JAX_PLATFORMS`` env var at import time,
     so scripts that must run on CPU (virtual-device dry runs, CI) also have
     to pin the jax config.  Call after ``import jax``, before any device
-    use.  Honors "cpu" anywhere in the list (e.g. ``cpu,tpu`` keeps the
-    plugin's priority semantics and is left alone).
+    use.  Only the exact value ``cpu`` is pinned; multi-platform lists
+    (e.g. ``cpu,tpu``) keep the plugin's own priority semantics and are
+    left alone.
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         import jax

@@ -1,0 +1,104 @@
+"""3D-parallel GPT: numerical parity against the single-device oracle.
+
+The strongest correctness check the framework has: the SAME model, init,
+and batch computed (a) unsharded on one device and (b) dp x sp x tp
+sharded over the 8-device virtual mesh with ring/Ulysses attention,
+Megatron-style tensor parallelism, and parallel cross-entropy — losses,
+gradients, and post-step parameters must agree.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.parallel import threed as T3
+
+
+CFG = G.GPTConfig(vocab_size=64, d_model=16, n_heads=4, n_layers=2,
+                  d_ff=32, max_seq=32, dtype=jnp.float32)
+
+
+def _data(cfg, batch=4, seq=8, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                          jnp.int32)
+    return tokens, targets
+
+
+def _oracle(cfg, tokens, targets, opt, steps=1, seed=0):
+    params = G.init_params(jax.random.PRNGKey(seed), cfg)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(G.loss_fn)(p, tokens, targets, cfg)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    loss = None
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+    return params, float(loss)
+
+
+def _tree_allclose(a, b, rtol=2e-4, atol=2e-5):
+    fa, _ = jax.tree_util.tree_flatten(a)
+    fb, _ = jax.tree_util.tree_flatten(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("dp,sp,tp,attn", [
+    (2, 2, 2, "ring"),
+    (2, 2, 2, "ulysses"),
+    (1, 1, 4, "dense"),   # pure tensor parallel
+    (4, 1, 1, "dense"),   # pure data parallel
+    (1, 4, 1, "ring"),    # pure sequence parallel
+])
+def test_parity_with_oracle(devices, dp, sp, tp, attn):
+    opt = optax.sgd(0.1)
+    tokens, targets = _data(CFG)
+    ref_params, ref_loss = _oracle(CFG, tokens, targets, opt, steps=1)
+
+    mesh = T3.mesh_3d(dp, sp, tp, devices)
+    params, state = T3.init_gpt(CFG, opt, mesh, seed=0)
+    step = T3.make_gpt_train_step(CFG, opt, mesh, attn=attn, donate=False)
+    params, state, loss = step(params, state, tokens, targets)
+
+    assert np.isclose(float(loss), ref_loss, rtol=1e-4), \
+        f"loss {float(loss)} != oracle {ref_loss}"
+    _tree_allclose(jax.device_get(params), ref_params)
+
+
+def test_loss_decreases_3d(devices):
+    opt = optax.adam(1e-2)
+    tokens, targets = _data(CFG, batch=8, seq=16, seed=1)
+    mesh = T3.mesh_3d(2, 2, 2, devices)
+    params, state = T3.init_gpt(CFG, opt, mesh, seed=1)
+    step = T3.make_gpt_train_step(CFG, opt, mesh)
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_parallel_cross_entropy_matches_optax(devices):
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 8, 64).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 64, (4, 8)), jnp.int32)
+    ours = G.parallel_cross_entropy(logits, targets)
+    ref = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_vocab_constraints():
+    with pytest.raises(ValueError):
+        G.GPTConfig(d_model=10, n_heads=3)

@@ -103,6 +103,12 @@ def _w_syncsgd(rank, peers, q):
         n = len(peers)
         import kungfu_tpu.torch as kft
 
+        # top-level identity API must reflect the LIVE peer (not the
+        # static env / jax process view)
+        import kungfu_tpu as kft_top
+        assert kft_top.current_rank() == rank
+        assert kft_top.current_cluster_size() == n
+
         torch.manual_seed(0)  # same init everywhere
         model = torch.nn.Linear(4, 2)
         opt = torch.optim.SGD(model.parameters(), lr=0.1)
