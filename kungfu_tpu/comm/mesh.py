@@ -48,6 +48,18 @@ def hierarchical_mesh(num_hosts: int,
     return Mesh(arr, (HOST_AXIS, CHIP_AXIS))
 
 
+def make_mesh(axis_names: Sequence[str], shape: Sequence[int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """N-D mesh with validated device count — the one constructor behind
+    every named-axis mesh in the framework (dp/sp/tp/pp/ep combos)."""
+    ds = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(shape))
+    if len(ds) < n:
+        raise ValueError(f"need {n} devices for mesh {tuple(shape)}, "
+                         f"have {len(ds)}")
+    return Mesh(np.array(ds[:n]).reshape(tuple(shape)), tuple(axis_names))
+
+
 def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 

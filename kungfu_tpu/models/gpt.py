@@ -116,11 +116,38 @@ def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
     }
 
 
-def _rms_norm(x, scale, eps=1e-5):
+def rms_norm(x, scale, eps=1e-5):
     """RMS layernorm in f32 (bias-free)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     return (xf * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def apply_layer(layer, x, cfg: GPTConfig, *,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None,
+                attn: str = "dense"):
+    """One transformer block on (local) activations ``x`` [B, T, D]."""
+    h = rms_norm(x, layer["ln1"])
+    q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cfg.dtype))
+    kk = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cfg.dtype))
+    if attn == "ring":
+        o = ring_attention(q, kk, v, sp_axis, causal=True)
+    elif attn == "ulysses":
+        o = ulysses_attention(q, kk, v, sp_axis, causal=True)
+    else:
+        o = reference_attention(q, kk, v, causal=True)
+    o = jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(cfg.dtype))
+    if tp_axis:
+        o = lax.psum(o, tp_axis)
+    x = x + o
+    h = rms_norm(x, layer["ln2"])
+    u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
+    m = u @ layer["wm"].astype(cfg.dtype)
+    if tp_axis:
+        m = lax.psum(m, tp_axis)
+    return x + m
 
 
 def forward_local(params, tokens, cfg: GPTConfig, *,
@@ -146,28 +173,10 @@ def forward_local(params, tokens, cfg: GPTConfig, *,
     x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
 
     for layer in params["layers"]:
-        h = _rms_norm(x, layer["ln1"])
-        q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cfg.dtype))
-        kk = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cfg.dtype))
-        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cfg.dtype))
-        if attn == "ring":
-            o = ring_attention(q, kk, v, sp_axis, causal=True)
-        elif attn == "ulysses":
-            o = ulysses_attention(q, kk, v, sp_axis, causal=True)
-        else:
-            o = reference_attention(q, kk, v, causal=True)
-        o = jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(cfg.dtype))
-        if tp_axis:
-            o = lax.psum(o, tp_axis)
-        x = x + o
-        h = _rms_norm(x, layer["ln2"])
-        u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
-        m = u @ layer["wm"].astype(cfg.dtype)
-        if tp_axis:
-            m = lax.psum(m, tp_axis)
-        x = x + m
+        x = apply_layer(layer, x, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                        attn=attn)
 
-    x = _rms_norm(x, params["lnf"])
+    x = rms_norm(x, params["lnf"])
     # f32 logits: the parallel cross-entropy reduces over the vocab shard
     return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                       params["lm_head"])

@@ -1,0 +1,175 @@
+"""Expert parallelism: switch-style Mixture-of-Experts over an ``ep`` axis.
+
+The reference framework has no expert parallelism (SURVEY.md §2.4); this is
+the TPU-native extension completing the parallelism matrix (dp/tp/sp/pp/ep).
+
+Design (the canonical TPU MoE dataflow):
+
+- top-1 (switch) routing with a static per-expert **capacity** — dispatch
+  and combine are dense one-hot einsums, so shapes stay static and the MXU
+  does the work; overflow tokens pass through the residual unchanged,
+- experts sharded over ``ep`` (each rank owns ``E / ep`` expert MLPs),
+- tokens travel to their expert's owner and back with two tiled
+  ``lax.all_to_all``s — the ``ep`` analogue of Ulysses' head re-sharding,
+- a switch load-balancing auxiliary loss (E * Σ_e fraction_e * prob_e),
+  pmean'd across the mesh.
+
+Composes with data parallelism: batch axes (dp and ep both carry tokens
+outside the expert block) shard the tokens; only the expert weights are
+ep-sharded.  Gradient psums are inserted by shard_map's varying-axis AD.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 256          # per-expert hidden width
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16  # expert-compute dtype (routing stays f32)
+
+
+def mesh_dp_ep(dp: int, ep: int,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    from ..comm.mesh import make_mesh
+    return make_mesh(("dp", "ep"), (dp, ep), devices)
+
+
+def init_moe_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    """Router (replicated) + stacked expert MLPs (leading axis = expert,
+    sharded over ep)."""
+    D, F, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "router": jax.random.normal(k1, (D, E), jnp.float32) / np.sqrt(D),
+        "wi": jax.random.normal(k2, (E, D, F), jnp.float32) / np.sqrt(D),
+        "wo": jax.random.normal(k3, (E, F, D), jnp.float32) / np.sqrt(F),
+    }
+
+
+def moe_param_specs(ep: Optional[str] = "ep") -> dict:
+    return {"router": P(), "wi": P(ep, None, None), "wo": P(ep, None, None)}
+
+
+def _capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    c = int(math.ceil(n_tokens * cfg.capacity_factor / cfg.n_experts))
+    return max(c, 1)
+
+
+def moe_ffn(params: dict, x, cfg: MoEConfig,
+            ep_axis: Optional[str] = None) -> Tuple[Any, Any]:
+    """Apply the MoE FFN to (local) activations ``x`` [B, T, D].
+
+    With ``ep_axis``, ``params["wi"]/["wo"]`` hold the local expert slice
+    ``[E/ep, ...]`` and tokens are exchanged with two all_to_alls; without
+    it they hold all ``E`` experts (the oracle).  Returns ``(y, aux_loss)``
+    where ``y`` includes the residual (overflowed tokens pass through).
+    """
+    B, T, D = x.shape
+    E = cfg.n_experts
+    n = B * T
+    C = _capacity(n, cfg)
+    xt = x.reshape(n, D)
+
+    # ---- routing (f32): top-1 expert + gate -----------------------------
+    logits = xt.astype(jnp.float32) @ params["router"]          # [n, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                              # [n]
+    expert = jnp.argmax(probs, axis=-1)                         # [n]
+
+    # switch load-balancing loss: E * sum_e fraction_e * mean-prob_e
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # [n, E]
+    fraction = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(fraction * mean_prob)
+    if ep_axis:
+        aux = lax.pmean(aux, ep_axis)
+
+    # ---- dense dispatch within capacity ---------------------------------
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # [n, E]
+    pos = pos.astype(jnp.int32)
+    keep = (pos >= 0) & (pos < C)
+    disp = (jax.nn.one_hot(jnp.where(keep, pos, -1), C, dtype=xt.dtype)
+            * onehot[..., None].astype(xt.dtype))               # [n, E, C]
+    comb = disp.astype(jnp.float32) * gate[:, None, None]       # [n, E, C]
+
+    # expert compute runs in cfg.dtype (bf16 on TPU); routing/combine f32
+    buf = jnp.einsum("nec,nd->ecd", disp.astype(cfg.dtype),
+                     xt.astype(cfg.dtype))                      # [E, C, D]
+
+    # ---- expert compute (locally, or via all_to_all over ep) ------------
+    if ep_axis:
+        ep = lax.axis_size(ep_axis)
+        e_local = params["wi"].shape[0]
+        # send each expert-block to its owner (tiled over leading axis)
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0,
+                             tiled=True)                        # [E, C, D]
+        # [src, e_local, C, D] -> per-expert batches [e_local, src*C, D]
+        buf = (buf.reshape(ep, e_local, C, D).transpose(1, 0, 2, 3)
+               .reshape(e_local, ep * C, D))
+    else:
+        e_local = E
+
+    def one_expert(b, wi, wo):
+        h = jax.nn.gelu(b @ wi.astype(b.dtype))
+        return h @ wo.astype(b.dtype)
+
+    out = jax.vmap(one_expert)(buf, params["wi"], params["wo"])
+
+    if ep_axis:
+        out = (out.reshape(e_local, ep, C, D).transpose(1, 0, 2, 3)
+               .reshape(E, C, D))
+        out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0,
+                             tiled=True)                        # [E, C, D]
+
+    y = jnp.einsum("nec,ecd->nd", comb, out.astype(jnp.float32))
+    y = x + y.astype(x.dtype).reshape(B, T, D)  # overflow -> pure residual
+    return y, aux
+
+
+def make_moe_step(cfg: MoEConfig, optimizer, mesh: Mesh,
+                  aux_weight: float = 0.01, donate: bool = True):
+    """Compile a toy regression train step over a (dp, ep) mesh — the
+    correctness harness for the MoE dataflow (batch sharded over dp x ep,
+    experts over ep).  ``step(params, opt_state, x, y) -> (params,
+    opt_state, loss)``."""
+    import optax
+
+    dp_axis, ep_axis = mesh.axis_names
+    data_spec = P((dp_axis, ep_axis))
+    specs = moe_param_specs(ep_axis)
+
+    def grad_body(params, x, y):
+        def local_loss(p):
+            out, aux = moe_ffn(p, x, cfg, ep_axis=ep_axis)
+            mse = jnp.mean((out.astype(jnp.float32)
+                            - y.astype(jnp.float32)) ** 2)
+            mse = lax.pmean(mse, (dp_axis, ep_axis))
+            aux = lax.pmean(aux, dp_axis)
+            return mse + aux_weight * aux
+        lval, grads = jax.value_and_grad(local_loss)(params)
+        return lval, grads
+
+    sm = jax.shard_map(grad_body, mesh=mesh,
+                       in_specs=(specs, data_spec, data_spec),
+                       out_specs=(P(), specs))
+
+    def step(params, opt_state, x, y):
+        loss, grads = sm(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    kwargs = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(step, **kwargs)

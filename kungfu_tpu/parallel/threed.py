@@ -21,7 +21,6 @@ from __future__ import annotations
 from typing import Callable, Optional, Sequence
 
 import jax
-import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -36,12 +35,8 @@ def mesh_3d(dp: int, sp: int, tp: int,
     """(dp, sp, tp) mesh.  Device order is jax's enumeration, so the
     innermost (last) axis gets the closest ICI neighbours — put tp (the
     chattiest axis: one psum per matmul group) innermost."""
-    ds = list(devices) if devices is not None else jax.devices()
-    n = dp * sp * tp
-    if len(ds) < n:
-        raise ValueError(f"need {n} devices, have {len(ds)}")
-    arr = np.array(ds[:n]).reshape(dp, sp, tp)
-    return Mesh(arr, (DP_AXIS, SP_AXIS, TP_AXIS))
+    from ..comm.mesh import make_mesh
+    return make_mesh((DP_AXIS, SP_AXIS, TP_AXIS), (dp, sp, tp), devices)
 
 
 def shard_params(params, cfg: G.GPTConfig, mesh: Mesh):
