@@ -185,3 +185,47 @@ class ElasticTrainer:
     def current_params(self, lane: int = 0):
         return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
                                       self.params)
+
+    # ------------------------------------------------------------ checkpoints
+    def save_checkpoint(self, ckpt, force: bool = False) -> bool:
+        """Write lane-0 model + optimizer state and progress counters.
+
+        One replica is the checkpoint (kungfu_tpu.checkpoint conventions);
+        under model-averaging schemes whose replicas diverge, lane 0 is
+        the representative — as in the reference, where rank 0's state is
+        what survives a membership change."""
+        state = {
+            "model": self.current_params(0),
+            "opt": jax.tree_util.tree_map(
+                lambda t: np.asarray(np.asarray(t)[0]),  # 0-d stays ndarray
+                self.opt_state),
+        }
+        meta = {"trained_samples": self.trained_samples,
+                "step_count": self.step_count,
+                "size": self.n}
+        return ckpt.save(self.step_count, state, meta=meta, force=force)
+
+    def restore_checkpoint(self, ckpt, step: Optional[int] = None) -> int:
+        """Resume from disk at the CURRENT cluster size (which may differ
+        from the size at save time): the restored replica is broadcast to
+        every lane, progress counters are restored.  Returns the step."""
+        # shape-only template (no device->host copy of the live state)
+        lane_template = lambda tree: jax.tree_util.tree_map(
+            lambda t: jax.ShapeDtypeStruct(t.shape[1:], t.dtype), tree)
+        like = {"model": lane_template(self.params),
+                "opt": lane_template(self.opt_state)}
+        step, state, meta = ckpt.restore(like=like, step=step)
+        one = lambda tree: jax.tree_util.tree_map(
+            lambda t: np.asarray(t)[None], tree)
+        params = _restack(one(state["model"]), self.n, self.mesh)
+        opt_state = _restack(one(state["opt"]), self.n, self.mesh)
+        # assign only after both restacks succeeded (keeps the n-lane
+        # invariant of _host_params if an incompatible checkpoint raises)
+        self.params = params
+        self.opt_state = opt_state
+        self._host_params = jax.tree_util.tree_map(
+            lambda t: np.asarray(t), self.params)
+        if meta:
+            self.trained_samples = int(meta.get("trained_samples", 0))
+            self.step_count = int(meta.get("step_count", step))
+        return step

@@ -147,6 +147,10 @@ def make_moe_step(cfg: MoEConfig, optimizer, mesh: Mesh,
     import optax
 
     dp_axis, ep_axis = mesh.axis_names
+    ep = mesh.devices.shape[1]
+    if cfg.n_experts % ep != 0:
+        raise ValueError(f"{cfg.n_experts} experts not divisible by "
+                         f"{ep} expert-parallel ranks")
     data_spec = P((dp_axis, ep_axis))
     specs = moe_param_specs(ep_axis)
 

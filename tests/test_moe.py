@@ -92,6 +92,12 @@ def test_moe_capacity_drops_pass_residual(devices):
     assert same > 0.4, same
 
 
+def test_moe_expert_divisibility(devices):
+    cfg = M.MoEConfig(d_model=16, d_ff=32, n_experts=6)
+    with pytest.raises(ValueError, match="not divisible"):
+        M.make_moe_step(cfg, optax.sgd(0.1), M.mesh_dp_ep(1, 4, devices))
+
+
 def test_moe_training_decreases_loss(devices):
     cfg = M.MoEConfig(d_model=16, d_ff=32, n_experts=4,
                       capacity_factor=2.0, dtype=jnp.float32)
