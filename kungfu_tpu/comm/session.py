@@ -149,13 +149,15 @@ class Session:
         return fn
 
     def _run(self, name: str, x: jax.Array, body: Callable, key: tuple) -> jax.Array:
+        from ..utils.trace import trace_scope
         x = jnp.asarray(x)
         if x.shape[0] != self.n:
             raise ValueError(f"leading axis {x.shape[0]} != cluster size {self.n}")
         fn = self._shard_fn(body, key + (x.shape, str(x.dtype)))
         t0 = time.perf_counter()
-        out = fn(x)
-        out.block_until_ready()
+        with trace_scope(f"kft::{name or 'collective'}"):
+            out = fn(x)
+            out.block_until_ready()
         dt = time.perf_counter() - t0
         stat = self._stats.setdefault(name or "default", StrategyStat())
         stat.update(x.nbytes, dt)

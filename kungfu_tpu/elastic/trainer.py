@@ -124,6 +124,8 @@ class ElasticTrainer:
         if new_size <= 0:
             _flags.set_detached(True)
             return True
+        from ..utils.trace import log_event
+        log_event(f"resize-begin:{self.n}->{new_size}")
         # consensus fence on the proposal (trivially true single-controller,
         # real check under multi-controller)
         if not self.session.bytes_consensus(str(new_size).encode()):
@@ -137,6 +139,7 @@ class ElasticTrainer:
         self._install(new_size, fresh_opt=False)
         self.opt_state = _restack(host_opt, new_size, self.mesh)
         self.session.barrier()
+        log_event(f"resize-end:{new_size}")
         return True
 
     def resize_from_url(self, timeout: float = 30.0) -> Tuple[bool, bool]:

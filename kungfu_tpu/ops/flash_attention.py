@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the transformer models: blockwise online-softmax attention
 computed in VMEM, grid (batch, heads, q-blocks, k-blocks) with the k-block
@@ -10,10 +10,20 @@ Inputs are [B, T, H, D].  The MXU sees [block_q, D] x [D, block_k] and
 [block_q, block_k] x [block_k, D] matmuls with
 ``preferred_element_type=f32``; bf16 inputs are upcast per block.
 
-On CPU (tests, CI) the kernel runs with ``interpret=True``.  The backward
-pass recomputes attention densely via the reference path (ring attention
-— kungfu_tpu.parallel — is the memory-lean trainable path; this kernel
-targets single-chip inference/forward throughput).
+The backward is FlashAttention-2 style: the forward also emits the
+log-sum-exp rows (stored lane-replicated as [B, H, T, 128] to satisfy the
+TPU (8, 128) tiling of block shapes — same convention as jax's reference
+TPU kernel); the backward recomputes ``p = exp(q k^T s - lse)`` per block
+and accumulates
+
+    dv += p^T dO,   ds = p * (dO v^T - delta),   dk += ds^T q * s,
+    dq += ds k * s,        with  delta = rowsum(dO * O)
+
+in two kernels (dq with k innermost; dk/dv with q innermost); ``delta`` is
+computed in-kernel from the O / dO blocks, so training memory stays
+O(T * D) — no [T, T] materialization anywhere.
+
+On CPU (tests, CI) the kernels run with ``interpret=True``.
 """
 from __future__ import annotations
 
@@ -27,11 +37,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-_LANES = 128  # TPU lane width: scratch row-stat buffers are [bq, 128]
+_LANES = 128  # TPU lane width: row-stat buffers are [bq, 128]
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *, causal, scale,
-               block_q, block_k, n_k):
+# ------------------------------------------------------------------ forward
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal, scale, block_q,
+               block_k, n_k, with_lse):
+    if with_lse:
+        lse_ref, acc, m, l = rest
+    else:
+        acc, m, l = rest
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -48,9 +63,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *, causal, scale,
 
     @pl.when(visible)
     def _attend():
-        q = q_ref[0, :, 0, :].astype(jnp.float32)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        # MXU eats the native (bf16) dtype; accumulation is f32 via
+        # preferred_element_type — upcasting inputs first would force the
+        # slow multi-pass f32 MXU path
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -68,51 +86,247 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *, causal, scale,
             corr * l[:, :1] + jnp.sum(p, axis=1, keepdims=True), l.shape)
         m[...] = jnp.broadcast_to(m_new, m.shape)
         acc[...] = acc[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_k - 1)
     def _finish():
-        o_ref[0, :, 0, :] = (acc[...] /
-                             jnp.maximum(l[:, :1], 1e-30)).astype(o_ref.dtype)
+        lsafe = jnp.maximum(l[:, :1], 1e-30)
+        o_ref[0, 0, :, :] = (acc[...] / lsafe).astype(o_ref.dtype)
+        if with_lse:
+            lse_ref[0, 0, :, :] = jnp.broadcast_to(
+                m[:, :1] + jnp.log(lsafe), lse_ref.shape[2:])
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-axis (vma) type, so the
+    kernels compose with shard_map's check_vma (e.g. flash attention on
+    each shard inside a dp/tp mesh)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def fit_block(T: int, requested: int) -> int:
+    """Largest usable block size <= requested for sequence length T: a
+    divisor of T that is a multiple of 8 (the TPU sublane tile), or T
+    itself when T <= requested.  Raises when no such divisor exists."""
+    b = min(requested, T)
+    if T % b == 0:
+        return b
+    for cand in range(b - b % 8, 7, -8):
+        if T % cand == 0:
+            return cand
+    raise ValueError(
+        f"sequence length {T} has no block divisor that is a multiple "
+        f"of 8 (pad the sequence)")
+
+
+def _block_sizes(T, Tk, block_q, block_k):
+    return fit_block(T, block_q), fit_block(Tk, block_k)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, with_lse: bool):
+    """``with_lse`` is set only on the VJP path — the primal would just
+    discard the [B, H, T, 128] residual (HBM allocation + write)."""
     B, T, H, D = q.shape
     Tk = k.shape[1]
-    block_q = min(block_q, T)
-    block_k = min(block_k, Tk)
-    if T % block_q or Tk % block_k:
-        raise ValueError(
-            f"sequence lengths ({T}, {Tk}) must divide block sizes "
-            f"({block_q}, {block_k})")
+    block_q, block_k = _block_sizes(T, Tk, block_q, block_k)
     n_q, n_k = T // block_q, Tk // block_k
     scale = 1.0 / np.sqrt(D)
 
+    # kernels run in [B, H, T, D] layout so blocks tile the (T, D) plane
+    # (the TPU (8, 128) constraint); the boundary transposes fuse into the
+    # surrounding projection einsums
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
     kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
-                               block_q=block_q, block_k=block_k, n_k=n_k)
-    return pl.pallas_call(
+                               block_q=block_q, block_k=block_k, n_k=n_k,
+                               with_lse=with_lse)
+    o_spec = pl.BlockSpec((1, 1, block_q, D),
+                          lambda b, h, iq, ik: (b, h, iq, 0))
+    out_specs = [o_spec]
+    out_shape = [_sds(qt.shape, qt.dtype, q)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, 1, block_q, _LANES),
+                                      lambda b, h, iq, ik: (b, h, iq, 0)))
+        out_shape.append(_sds((B, H, T, _LANES), jnp.float32, q))
+    res = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, D),
-                         lambda b, h, iq, ik: (b, iq, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, iq, ik: (b, ik, h, 0)),
-            pl.BlockSpec((1, block_k, 1, D),
-                         lambda b, h, iq, ik: (b, ik, h, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, iq, ik: (b, h, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, D),
-                               lambda b, h, iq, ik: (b, iq, h, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(qt, kt, vt)
+    out = res[0]
+    lse = res[1] if with_lse else None
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+# ----------------------------------------------------------------- backward
+def _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, causal,
+                scale, block_q, block_k, iq, ik):
+    """Recompute p and ds for one (q-block, k-block) pair, all f32.
+
+    delta = rowsum(dO * O) comes straight from the O / dO blocks, so no
+    separate delta array exists.
+    """
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    o = o_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    lse = lse_ref[0, 0, :, :1]                            # [bq, 1]
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=1, keepdims=True)                # [bq, 1]
+    ds = p * (dp - delta) * scale
+    return p, ds, q, do
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                      dq_ref, dq_acc, *, causal, scale, block_q, block_k,
+                      n_k):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    visible = True
+    if causal:
+        visible = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(visible)
+    def _accum():
+        _, ds, _, _ = _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref,
+                                  lse_ref, causal=causal, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  iq=iq, ik=ik)
+        k = k_ref[0, 0, :, :]
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale,
+                       block_q, block_k, n_q):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)  # q innermost: accumulators carry across q-blocks
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    visible = True
+    if causal:
+        visible = iq * block_q + block_q - 1 >= ik * block_k
+
+    @pl.when(visible)
+    def _accum():
+        p, ds, q, do = _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref,
+                                   lse_ref, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   iq=iq, ik=ik)
+        # dv += p^T dO ; dk += ds^T q
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == n_q - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    B, T, H, D = q.shape
+    Tk = k.shape[1]
+    block_q, block_k = _block_sizes(T, Tk, block_q, block_k)
+    n_q, n_k = T // block_q, Tk // block_k
+    scale = 1.0 / np.sqrt(D)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    ot = jnp.transpose(out, (0, 2, 1, 3))
+    gt = jnp.transpose(g, (0, 2, 1, 3))
+    q_spec = pl.BlockSpec((1, 1, block_q, D),
+                          lambda b, h, iq, ik: (b, h, iq, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, iq, ik: (b, h, ik, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                            lambda b, h, iq, ik: (b, h, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(B, H, n_q, n_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_sds(qt.shape, qt.dtype, q),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, ot, gt, lse)
+
+    # q innermost for dk/dv: k/v block indexed by grid axis 2
+    kq_spec = pl.BlockSpec((1, 1, block_q, D),
+                           lambda b, h, ik, iq: (b, h, iq, 0))
+    kk_spec = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, h, ik, iq: (b, h, ik, 0))
+    krow_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                             lambda b, h, ik, iq: (b, h, iq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(B, H, n_k, n_q),
+        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_spec, krow_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[_sds(kt.shape, kt.dtype, k),
+                   _sds(vt.shape, vt.dtype, v)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, ot, gt, lse)
+    back = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return back(dq), back(dk), back(dv)
 
 
 def _auto_interpret() -> bool:
@@ -120,26 +334,30 @@ def _auto_interpret() -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128):
-    """Pallas flash attention, [B, T, H, D] → [B, T, H, D]."""
-    return _flash_forward(q, k, v, causal, block_q, block_k,
-                          _auto_interpret())
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
+                    block_k: int = 512):
+    """Pallas flash attention, [B, T, H, D] → [B, T, H, D].
+
+    Default 512x512 blocks: measured 2-3x faster than 128x128 on v5e (the
+    [bq, bk] probability tile is the VMEM budget — 1 MiB f32 at 512x512 —
+    and bigger tiles amortize the grid/revisit overhead; 1024x1024 is
+    slightly faster still when VMEM allows, at 4 MiB per tile).
+    """
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
+                            _auto_interpret(), with_lse=False)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k):
-    out = _flash_forward(q, k, v, causal, block_q, block_k,
-                         _auto_interpret())
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              _auto_interpret(), with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, res, g):
-    # dense recompute backward; ring attention is the memory-lean path
-    from ..parallel.ring_attention import reference_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        functools.partial(reference_attention, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           _auto_interpret())
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

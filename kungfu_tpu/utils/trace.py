@@ -1,0 +1,100 @@
+"""Tracing / profiling.
+
+Reference analogues (SURVEY.md §5): the compile-time ``TRACE_SCOPE``
+macros around collective calls (include/kungfu/utils/trace.hpp:1-16,
+enabled by KUNGFU_ENABLE_TRACE) and the elastic hook's ``_log_event``
+timestamps (hooks/elastic.py:49-56).
+
+TPU-native form: scopes are runtime-gated by ``KFT_CONFIG_ENABLE_TRACE``
+(same toggle tier as the reference's env) and, when jax is tracing a
+profile, annotate the XLA timeline via ``jax.profiler.TraceAnnotation`` —
+so the same scope names appear in host-side stats and in XProf/TensorBoard
+device traces.  ``start_capture``/``stop_capture`` wrap ``jax.profiler``
+for on-demand device trace dumps.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENABLE_ENV = "KFT_CONFIG_ENABLE_TRACE"
+
+_lock = threading.Lock()
+_scopes: Dict[str, Tuple[int, float]] = {}   # name -> (count, total_s)
+_events: List[Tuple[float, str]] = []
+
+
+def enabled() -> bool:
+    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "True")
+
+
+@contextlib.contextmanager
+def trace_scope(name: str):
+    """Time a scope (reference TRACE_SCOPE).  No-op unless enabled."""
+    if not enabled():
+        yield
+        return
+    import jax
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dt = time.perf_counter() - t0
+    with _lock:
+        c, tot = _scopes.get(name, (0, 0.0))
+        _scopes[name] = (c + 1, tot + dt)
+
+
+def scope_stats() -> Dict[str, Tuple[int, float]]:
+    """{name: (count, total_seconds)} accumulated by trace_scope."""
+    with _lock:
+        return dict(_scopes)
+
+
+def log_event(name: str) -> float:
+    """Timestamped event mark (reference _log_event); always on — events
+    are cheap and the elastic protocol logs them unconditionally."""
+    ts = time.time()
+    with _lock:
+        _events.append((ts, name))
+    return ts
+
+
+def events() -> List[Tuple[float, str]]:
+    with _lock:
+        return list(_events)
+
+
+def reset() -> None:
+    with _lock:
+        _scopes.clear()
+        _events.clear()
+
+
+def report() -> str:
+    lines = [f"{name}: {c} calls, {tot * 1e3:.2f} ms total, "
+             f"{tot / c * 1e3:.3f} ms/call"
+             for name, (c, tot) in sorted(scope_stats().items())]
+    return "\n".join(lines)
+
+
+def start_capture(logdir: str) -> None:
+    """Begin an XLA device trace (view in XProf/TensorBoard)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_capture() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def capture(logdir: str):
+    start_capture(logdir)
+    try:
+        yield
+    finally:
+        stop_capture()

@@ -62,7 +62,41 @@ def test_flash_gradients_match_dense():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_flash_rejects_ragged_blocks():
+@pytest.mark.parametrize("causal,bq,bk", [
+    (False, 32, 16),
+    (True, 16, 32),
+    (True, 64, 64),
+])
+def test_flash_gradients_multiblock(causal, bq, bk):
+    """Pallas backward (dq / dkv kernels) vs dense AD across block shapes
+    where accumulators must carry over several inner-grid steps."""
+    q, k, v = _qkv(T=64, seed=3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, bq, bk) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_adapts_block_to_ragged_sequence():
+    """Requested blocks that don't divide T are shrunk to the largest
+    8-multiple divisor (48 % 32 != 0 → block 24)."""
     q, k, v = _qkv(T=48)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, False, 32, 32)
+    got = flash_attention(q, k, v, False, 32, 32)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_rejects_unpaddable_sequence():
+    # T=100 has no divisor that is a multiple of 8 below the requested 64
+    q, k, v = _qkv(T=100)
+    with pytest.raises(ValueError, match="no block divisor"):
+        flash_attention(q, k, v, False, 64, 64)
