@@ -117,19 +117,22 @@ class ElasticTrainer:
         session rebuild → state re-sync (survivor replicas kept, newcomer
         lanes cloned from lane 0) → progress sync.
         """
+        from ..utils.trace import log_event
         if new_size == self.n:
             return False
         if new_size > self.max_size:
             raise ValueError(f"size {new_size} exceeds capacity {self.max_size}")
         if new_size <= 0:
+            log_event(f"resize-detach:{self.n}->0")
             _flags.set_detached(True)
             return True
-        from ..utils.trace import log_event
-        log_event(f"resize-begin:{self.n}->{new_size}")
         # consensus fence on the proposal (trivially true single-controller,
         # real check under multi-controller)
         if not self.session.bytes_consensus(str(new_size).encode()):
+            log_event(f"resize-abort:{self.n}->{new_size}")
             raise RuntimeError("resize proposal diverged across peers")
+        # begin is logged after the fence so begin/end events always pair
+        log_event(f"resize-begin:{self.n}->{new_size}")
         self._host_params = jax.tree_util.tree_map(
             lambda t: np.asarray(t), self.params)
         host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),

@@ -29,11 +29,12 @@ COORDINATOR = "KFT_COORDINATOR"          # jax.distributed coordinator addr
 # runtime feature toggles (reference: KUNGFU_CONFIG_*, config/config.go:41-67)
 ENABLE_MONITORING = "KFT_CONFIG_ENABLE_MONITORING"
 ENABLE_STALL_DETECTION = "KFT_CONFIG_ENABLE_STALL_DETECTION"
+ENABLE_TRACE = "KFT_CONFIG_ENABLE_TRACE"
 MONITORING_PERIOD = "KFT_CONFIG_MONITORING_PERIOD_MS"
 LOG_LEVEL = "KFT_CONFIG_LOG_LEVEL"
 
 CONFIG_ENV_KEYS = [ENABLE_MONITORING, ENABLE_STALL_DETECTION,
-                   MONITORING_PERIOD, LOG_LEVEL]
+                   ENABLE_TRACE, MONITORING_PERIOD, LOG_LEVEL]
 
 
 @dataclasses.dataclass

@@ -19,9 +19,10 @@ and accumulates
     dv += p^T dO,   ds = p * (dO v^T - delta),   dk += ds^T q * s,
     dq += ds k * s,        with  delta = rowsum(dO * O)
 
-in two kernels (dq with k innermost; dk/dv with q innermost); ``delta`` is
-computed in-kernel from the O / dO blocks, so training memory stays
-O(T * D) — no [T, T] materialization anywhere.
+in two kernels (dq with k innermost; dk/dv with q innermost); ``delta``
+is precomputed once per row by a tiny third kernel (lane-replicated like
+lse), so training memory stays O(T * D) — no [T, T] materialization
+anywhere.
 
 On CPU (tests, CI) the kernels run with ``interpret=True``.
 """
@@ -180,17 +181,21 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
 
 # ----------------------------------------------------------------- backward
-def _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, causal,
-                scale, block_q, block_k, iq, ik):
-    """Recompute p and ds for one (q-block, k-block) pair, all f32.
+def _fa_delta_kernel(o_ref, do_ref, delta_ref):
+    """delta = rowsum(dO * O), stored lane-replicated like lse — computed
+    once per q row instead of once per (q-block, k-block) pair."""
+    o = o_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    d = jnp.sum(o * do, axis=1, keepdims=True)
+    delta_ref[0, 0, :, :] = jnp.broadcast_to(d, delta_ref.shape[2:])
 
-    delta = rowsum(dO * O) comes straight from the O / dO blocks, so no
-    separate delta array exists.
-    """
+
+def _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *, causal,
+                scale, block_q, block_k, iq, ik):
+    """Recompute p and ds for one (q-block, k-block) pair, all f32."""
     q = q_ref[0, 0, :, :]
     k = k_ref[0, 0, :, :]
     v = v_ref[0, 0, :, :]
-    o = o_ref[0, 0, :, :]
     do = do_ref[0, 0, :, :]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -204,13 +209,12 @@ def _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *, causal,
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=1, keepdims=True)                # [bq, 1]
+    delta = delta_ref[0, 0, :, :1]                        # [bq, 1]
     ds = p * (dp - delta) * scale
     return p, ds, q, do
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dq_acc, *, causal, scale, block_q, block_k,
                       n_k):
     iq = pl.program_id(2)
@@ -226,8 +230,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(visible)
     def _accum():
-        _, ds, _, _ = _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref,
-                                  lse_ref, causal=causal, scale=scale,
+        _, ds, _, _ = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                  delta_ref, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   iq=iq, ik=ik)
         k = k_ref[0, 0, :, :]
@@ -240,7 +244,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dq_ref[0, 0, :, :] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale,
                        block_q, block_k, n_q):
     ik = pl.program_id(2)
@@ -257,8 +261,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(visible)
     def _accum():
-        p, ds, q, do = _block_p_ds(q_ref, k_ref, v_ref, o_ref, do_ref,
-                                   lse_ref, causal=causal, scale=scale,
+        p, ds, q, do = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                   delta_ref, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k,
                                    iq=iq, ik=ik)
         # dv += p^T dO ; dk += ds^T q
@@ -295,16 +299,28 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
     row_spec = pl.BlockSpec((1, 1, block_q, _LANES),
                             lambda b, h, iq, ik: (b, h, iq, 0))
 
+    # delta preprocess: one rowsum per q row (vs per block pair)
+    dspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, iq: (b, h, iq, 0))
+    delta = pl.pallas_call(
+        _fa_delta_kernel,
+        grid=(B, H, n_q),
+        in_specs=[dspec, dspec],
+        out_specs=pl.BlockSpec((1, 1, block_q, _LANES),
+                               lambda b, h, iq: (b, h, iq, 0)),
+        out_shape=_sds((B, H, T, _LANES), jnp.float32, q),
+        interpret=interpret,
+    )(ot, gt)
+
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k, n_k=n_k),
         grid=(B, H, n_q, n_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, row_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         out_shape=_sds(qt.shape, qt.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, ot, gt, lse)
+    )(qt, kt, vt, gt, lse, delta)
 
     # q innermost for dk/dv: k/v block indexed by grid axis 2
     kq_spec = pl.BlockSpec((1, 1, block_q, D),
@@ -317,14 +333,14 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k, n_q=n_q),
         grid=(B, H, n_k, n_q),
-        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, kq_spec, krow_spec],
+        in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, krow_spec, krow_spec],
         out_specs=[kk_spec, kk_spec],
         out_shape=[_sds(kt.shape, kt.dtype, k),
                    _sds(vt.shape, vt.dtype, v)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, ot, gt, lse)
+    )(qt, kt, vt, gt, lse, delta)
     back = lambda x: jnp.transpose(x, (0, 2, 1, 3))
     return back(dq), back(dk), back(dv)
 

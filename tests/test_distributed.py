@@ -1,0 +1,52 @@
+"""Multi-process jax.distributed bootstrap via the launcher + env ABI.
+
+The real multi-host path: each launcher-spawned worker calls
+kungfu_tpu.init_distributed(), which derives the coordinator from the
+shared peer list and joins one jax distributed runtime; a global psum
+then spans every process's devices (on TPU pods this is the ICI/DCN
+path; here each process contributes its virtual CPU devices).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import kungfu_tpu as kft
+    ok = kft.init_distributed()
+    assert ok, "init_distributed returned False under the launcher"
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import jax.experimental.multihost_utils as mh
+    assert jax.process_count() == 2, jax.process_count()
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("x",))
+    fn = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "x"), mesh=mesh,
+                               in_specs=P("x"), out_specs=P("x")))
+    n = len(devs)
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1) + 1
+    y = fn(jax.device_put(x, NamedSharding(mesh, P("x"))))
+    val = float(np.asarray(mh.process_allgather(y[:1], tiled=True))[0, 0])
+    assert val == n * (n + 1) / 2, (val, n)
+    print(f"DIST_OK rank={jax.process_index()} ndev={n} psum={val}")
+""")
+
+
+def test_two_process_global_psum(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    # each worker gets 2 virtual CPU devices -> 4 global
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.launcher", "-np", "2", "--",
+         sys.executable, str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("DIST_OK") == 2, out.stdout
