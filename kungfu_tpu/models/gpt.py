@@ -123,24 +123,19 @@ def rms_norm(x, scale, eps=1e-5):
     return (xf * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
 
 
-def apply_layer(layer, x, cfg: GPTConfig, *,
-                tp_axis: Optional[str] = None,
-                sp_axis: Optional[str] = None,
-                attn: str = "dense"):
-    """One transformer block on (local) activations ``x`` [B, T, D]."""
+def _layer_qkv(layer, x, cfg: GPTConfig):
+    """ln1 + q/k/v projections — shared by the train and decode paths."""
     h = rms_norm(x, layer["ln1"])
     q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cfg.dtype))
     kk = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cfg.dtype))
     v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cfg.dtype))
-    if attn == "ring":
-        o = ring_attention(q, kk, v, sp_axis, causal=True)
-    elif attn == "ulysses":
-        o = ulysses_attention(q, kk, v, sp_axis, causal=True)
-    elif attn == "flash":
-        from ..ops.flash_attention import flash_attention
-        o = flash_attention(q, kk, v, causal=True)
-    else:
-        o = reference_attention(q, kk, v, causal=True)
+    return q, kk, v
+
+
+def _layer_finish(layer, x, o, cfg: GPTConfig,
+                  tp_axis: Optional[str] = None):
+    """Attention output projection + residual + MLP — shared by the train
+    and decode paths (any architecture change lands in both)."""
     o = jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(cfg.dtype))
     if tp_axis:
         o = lax.psum(o, tp_axis)
@@ -151,6 +146,24 @@ def apply_layer(layer, x, cfg: GPTConfig, *,
     if tp_axis:
         m = lax.psum(m, tp_axis)
     return x + m
+
+
+def apply_layer(layer, x, cfg: GPTConfig, *,
+                tp_axis: Optional[str] = None,
+                sp_axis: Optional[str] = None,
+                attn: str = "dense"):
+    """One transformer block on (local) activations ``x`` [B, T, D]."""
+    q, kk, v = _layer_qkv(layer, x, cfg)
+    if attn == "ring":
+        o = ring_attention(q, kk, v, sp_axis, causal=True)
+    elif attn == "ulysses":
+        o = ulysses_attention(q, kk, v, sp_axis, causal=True)
+    elif attn == "flash":
+        from ..ops.flash_attention import flash_attention
+        o = flash_attention(q, kk, v, causal=True)
+    else:
+        o = reference_attention(q, kk, v, causal=True)
+    return _layer_finish(layer, x, o, cfg, tp_axis)
 
 
 def forward_local(params, tokens, cfg: GPTConfig, *,
@@ -229,6 +242,116 @@ def parallel_cross_entropy(logits_local, targets, *,
 def forward(params, tokens, cfg: GPTConfig):
     """Unsharded single-device forward → full logits (the oracle)."""
     return forward_local(params, tokens, cfg)
+
+
+# --------------------------------------------------------------- generation
+def init_kv_cache(cfg: GPTConfig, batch: int, max_len: Optional[int] = None):
+    """Per-layer KV cache: k/v [B, max_len, H, Dh] in the model dtype."""
+    L = max_len or cfg.max_seq
+    if L > cfg.max_seq:
+        raise ValueError(f"cache length {L} exceeds max_seq {cfg.max_seq} "
+                         f"(wpe has no embeddings past it)")
+    shape = (batch, L, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def _decode_attend(q, kc, vc, pos):
+    """q [B, 1, H, Dh] vs cache [B, L, H, Dh]; positions > pos masked."""
+    L = kc.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    mask = (jnp.arange(L) <= pos)[None, None, None, :]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_hidden(params, cfg: GPTConfig, cache, pos, token):
+    """One incremental step through the layer stack (no lm_head):
+    ``(x_final [B, 1, D], new_cache)``.  Layer math is shared with the
+    training path via _layer_qkv/_layer_finish; only the attend differs."""
+    x = (params["wte"][token][:, None]
+         + params["wpe"][pos][None, None]).astype(cfg.dtype)   # [B, 1, D]
+    new_cache = []
+    for layer, kv in zip(params["layers"], cache):
+        q, kk, v = _layer_qkv(layer, x, cfg)
+        kc = lax.dynamic_update_slice(kv["k"], kk, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(kv["v"], v, (0, pos, 0, 0))
+        new_cache.append({"k": kc, "v": vc})
+        o = _decode_attend(q, kc, vc, pos)
+        x = _layer_finish(layer, x, o, cfg)
+    return rms_norm(x, params["lnf"]), new_cache
+
+
+def _head(params, x):
+    """lm_head on [B, 1, D] → [B, V] f32 logits."""
+    return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                      params["lm_head"])[:, 0]
+
+
+def decode_step(params, cfg: GPTConfig, cache, pos, token):
+    """One incremental decode step.
+
+    ``token``: [B] int32 at position ``pos`` (scalar int32).  Returns
+    ``(logits [B, V], new_cache)``.  Static shapes — jit/scan friendly.
+    """
+    x, cache = _decode_hidden(params, cfg, cache, pos, token)
+    return _head(params, x), cache
+
+
+def prefill(params, cfg: GPTConfig, cache, tokens):
+    """Fill the cache from a prompt [B, T] by running T incremental steps
+    in a scan; returns (last_logits [B, V], cache).  The vocab-sized
+    lm_head matmul runs ONCE, on the final hidden state — not inside the
+    scan."""
+    T = tokens.shape[1]
+
+    def body(carry, t):
+        cache, _ = carry
+        x, cache = _decode_hidden(params, cfg, cache, t, tokens[:, t])
+        return (cache, x), None
+
+    z = jnp.zeros((tokens.shape[0], 1, cfg.d_model), cfg.dtype)
+    (cache, x), _ = lax.scan(body, (cache, z), jnp.arange(T))
+    return _head(params, x), cache
+
+
+def generate(params, cfg: GPTConfig, prompt, n_tokens: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None,
+             max_len: Optional[int] = None):
+    """Autoregressive generation (greedy, or sampled when temperature>0).
+
+    ``prompt``: [B, T] int32.  Returns [B, n_tokens] int32.  The whole
+    loop is one jittable scan over a static-shape KV cache.
+    """
+    B, T = prompt.shape
+    L = max_len or cfg.max_seq
+    if T + n_tokens > L:
+        raise ValueError(f"prompt {T} + {n_tokens} new tokens exceeds "
+                         f"cache length {L}")
+    cache = init_kv_cache(cfg, B, L)
+    logits, cache = prefill(params, cfg, cache, prompt)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def body(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = pick(logits, sub).astype(jnp.int32)
+        logits, cache = decode_step(params, cfg, cache, T + i, tok)
+        return (cache, logits, key), tok
+
+    (_, _, _), toks = lax.scan(body, (cache, logits, rng),
+                               jnp.arange(n_tokens))
+    return jnp.transpose(toks, (1, 0))  # [B, n_tokens]
 
 
 def loss_fn(params, tokens, targets, cfg: GPTConfig):
