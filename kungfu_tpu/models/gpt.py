@@ -156,6 +156,9 @@ def apply_layer(layer, x, cfg: GPTConfig, *,
     q, kk, v = _layer_qkv(layer, x, cfg)
     if attn == "ring":
         o = ring_attention(q, kk, v, sp_axis, causal=True)
+    elif attn == "ring_flash":
+        from ..parallel.ring_attention import ring_flash_attention
+        o = ring_flash_attention(q, kk, v, sp_axis, causal=True)
     elif attn == "ulysses":
         o = ulysses_attention(q, kk, v, sp_axis, causal=True)
     elif attn == "flash":
@@ -177,24 +180,25 @@ def forward_local(params, tokens, cfg: GPTConfig, *,
     the head/feature dims hold the local slice and the returned logits are
     vocab-sharded ``[B_local, T_local, V/tp]``.
 
-    ``attn``: "ring" | "ulysses" (both need ``sp_axis``) | "flash"
-    (Pallas kernel) | "dense"; "auto" = ring when sequence-parallel, else
-    the flash kernel on TPU when the sequence tiles into its blocks
-    (~1.5x dense throughput and no [T, T] materialization), else dense.
+    ``attn``: "ring" | "ring_flash" | "ulysses" (these need ``sp_axis``) |
+    "flash" (Pallas kernel) | "dense"; "auto" = ring (flash-chunked on
+    TPU) when sequence-parallel, else the flash kernel on TPU when the
+    sequence tiles into its blocks (~1.5x dense throughput and no [T, T]
+    materialization), else dense.
     """
     T = tokens.shape[1]
     if attn == "auto":
-        if sp_axis:
-            attn = "ring"
-        elif jax.default_backend() == "tpu":
+        def _flash_ok():
             from ..ops.flash_attention import fit_block
             try:
-                ok = fit_block(T, 512) >= 128  # tiny blocks lose to dense
+                return fit_block(T, 512) >= 128  # tiny blocks lose to dense
             except ValueError:
-                ok = False
-            attn = "flash" if ok else "dense"
+                return False
+        on_tpu = jax.default_backend() == "tpu"
+        if sp_axis:
+            attn = "ring_flash" if (on_tpu and _flash_ok()) else "ring"
         else:
-            attn = "dense"
+            attn = "flash" if (on_tpu and _flash_ok()) else "dense"
     offset = lax.axis_index(sp_axis) * T if sp_axis else 0
     pos = offset + jnp.arange(T)
 

@@ -280,7 +280,12 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
-                    interpret):
+                    interpret, dlse=None):
+    """``dlse`` (optional, [B, H, T] f32): cotangent of the lse output.
+    It folds into the per-row term of ``ds`` — mathematically
+    d lse/d s = p, so ds picks up ``+ p * dlse`` exactly where the delta
+    correction subtracts (FA2 with lse gradient, as needed by ring-flash
+    merging)."""
     B, T, H, D = q.shape
     Tk = k.shape[1]
     block_q, block_k = _block_sizes(T, Tk, block_q, block_k)
@@ -310,6 +315,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
         out_shape=_sds((B, H, T, _LANES), jnp.float32, q),
         interpret=interpret,
     )(ot, gt)
+    if dlse is not None:
+        # ds = p * (dp - delta + dlse) * scale — fold dlse into the row term
+        delta = delta - jnp.broadcast_to(
+            dlse.astype(jnp.float32)[..., None], delta.shape)
 
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
@@ -349,16 +358,34 @@ def _auto_interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
-                    block_k: int = 512):
-    """Pallas flash attention, [B, T, H, D] → [B, T, H, D].
+def _use_jnp_fallback(q) -> bool:
+    """Interpret-mode Pallas can't run under a vma-tracking shard_map
+    (its internal scratch ops mix varying/invarying states), so on CPU
+    inside shard_map we compute with an equivalent jnp path instead.  On
+    TPU the real kernels run everywhere (verified in-shard on hardware);
+    direct CPU calls still exercise the kernels via interpret=True."""
+    return _auto_interpret() and bool(getattr(jax.typeof(q), "vma", ()))
 
-    Default 512x512 blocks: measured 2-3x faster than 128x128 on v5e (the
-    [bq, bk] probability tile is the VMEM budget — 1 MiB f32 at 512x512 —
-    and bigger tiles amortize the grid/revisit overhead; 1024x1024 is
-    slightly faster still when VMEM allows, at 4 MiB per tile).
-    """
+
+def _jnp_flash(q, k, v, causal):
+    """Differentiable jnp twin of the kernel: (out, lse [B, H, T] f32)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    if causal:
+        Tq, Tk = s.shape[2], s.shape[3]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jax.lax.stop_gradient(jnp.max(s, axis=-1))
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / l[..., None],
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype), m + jnp.log(l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_pallas(q, k, v, causal, block_q, block_k):
+
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k,
                             _auto_interpret(), with_lse=False)
     return out
@@ -376,4 +403,53 @@ def _fa_bwd(causal, block_q, block_k, res, g):
                            _auto_interpret())
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
+                    block_k: int = 512):
+    """Pallas flash attention, [B, T, H, D] → [B, T, H, D].
+
+    Default 512x512 blocks: measured 2-3x faster than 128x128 on v5e (the
+    [bq, bk] probability tile is the VMEM budget — 1 MiB f32 at 512x512 —
+    and bigger tiles amortize the grid/revisit overhead; 1024x1024 is
+    slightly faster still when VMEM allows, at 4 MiB per tile).
+    """
+    if _use_jnp_fallback(q):
+        return _jnp_flash(q, k, v, causal)[0]
+    return _flash_attention_pallas(q, k, v, causal, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_with_lse_pallas(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              _auto_interpret(), with_lse=True)
+    return out, lse[..., 0]
+
+
+def _fal_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k,
+                              _auto_interpret(), with_lse=True)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _fal_bwd(causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    do, dlse = g
+    return _flash_backward(q, k, v, out, lse, do, causal, block_q, block_k,
+                           _auto_interpret(), dlse=dlse)
+
+
+_flash_with_lse_pallas.defvjp(_fal_fwd, _fal_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             block_q: int = 512, block_k: int = 512):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``[B, H, T]`` (f32) — the merge statistic for combining
+    partial attentions over KV chunks (ring-flash).  Both outputs are
+    differentiable: the lse cotangent folds into the backward's row term.
+    """
+    if _use_jnp_fallback(q):
+        return _jnp_flash(q, k, v, causal)
+    return _flash_with_lse_pallas(q, k, v, causal, block_q, block_k)

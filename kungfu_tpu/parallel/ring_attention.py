@@ -93,6 +93,56 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         block_q: int = 512, block_k: int = 512):
+    """Ring attention whose per-chunk compute is the Pallas flash kernel.
+
+    Same semantics and layout as :func:`ring_attention` (inside shard_map,
+    local shards [B, T_local, H, D], global sequence = rank-order concat),
+    but each (queries x KV-chunk) block runs on the MXU via
+    ``flash_attention_with_lse`` and partial results merge with the
+    numerically-stable log-sum-exp combine.  Gradients flow through the
+    kernel's custom VJP (the lse cotangent folds into its row term) and
+    through ``ppermute``'s transpose — the backward ring is generated
+    by AD.
+
+    Chunk visibility under ``causal``: step 0 is the diagonal chunk
+    (causal mask inside the kernel); at step s the incoming chunk
+    originated at ``rank - s``, which is entirely in the past when
+    ``rank >= s`` (full attention) and entirely in the future otherwise
+    (merged with weight zero).
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    o0, lse0 = flash_attention_with_lse(q, k, v, causal, block_q, block_k)
+    acc = o0.astype(jnp.float32)
+    lse_acc = lse0                       # [B, H, T_local] f32
+
+    def step(carry, s):
+        acc, lse_acc, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm=perm)
+        vc = lax.ppermute(vc, axis_name, perm=perm)
+        oi, lsei = flash_attention_with_lse(q, kc, vc, False,
+                                            block_q, block_k)
+        if causal:
+            # wrapped chunks (src rank > this rank) are future: weight 0
+            lsei = jnp.where(rank >= s, lsei, NEG_INF)
+        lse_new = jnp.logaddexp(lse_acc, lsei)
+        w_old = jnp.exp(lse_acc - lse_new)               # [B, H, T]
+        w_new = jnp.exp(lsei - lse_new)
+        tohd = lambda w: jnp.transpose(w, (0, 2, 1))[..., None]
+        acc = acc * tohd(w_old) + oi.astype(jnp.float32) * tohd(w_new)
+        return (acc, lse_new, kc, vc), None
+
+    if n > 1:
+        (acc, _, _, _), _ = lax.scan(step, (acc, lse_acc, k, v),
+                                     jnp.arange(1, n))
+    return acc.astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     """All-to-all (Ulysses/DeepSpeed-style) sequence parallelism.
 

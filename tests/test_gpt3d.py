@@ -56,10 +56,12 @@ def _tree_allclose(a, b, rtol=2e-4, atol=2e-5):
 
 @pytest.mark.parametrize("dp,sp,tp,attn", [
     (2, 2, 2, "ring"),
+    (2, 2, 2, "ring_flash"),
     (2, 2, 2, "ulysses"),
     (1, 1, 4, "dense"),   # pure tensor parallel
     (4, 1, 1, "dense"),   # pure data parallel
     (1, 4, 1, "ring"),    # pure sequence parallel
+    (1, 4, 1, "ring_flash"),
 ])
 def test_parity_with_oracle(devices, dp, sp, tp, attn):
     opt = optax.sgd(0.1)
