@@ -133,14 +133,21 @@ def _layer_qkv(layer, x, cfg: GPTConfig):
 
 
 def _layer_finish(layer, x, o, cfg: GPTConfig,
-                  tp_axis: Optional[str] = None):
-    """Attention output projection + residual + MLP — shared by the train
-    and decode paths (any architecture change lands in both)."""
+                  tp_axis: Optional[str] = None,
+                  ffn: Optional[Any] = None):
+    """Attention output projection + residual + FFN — shared by the train
+    and decode paths (any architecture change lands in both).
+
+    ``ffn(layer, h) -> delta`` swaps the dense MLP for another FFN
+    (e.g. switch-MoE) on the POST-norm activations; the residual add
+    stays here so every GPT variant keeps the same block structure."""
     o = jnp.einsum("bthk,hkd->btd", o, layer["wo"].astype(cfg.dtype))
     if tp_axis:
         o = lax.psum(o, tp_axis)
     x = x + o
     h = rms_norm(x, layer["ln2"])
+    if ffn is not None:
+        return x + ffn(layer, h)
     u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
     m = u @ layer["wm"].astype(cfg.dtype)
     if tp_axis:
@@ -148,25 +155,33 @@ def _layer_finish(layer, x, o, cfg: GPTConfig,
     return x + m
 
 
+def _attend(q, kk, v, attn: str, sp_axis: Optional[str]):
+    if attn in ("ring", "ring_flash", "ulysses") and sp_axis is None:
+        raise ValueError(f"attn={attn!r} needs a sequence-parallel axis")
+    if attn == "ring":
+        return ring_attention(q, kk, v, sp_axis, causal=True)
+    if attn == "ring_flash":
+        from ..parallel.ring_attention import ring_flash_attention
+        return ring_flash_attention(q, kk, v, sp_axis, causal=True)
+    if attn == "ulysses":
+        return ulysses_attention(q, kk, v, sp_axis, causal=True)
+    if attn == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, kk, v, causal=True)
+    if attn == "dense":
+        return reference_attention(q, kk, v, causal=True)
+    raise ValueError(f"unknown attention mode {attn!r}")
+
+
 def apply_layer(layer, x, cfg: GPTConfig, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None,
-                attn: str = "dense"):
+                attn: str = "dense",
+                ffn: Optional[Any] = None):
     """One transformer block on (local) activations ``x`` [B, T, D]."""
     q, kk, v = _layer_qkv(layer, x, cfg)
-    if attn == "ring":
-        o = ring_attention(q, kk, v, sp_axis, causal=True)
-    elif attn == "ring_flash":
-        from ..parallel.ring_attention import ring_flash_attention
-        o = ring_flash_attention(q, kk, v, sp_axis, causal=True)
-    elif attn == "ulysses":
-        o = ulysses_attention(q, kk, v, sp_axis, causal=True)
-    elif attn == "flash":
-        from ..ops.flash_attention import flash_attention
-        o = flash_attention(q, kk, v, causal=True)
-    else:
-        o = reference_attention(q, kk, v, causal=True)
-    return _layer_finish(layer, x, o, cfg, tp_axis)
+    o = _attend(q, kk, v, attn, sp_axis)
+    return _layer_finish(layer, x, o, cfg, tp_axis, ffn=ffn)
 
 
 def forward_local(params, tokens, cfg: GPTConfig, *,

@@ -68,13 +68,17 @@ def _capacity(n_tokens: int, cfg: MoEConfig) -> int:
 
 
 def moe_ffn(params: dict, x, cfg: MoEConfig,
-            ep_axis: Optional[str] = None) -> Tuple[Any, Any]:
+            ep_axis: Optional[str] = None,
+            residual: bool = True) -> Tuple[Any, Any]:
     """Apply the MoE FFN to (local) activations ``x`` [B, T, D].
 
     With ``ep_axis``, ``params["wi"]/["wo"]`` hold the local expert slice
     ``[E/ep, ...]`` and tokens are exchanged with two all_to_alls; without
     it they hold all ``E`` experts (the oracle).  Returns ``(y, aux_loss)``
-    where ``y`` includes the residual (overflowed tokens pass through).
+    where ``y`` includes the residual (overflowed tokens pass through);
+    ``residual=False`` returns just the expert contribution, for callers
+    (pre-norm transformers) that add their own residual on the un-normed
+    stream.
     """
     B, T, D = x.shape
     E = cfg.n_experts
@@ -134,7 +138,9 @@ def moe_ffn(params: dict, x, cfg: MoEConfig,
                              tiled=True)                        # [E, C, D]
 
     y = jnp.einsum("nec,ecd->nd", comb, out.astype(jnp.float32))
-    y = x + y.astype(x.dtype).reshape(B, T, D)  # overflow -> pure residual
+    y = y.astype(x.dtype).reshape(B, T, D)
+    if residual:
+        y = x + y          # overflow -> pure residual
     return y, aux
 
 
