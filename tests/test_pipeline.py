@@ -68,6 +68,30 @@ def test_pp_parity_with_oracle(devices, dp, pp, n_layers, n_micro):
     _tree_allclose(got, ref_params)
 
 
+@pytest.mark.parametrize("dp,pp,tp,n_layers,n_micro", [
+    (2, 2, 2, 2, 2),
+    (1, 2, 4, 2, 2),
+])
+def test_pp_tp_parity_with_oracle(devices, dp, pp, tp, n_layers, n_micro):
+    """Megatron-style 3D: pipeline stages each running tensor-parallel
+    layers, against the same single-device oracle."""
+    cfg = _cfg(n_layers)
+    opt = optax.sgd(0.1)
+    tokens, targets = _data(cfg)
+    ref_params, ref_loss = _oracle(cfg, tokens, targets, opt)
+
+    mesh = PP.mesh_dp_pp_tp(dp, pp, tp, devices)
+    params, state = PP.init_gpt_pp(cfg, opt, mesh, seed=0)
+    step = PP.make_gpt_pp_train_step(cfg, opt, mesh, n_micro=n_micro,
+                                     donate=False)
+    params, state, loss = step(params, state, tokens, targets)
+
+    assert np.isclose(float(loss), ref_loss, rtol=1e-4), \
+        f"loss {float(loss)} != oracle {ref_loss}"
+    got = PP.unstack_layers(jax.device_get(params), cfg.n_layers)
+    _tree_allclose(got, ref_params)
+
+
 def test_pp_loss_decreases(devices):
     cfg = _cfg(2)
     opt = optax.adam(1e-2)
@@ -87,3 +111,12 @@ def test_pp_validation(devices):
     mesh = PP.mesh_dp_pp(1, 2, devices)
     with pytest.raises(ValueError, match="not divisible"):
         PP.make_gpt_pp_train_step(cfg, optax.sgd(0.1), mesh, n_micro=2)
+
+
+def test_pp_tp_divisibility_validation(devices):
+    cfg = _cfg(2)  # n_heads=4
+    mesh = PP.mesh_dp_pp_tp(1, 2, 4, devices)
+    bad = G.GPTConfig(vocab_size=64, d_model=18, n_heads=6, n_layers=2,
+                      d_ff=32, max_seq=32, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        PP.make_gpt_pp_train_step(bad, optax.sgd(0.1), mesh, n_micro=2)
