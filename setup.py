@@ -11,9 +11,18 @@ setup(
     packages=find_packages(include=["kungfu_tpu", "kungfu_tpu.*"]),
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "numpy"],
+    extras_require={
+        "checkpoint": ["orbax-checkpoint"],
+        "torch": ["torch"],
+    },
     entry_points={
+        # the reference ships four binaries (kungfu-run, -config-server,
+        # -distribute, -rrun); same surface here
         "console_scripts": [
             "kft-run = kungfu_tpu.launcher.cli:main",
+            "kft-config-server = kungfu_tpu.elastic.config_server:main",
+            "kft-distribute = kungfu_tpu.launcher.distribute:main",
+            "kft-rrun = kungfu_tpu.launcher.rrun:main",
         ],
     },
 )
