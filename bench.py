@@ -94,4 +94,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # remote-tunnelled TPU runtimes occasionally fail one compile RPC
+    # transiently; one retry keeps the harness from losing the round's
+    # measurement to a blip
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        print(f"bench attempt 1 failed ({type(e).__name__}); retrying",
+              file=sys.stderr)
+        time.sleep(10)
+        main()
