@@ -26,7 +26,8 @@ from jax.sharding import Mesh
 
 from kungfu_tpu.parallel import (make_ring_attention,
                                  make_ulysses_attention)
-from kungfu_tpu.parallel.ring_attention import reference_attention
+from kungfu_tpu.parallel.ring_attention import (make_ring_flash_attention,
+                                                reference_attention)
 
 
 def main():
@@ -40,9 +41,13 @@ def main():
 
     ring = make_ring_attention(mesh, axis="sp", causal=True)
     ulysses = make_ulysses_attention(mesh, axis="sp", causal=True)
+    # ring with Pallas flash chunks — the fast path on TPU pods
+    ring_flash = make_ring_flash_attention(mesh, axis="sp", causal=True,
+                                           block_q=64, block_k=64)
     dense = reference_attention(q, k, v, causal=True)
 
-    for name, fn in (("ring", ring), ("ulysses", ulysses)):
+    for name, fn in (("ring", ring), ("ulysses", ulysses),
+                     ("ring_flash", ring_flash)):
         out = fn(q, k, v)
         err = float(jnp.max(jnp.abs(out - dense)))
         print(f"{name:8s} attention: seq={T} over {n} lanes, "

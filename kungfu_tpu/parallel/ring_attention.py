@@ -204,3 +204,15 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "sp",
         mesh=mesh, in_specs=(_seq_specs(axis),) * 3,
         out_specs=_seq_specs(axis))
     return jax.jit(fn)
+
+
+def make_ring_flash_attention(mesh: Mesh, axis: str = "sp",
+                              causal: bool = False,
+                              block_q: int = 512, block_k: int = 512):
+    """Jitted [B, T, H, D] ring attention with Pallas flash chunks."""
+    fn = jax.shard_map(
+        functools.partial(ring_flash_attention, axis_name=axis,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        mesh=mesh, in_specs=(_seq_specs(axis),) * 3,
+        out_specs=_seq_specs(axis))
+    return jax.jit(fn)
