@@ -12,22 +12,22 @@ Eager collectives operate on *peer-stacked* arrays: leading axis = peer
 """
 from __future__ import annotations
 
-import functools
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..plan.graph import Graph
 from ..plan.peer import PeerID, PeerList
-from ..plan.topology import (DEFAULT_STRATEGY, GraphPair, Strategy,
-                             auto_select, generate)
+from ..plan.topology import (GraphPair, Strategy, auto_select,
+                             generate)
 from . import collectives as C
 from .mesh import PEER_AXIS, flat_mesh
+from ..utils.trace import trace_scope
 
 
 class StrategyStat:
@@ -149,7 +149,6 @@ class Session:
         return fn
 
     def _run(self, name: str, x: jax.Array, body: Callable, key: tuple) -> jax.Array:
-        from ..utils.trace import trace_scope
         x = jnp.asarray(x)
         if x.shape[0] != self.n:
             raise ValueError(f"leading axis {x.shape[0]} != cluster size {self.n}")

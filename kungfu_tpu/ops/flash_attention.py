@@ -29,7 +29,7 @@ On CPU (tests, CI) the kernels run with ``interpret=True``.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ the same mesh/collective substrate as the DP engine.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -45,13 +45,7 @@ def _oracle(cfg, tokens, targets, opt, steps=1, seed=0):
     return params, float(loss)
 
 
-def _tree_allclose(a, b, rtol=2e-4, atol=2e-5):
-    fa, _ = jax.tree_util.tree_flatten(a)
-    fb, _ = jax.tree_util.tree_flatten(b)
-    assert len(fa) == len(fb)
-    for x, y in zip(fa, fb):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
-                                   rtol=rtol, atol=atol)
+from testutil import tree_allclose as _tree_allclose  # noqa: E402
 
 
 @pytest.mark.parametrize("dp,sp,tp,attn", [

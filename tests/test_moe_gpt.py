@@ -62,10 +62,8 @@ def test_parity_with_oracle_no_drop(devices, dp, ep):
 
     assert np.isclose(float(loss), ref_loss, rtol=1e-4), \
         (float(loss), ref_loss)
-    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(params)),
-                    jax.tree_util.tree_leaves(ref_params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5)
+    from testutil import tree_allclose
+    tree_allclose(jax.device_get(params), ref_params)
 
 
 def test_moe_gpt_loss_decreases(devices):

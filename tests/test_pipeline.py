@@ -36,13 +36,7 @@ def _oracle(cfg, tokens, targets, opt, seed=0):
     return optax.apply_updates(params, updates), float(loss)
 
 
-def _tree_allclose(a, b, rtol=2e-4, atol=2e-5):
-    fa, ta = jax.tree_util.tree_flatten(a)
-    fb, tb = jax.tree_util.tree_flatten(b)
-    assert len(fa) == len(fb)
-    for x, y in zip(fa, fb):
-        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
-                                   rtol=rtol, atol=atol)
+from testutil import tree_allclose as _tree_allclose  # noqa: E402
 
 
 @pytest.mark.parametrize("dp,pp,n_layers,n_micro", [

@@ -1,0 +1,13 @@
+"""Shared test helpers (pytest adds tests/ to sys.path: `import testutil`)."""
+import jax
+import numpy as np
+
+
+def tree_allclose(a, b, rtol=2e-4, atol=2e-5):
+    """Assert two pytrees match leaf-for-leaf within tolerance."""
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb), (len(fa), len(fb))
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
