@@ -12,6 +12,8 @@ import pytest
 
 from jax.sharding import PartitionSpec as P
 
+from testutil import tree_allclose
+
 from kungfu_tpu.parallel import moe as M
 
 
@@ -72,10 +74,7 @@ def test_moe_grad_parity_no_drop(devices):
     new, state, loss = step(params, state, x, y)
 
     assert np.isclose(float(loss), float(ref_loss), rtol=1e-4)
-    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(new)),
-                    jax.tree_util.tree_leaves(ref_new)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-4, atol=2e-5)
+    tree_allclose(jax.device_get(new), ref_new)
 
 
 def test_moe_capacity_drops_pass_residual(devices):

@@ -5,6 +5,8 @@ import numpy as np
 import optax
 import pytest
 
+from testutil import tree_allclose
+
 from kungfu_tpu.models import gpt as G
 from kungfu_tpu.parallel import moe_gpt as MG
 
@@ -62,7 +64,6 @@ def test_parity_with_oracle_no_drop(devices, dp, ep):
 
     assert np.isclose(float(loss), ref_loss, rtol=1e-4), \
         (float(loss), ref_loss)
-    from testutil import tree_allclose
     tree_allclose(jax.device_get(params), ref_params)
 
 
