@@ -51,11 +51,15 @@ def main():
         nll = optax.softmax_cross_entropy_with_integer_labels(logits, tokens)
         return (nll * is_masked).sum() / jnp.maximum(is_masked.sum(), 1)
 
-    # SMA keeps replicas loosely coupled; the GNS monitor rides the same
-    # cross-replica psum'd gradients and exposes state.noise_scale
-    opt = kfopt.gradient_noise_scale(
-        kfopt.synchronous_averaging(optax.adam(1e-3), alpha=0.1),
-        batch_size=per_lane_batch)
+    # SMA keeps replicas loosely coupled (each applies its LOCAL gradient
+    # plus a pull toward the average); the GNS monitor psums gradients for
+    # its statistics only — apply="local" hands the un-averaged gradient
+    # through so the replicas genuinely diverge between sync points
+    opt = kfopt.synchronous_averaging(
+        kfopt.gradient_noise_scale(optax.adam(1e-3),
+                                   batch_size=per_lane_batch,
+                                   apply="local"),
+        alpha=0.1)
     sp = broadcast_variables(replicate(params, mesh), mesh)
     st = init_opt_state(opt, sp, mesh)
     step = build_train_step(loss_fn, opt, mesh, donate=False)

@@ -288,10 +288,14 @@ def _decode_attend(q, kc, vc, pos):
                       vc.astype(jnp.float32)).astype(q.dtype)
 
 
-def _decode_hidden(params, cfg: GPTConfig, cache, pos, token):
+def _decode_hidden(params, cfg: GPTConfig, cache, pos, token,
+                   tp_axis: Optional[str] = None):
     """One incremental step through the layer stack (no lm_head):
     ``(x_final [B, 1, D], new_cache)``.  Layer math is shared with the
-    training path via _layer_qkv/_layer_finish; only the attend differs."""
+    training path via _layer_qkv/_layer_finish; only the attend differs.
+    Under ``tp_axis`` the cache and q/k/v hold the local head shard and
+    the per-layer psums restore replicated activations — the same
+    Megatron sharding as training."""
     x = (params["wte"][token][:, None]
          + params["wpe"][pos][None, None]).astype(cfg.dtype)   # [B, 1, D]
     new_cache = []
@@ -301,7 +305,7 @@ def _decode_hidden(params, cfg: GPTConfig, cache, pos, token):
         vc = lax.dynamic_update_slice(kv["v"], v, (0, pos, 0, 0))
         new_cache.append({"k": kc, "v": vc})
         o = _decode_attend(q, kc, vc, pos)
-        x = _layer_finish(layer, x, o, cfg)
+        x = _layer_finish(layer, x, o, cfg, tp_axis)
     return rms_norm(x, params["lnf"]), new_cache
 
 
@@ -321,38 +325,53 @@ def decode_step(params, cfg: GPTConfig, cache, pos, token):
     return _head(params, x), cache
 
 
-def prefill(params, cfg: GPTConfig, cache, tokens):
+def prefill(params, cfg: GPTConfig, cache, tokens,
+            tp_axis: Optional[str] = None, head=None):
     """Fill the cache from a prompt [B, T] by running T incremental steps
-    in a scan; returns (last_logits [B, V], cache).  The vocab-sized
-    lm_head matmul runs ONCE, on the final hidden state — not inside the
-    scan."""
+    in a scan; returns (last_logits, cache).  The vocab-sized lm_head
+    matmul runs ONCE, on the final hidden state — not inside the scan.
+    ``head(x)`` overrides the logits head (e.g. the tp all-gathered one)."""
     T = tokens.shape[1]
+    head = head or (lambda x: _head(params, x))
 
     def body(carry, t):
         cache, _ = carry
-        x, cache = _decode_hidden(params, cfg, cache, t, tokens[:, t])
+        x, cache = _decode_hidden(params, cfg, cache, t, tokens[:, t],
+                                  tp_axis=tp_axis)
         return (cache, x), None
 
     z = jnp.zeros((tokens.shape[0], 1, cfg.d_model), cfg.dtype)
     (cache, x), _ = lax.scan(body, (cache, z), jnp.arange(T))
-    return _head(params, x), cache
+    return head(x), cache
 
 
 def generate(params, cfg: GPTConfig, prompt, n_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
-             max_len: Optional[int] = None):
+             max_len: Optional[int] = None, cache=None,
+             tp_axis: Optional[str] = None, head=None):
     """Autoregressive generation (greedy, or sampled when temperature>0).
 
     ``prompt``: [B, T] int32.  Returns [B, n_tokens] int32.  The whole
     loop is one jittable scan over a static-shape KV cache.
+
+    This is the ONLY decode loop — the tensor-parallel path
+    (parallel.threed.make_tp_generate) calls it with a sharded ``cache``,
+    ``tp_axis``, and an all-gathered ``head``, so sampling/cache changes
+    land in both paths.
     """
     B, T = prompt.shape
-    L = max_len or cfg.max_seq
+    if cache is None:
+        cache = init_kv_cache(cfg, B, max_len or cfg.max_seq)
+    L = cache[0]["k"].shape[1]
+    if L > cfg.max_seq:
+        raise ValueError(f"cache length {L} exceeds max_seq {cfg.max_seq} "
+                         f"(wpe has no embeddings past it)")
     if T + n_tokens > L:
         raise ValueError(f"prompt {T} + {n_tokens} new tokens exceeds "
                          f"cache length {L}")
-    cache = init_kv_cache(cfg, B, L)
-    logits, cache = prefill(params, cfg, cache, prompt)
+    head = head or (lambda x: _head(params, x))
+    logits, cache = prefill(params, cfg, cache, prompt, tp_axis=tp_axis,
+                            head=head)
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -365,8 +384,9 @@ def generate(params, cfg: GPTConfig, prompt, n_tokens: int,
         cache, logits, key = carry
         key, sub = jax.random.split(key)
         tok = pick(logits, sub).astype(jnp.int32)
-        logits, cache = decode_step(params, cfg, cache, T + i, tok)
-        return (cache, logits, key), tok
+        x, cache = _decode_hidden(params, cfg, cache, T + i, tok,
+                                  tp_axis=tp_axis)
+        return (cache, head(x), key), tok
 
     (_, _, _), toks = lax.scan(body, (cache, logits, rng),
                                jnp.arange(n_tokens))

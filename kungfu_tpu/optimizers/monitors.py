@@ -38,7 +38,8 @@ class NoiseScaleState(NamedTuple):
 def gradient_noise_scale(base: optax.GradientTransformation,
                          batch_size: int,
                          axis_name: str = PEER_AXIS,
-                         ema_decay: float = 0.95
+                         ema_decay: float = 0.95,
+                         apply: str = "mean"
                          ) -> optax.GradientTransformation:
     """MonitorGradientNoiseScaleOptimizer equivalent.
 
@@ -52,7 +53,15 @@ def gradient_noise_scale(base: optax.GradientTransformation,
 
     The running noise scale is exposed in the optimizer state
     (``state.noise_scale``) the way the reference exposes a TF variable.
+
+    ``apply`` selects what the wrapped optimizer consumes: ``"mean"`` (the
+    psum'd gradient — sync-SGD semantics, the reference's behaviour) or
+    ``"local"`` (this peer's own gradient — for model-averaging schemes
+    like SMA whose replicas must keep diverging while the monitor still
+    measures the cross-replica statistics).
     """
+    if apply not in ("mean", "local"):
+        raise ValueError(f"apply must be 'mean' or 'local', got {apply!r}")
 
     def init_fn(params):
         z = jnp.zeros((), jnp.float32)
@@ -79,7 +88,8 @@ def gradient_noise_scale(base: optax.GradientTransformation,
         ema_g2 = jnp.where(first, g2_est, d * state.ema_g2 + (1 - d) * g2_est)
         noise_scale = ema_s / jnp.where(jnp.abs(ema_g2) < 1e-30, 1e-30, ema_g2)
 
-        new_updates, base_state = base.update(g_mean, state.base, params)
+        fed = g_mean if apply == "mean" else updates
+        new_updates, base_state = base.update(fed, state.base, params)
         return new_updates, NoiseScaleState(base_state, ema_s, ema_g2,
                                             noise_scale, state.step + 1)
 

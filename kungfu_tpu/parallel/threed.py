@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -107,3 +108,51 @@ def init_gpt(cfg: G.GPTConfig, optimizer: optax.GradientTransformation,
                           cfg, mesh)
     opt_state = jax.jit(optimizer.init)(params)
     return params, opt_state
+
+
+def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
+                     temperature: float = 0.0,
+                     max_len: Optional[int] = None) -> Callable:
+    """Compile tensor-parallel generation: ``fn(params, prompt, rng) ->
+    [B, n_tokens]`` with heads and vocab sharded over the mesh's tp axis
+    (params exactly as trained by :func:`make_gpt_train_step`; use a
+    (1, 1, tp) mesh or re-shard).
+
+    The decode loop runs inside shard_map: the KV cache holds each rank's
+    head shard, per-layer psums restore activations, and sampling
+    all-gathers the vocab-sharded logits over tp only (a [B, V] f32 row —
+    tiny next to the cache).
+    """
+    specs = G.param_specs(cfg, TP_AXIS)
+    L = max_len or cfg.max_seq
+
+    def body(params, prompt, rng):
+        B = prompt.shape[0]
+        tp = lax.axis_size(TP_AXIS)
+        # pcast: the cache holds tp-varying head shards from step 1 on;
+        # align the zero-init carry's varying-state with that.  Length
+        # validation (incl. L <= max_seq) happens inside G.generate.
+        zero = lax.pcast(
+            jnp.zeros((B, L, cfg.n_heads // tp, cfg.head_dim), cfg.dtype),
+            (TP_AXIS,), to="varying")
+        cache = [{"k": zero, "v": zero} for _ in range(cfg.n_layers)]
+
+        def gathered_head(x):
+            # [B, V/tp] local -> [B, V] via tp all-gather (tiny); every
+            # rank then holds identical logits and the same rng stream,
+            # so all tp ranks sample the SAME token
+            local = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                               params["lm_head"])[:, 0]
+            return lax.all_gather(local, TP_AXIS, axis=1, tiled=True)
+
+        toks = G.generate(params, cfg, prompt, n_tokens,
+                          temperature=temperature, rng=rng, cache=cache,
+                          tp_axis=TP_AXIS, head=gathered_head)
+        # ranks computed identical tokens; the pmax is an identity that
+        # PROVES replication so out_specs P() type-checks
+        return lax.pmax(toks, TP_AXIS)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(specs, P(), P()),
+                       out_specs=P())
+    return jax.jit(sm)

@@ -68,6 +68,21 @@ def test_generate_rejects_overflow():
         G.generate(params, CFG, prompt, CFG.max_seq)
 
 
+def test_tp_generation_matches_single_device(devices):
+    """Tensor-parallel decode (sharded heads + vocab, all-gathered
+    sampling) must reproduce the single-device greedy generation
+    token-for-token."""
+    from kungfu_tpu.parallel import threed as T3
+    params, prompt = _setup(seed=4)
+    want = np.asarray(G.generate(params, CFG, prompt, 5))
+
+    mesh = T3.mesh_3d(1, 1, 4, devices)
+    sharded = T3.shard_params(params, CFG, mesh)
+    fn = T3.make_tp_generate(CFG, mesh, n_tokens=5)
+    got = np.asarray(fn(sharded, prompt, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_cache_rejects_len_beyond_max_seq():
     """max_len > max_seq would silently clamp into wpe's last row."""
     with pytest.raises(ValueError, match="max_seq"):

@@ -123,6 +123,23 @@ def test_noise_scale_monitor():
     assert np.all(np.isfinite(ns))
 
 
+def test_noise_scale_local_apply_keeps_replicas_diverging():
+    """apply="local" hands the un-averaged gradient to the base, so SMA
+    over a GNS monitor still lets replicas diverge (monitored SMA)."""
+    opt = kfopt.synchronous_averaging(
+        kfopt.gradient_noise_scale(optax.sgd(0.1), batch_size=32,
+                                   apply="local"),
+        alpha=0.1)
+    params, opt_state, losses, _ = run_steps(opt, steps=10)
+    w = np.asarray(params["w"])
+    assert not np.allclose(w[0], w[N - 1]), "replicas must diverge under SMA"
+    assert np.all(np.isfinite(np.asarray(opt_state.noise_scale)))
+    import pytest
+    with pytest.raises(ValueError, match="apply"):
+        kfopt.gradient_noise_scale(optax.sgd(0.1), batch_size=32,
+                                   apply="bogus")
+
+
 def test_gradient_variance_monitor():
     opt = kfopt.gradient_variance(optax.sgd(0.1))
     params, opt_state, losses, _ = run_steps(opt, steps=10)
