@@ -67,6 +67,9 @@ def log_detailed_result(value, error, attrs):
 
 def _sizes_for(args):
     from ..models.fake_model import MODEL_SIZES
+    if args.model not in _MODEL_KEYS:
+        raise SystemExit(f"error: unknown --model {args.model!r}; "
+                         f"choose from {', '.join(_MODEL_KEYS)}")
     sizes = list(MODEL_SIZES[_MODEL_KEYS[args.model]])
     if args.fuse:
         sizes = [sum(sizes)]
