@@ -45,7 +45,8 @@ _MODEL_KEYS = {
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="allreduce microbenchmark")
     p.add_argument("--model", default="ResNet50",
-                   help="ResNet50 | VGG16 | BERT | SLP")
+                   choices=list(_MODEL_KEYS),
+                   help="gradient-size fixture to benchmark")
     p.add_argument("--method", default="XLA", help="XLA | HIER | NATIVE")
     p.add_argument("--fuse", action="store_true", default=False)
     p.add_argument("--max-count", type=int, default=0, help="max grad count")
@@ -67,9 +68,6 @@ def log_detailed_result(value, error, attrs):
 
 def _sizes_for(args):
     from ..models.fake_model import MODEL_SIZES
-    if args.model not in _MODEL_KEYS:
-        raise SystemExit(f"error: unknown --model {args.model!r}; "
-                         f"choose from {', '.join(_MODEL_KEYS)}")
     sizes = list(MODEL_SIZES[_MODEL_KEYS[args.model]])
     if args.fuse:
         sizes = [sum(sizes)]

@@ -61,7 +61,8 @@ def make_gpt_train_step(cfg: G.GPTConfig,
                         optimizer: optax.GradientTransformation,
                         mesh: Mesh,
                         attn: str = "auto",
-                        donate: bool = True) -> Callable:
+                        donate: bool = True,
+                        remat: bool = False) -> Callable:
     """Compile ``step(params, opt_state, tokens, targets) -> (params,
     opt_state, loss)`` over a (dp, sp, tp) mesh.
 
@@ -71,6 +72,12 @@ def make_gpt_train_step(cfg: G.GPTConfig,
     """
     specs = G.param_specs(cfg, TP_AXIS)
     data_spec = P(DP_AXIS, SP_AXIS)
+    ntp = mesh.devices.shape[mesh.axis_names.index(TP_AXIS)]
+    for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
+                      ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
+        if val % ntp != 0:
+            raise ValueError(f"{what}={val} not divisible by {ntp} "
+                             f"tensor-parallel ranks")
 
     def grad_body(params, tokens, targets):
         # static global token count: local tokens x dp x sp
@@ -79,7 +86,8 @@ def make_gpt_train_step(cfg: G.GPTConfig,
 
         def local_loss(p):
             logits = G.forward_local(p, tokens, cfg, tp_axis=TP_AXIS,
-                                     sp_axis=SP_AXIS, attn=attn)
+                                     sp_axis=SP_AXIS, attn=attn,
+                                     remat=remat)
             nll = G.parallel_cross_entropy(logits, targets, tp_axis=TP_AXIS)
             return nll.sum() / total  # this shard's share of the global mean
 
@@ -125,6 +133,12 @@ def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
     """
     specs = G.param_specs(cfg, TP_AXIS)
     L = max_len or cfg.max_seq
+    ntp = mesh.devices.shape[mesh.axis_names.index(TP_AXIS)]
+    for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
+                      ("vocab_size", cfg.vocab_size)):
+        if val % ntp != 0:
+            raise ValueError(f"{what}={val} not divisible by {ntp} "
+                             f"tensor-parallel ranks")
 
     def body(params, prompt, rng):
         B = prompt.shape[0]
@@ -133,7 +147,7 @@ def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
         # align the zero-init carry's varying-state with that.  Length
         # validation (incl. L <= max_seq) happens inside G.generate.
         zero = lax.pcast(
-            jnp.zeros((B, L, cfg.n_heads // tp, cfg.head_dim), cfg.dtype),
+            jnp.zeros((B, L, cfg.kv_heads // tp, cfg.head_dim), cfg.dtype),
             (TP_AXIS,), to="varying")
         cache = [{"k": zero, "v": zero} for _ in range(cfg.n_layers)]
 

@@ -72,6 +72,24 @@ def test_parity_with_oracle(devices, dp, sp, tp, attn):
     _tree_allclose(jax.device_get(params), ref_params)
 
 
+def test_remat_matches_no_remat(devices):
+    """Per-layer rematerialization must not change loss or post-step
+    params — only the backward's memory/FLOP trade."""
+    opt = optax.sgd(0.1)
+    tokens, targets = _data(CFG)
+    mesh = T3.mesh_3d(2, 2, 2, devices)
+
+    results = []
+    for remat in (False, True):
+        params, state = T3.init_gpt(CFG, opt, mesh, seed=0)
+        step = T3.make_gpt_train_step(CFG, opt, mesh, attn="ring",
+                                      donate=False, remat=remat)
+        params, state, loss = step(params, state, tokens, targets)
+        results.append((float(loss), jax.device_get(params)))
+    assert np.isclose(results[0][0], results[1][0], rtol=1e-5)
+    _tree_allclose(results[0][1], results[1][1], rtol=1e-5, atol=1e-6)
+
+
 def test_loss_decreases_3d(devices):
     opt = optax.adam(1e-2)
     tokens, targets = _data(CFG, batch=8, seq=16, seed=1)
