@@ -50,6 +50,10 @@ class GPTConfig:
     # MHA).  Shrinks KV projections and, above all, the decode KV cache
     # by n_heads/n_kv_heads
     n_kv_heads: Optional[int] = None
+    # rotary position embeddings instead of the learned wpe table (no
+    # max_seq-bound position parameters; the LLaMA-style configuration
+    # together with bias-free blocks + GQA)
+    rope: bool = False
 
     def __post_init__(self):
         if self.d_model % self.n_heads != 0:
@@ -61,6 +65,9 @@ class GPTConfig:
         if self.n_heads % self.kv_heads != 0:
             raise ValueError(f"n_heads {self.n_heads} not divisible by "
                              f"n_kv_heads {self.kv_heads}")
+        if self.rope and self.head_dim % 2 != 0:
+            raise ValueError(f"RoPE needs an even head_dim, "
+                             f"got {self.head_dim}")
 
     @property
     def head_dim(self) -> int:
@@ -101,13 +108,15 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
             "wi": dense(next(k), (D, F), D),
             "wm": dense(next(k), (F, D), F),
         })
-    return {
+    out = {
         "wte": dense(next(k), (V, D), D),
-        "wpe": dense(next(k), (cfg.max_seq, D), D) * 0.1,
         "layers": layers,
         "lnf": jnp.ones((D,), jnp.float32),
         "lm_head": dense(next(k), (D, V), D),
     }
+    if not cfg.rope:
+        out["wpe"] = dense(next(k), (cfg.max_seq, D), D) * 0.1
+    return out
 
 
 def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
@@ -127,13 +136,24 @@ def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
             "wi": P(None, t),
             "wm": P(t, None),
         }
-    return {
+    out = {
         "wte": P(),
-        "wpe": P(),
         "layers": [layer_specs() for _ in range(cfg.n_layers)],
         "lnf": P(),
         "lm_head": P(None, t),
     }
+    if not cfg.rope:
+        out["wpe"] = P()
+    return out
+
+
+def embed(params, tokens, pos, cfg: GPTConfig):
+    """Token (+ learned position, unless RoPE) embedding.
+    ``tokens`` [...,]; ``pos`` broadcastable positions."""
+    x = params["wte"][tokens]
+    if not cfg.rope:
+        x = x + params["wpe"][pos]
+    return x.astype(cfg.dtype)
 
 
 def rms_norm(x, scale, eps=1e-5):
@@ -143,14 +163,37 @@ def rms_norm(x, scale, eps=1e-5):
     return (xf * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
 
 
-def _layer_qkv(layer, x, cfg: GPTConfig):
+def _rope_rotate(t, pos, cfg: GPTConfig):
+    """Rotary position embedding on [B, T, heads, Dh] with GLOBAL
+    positions ``pos`` [T] — under sequence parallelism each shard rotates
+    by its own global offsets, so ring/Ulysses attention needs no other
+    change."""
+    half = cfg.head_dim // 2
+    freqs = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    tf = t.astype(jnp.float32)
+    t1, t2 = tf[..., :half], tf[..., half:]
+    out = jnp.concatenate([t1 * cos - t2 * sin,
+                           t1 * sin + t2 * cos], axis=-1)
+    return out.astype(t.dtype)
+
+
+def _layer_qkv(layer, x, cfg: GPTConfig, pos=None):
     """ln1 + q/k/v projections — shared by the train and decode paths.
     Under GQA, k/v come out with ``kv_heads`` heads (the cache shape);
-    use :func:`_expand_kv` before a full-width attend."""
+    use :func:`_expand_kv` before a full-width attend.  With RoPE, q/k
+    are rotated here by the global positions ``pos``."""
     h = rms_norm(x, layer["ln1"])
     q = jnp.einsum("btd,dhk->bthk", h, layer["wq"].astype(cfg.dtype))
     kk = jnp.einsum("btd,dhk->bthk", h, layer["wk"].astype(cfg.dtype))
     v = jnp.einsum("btd,dhk->bthk", h, layer["wv"].astype(cfg.dtype))
+    if cfg.rope:
+        if pos is None:
+            raise ValueError("RoPE model needs positions in _layer_qkv")
+        q = _rope_rotate(q, pos, cfg)
+        kk = _rope_rotate(kk, pos, cfg)
     return q, kk, v
 
 
@@ -184,21 +227,30 @@ def _layer_finish(layer, x, o, cfg: GPTConfig,
     return x + m
 
 
-def _attend(q, kk, v, attn: str, sp_axis: Optional[str]):
+def _attend(q, kk, v, attn: str, sp_axis: Optional[str],
+            kv_groups: int = 1):
+    """``kk``/``v`` arrive COMPACT (kv_heads) under GQA: the sp paths
+    transport them compact and expand at local compute (kv_groups-times
+    less inter-chip KV traffic); local paths expand here."""
     if attn in ("ring", "ring_flash", "ulysses") and sp_axis is None:
         raise ValueError(f"attn={attn!r} needs a sequence-parallel axis")
     if attn == "ring":
-        return ring_attention(q, kk, v, sp_axis, causal=True)
+        return ring_attention(q, kk, v, sp_axis, causal=True,
+                              kv_groups=kv_groups)
     if attn == "ring_flash":
         from ..parallel.ring_attention import ring_flash_attention
-        return ring_flash_attention(q, kk, v, sp_axis, causal=True)
+        return ring_flash_attention(q, kk, v, sp_axis, causal=True,
+                                    kv_groups=kv_groups)
     if attn == "ulysses":
-        return ulysses_attention(q, kk, v, sp_axis, causal=True)
+        return ulysses_attention(q, kk, v, sp_axis, causal=True,
+                                 kv_groups=kv_groups)
+    expand = (lambda t: t) if kv_groups == 1 else (
+        lambda t: jnp.repeat(t, kv_groups, axis=2))
     if attn == "flash":
         from ..ops.flash_attention import flash_attention
-        return flash_attention(q, kk, v, causal=True)
+        return flash_attention(q, expand(kk), expand(v), causal=True)
     if attn == "dense":
-        return reference_attention(q, kk, v, causal=True)
+        return reference_attention(q, expand(kk), expand(v), causal=True)
     raise ValueError(f"unknown attention mode {attn!r}")
 
 
@@ -206,10 +258,19 @@ def apply_layer(layer, x, cfg: GPTConfig, *,
                 tp_axis: Optional[str] = None,
                 sp_axis: Optional[str] = None,
                 attn: str = "dense",
-                ffn: Optional[Any] = None):
-    """One transformer block on (local) activations ``x`` [B, T, D]."""
-    q, kk, v = _layer_qkv(layer, x, cfg)
-    o = _attend(q, _expand_kv(kk, cfg), _expand_kv(v, cfg), attn, sp_axis)
+                ffn: Optional[Any] = None,
+                pos=None):
+    """One transformer block on (local) activations ``x`` [B, T, D].
+    ``pos`` [T]: GLOBAL token positions — required whenever the sequence
+    is sharded (sp_axis) so RoPE rotates by global offsets; defaults to
+    arange only in the unsharded case."""
+    if pos is None:
+        if cfg.rope and sp_axis is not None:
+            raise ValueError("RoPE under sequence parallelism needs "
+                             "explicit global positions (pos)")
+        pos = jnp.arange(x.shape[1])
+    q, kk, v = _layer_qkv(layer, x, cfg, pos=pos)
+    o = _attend(q, kk, v, attn, sp_axis, kv_groups=cfg.kv_groups)
     return _layer_finish(layer, x, o, cfg, tp_axis, ffn=ffn)
 
 
@@ -247,10 +308,10 @@ def forward_local(params, tokens, cfg: GPTConfig, *,
     offset = lax.axis_index(sp_axis) * T if sp_axis else 0
     pos = offset + jnp.arange(T)
 
-    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+    x = embed(params, tokens, pos[None], cfg)
 
     layer_fn = functools.partial(apply_layer, cfg=cfg, tp_axis=tp_axis,
-                                 sp_axis=sp_axis, attn=attn)
+                                 sp_axis=sp_axis, attn=attn, pos=pos)
     if remat:
         # trade FLOPs for HBM: save only each block's input; recompute
         # activations in the backward (jax.checkpoint per layer).  With
@@ -307,9 +368,10 @@ def init_kv_cache(cfg: GPTConfig, batch: int, max_len: Optional[int] = None):
     dtype (GQA stores only the KV heads — the cache shrinks by
     kv_groups)."""
     L = max_len or cfg.max_seq
-    if L > cfg.max_seq:
+    if L > cfg.max_seq and not cfg.rope:
         raise ValueError(f"cache length {L} exceeds max_seq {cfg.max_seq} "
-                         f"(wpe has no embeddings past it)")
+                         f"(wpe has no embeddings past it; RoPE models "
+                         f"have no such bound)")
     shape = (batch, L, cfg.kv_heads, cfg.head_dim)
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
@@ -345,11 +407,11 @@ def _decode_hidden(params, cfg: GPTConfig, cache, pos, token,
     Under ``tp_axis`` the cache and q/k/v hold the local head shard and
     the per-layer psums restore replicated activations — the same
     Megatron sharding as training."""
-    x = (params["wte"][token][:, None]
-         + params["wpe"][pos][None, None]).astype(cfg.dtype)   # [B, 1, D]
+    x = embed(params, token[:, None], pos, cfg)               # [B, 1, D]
+    pos1 = jnp.reshape(pos, (1,))
     new_cache = []
     for layer, kv in zip(params["layers"], cache):
-        q, kk, v = _layer_qkv(layer, x, cfg)
+        q, kk, v = _layer_qkv(layer, x, cfg, pos=pos1)
         kc = lax.dynamic_update_slice(kv["k"], kk, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(kv["v"], v, (0, pos, 0, 0))
         new_cache.append({"k": kc, "v": vc})
@@ -412,9 +474,10 @@ def generate(params, cfg: GPTConfig, prompt, n_tokens: int,
     if cache is None:
         cache = init_kv_cache(cfg, B, max_len or cfg.max_seq)
     L = cache[0]["k"].shape[1]
-    if L > cfg.max_seq:
+    if L > cfg.max_seq and not cfg.rope:
         raise ValueError(f"cache length {L} exceeds max_seq {cfg.max_seq} "
-                         f"(wpe has no embeddings past it)")
+                         f"(wpe has no embeddings past it; RoPE models "
+                         f"have no such bound)")
     if T + n_tokens > L:
         raise ValueError(f"prompt {T} + {n_tokens} new tokens exceeds "
                          f"cache length {L}")

@@ -85,7 +85,7 @@ def forward_local(params, tokens, cfg: MoEGPTConfig,
     g = cfg.gpt
     T = tokens.shape[1]
     pos = jnp.arange(T)
-    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(g.dtype)
+    x = G.embed(params, tokens, pos[None], g)
 
     # both layer kinds run through gpt.apply_layer (same attention dispatch
     # and block structure); MoE layers just plug a different FFN in
@@ -99,7 +99,7 @@ def forward_local(params, tokens, cfg: MoEGPTConfig,
 
     for layer in params["layers"]:
         ffn = moe_ffn_cb if "moe" in layer else None
-        x = G.apply_layer(layer, x, g, attn=attn, ffn=ffn)
+        x = G.apply_layer(layer, x, g, attn=attn, ffn=ffn, pos=pos)
     x = G.rms_norm(x, params["lnf"])
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                         params["lm_head"])

@@ -48,13 +48,23 @@ def _block_attend(q, k, v, acc, m, l, bias):
     return acc_new, m_new, l_new
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def _expand_groups(t, groups: int):
+    """[B, T, Hkv, D] -> [B, T, Hkv*groups, D] (GQA head expansion)."""
+    return t if groups == 1 else jnp.repeat(t, groups, axis=2)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   kv_groups: int = 1):
     """Blockwise ring attention over sequence shards.
 
     Must run inside ``shard_map`` over ``axis_name``.  All of q, k, v are
     the local sequence shard ``[B, T_local, H, D]``; the global sequence is
     the concatenation over ranks in rank order.  Returns the local output
     shard ``[B, T_local, H, D]``.
+
+    ``kv_groups`` > 1 (GQA): k/v carry only ``H / kv_groups`` heads — the
+    COMPACT form rotates around the ring (kv_groups-times less inter-chip
+    traffic) and is expanded just-in-time for each local block compute.
     """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -78,8 +88,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
             bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, NEG_INF)
         else:
             bias = jnp.zeros((T, T), jnp.float32)
-        acc, m, l = _block_attend(qf, kc.astype(jnp.float32),
-                                  vc.astype(jnp.float32), acc, m, l, bias)
+        acc, m, l = _block_attend(
+            qf, _expand_groups(kc, kv_groups).astype(jnp.float32),
+            _expand_groups(vc, kv_groups).astype(jnp.float32),
+            acc, m, l, bias)
         # rotate KV around the ring (skippable on the last step, but a
         # static ppermute inside scan keeps the schedule uniform)
         kc = lax.ppermute(kc, axis_name, perm=perm)
@@ -93,7 +105,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
 
 def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
-                         block_q: int = 512, block_k: int = 512):
+                         block_q: int = 512, block_k: int = 512,
+                         kv_groups: int = 1):
     """Ring attention whose per-chunk compute is the Pallas flash kernel.
 
     Same semantics and layout as :func:`ring_attention` (inside shard_map,
@@ -116,7 +129,9 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
     from ..ops.flash_attention import flash_attention_with_lse
 
-    o0, lse0 = flash_attention_with_lse(q, k, v, causal, block_q, block_k)
+    o0, lse0 = flash_attention_with_lse(q, _expand_groups(k, kv_groups),
+                                        _expand_groups(v, kv_groups),
+                                        causal, block_q, block_k)
     acc = o0.astype(jnp.float32)
     lse_acc = lse0                       # [B, H, T_local] f32
 
@@ -124,8 +139,9 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
         acc, lse_acc, kc, vc = carry
         kc = lax.ppermute(kc, axis_name, perm=perm)
         vc = lax.ppermute(vc, axis_name, perm=perm)
-        oi, lsei = flash_attention_with_lse(q, kc, vc, False,
-                                            block_q, block_k)
+        oi, lsei = flash_attention_with_lse(
+            q, _expand_groups(kc, kv_groups), _expand_groups(vc, kv_groups),
+            False, block_q, block_k)
         if causal:
             # wrapped chunks (src rank > this rank) are future: weight 0
             lsei = jnp.where(rank >= s, lsei, NEG_INF)
@@ -142,16 +158,23 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     return acc.astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      kv_groups: int = 1):
     """All-to-all (Ulysses/DeepSpeed-style) sequence parallelism.
 
     Inside ``shard_map``: re-shard [B, T/n, H, D] → [B, T, H/n, D] with one
     ``all_to_all``, run dense local attention on full sequences for the
     local head group, then re-shard back.  Requires H % n == 0.
+
+    ``kv_groups`` > 1 (GQA): the compact k/v go through the all_to_all
+    (kv_groups-times less traffic; needs kv_heads % n == 0) and expand
+    after re-sharding.
     """
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"heads {q.shape[2]} not divisible by ring {n}")
+    if k.shape[2] % n != 0:
+        raise ValueError(f"kv heads {k.shape[2]} not divisible by ring {n}")
 
     def to_heads(x):   # [B, T/n, H, D] -> [B, T, H/n, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -161,7 +184,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    qh = to_heads(q)
+    kh = _expand_groups(to_heads(k), kv_groups)
+    vh = _expand_groups(to_heads(v), kv_groups)
     out = reference_attention(qh, kh, vh, causal=causal)
     return to_seq(out)
 

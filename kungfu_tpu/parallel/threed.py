@@ -135,7 +135,7 @@ def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
     L = max_len or cfg.max_seq
     ntp = mesh.devices.shape[mesh.axis_names.index(TP_AXIS)]
     for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
-                      ("vocab_size", cfg.vocab_size)):
+                      ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
         if val % ntp != 0:
             raise ValueError(f"{what}={val} not divisible by {ntp} "
                              f"tensor-parallel ranks")

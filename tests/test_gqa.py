@@ -66,6 +66,23 @@ def test_gqa_decode_matches_forward():
         seq = np.concatenate([seq, nxt[:, None].astype(np.int32)], axis=1)
 
 
+@pytest.mark.parametrize("attn", ["ring", "ring_flash", "ulysses"])
+def test_gqa_sp_parity(devices, attn):
+    """GQA under pure sequence parallelism: compact KV rides the ring /
+    all_to_all and expands at local compute — results must match the
+    expanded oracle exactly."""
+    cfg = _cfg(2)
+    tokens, targets = _data(cfg)
+    params = G.init_params(jax.random.PRNGKey(3), cfg)
+    ref = float(G.loss_fn(params, tokens, targets, cfg))
+    mesh = T3.mesh_3d(1, 2, 1, devices)
+    sp, st = T3.init_gpt(cfg, optax.sgd(0.1), mesh, seed=3)
+    step = T3.make_gpt_train_step(cfg, optax.sgd(0.1), mesh, attn=attn,
+                                  donate=False)
+    _, _, loss = step(sp, st, tokens, targets)
+    assert np.isclose(float(loss), ref, rtol=1e-4), (float(loss), ref)
+
+
 def test_gqa_3d_parity(devices):
     """GQA under dp x sp x tp (kv heads sharded over tp) vs oracle."""
     cfg = _cfg(2)
