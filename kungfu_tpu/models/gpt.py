@@ -54,6 +54,10 @@ class GPTConfig:
     # max_seq-bound position parameters; the LLaMA-style configuration
     # together with bias-free blocks + GQA)
     rope: bool = False
+    # FFN nonlinearity: "gelu" (GPT-2 style) or "swiglu" (LLaMA style;
+    # wi holds gate and up projections as [D, d_ff, 2] so tensor
+    # parallelism shards d_ff with gate/up pairs kept together)
+    mlp: str = "gelu"
 
     def __post_init__(self):
         if self.d_model % self.n_heads != 0:
@@ -68,6 +72,9 @@ class GPTConfig:
         if self.rope and self.head_dim % 2 != 0:
             raise ValueError(f"RoPE needs an even head_dim, "
                              f"got {self.head_dim}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp must be 'gelu' or 'swiglu', "
+                             f"got {self.mlp!r}")
 
     @property
     def head_dim(self) -> int:
@@ -105,7 +112,8 @@ def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
             "wv": dense(next(k), (D, Hkv, Dh), D),
             "wo": dense(next(k), (H, Dh, D), D),
             "ln2": jnp.ones((D,), jnp.float32),
-            "wi": dense(next(k), (D, F), D),
+            "wi": dense(next(k), (D, F, 2) if cfg.mlp == "swiglu"
+                        else (D, F), D),
             "wm": dense(next(k), (F, D), F),
         })
     out = {
@@ -133,7 +141,7 @@ def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
             "wv": P(None, t, None),
             "wo": P(t, None, None),
             "ln2": P(),
-            "wi": P(None, t),
+            "wi": P(None, t, None) if cfg.mlp == "swiglu" else P(None, t),
             "wm": P(t, None),
         }
     out = {
@@ -220,7 +228,11 @@ def _layer_finish(layer, x, o, cfg: GPTConfig,
     h = rms_norm(x, layer["ln2"])
     if ffn is not None:
         return x + ffn(layer, h)
-    u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
+    if cfg.mlp == "swiglu":
+        u = jnp.einsum("btd,dfo->btfo", h, layer["wi"].astype(cfg.dtype))
+        u = jax.nn.silu(u[..., 0]) * u[..., 1]
+    else:
+        u = jax.nn.gelu(h @ layer["wi"].astype(cfg.dtype))
     m = u @ layer["wm"].astype(cfg.dtype)
     if tp_axis:
         m = lax.psum(m, tp_axis)
