@@ -91,8 +91,9 @@ class GPTConfig:
 
 def init_params(rng: jax.Array, cfg: GPTConfig) -> Dict:
     """f32 parameter pytree.  Layout chosen so tensor-parallel sharding is
-    a plain leading/trailing-axis split: q/k/v ``[D, H, Dh]`` (shard H),
-    attention out ``[H, Dh, D]`` (shard H), MLP in ``[D, F]`` / out
+    a plain leading/trailing-axis split: q/k/v ``[D, H, Dh]`` (shard H;
+    kv_heads under GQA), attention out ``[H, Dh, D]`` (shard H), MLP in
+    ``[D, F]`` — or ``[D, F, 2]`` gate/up pairs under swiglu — / out
     ``[F, D]`` (shard F), LM head ``[D, V]`` (shard V)."""
     D, H, Dh, F, V = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
                       cfg.vocab_size)
