@@ -33,8 +33,11 @@ def main():
     devices = jax.devices()
     assert len(devices) >= 8, "run with an 8-device mesh (see module doc)"
 
+    # the LLaMA-style configuration: RoPE + grouped-query attention +
+    # SwiGLU, all composable with the 3D mesh
     cfg = GPTConfig(vocab_size=512, d_model=128, n_heads=8, n_layers=4,
-                    d_ff=512, max_seq=256,
+                    d_ff=512, max_seq=256, rope=True, n_kv_heads=4,
+                    mlp="swiglu",
                     dtype=jnp.bfloat16 if devices[0].platform == "tpu"
                     else jnp.float32)
     mesh = T3.mesh_3d(dp=2, sp=2, tp=2, devices=devices)
