@@ -14,6 +14,7 @@ disk checkpoints).
 """
 import os
 import sys
+import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -55,11 +56,10 @@ def main():
     ys = rng.randn(4096, 4).astype(np.float32)
     shard = ElasticDataShard(len(xs))
 
-    import tempfile
-    ckpt_dir = tempfile.mkdtemp(prefix="kft_ckpt_")
     per_lane_batch = 16
     half = schedule.total_steps() // 2
-    with Checkpointer(ckpt_dir) as ck:
+    with tempfile.TemporaryDirectory(prefix="kft_ckpt_") as ckpt_dir, \
+            Checkpointer(ckpt_dir) as ck:
         for step_i in range(half):
             want = schedule.size_at(step_i)
             if want != tr.n:

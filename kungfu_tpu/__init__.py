@@ -157,12 +157,72 @@ def detached() -> bool:
     return _es.is_detached()
 
 
+def uid() -> str:
+    """Globally-unique worker identity ``host:port:initVersion``
+    (reference: peer.go:121-125 UID, exposed via python/__init__.py uid)."""
+    we = _worker_env()
+    if we.singleton:
+        import jax
+        return f"localhost:0:{jax.process_index()}"
+    p = we.self_spec
+    return f"{p.host}:{p.port}:{we.cluster_version}"
+
+
+def propose_new_size(new_size: int) -> bool:
+    """Propose a new cluster size by PUTting a resized cluster to the
+    config server named in the KFT_* env ABI (reference: ProposeNewSize,
+    peer/legacy.go:18-38; op wrapper adapt.py).  Returns True on success;
+    workers then pick the change up via elastic resize-from-URL polling."""
+    we = _worker_env()
+    url = we.config_server
+    if not url:
+        raise RuntimeError("propose_new_size: no KFT_CONFIG_SERVER set")
+    import urllib.error
+
+    from .elastic import config_server as _cs
+    try:
+        version, cluster = _cs.fetch_config(url)
+        # CAS on the fetched version: a concurrent proposal (409) loses
+        # cleanly instead of silently overwriting the winner's layout
+        _cs.put_config(url, cluster.resize(int(new_size)),
+                       if_version=version)
+        return True
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return False
+
+
+def check_interference(threshold: float = 0.8) -> bool:
+    """Interference check: any monitored collective's throughput below
+    ``threshold`` x its reference rate (reference: python/__init__.py
+    check_interference, session/adaptiveStrategies.go:61-121).  In the
+    single-controller lane model the controller's view already IS the
+    cluster view, so the reference's cross-peer majority vote reduces to
+    this local threshold test."""
+    return _ensure_session().check_interference(threshold)
+
+
+def calc_stats():
+    """Per-strategy throughput snapshot (reference: calc_stats)."""
+    return _ensure_session().calc_stats()
+
+
+def log_stats() -> str:
+    return _ensure_session().log_stats()
+
+
+def print_stats() -> None:
+    """Print per-strategy throughput stats (reference: print_stats)."""
+    print(log_stats())
+
+
 __all__ = [
     "Session", "Cluster", "HostList", "PeerID", "PeerList", "Strategy",
     "comm", "plan", "init", "init_distributed", "current_session",
     "current_rank",
     "current_cluster_size", "current_local_rank", "current_local_size",
-    "run_barrier", "detached", "broadcast_variables", "build_train_step",
+    "run_barrier", "detached", "uid", "propose_new_size",
+    "check_interference", "calc_stats", "log_stats", "print_stats",
+    "broadcast_variables", "build_train_step",
     "build_train_step_with_state", "init_opt_state", "lane", "lane_mean",
     "replicate",
 ]

@@ -207,6 +207,14 @@ class Session:
         out.block_until_ready()
         return out
 
+    def all_gather_transform(self, x, transform, name: str = ""):
+        """All-gather then apply ``transform(stacked)`` on the host
+        (reference: kungfu::Peer::AllGatherTransform template helper,
+        peer.hpp:13-162) — e.g. latency vectors → MST edges.  In the lane
+        model the peer-stacked input [n, ...] already IS the gathered
+        value every lane would see, so no collective is needed."""
+        return transform(np.asarray(x))
+
     def gather(self, x, root: int = 0, name: str = "") -> jax.Array:
         """Gather shards to ``root`` lane; others zero-filled
         (reference: session.go:185-207)."""

@@ -77,7 +77,13 @@ def _make_handler(state: _State, server_ref):
             except (ValueError, KeyError, json.JSONDecodeError) as e:
                 self._send(400, json.dumps({"error": str(e)}).encode())
                 return
+            expect = self.headers.get("If-Match")
             with state.lock:
+                if expect is not None and int(expect) != state.version:
+                    self._send(409, json.dumps(
+                        {"error": "version conflict",
+                         "version": state.version}).encode())
+                    return
                 state.version += 1
                 state.cluster = c
                 state.history.append({"version": state.version,
@@ -141,10 +147,15 @@ def fetch_config(url: str, timeout: float = 5.0) -> Tuple[int, Cluster]:
     return d["version"], Cluster.from_json(json.dumps(d["cluster"]))
 
 
-def put_config(url: str, cluster: Cluster, timeout: float = 5.0) -> int:
+def put_config(url: str, cluster: Cluster, timeout: float = 5.0,
+               if_version: Optional[int] = None) -> int:
+    """PUT a cluster; ``if_version`` makes it a compare-and-swap — the
+    server rejects with 409 when its version moved since that fetch."""
     import urllib.request
     req = urllib.request.Request(url, data=cluster.to_json().encode(),
                                  method="PUT")
+    if if_version is not None:
+        req.add_header("If-Match", str(if_version))
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read().decode())["version"]
 

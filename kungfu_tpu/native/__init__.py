@@ -240,6 +240,13 @@ class NativePeer:
             name.encode()), "all_gather")
         return out
 
+    def all_gather_transform(self, x: np.ndarray, transform,
+                             name: str = "allgather"):
+        """All-gather then apply ``transform(stacked)`` — the multi-process
+        form of the reference's AllGatherTransform helper (peer.hpp:13-162,
+        e.g. latency vectors -> MST tree)."""
+        return transform(self.all_gather(x, name=name))
+
     def consensus(self, payload: bytes, name: str = "consensus") -> bool:
         """True iff every peer passed bit-identical bytes
         (reference: BytesConsensus, session.go:111-151)."""

@@ -1,0 +1,102 @@
+"""Top-level worker API: uid, propose_new_size, stats, all_gather_transform.
+
+Mirrors the reference's kungfu.python surface
+(srcs/python/kungfu/python/__init__.py:36-103) and the public-API
+integration test (tests/go/cmd/kungfu-test-public-apis).
+"""
+import numpy as np
+
+import kungfu_tpu as kf
+from kungfu_tpu.comm.mesh import flat_mesh
+from kungfu_tpu.comm.session import Session
+from kungfu_tpu.elastic import ConfigServer
+from kungfu_tpu.launcher import env as E
+from kungfu_tpu.plan import Cluster, HostList, PeerID, PeerList
+
+
+def make_peers(n):
+    return PeerList([PeerID("127.0.0.1", 10000 + i, i) for i in range(n)])
+
+
+def test_uid_singleton(monkeypatch):
+    monkeypatch.delenv(E.SELF_SPEC, raising=False)
+    assert kf.uid() == "localhost:0:0"
+
+
+def test_uid_worker(monkeypatch):
+    monkeypatch.setenv(E.SELF_SPEC, "10.0.0.1:9100:0")
+    monkeypatch.setenv(E.INIT_PEERS, "10.0.0.1:9100:0,10.0.0.1:9101:1")
+    monkeypatch.setenv(E.CLUSTER_VERSION, "7")
+    assert kf.uid() == "10.0.0.1:9100:7"
+
+
+def test_propose_new_size_roundtrip(monkeypatch):
+    hl = HostList.parse("127.0.0.1:4")
+    cluster = Cluster(runners=hl.gen_runner_list(30100),
+                      workers=hl.gen_peer_list(2, 10000))
+    srv = ConfigServer().start()
+    try:
+        srv.put_cluster(cluster)
+        monkeypatch.setenv(E.CONFIG_SERVER, srv.url)
+        assert kf.propose_new_size(3)
+        _, got = srv.get_cluster()
+        assert len(got.workers) == 3
+    finally:
+        srv.stop()
+
+
+def test_put_config_cas_conflict():
+    import urllib.error
+
+    from kungfu_tpu.elastic import fetch_config, put_config
+
+    hl = HostList.parse("127.0.0.1:4")
+    cluster = Cluster(runners=hl.gen_runner_list(30100),
+                      workers=hl.gen_peer_list(2, 10000))
+    srv = ConfigServer().start()
+    try:
+        srv.put_cluster(cluster)
+        v, got = fetch_config(srv.url)
+        put_config(srv.url, got.resize(3))  # moves version past v
+        try:
+            put_config(srv.url, got.resize(4), if_version=v)
+            assert False, "expected 409 on stale If-Match version"
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        _, cur = srv.get_cluster()
+        assert len(cur.workers) == 3  # loser did not overwrite the winner
+    finally:
+        srv.stop()
+
+
+def test_propose_new_size_no_server(monkeypatch):
+    monkeypatch.delenv(E.CONFIG_SERVER, raising=False)
+    try:
+        kf.propose_new_size(2)
+        assert False, "expected RuntimeError"
+    except RuntimeError:
+        pass
+
+
+def test_stats_and_interference_api():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    old = kf._default_session
+    kf.init(sess)
+    try:
+        x = np.ones((n, 256), dtype=np.float32)
+        sess.all_reduce(x, name="g")
+        assert kf.calc_stats()["g"] > 0
+        assert "GiB/s" in kf.log_stats()
+        assert kf.check_interference() is False
+        kf.print_stats()
+    finally:
+        kf._default_session = old
+
+
+def test_all_gather_transform():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    total = sess.all_gather_transform(x, lambda stacked: stacked.sum())
+    assert total == float(np.arange(n).sum())
