@@ -78,8 +78,15 @@ def _make_handler(state: _State, server_ref):
                 self._send(400, json.dumps({"error": str(e)}).encode())
                 return
             expect = self.headers.get("If-Match")
+            if expect is not None:
+                try:
+                    expect = int(expect.strip().strip('"'))
+                except ValueError:
+                    self._send(400, json.dumps(
+                        {"error": f"bad If-Match: {expect!r}"}).encode())
+                    return
             with state.lock:
-                if expect is not None and int(expect) != state.version:
+                if expect is not None and expect != state.version:
                     self._send(409, json.dumps(
                         {"error": "version conflict",
                          "version": state.version}).encode())

@@ -34,6 +34,11 @@ _DTYPES = {
 OPS = {"SUM": 0, "MIN": 1, "MAX": 2, "PROD": 3}
 STRATEGIES = {"STAR": 0, "RING": 1, "BINARY_TREE": 2, "CLIQUE": 3, "AUTO": 4}
 
+# Host-structured strategies (reference: topology.go local-master graphs) are
+# lowered in Python to reduce forests and run via kft_all_reduce_tree.
+_HOST_STRUCTURED = ("TREE", "MULTI_STAR", "BINARY_TREE_STAR",
+                    "MULTI_BINARY_TREE_STAR")
+
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -134,6 +139,8 @@ class NativePeer:
             raise NativeError(
                 f"peer init failed: {lib.kft_last_error().decode()}")
         self._started = False
+        self._peers = list(peers)
+        self._forest_cache = {}
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "NativePeer":
@@ -182,12 +189,51 @@ class NativePeer:
     def barrier(self, name: str = "barrier") -> None:
         _check(self._lib.kft_barrier(self._h, name.encode()), "barrier")
 
+    def _strategy_forests(self, strategy: str):
+        """Lower a host-structured strategy to reduce-forest father arrays
+        over this cluster's peer list (reference: the local-master graphs of
+        topology.go:17-31,55-115, run here through kft_all_reduce_tree)."""
+        if strategy not in self._forest_cache:
+            from ..plan import PeerID, PeerList
+            from ..plan import topology as T
+            ids = []
+            for i, s in enumerate(self._peers):
+                host, port = s.rsplit(":", 1)
+                ids.append(PeerID(host, int(port), i))
+            pairs = T.generate(T.Strategy.parse(strategy), PeerList(ids))
+            self._forest_cache[strategy] = [
+                p.reduce_graph.to_forest_array() for p in pairs]
+        return self._forest_cache[strategy]
+
     def all_reduce(self, x: np.ndarray, op: str = "SUM",
                    strategy: str = "AUTO", name: str = "allreduce"
                    ) -> np.ndarray:
         x = np.ascontiguousarray(x)
         if x.dtype not in _DTYPES:
             raise TypeError(f"unsupported dtype {x.dtype}")
+        if strategy in _HOST_STRUCTURED:
+            forests = self._strategy_forests(strategy)
+            if len(forests) == 1:
+                return self.all_reduce_tree(x, forests[0], op=op, name=name)
+            # stripe contiguous chunks across the forests, concurrently —
+            # ctypes drops the GIL during the blocking native call, so the
+            # stripes overlap like the reference's per-chunk goroutines
+            # (session.go:288-317 chunked multi-strategy striping)
+            from concurrent.futures import ThreadPoolExecutor
+            flat = x.reshape(-1)
+            out = np.empty_like(flat)
+            k = len(forests)
+            bounds = [flat.size * i // k for i in range(k + 1)]
+
+            def run(i):
+                lo, hi = bounds[i], bounds[i + 1]
+                if lo < hi:
+                    out[lo:hi] = self.all_reduce_tree(
+                        flat[lo:hi], forests[i], op=op, name=f"{name}|s{i}")
+            with ThreadPoolExecutor(max_workers=k) as ex:
+                for f in [ex.submit(run, i) for i in range(k)]:
+                    f.result()
+            return out.reshape(x.shape)
         out = np.empty_like(x)
         _check(self._lib.kft_all_reduce(
             self._h, x.ctypes.data, out.ctypes.data, x.size,

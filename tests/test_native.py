@@ -226,8 +226,10 @@ def _w_mst(rank, peers, q):
 
 # ------------------------------------------------------------------- tests
 
-@pytest.mark.parametrize("strategy", ["STAR", "RING", "BINARY_TREE",
-                                      "CLIQUE", "AUTO"])
+@pytest.mark.parametrize("strategy", ["STAR", "MULTI_STAR", "RING", "CLIQUE",
+                                      "TREE", "BINARY_TREE",
+                                      "BINARY_TREE_STAR",
+                                      "MULTI_BINARY_TREE_STAR", "AUTO"])
 @pytest.mark.parametrize("n", [1, 2, 4])
 def test_allreduce_strategies(strategy, n):
     if n == 1 and strategy != "AUTO":
