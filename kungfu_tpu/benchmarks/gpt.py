@@ -1,0 +1,132 @@
+"""GPT causal-LM training-throughput benchmark (tokens/sec/chip).
+
+The LLM-era companion to the allreduce microbench: measures end-to-end
+training step time of the GPT family (models/gpt.py) through the same
+distributed train-step path users run — synchronous-SGD wrapper over a
+mesh, flash attention on TPU — and reports tokens/sec plus model FLOPs
+utilisation (6*N*T FLOPs/token approximation).
+
+The reference has no LLM benchmark (its fixtures stop at BERT gradient
+*sizes*, srcs/python/kungfu/tensorflow/v1/benchmarks/model_sizes.py); this
+extends the harness to the model family the TPU framework treats as its
+flagship.
+
+Usage:
+    python -m kungfu_tpu.benchmarks.gpt                    # gpt-small-ish
+    python -m kungfu_tpu.benchmarks.gpt --d-model 1024 --n-layers 24 \
+        --seq 2048 --batch 8 --rope --swiglu
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GPT training throughput")
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--n-layers", type=int, default=12)
+    p.add_argument("--n-heads", type=int, default=12)
+    p.add_argument("--n-kv-heads", type=int, default=0,
+                   help="GQA KV heads (0 = MHA)")
+    p.add_argument("--d-ff", type=int, default=3072)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup-steps", type=int, default=3)
+    p.add_argument("--rope", action="store_true")
+    p.add_argument("--swiglu", action="store_true")
+    p.add_argument("--remat", action="store_true",
+                   help="per-layer rematerialization")
+    p.add_argument("--attn", default="auto",
+                   help="auto | flash | dense")
+    p.add_argument("--f32", action="store_true",
+                   help="float32 instead of bfloat16")
+    return p.parse_args(argv)
+
+
+def param_count(params) -> int:
+    import jax
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kungfu_tpu.utils.platform import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import kungfu_tpu.optimizers as kfopt
+    from kungfu_tpu.comm.mesh import flat_mesh
+    from kungfu_tpu.models.gpt import GPTConfig, forward_local, init_params
+    from kungfu_tpu.training import (build_train_step, init_opt_state,
+                                     replicate)
+
+    cfg = GPTConfig(vocab_size=args.vocab, d_model=args.d_model,
+                    n_heads=args.n_heads, n_layers=args.n_layers,
+                    d_ff=args.d_ff, max_seq=args.seq,
+                    dtype=jnp.float32 if args.f32 else jnp.bfloat16,
+                    n_kv_heads=args.n_kv_heads or None,
+                    rope=args.rope,
+                    mlp="swiglu" if args.swiglu else "gelu")
+
+    mesh = flat_mesh(n=1)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_params = param_count(params)
+
+    def loss_fn(p, batch):
+        bt, by = batch
+        logits = forward_local(p, bt, cfg, attn=args.attn, remat=args.remat)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, by).mean()
+
+    opt = kfopt.synchronous_sgd(optax.adamw(3e-4))
+    sp = replicate(params, mesh)
+    st = init_opt_state(opt, sp, mesh)
+    step = build_train_step(loss_fn, opt, mesh, donate=False)
+
+    for _ in range(args.warmup_steps):
+        sp, st, loss = step(sp, st, (toks, tgts))
+    if args.warmup_steps:
+        float(np.asarray(loss)[0])  # host fetch = reliable sync (see bench.py)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        sp, st, loss = step(sp, st, (toks, tgts))
+    final_loss = float(np.asarray(loss)[0])
+    dt = time.perf_counter() - t0
+
+    tokens = args.batch * args.seq * args.steps
+    tok_per_sec = tokens / dt
+    # 6ND fwd+bwd FLOPs/token + attention term 12*L*D*T (causal halved)
+    flops_per_tok = 6 * n_params + 6 * cfg.n_layers * cfg.d_model * args.seq
+    tflops = tok_per_sec * flops_per_tok / 1e12
+    out = {
+        "metric": "gpt_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "params": n_params,
+        "model_tflops_per_sec": round(tflops, 2),
+        "loss": round(final_loss, 4),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -141,6 +141,7 @@ class NativePeer:
         self._started = False
         self._peers = list(peers)
         self._forest_cache = {}
+        self._pool = None
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "NativePeer":
@@ -158,6 +159,9 @@ class NativePeer:
 
     def close(self) -> None:
         self.stop()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         if self._h:
             self._lib.kft_peer_free(self._h)
             self._h = None
@@ -189,6 +193,16 @@ class NativePeer:
     def barrier(self, name: str = "barrier") -> None:
         _check(self._lib.kft_barrier(self._h, name.encode()), "barrier")
 
+    def _stripe_pool(self):
+        """Shared executor for concurrent chunk stripes (capped; created
+        once per peer rather than per call)."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(16, max(2, self.size)),
+                thread_name_prefix="kft-stripe")
+        return self._pool
+
     def _strategy_forests(self, strategy: str):
         """Lower a host-structured strategy to reduce-forest father arrays
         over this cluster's peer list (reference: the local-master graphs of
@@ -219,7 +233,6 @@ class NativePeer:
             # ctypes drops the GIL during the blocking native call, so the
             # stripes overlap like the reference's per-chunk goroutines
             # (session.go:288-317 chunked multi-strategy striping)
-            from concurrent.futures import ThreadPoolExecutor
             flat = x.reshape(-1)
             out = np.empty_like(flat)
             k = len(forests)
@@ -230,9 +243,8 @@ class NativePeer:
                 if lo < hi:
                     out[lo:hi] = self.all_reduce_tree(
                         flat[lo:hi], forests[i], op=op, name=f"{name}|s{i}")
-            with ThreadPoolExecutor(max_workers=k) as ex:
-                for f in [ex.submit(run, i) for i in range(k)]:
-                    f.result()
+            for f in [self._stripe_pool().submit(run, i) for i in range(k)]:
+                f.result()
             return out.reshape(x.shape)
         out = np.empty_like(x)
         _check(self._lib.kft_all_reduce(

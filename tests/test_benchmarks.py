@@ -54,3 +54,20 @@ def test_native_bench_via_launcher():
     out = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr
     assert "RESULT: " in out.stdout
+
+
+def test_gpt_bench_emits_json(capsys):
+    import json
+
+    from kungfu_tpu.benchmarks.gpt import main as gpt_main
+
+    rc = gpt_main(["--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+                   "--d-ff", "64", "--vocab", "128", "--seq", "32",
+                   "--batch", "2", "--steps", "2", "--warmup-steps", "1",
+                   "--rope", "--swiglu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(out)
+    assert d["metric"] == "gpt_tokens_per_sec_per_chip"
+    assert d["value"] > 0
+    assert d["params"] > 0
