@@ -47,6 +47,18 @@ def fsdp_all_gather_params(param_shard, axis_name: str):
     return lax.all_gather(param_shard, axis_name, axis=0, tiled=True)
 
 
+def _state_specs(optimizer, local_size: int, dtype, axis: str):
+    """Per-leaf optimizer-state specs: leaves mirroring the local param
+    shard are sharded over ``axis``; scalar bookkeeping (Adam's count, …)
+    is replicated."""
+    shapes = jax.eval_shape(optimizer.init,
+                            jax.ShapeDtypeStruct((local_size,), dtype))
+    return jax.tree_util.tree_map(
+        lambda s: P(axis) if (getattr(s, "ndim", 0) == 1 and
+                              s.shape[0] == local_size) else P(),
+        shapes)
+
+
 def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
                    axis: str = FSDP_AXIS
                    ) -> Tuple[Callable, Callable]:
@@ -62,25 +74,14 @@ def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
 
     The batch must be sharded over the same axis (leading dim).
     """
-    n = int(np.prod([mesh.shape[a] for a in (axis,)]))
-
-    def _state_specs(local_size: int, dtype):
-        """Per-leaf specs: leaves mirroring the local param shard are
-        sharded over ``axis``; scalar bookkeeping (Adam's count, …) is
-        replicated."""
-        shapes = jax.eval_shape(optimizer.init,
-                                jax.ShapeDtypeStruct((local_size,), dtype))
-        return jax.tree_util.tree_map(
-            lambda s: P(axis) if (getattr(s, "ndim", 0) == 1 and
-                                  s.shape[0] == local_size) else P(),
-            shapes)
+    n = int(mesh.shape[axis])
 
     def init(params):
         flat, unravel = ravel_pytree(params)
         size = flat.shape[0]
         flat = _pad_to(flat, n)
         local = flat.shape[0] // n
-        specs = _state_specs(local, flat.dtype)
+        specs = _state_specs(optimizer, local, flat.dtype, axis)
         sharding = shard_pytree_spec(mesh, axis)
         flat = jax.device_put(flat, sharding)
 
@@ -107,6 +108,71 @@ def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
             body, mesh=mesh,
             in_specs=(P(axis), specs, P(axis)),
             out_specs=(P(axis), specs, P()))
+        return jax.jit(fn)
+
+    return init, make_step
+
+
+def make_zero1_step(loss_fn: Callable, optimizer, mesh: Mesh,
+                    axis: str = FSDP_AXIS
+                    ) -> Tuple[Callable, Callable]:
+    """ZeRO-1: replicated parameters, sharded optimizer state.
+
+    The middle point between plain sync SGD (everything replicated) and
+    ZeRO-3 (`make_fsdp_step`, everything sharded): each device computes
+    gradients on its batch shard, reduce-scatters the flat gradient to its
+    1/n chunk, runs the optimizer only on that chunk (so Adam's m/v cost
+    1/n of the memory), and all-gathers the resulting parameter updates.
+    The training trajectory is identical to replicated sync SGD with the
+    same base optimizer.
+
+    Usage matches ``make_fsdp_step``::
+
+        init, make_step = make_zero1_step(loss_fn, opt, mesh)
+        flat_params, opt_state, meta = init(params)
+        step = make_step(meta)
+        flat_params, opt_state, loss = step(flat_params, opt_state, batch)
+    """
+    n = int(mesh.shape[axis])
+
+    def init(params):
+        flat, unravel = ravel_pytree(params)
+        size = flat.shape[0]
+        flat = _pad_to(flat, n)
+        local = flat.shape[0] // n
+        specs = _state_specs(optimizer, local, flat.dtype, axis)
+
+        # init from the REAL param shard (optimizers like prodigy capture
+        # initial parameter values in their state)
+        opt_state = jax.jit(jax.shard_map(
+            optimizer.init, mesh=mesh, in_specs=P(axis),
+            out_specs=specs))(jax.device_put(
+                flat, shard_pytree_spec(mesh, axis)))
+        flat = jax.device_put(flat, NamedSharding(mesh, P()))
+        return flat, opt_state, (unravel, size, specs, local)
+
+    def make_step(meta):
+        unravel, size, specs, local = meta
+
+        def body(flat_params, opt_state, batch):
+            params = unravel(flat_params[:size])
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            gflat = _pad_to(ravel_pytree(grads)[0], n)
+            gshard = fsdp_grad_sync(gflat, axis)
+            lo = lax.axis_index(axis) * local
+            pshard = lax.dynamic_slice(flat_params, (lo,), (local,))
+            updates, new_opt = optimizer.update(gshard, opt_state, pshard)
+            full_updates = lax.all_gather(updates, axis, axis=0, tiled=True)
+            return flat_params + full_updates, new_opt, lax.pmean(loss, axis)
+
+        # check_vma=False: the all_gathered updates are bit-identical on
+        # every device, but the static varying-ness analysis cannot infer
+        # that, so the replicated P() out_spec needs the check disabled
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), specs, P(axis)),
+            out_specs=(P(), specs, P()),
+            check_vma=False)
         return jax.jit(fn)
 
     return init, make_step
