@@ -12,12 +12,10 @@ Session/mesh substrate, one more way to lay out the state.
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -59,6 +57,25 @@ def _state_specs(optimizer, local_size: int, dtype, axis: str):
         shapes)
 
 
+def _flat_init(params, optimizer, mesh: Mesh, axis: str):
+    """Shared ZeRO init: ravel params, pad to the axis size, infer state
+    specs, and build the axis-sharded optimizer state from the REAL
+    parameter shard (optimizers like prodigy capture initial parameter
+    values in their state).  Returns (flat_padded, opt_state, unravel,
+    size, local, specs); the caller picks the flat vector's placement."""
+    n = int(mesh.shape[axis])
+    flat, unravel = ravel_pytree(params)
+    size = flat.shape[0]
+    flat = _pad_to(flat, n)
+    local = flat.shape[0] // n
+    specs = _state_specs(optimizer, local, flat.dtype, axis)
+    sharded = jax.device_put(flat, shard_pytree_spec(mesh, axis))
+    opt_state = jax.jit(jax.shard_map(
+        optimizer.init, mesh=mesh, in_specs=P(axis),
+        out_specs=specs))(sharded)
+    return sharded, opt_state, unravel, size, local, specs
+
+
 def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
                    axis: str = FSDP_AXIS
                    ) -> Tuple[Callable, Callable]:
@@ -77,17 +94,8 @@ def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
     n = int(mesh.shape[axis])
 
     def init(params):
-        flat, unravel = ravel_pytree(params)
-        size = flat.shape[0]
-        flat = _pad_to(flat, n)
-        local = flat.shape[0] // n
-        specs = _state_specs(optimizer, local, flat.dtype, axis)
-        sharding = shard_pytree_spec(mesh, axis)
-        flat = jax.device_put(flat, sharding)
-
-        opt_state = jax.jit(jax.shard_map(
-            optimizer.init, mesh=mesh, in_specs=P(axis),
-            out_specs=specs))(flat)
+        flat, opt_state, unravel, size, _, specs = _flat_init(
+            params, optimizer, mesh, axis)
         return flat, opt_state, (unravel, size, specs)
 
     def make_step(meta):
@@ -123,8 +131,11 @@ def make_zero1_step(loss_fn: Callable, optimizer, mesh: Mesh,
     gradients on its batch shard, reduce-scatters the flat gradient to its
     1/n chunk, runs the optimizer only on that chunk (so Adam's m/v cost
     1/n of the memory), and all-gathers the resulting parameter updates.
-    The training trajectory is identical to replicated sync SGD with the
-    same base optimizer.
+    For *elementwise* base optimizers (sgd, momentum, adam, adamw, …) the
+    trajectory is identical to replicated sync SGD; transforms that reduce
+    across the whole gradient (e.g. ``clip_by_global_norm``) would see
+    only their 1/n chunk — as in ``make_fsdp_step`` — and are not
+    trajectory-equivalent here.
 
     Usage matches ``make_fsdp_step``::
 
@@ -136,18 +147,8 @@ def make_zero1_step(loss_fn: Callable, optimizer, mesh: Mesh,
     n = int(mesh.shape[axis])
 
     def init(params):
-        flat, unravel = ravel_pytree(params)
-        size = flat.shape[0]
-        flat = _pad_to(flat, n)
-        local = flat.shape[0] // n
-        specs = _state_specs(optimizer, local, flat.dtype, axis)
-
-        # init from the REAL param shard (optimizers like prodigy capture
-        # initial parameter values in their state)
-        opt_state = jax.jit(jax.shard_map(
-            optimizer.init, mesh=mesh, in_specs=P(axis),
-            out_specs=specs))(jax.device_put(
-                flat, shard_pytree_spec(mesh, axis)))
+        flat, opt_state, unravel, size, local, specs = _flat_init(
+            params, optimizer, mesh, axis)
         flat = jax.device_put(flat, NamedSharding(mesh, P()))
         return flat, opt_state, (unravel, size, specs, local)
 
