@@ -46,6 +46,11 @@ def parse_args(argv=None):
                    help="auto | flash | dense")
     p.add_argument("--f32", action="store_true",
                    help="float32 instead of bfloat16")
+    p.add_argument("--decode", action="store_true",
+                   help="measure KV-cache autoregressive generation "
+                        "instead of training")
+    p.add_argument("--prompt-len", type=int, default=128,
+                   help="decode mode: prompt length to prefill")
     return p.parse_args(argv)
 
 
@@ -79,14 +84,21 @@ def main(argv=None) -> int:
                     rope=args.rope,
                     mlp="swiglu" if args.swiglu else "gelu")
 
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_params = param_count(params)
+
+    if args.decode:
+        if args.attn != "auto" or args.remat:
+            raise SystemExit("--attn/--remat apply to training only; the "
+                             "decode loop always runs dense per-token "
+                             "attention over the KV cache")
+        return _decode_bench(args, cfg, params, n_params)
+
     mesh = flat_mesh(n=1)
     rng = np.random.RandomState(0)
     toks = jnp.asarray(
         rng.randint(0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
     tgts = jnp.roll(toks, -1, axis=1)
-
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    n_params = param_count(params)
 
     def loss_fn(p, batch):
         bt, by = batch
@@ -125,6 +137,49 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
     }
     print(json.dumps(out))
+    return 0
+
+
+def _decode_bench(args, cfg, params, n_params) -> int:
+    """KV-cache autoregressive generation throughput: prefill a prompt,
+    then greedy-decode ``--seq - --prompt-len`` new tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.models.gpt import generate
+
+    if args.prompt_len <= 0:
+        raise SystemExit("--prompt-len must be positive in decode mode")
+    n_new = args.seq - args.prompt_len
+    if n_new <= 0:
+        raise SystemExit("--seq must exceed --prompt-len in decode mode")
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (args.batch, args.prompt_len)),
+        jnp.int32)
+
+    gen = jax.jit(lambda p, t: generate(p, cfg, t, n_new,
+                                        max_len=args.seq))
+    out = np.asarray(gen(params, prompt))  # compile + warm
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = gen(params, prompt)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+
+    tok_per_sec = args.batch * n_new * args.steps / dt
+    print(json.dumps({
+        "metric": "gpt_decode_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "params": n_params,
+        "prompt_len": args.prompt_len,
+        "new_tokens": n_new,
+        "batch": args.batch,
+        "reps": args.steps,
+        "backend": jax.default_backend(),
+    }))
     return 0
 
 

@@ -71,3 +71,19 @@ def test_gpt_bench_emits_json(capsys):
     assert d["metric"] == "gpt_tokens_per_sec_per_chip"
     assert d["value"] > 0
     assert d["params"] > 0
+
+
+def test_gpt_decode_bench_emits_json(capsys):
+    import json
+
+    from kungfu_tpu.benchmarks.gpt import main as gpt_main
+
+    rc = gpt_main(["--decode", "--d-model", "32", "--n-layers", "1",
+                   "--n-heads", "2", "--d-ff", "64", "--vocab", "128",
+                   "--seq", "32", "--prompt-len", "8", "--batch", "2",
+                   "--steps", "2"])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["metric"] == "gpt_decode_tokens_per_sec_per_chip"
+    assert d["value"] > 0
+    assert d["new_tokens"] == 24
