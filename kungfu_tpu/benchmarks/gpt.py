@@ -49,6 +49,9 @@ def parse_args(argv=None):
     p.add_argument("--decode", action="store_true",
                    help="measure KV-cache autoregressive generation "
                         "instead of training")
+    p.add_argument("--chunked-ce", type=int, default=0, metavar="CHUNK",
+                   help="compute the loss with chunked-vocab cross-entropy "
+                        "(no [B,T,V] logits tensor); value = vocab chunk")
     p.add_argument("--prompt-len", type=int, default=128,
                    help="decode mode: prompt length to prefill")
     return p.parse_args(argv)
@@ -88,10 +91,10 @@ def main(argv=None) -> int:
     n_params = param_count(params)
 
     if args.decode:
-        if args.attn != "auto" or args.remat:
-            raise SystemExit("--attn/--remat apply to training only; the "
-                             "decode loop always runs dense per-token "
-                             "attention over the KV cache")
+        if args.attn != "auto" or args.remat or args.chunked_ce:
+            raise SystemExit("--attn/--remat/--chunked-ce apply to training "
+                             "only; the decode loop always runs dense "
+                             "per-token attention over the KV cache")
         return _decode_bench(args, cfg, params, n_params)
 
     mesh = flat_mesh(n=1)
@@ -100,11 +103,23 @@ def main(argv=None) -> int:
         rng.randint(0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32)
     tgts = jnp.roll(toks, -1, axis=1)
 
-    def loss_fn(p, batch):
-        bt, by = batch
-        logits = forward_local(p, bt, cfg, attn=args.attn, remat=args.remat)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, by).mean()
+    if args.chunked_ce:
+        from kungfu_tpu.models.gpt import forward_features
+        from kungfu_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        def loss_fn(p, batch):
+            bt, by = batch
+            feats = forward_features(p, bt, cfg, attn=args.attn,
+                                     remat=args.remat)
+            return chunked_cross_entropy(feats, p["lm_head"], by,
+                                         args.chunked_ce).mean()
+    else:
+        def loss_fn(p, batch):
+            bt, by = batch
+            logits = forward_local(p, bt, cfg, attn=args.attn,
+                                   remat=args.remat)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, by).mean()
 
     opt = kfopt.synchronous_sgd(optax.adamw(3e-4))
     sp = replicate(params, mesh)

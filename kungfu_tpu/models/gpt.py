@@ -287,17 +287,22 @@ def apply_layer(layer, x, cfg: GPTConfig, *,
     return _layer_finish(layer, x, o, cfg, tp_axis, ffn=ffn)
 
 
-def forward_local(params, tokens, cfg: GPTConfig, *,
-                  tp_axis: Optional[str] = None,
-                  sp_axis: Optional[str] = None,
-                  attn: str = "auto",
-                  remat: bool = False):
-    """Causal LM forward on this device's shard.
+def forward_features(params, tokens, cfg: GPTConfig, *,
+                     tp_axis: Optional[str] = None,
+                     sp_axis: Optional[str] = None,
+                     attn: str = "auto",
+                     remat: bool = False):
+    """Transformer stack on this device's shard → post-norm features
+    [B_local, T_local, D] (everything except the LM head).  With an
+    UNSHARDED head (no ``tp_axis``), feed these to
+    ``ops.chunked_ce.chunked_cross_entropy`` to train without ever
+    materializing [B, T, V] logits; under tensor parallelism use
+    ``parallel_cross_entropy`` on the vocab-sharded logits instead.
 
     ``tokens``: [B_local, T_local] int32.  With ``sp_axis`` the global
     sequence is the rank-order concatenation of shards; with ``tp_axis``
-    the head/feature dims hold the local slice and the returned logits are
-    vocab-sharded ``[B_local, T_local, V/tp]``.
+    the head/feature dims hold the local slice and (in forward_local) the
+    returned logits are vocab-sharded ``[B_local, T_local, V/tp]``.
 
     ``attn``: "ring" | "ring_flash" | "ulysses" (these need ``sp_axis``) |
     "flash" (Pallas kernel) | "dense"; "auto" = ring (flash-chunked on
@@ -335,7 +340,18 @@ def forward_local(params, tokens, cfg: GPTConfig, *,
     for layer in params["layers"]:
         x = layer_fn(layer, x)
 
-    x = rms_norm(x, params["lnf"])
+    return rms_norm(x, params["lnf"])
+
+
+def forward_local(params, tokens, cfg: GPTConfig, *,
+                  tp_axis: Optional[str] = None,
+                  sp_axis: Optional[str] = None,
+                  attn: str = "auto",
+                  remat: bool = False):
+    """``forward_features`` + LM head → logits (see forward_features for
+    the sharding/attention contract)."""
+    x = forward_features(params, tokens, cfg, tp_axis=tp_axis,
+                         sp_axis=sp_axis, attn=attn, remat=remat)
     # f32 logits: the parallel cross-entropy reduces over the vocab shard
     return jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
                       params["lm_head"])

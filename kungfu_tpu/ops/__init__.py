@@ -101,3 +101,4 @@ def peer_info(axis_name: str = PEER_AXIS):
 from .state import (Counter, CounterState, EmaState,  # noqa: E402,F401
                     ExponentialMovingAverage, counter_init, counter_update,
                     ema_init, ema_update)
+from .chunked_ce import chunked_cross_entropy  # noqa: E402,F401
