@@ -30,14 +30,12 @@ from kungfu_tpu.training import (broadcast_variables, build_train_step,
                                  init_opt_state, lane, replicate)
 
 
-def load_mnist(n=8192, seed=0):
-    """Synthetic MNIST-shaped data (no dataset download in this example;
-    swap in real MNIST arrays of the same shape to train for real)."""
-    rng = np.random.RandomState(seed)
-    x = rng.rand(n, 28 * 28).astype(np.float32)
-    w_true = rng.randn(28 * 28, 10).astype(np.float32)
-    y = (x @ w_true + 0.1 * rng.randn(n, 10)).argmax(axis=1)
-    return x, y.astype(np.int32)
+def load_mnist():
+    """Real MNIST when MNIST_DIR points at the idx files, else the
+    deterministic synthetic stand-in (kungfu_tpu.data.mnist)."""
+    from kungfu_tpu.data import mnist
+    (x, y), _ = mnist(os.environ.get("MNIST_DIR"))
+    return x.reshape(len(x), -1), y
 
 
 def main():

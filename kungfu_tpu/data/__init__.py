@@ -1,0 +1,128 @@
+"""Dataset helpers: MNIST / CIFAR-10 loaders + synthetic stand-ins.
+
+Reference: srcs/python/kungfu/tensorflow/v1/helpers/{mnist,cifar,imagenet}.py
+— loaders feeding the examples and integration tests.  This environment has
+no network egress, so these read the standard on-disk formats when a data
+directory is provided (MNIST idx / CIFAR-10 python pickles, the formats the
+reference's helpers download) and otherwise fall back to deterministic
+synthetic data with the correct shapes/dtypes — the same idea as the
+fake-model fixtures (models/fake_model.py) at the dataset level.
+
+Pair with :class:`kungfu_tpu.elastic.ElasticDataShard` for elastic
+skip+shard iteration (reference: v1/datasets/adaptor.py).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["mnist", "cifar10", "synthetic_image_classification", "read_idx"]
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (the MNIST container format): big-endian magic
+    ``[0, 0, dtype, ndim]`` then dims then raw data."""
+    with _open_maybe_gz(path) as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError(f"{path}: bad IDX magic")
+        dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                  0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+        if dtype_code not in dtypes:
+            raise ValueError(f"{path}: unknown IDX dtype 0x{dtype_code:02x}")
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.dtype(dtypes[dtype_code]
+                                                      ).newbyteorder(">"))
+        return data.reshape(dims).astype(dtypes[dtype_code])
+
+
+def synthetic_image_classification(
+        n: int, shape: Tuple[int, ...], num_classes: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-separable synthetic data: per-class mean images
+    plus noise, so optimizers actually reduce loss on it."""
+    rng = np.random.RandomState(seed)
+    means = rng.rand(num_classes, *shape).astype(np.float32)
+    y = rng.randint(0, num_classes, size=n).astype(np.int32)
+    x = means[y] + 0.3 * rng.randn(n, *shape).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+_MNIST_FILES = {
+    "x_train": ("train-images-idx3-ubyte", "train-images.idx3-ubyte"),
+    "y_train": ("train-labels-idx1-ubyte", "train-labels.idx1-ubyte"),
+    "x_test": ("t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"),
+    "y_test": ("t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"),
+}
+
+
+def mnist(data_dir: Optional[str] = None, normalize: bool = True):
+    """((x_train, y_train), (x_test, y_test)) with x [N, 28, 28, 1] f32.
+
+    ``data_dir`` holding the standard idx files (optionally .gz) loads the
+    real dataset (reference: helpers/mnist.py load_datasets); ``None``
+    yields deterministic synthetic data of the same shape.  A provided but
+    missing directory raises rather than silently training on fake data.
+    """
+    if data_dir is not None and not os.path.isdir(data_dir):
+        raise FileNotFoundError(f"data_dir {data_dir!r} does not exist "
+                                f"(pass None for synthetic data)")
+    if data_dir:
+        out = {}
+        for key, names in _MNIST_FILES.items():
+            for name in names:
+                p = os.path.join(data_dir, name)
+                if os.path.exists(p) or os.path.exists(p + ".gz"):
+                    out[key] = read_idx(p)
+                    break
+            else:
+                raise FileNotFoundError(
+                    f"{data_dir}: missing MNIST file {names[0]}[.gz]")
+        xtr = out["x_train"][..., None].astype(np.float32)
+        xte = out["x_test"][..., None].astype(np.float32)
+        if normalize:
+            xtr, xte = xtr / 255.0, xte / 255.0
+        return ((xtr, out["y_train"].astype(np.int32)),
+                (xte, out["y_test"].astype(np.int32)))
+    xtr, ytr = synthetic_image_classification(8192, (28, 28, 1), 10, seed=0)
+    xte, yte = synthetic_image_classification(1024, (28, 28, 1), 10, seed=1)
+    return (xtr, ytr), (xte, yte)
+
+
+def cifar10(data_dir: Optional[str] = None, normalize: bool = True):
+    """((x_train, y_train), (x_test, y_test)) with x [N, 32, 32, 3] f32.
+
+    ``data_dir`` = the extracted ``cifar-10-batches-py`` directory
+    (reference: helpers/cifar.py); ``None`` = synthetic fallback; a
+    provided but missing directory raises.
+    """
+    if data_dir is not None and not os.path.isdir(data_dir):
+        raise FileNotFoundError(f"data_dir {data_dir!r} does not exist "
+                                f"(pass None for synthetic data)")
+    if data_dir:
+        def load_batch(name):
+            with open(os.path.join(data_dir, name), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return x.astype(np.float32), np.asarray(d[b"labels"], np.int32)
+
+        xs, ys = zip(*[load_batch(f"data_batch_{i}") for i in range(1, 6)])
+        xtr, ytr = np.concatenate(xs), np.concatenate(ys)
+        xte, yte = load_batch("test_batch")
+        if normalize:
+            xtr, xte = xtr / 255.0, xte / 255.0
+        return (xtr, ytr), (xte, yte)
+    xtr, ytr = synthetic_image_classification(8192, (32, 32, 3), 10, seed=2)
+    xte, yte = synthetic_image_classification(1024, (32, 32, 3), 10, seed=3)
+    return (xtr, ytr), (xte, yte)

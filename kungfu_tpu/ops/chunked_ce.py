@@ -1,8 +1,9 @@
 """Chunked-vocab softmax cross-entropy: LM loss without the logits tensor.
 
 Training a causal LM the plain way materializes ``[B, T, V]`` float32
-logits — at seq 8192 x vocab 32768 that is 1 GiB per 8-sequence batch,
-usually the single largest training buffer.  This op computes
+logits — at seq 8192 x vocab 32768 that is 1 GiB per sequence (8 GiB for
+a batch of 8), usually the single largest training buffer.  This op
+computes
 
     loss[b, t] = logsumexp_v(x[b, t] @ W[:, v]) - x[b, t] @ W[:, y[b, t]]
 

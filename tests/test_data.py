@@ -1,0 +1,96 @@
+"""Dataset helpers: idx/CIFAR parsing and synthetic fallback."""
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from kungfu_tpu.data import cifar10, mnist, read_idx, \
+    synthetic_image_classification
+
+
+def write_idx(path, arr):
+    codes = {np.uint8: 0x08, np.int32: 0x0C, np.float32: 0x0D}
+    code = codes[arr.dtype.type]
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, code, arr.ndim))
+        f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+        f.write(arr.astype(arr.dtype.newbyteorder(">")).tobytes())
+
+
+def test_idx_roundtrip(tmp_path):
+    arr = np.arange(2 * 5 * 5, dtype=np.uint8).reshape(2, 5, 5)
+    p = str(tmp_path / "images.idx")
+    write_idx(p, arr)
+    np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_idx_gzip(tmp_path):
+    arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+    raw = str(tmp_path / "d.idx")
+    write_idx(raw, arr)
+    with open(raw, "rb") as f:
+        blob = f.read()
+    os.remove(raw)
+    with gzip.open(raw + ".gz", "wb") as f:
+        f.write(blob)
+    np.testing.assert_array_equal(read_idx(raw), arr)
+
+
+def test_mnist_from_idx_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    d = str(tmp_path)
+    write_idx(os.path.join(d, "train-images-idx3-ubyte"),
+              rng.randint(0, 256, (6, 28, 28)).astype(np.uint8))
+    write_idx(os.path.join(d, "train-labels-idx1-ubyte"),
+              rng.randint(0, 10, 6).astype(np.uint8))
+    write_idx(os.path.join(d, "t10k-images-idx3-ubyte"),
+              rng.randint(0, 256, (3, 28, 28)).astype(np.uint8))
+    write_idx(os.path.join(d, "t10k-labels-idx1-ubyte"),
+              rng.randint(0, 10, 3).astype(np.uint8))
+    (xtr, ytr), (xte, yte) = mnist(d)
+    assert xtr.shape == (6, 28, 28, 1) and xtr.dtype == np.float32
+    assert xtr.max() <= 1.0
+    assert ytr.shape == (6,) and yte.shape == (3,)
+
+
+def test_mnist_synthetic_fallback():
+    (xtr, ytr), (xte, yte) = mnist(None)
+    assert xtr.shape == (8192, 28, 28, 1)
+    assert set(np.unique(ytr)) <= set(range(10))
+    # deterministic
+    (xtr2, _), _ = mnist(None)
+    np.testing.assert_array_equal(xtr, xtr2)
+
+
+def test_cifar10_from_pickle_dir(tmp_path):
+    rng = np.random.RandomState(1)
+    d = str(tmp_path)
+    for name, n in [(f"data_batch_{i}", 4) for i in range(1, 6)] + [
+            ("test_batch", 2)]:
+        batch = {b"data": rng.randint(0, 256, (n, 3072)).astype(np.uint8),
+                 b"labels": rng.randint(0, 10, n).tolist()}
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump(batch, f)
+    (xtr, ytr), (xte, yte) = cifar10(d)
+    assert xtr.shape == (20, 32, 32, 3)
+    assert xte.shape == (2, 32, 32, 3)
+    assert ytr.dtype == np.int32
+
+
+def test_synthetic_is_learnable():
+    """Class-mean structure: a nearest-mean classifier beats chance."""
+    x, y = synthetic_image_classification(512, (8, 8, 1), 4, seed=7)
+    means = np.stack([x[y == c].mean(axis=0) for c in range(4)])
+    pred = np.argmin(((x[:, None] - means[None]) ** 2).sum((2, 3, 4)),
+                     axis=1)
+    assert (pred == y).mean() > 0.9
+
+
+def test_missing_dir_raises():
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        mnist("/no/such/dir")
+    with pytest.raises(FileNotFoundError):
+        cifar10("/no/such/dir")
