@@ -34,7 +34,7 @@ def load_mnist():
     """Real MNIST when MNIST_DIR points at the idx files, else the
     deterministic synthetic stand-in (kungfu_tpu.data.mnist)."""
     from kungfu_tpu.data import mnist
-    (x, y), _ = mnist(os.environ.get("MNIST_DIR"))
+    (x, y), _ = mnist(os.environ.get("MNIST_DIR") or None)
     return x.reshape(len(x), -1), y
 
 

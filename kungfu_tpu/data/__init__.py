@@ -25,9 +25,10 @@ __all__ = ["mnist", "cifar10", "synthetic_image_classification", "read_idx"]
 
 
 def _open_maybe_gz(path: str):
-    if os.path.exists(path + ".gz"):
-        return gzip.open(path + ".gz", "rb")
-    return open(path, "rb")
+    # the exact path wins; fall back to a .gz sibling only when absent
+    if os.path.exists(path):
+        return open(path, "rb")
+    return gzip.open(path + ".gz", "rb")
 
 
 def read_idx(path: str) -> np.ndarray:

@@ -132,7 +132,22 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     exits when the whole cluster is gone).
     """
     w = Watcher(job, host, parent, pool)
-    w.update(0, initial)
+    # align the initial stage version with the config server's counter —
+    # spawned workers carry the version as their fencing token, so a skew
+    # here makes them mistake the CURRENT config for a resize (the
+    # reference runner likewise takes Stage{version} from the server)
+    version0 = 0
+    if config_url:
+        for _ in range(10):  # brief retry: server may be starting up
+            try:
+                version0, initial = fetch_config(config_url)
+                break
+            except Exception:
+                time.sleep(0.2)
+        # still unseeded: spawn from the provided cluster at version 0; a
+        # later PUT of the same cluster costs the workers one benign
+        # in-process rebuild (resize_from_url), nothing more
+    w.update(version0, initial)
     global_size = initial.size()
     while True:
         w.reap()

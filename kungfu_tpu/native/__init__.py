@@ -366,6 +366,65 @@ class NativePeer:
 _default_peer: Optional[NativePeer] = None
 
 
+def resize_from_url(timeout: float = 5.0):
+    """Worker-side elastic resize over the host runtime (reference:
+    Peer.ResizeClusterFromURL, peer/peer.go:236-263): fetch the cluster
+    from the config server named in the KFT_* env ABI; when its version
+    has advanced past this peer's token, rebuild the default peer over the
+    new membership with token = version (fencing stale connections) and
+    barrier on the new cluster.
+
+    Returns ``(changed, detached)``.  A worker whose spec disappeared from
+    the cluster is marked detached (kungfu_tpu.detached() turns True), its
+    peer is torn down, and it should exit; the watcher will also reap it.
+    Surviving workers keep running — only their runtime is rebuilt, which
+    is the TPU-native analogue of the reference's in-place session swap
+    (XLA state lives in the jax mesh, rebuilt separately by the trainer).
+    """
+    from ..elastic import config_server as _cs
+    from ..elastic import state as _es
+    from ..launcher import env as E
+
+    we = E.from_env()
+    if not we.config_server:
+        raise RuntimeError("resize_from_url: KFT_CONFIG_SERVER not set")
+    if installed_peer() is None and not _es.is_detached():
+        default_peer()  # first call: build from the env ABI
+    me = f"{we.self_spec.host}:{we.self_spec.port}"
+    changed = False
+    while True:
+        version, cluster = _cs.fetch_config(we.config_server,
+                                            timeout=timeout)
+        p = installed_peer()
+        if p is not None and version <= p.token:
+            return changed, False
+        specs = [f"{w.host}:{w.port}" for w in cluster.workers]
+        if me not in specs:
+            use_peer(None)  # uninstall BEFORE close: no NULL-handle default
+            if p is not None:
+                p.close()
+            _es.set_detached(True)
+            return True, True
+        if _es.is_detached():
+            # fenced out earlier; a later config cannot re-admit this
+            # worker in-process (the launcher respawns it instead)
+            return changed, True
+        new_rank = specs.index(me)
+        use_peer(None)
+        if p is not None:
+            p.close()  # frees this worker's listen port for the rebuild
+        # install only after a successful start — a failed rebuild leaves
+        # no peer installed (callers can retry) rather than a dead handle
+        newp = NativePeer(new_rank, specs, token=version).start()
+        use_peer(newp)
+        changed = True
+        # re-fetch before returning: a further resize may have landed
+        # while we rebuilt — a peer acting on this stale membership would
+        # rendezvous with nobody (peers fence on token = version).  No
+        # explicit barrier otherwise: the next collective rendezvouses
+        # the membership (connection retries cover peers still rebuilding).
+
+
 def use_peer(p: Optional[NativePeer]) -> None:
     """Install an explicitly-constructed peer as the process default (for
     embedding the runtime without the KFT_* env ABI, e.g. tests)."""

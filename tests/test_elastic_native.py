@@ -1,0 +1,99 @@
+"""End-to-end elastic loop over the native runtime: watch mode + config
+server + in-process worker resize.
+
+The reference's core elastic scenario (peer.go ResizeClusterFromURL +
+runner watch.go): workers allreduce at version 0, rank 0 proposes a bigger
+cluster, the watcher spawns the new worker, SURVIVING workers rebuild
+their runtime in-process at the new version, and the new membership
+allreduces together.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kungfu_tpu import native
+from kungfu_tpu.elastic import ConfigServer, put_config
+from kungfu_tpu.launcher.job import Job
+from kungfu_tpu.launcher.watch import watch_run
+from kungfu_tpu.plan import Cluster, HostList, PeerID
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+WORKER = r"""
+import os, sys, time
+import numpy as np
+import kungfu_tpu as kf
+from kungfu_tpu import native
+from kungfu_tpu.launcher import env as E
+
+out_dir = os.environ["TEST_OUT"]
+we = E.from_env()
+p = native.default_peer()
+
+def record(stage, size):
+    path = os.path.join(out_dir,
+                        f"{stage}.{we.self_spec.port}")
+    with open(path, "w") as f:
+        f.write(str(int(size)))
+
+# collective names carry the membership version so every member of an
+# epoch rendezvouses on the same channel regardless of when it joined
+got = p.all_reduce(np.ones(4, np.float32), name=f"step@{p.token}")
+record(f"v{p.token}", got[0])
+
+if p.size == 2:
+    # original workers: rank 0 proposes growth, then everyone polls
+    if p.rank == 0:
+        assert kf.propose_new_size(3)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        changed, detached = native.resize_from_url()
+        if changed:
+            break
+        time.sleep(0.1)
+    else:
+        sys.exit(3)
+    assert not detached
+    p = native.installed_peer()
+    got = p.all_reduce(np.ones(4, np.float32), name=f"step@{p.token}")
+    record(f"v{p.token}", got[0])
+"""
+
+
+def test_grow_with_surviving_workers(tmp_path, monkeypatch):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out_dir))  # Proc merges os.environ
+
+    hl = HostList.parse("127.0.0.1:4")
+    cluster = Cluster.from_hostlist(hl, 2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31990),
+                       cluster, srv.url, poll_interval=0.1)
+        assert rc == 0
+    finally:
+        srv.stop()
+
+    files = {f: int((out_dir / f).read_text())
+             for f in os.listdir(out_dir)}
+    versions = sorted({int(k.split(".")[0][1:]) for k in files})
+    assert len(versions) == 2, files
+    first = {k: v for k, v in files.items()
+             if k.startswith(f"v{versions[0]}.")}
+    second = {k: v for k, v in files.items()
+              if k.startswith(f"v{versions[1]}.")}
+    # two original workers allreduced a 2-cluster...
+    assert len(first) == 2 and set(first.values()) == {2}, files
+    # ...then all three (2 rebuilt in-process + 1 freshly spawned)
+    # allreduced a 3-cluster at the bumped version
+    assert len(second) == 3 and set(second.values()) == {3}, files
