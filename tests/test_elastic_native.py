@@ -64,36 +64,97 @@ if p.size == 2:
 """
 
 
-def test_grow_with_surviving_workers(tmp_path, monkeypatch):
+
+def _run_elastic(tmp_path, monkeypatch, script_body, initial_size,
+                 parent_port, watcher_poll=0.1):
+    """Start a watch-mode cluster of ``initial_size`` running
+    ``script_body``; return the parsed record files on clean drain."""
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(script_body)
     out_dir = tmp_path / "out"
     out_dir.mkdir()
     monkeypatch.setenv("TEST_OUT", str(out_dir))  # Proc merges os.environ
 
     hl = HostList.parse("127.0.0.1:4")
-    cluster = Cluster.from_hostlist(hl, 2)
+    cluster = Cluster.from_hostlist(hl, initial_size)
     srv = ConfigServer().start()
     try:
         put_config(srv.url, cluster)
         job = Job(prog=sys.executable, args=[str(script)],
                   config_server=srv.url)
-        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31990),
-                       cluster, srv.url, poll_interval=0.1)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", parent_port),
+                       cluster, srv.url, poll_interval=watcher_poll)
         assert rc == 0
     finally:
         srv.stop()
-
     files = {f: int((out_dir / f).read_text())
              for f in os.listdir(out_dir)}
-    versions = sorted({int(k.split(".")[0][1:]) for k in files})
+    versions = sorted({int(k.split(".")[0][1:]) for k in files
+                       if k.startswith("v")})
     assert len(versions) == 2, files
-    first = {k: v for k, v in files.items()
-             if k.startswith(f"v{versions[0]}.")}
-    second = {k: v for k, v in files.items()
-              if k.startswith(f"v{versions[1]}.")}
+    epochs = [{k: v for k, v in files.items()
+               if k.startswith(f"v{ver}.")} for ver in versions]
+    return files, epochs
+
+
+def test_grow_with_surviving_workers(tmp_path, monkeypatch):
+    files, (first, second) = _run_elastic(tmp_path, monkeypatch, WORKER,
+                                          initial_size=2, parent_port=31990)
     # two original workers allreduced a 2-cluster...
     assert len(first) == 2 and set(first.values()) == {2}, files
     # ...then all three (2 rebuilt in-process + 1 freshly spawned)
     # allreduced a 3-cluster at the bumped version
     assert len(second) == 3 and set(second.values()) == {3}, files
+
+
+SHRINK_WORKER = r"""
+import os, sys, time
+import numpy as np
+import kungfu_tpu as kf
+from kungfu_tpu import native
+from kungfu_tpu.launcher import env as E
+
+out_dir = os.environ["TEST_OUT"]
+we = E.from_env()
+p = native.default_peer()
+
+def record(stage, val):
+    with open(os.path.join(out_dir, f"{stage}.{we.self_spec.port}"),
+              "w") as f:
+        f.write(str(int(val)))
+
+got = p.all_reduce(np.ones(2, np.float32), name=f"step@{p.token}")
+record(f"v{p.token}", got[0])
+
+if p.rank == 0:
+    assert kf.propose_new_size(2)
+deadline = time.time() + 30
+while time.time() < deadline:
+    changed, detached = native.resize_from_url()
+    if changed:
+        break
+    time.sleep(0.05)
+else:
+    sys.exit(3)
+if detached:
+    assert kf.detached()
+    record("detached", 1)
+    sys.exit(0)  # fenced out: exit cleanly; the watcher reaps us anyway
+p = native.installed_peer()
+got = p.all_reduce(np.ones(2, np.float32), name=f"step@{p.token}")
+record(f"v{p.token}", got[0])
+"""
+
+
+def test_shrink_detaches_removed_worker(tmp_path, monkeypatch):
+    # the removed worker races the watcher's SIGTERM to record detachment:
+    # it polls at 20 Hz against a 2 Hz watcher, so it observes the resize
+    # (HTTP fetch + one file write) long before the kill arrives
+    files, (first, second) = _run_elastic(tmp_path, monkeypatch,
+                                          SHRINK_WORKER, initial_size=3,
+                                          parent_port=31991,
+                                          watcher_poll=0.5)
+    assert len(first) == 3 and set(first.values()) == {3}, files
+    assert len(second) == 2 and set(second.values()) == {2}, files
+    # exactly one worker observed detachment (the removed rank 2)
+    assert sum(1 for k in files if k.startswith("detached")) == 1, files
