@@ -2,7 +2,7 @@
 from .ada_sgd import adaptive_sgd
 from .monitors import (GradVarianceState, NoiseScaleState,
                        gradient_noise_scale, gradient_variance)
-from .pair_avg import pair_averaging
+from .pair_avg import AsyncPairAverager, pair_averaging
 from .sma import synchronous_averaging
 from .sync_sgd import cross_replica_mean_gradients, synchronous_sgd
 
@@ -16,6 +16,7 @@ MonitorGradientVarianceOptimizer = gradient_variance
 
 __all__ = [
     "synchronous_sgd", "synchronous_averaging", "pair_averaging",
+    "AsyncPairAverager",
     "adaptive_sgd", "gradient_noise_scale", "gradient_variance",
     "cross_replica_mean_gradients", "NoiseScaleState", "GradVarianceState",
     "SynchronousSGDOptimizer", "SynchronousAveragingOptimizer",

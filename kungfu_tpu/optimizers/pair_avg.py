@@ -12,9 +12,10 @@ which every peer both sends and receives exactly one model per step and
 meets every other peer every n-1 steps.  This preserves AD-PSGD's gossip
 mixing (doubly-stochastic averaging matrix per step) while riding ICI at
 full bandwidth.  The deviation from true asynchrony is documented: there is
-no stale-model window; the mixing schedule is deterministic.  A
-store-backed asynchronous variant for multi-controller setups lives in
-kungfu_tpu.store.
+no stale-model window; the mixing schedule is deterministic.  The
+TRUE-asynchronous store-backed variant for multi-controller setups is
+:class:`AsyncPairAverager` below (native p2p store, random/roundrobin
+peer selection).
 """
 from __future__ import annotations
 
@@ -26,6 +27,82 @@ import optax
 from jax import lax
 
 from ..comm.mesh import PEER_AXIS
+
+
+class AsyncPairAverager:
+    """TRUE-asynchronous AD-PSGD model exchange over the host runtime's
+    p2p store — the multi-controller companion to :func:`pair_averaging`
+    (reference: PairAveragingOptimizer, async_sgd.py:13-142, over the Go
+    store; selection strategies random/roundrobin, peer_to_peer.cpp
+    SelectionStrategy).
+
+    Each controller trains independently; per step it requests one OTHER
+    peer's latest saved model (no synchronization — the serving peer's
+    store answers from whatever version it last saved), mixes
+    ``v <- (1-mix)*v + mix*v_peer``, and saves its own model back.
+
+    Usage (inside a launcher-spawned worker holding a NativePeer)::
+
+        avg = AsyncPairAverager(native.default_peer())
+        avg.save(params)               # step-0 init (reference: barrier'd)
+        ...
+        params = avg.mix(params)       # request + average, then train
+        avg.save(params)
+    """
+
+    def __init__(self, peer, selection: str = "random", mix: float = 0.5,
+                 name: str = "model", seed: Optional[int] = None):
+        import numpy as np
+
+        from ..plan.mst import RoundRobin
+        self._peer = peer
+        self._mix = float(mix)
+        self._name = name
+        self._mask = [r != peer.rank for r in range(peer.size)]
+        if selection == "roundrobin":
+            rr = RoundRobin()
+            self._pick = lambda: rr(self._mask)
+        elif selection == "random":
+            rng = np.random.RandomState(
+                peer.rank if seed is None else seed)
+            others = [r for r in range(peer.size) if r != peer.rank]
+            self._pick = (lambda: int(rng.choice(others))) if others else (
+                lambda: -1)
+        else:
+            raise ValueError(f"unknown selection {selection!r}")
+
+    _unravel = None
+
+    def _flat(self, tree):
+        import numpy as np
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(tree)
+        self._unravel = unravel  # same treedef every step: cache it
+        return np.asarray(flat)
+
+    def save(self, tree, version: int = -1) -> None:
+        """Publish this controller's model to its store."""
+        self._peer.save(self._name, self._flat(tree), version=version)
+
+    def _mix_flat(self, flat, version):
+        target = self._pick()
+        if target < 0:
+            return flat
+        theirs = self._peer.request(target, self._name, flat,
+                                    version=version)
+        return (1.0 - self._mix) * flat + self._mix * theirs
+
+    def mix(self, tree, version: int = -1):
+        """Pull one peer's model and average it into ``tree``."""
+        mixed = self._mix_flat(self._flat(tree), version)
+        return self._unravel(jnp.asarray(mixed))
+
+    def mix_and_save(self, tree, version: int = -1):
+        """``mix`` then ``save`` with a single flatten of the model —
+        the per-step fast path."""
+        mixed = self._mix_flat(self._flat(tree), version)
+        self._peer.save(self._name, mixed, version=version)
+        return self._unravel(jnp.asarray(mixed))
 
 
 def pair_averaging(base: optax.GradientTransformation,

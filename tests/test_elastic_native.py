@@ -15,7 +15,7 @@ import time
 import pytest
 
 from kungfu_tpu import native
-from kungfu_tpu.elastic import ConfigServer, put_config
+from kungfu_tpu.elastic import ConfigServer, fetch_config, put_config
 from kungfu_tpu.launcher.job import Job
 from kungfu_tpu.launcher.watch import watch_run
 from kungfu_tpu.plan import Cluster, HostList, PeerID
@@ -147,13 +147,49 @@ record(f"v{p.token}", got[0])
 
 
 def test_shrink_detaches_removed_worker(tmp_path, monkeypatch):
-    # the removed worker races the watcher's SIGTERM to record detachment:
-    # it polls at 20 Hz against a 2 Hz watcher, so it observes the resize
-    # (HTTP fetch + one file write) long before the kill arrives
-    files, (first, second) = _run_elastic(tmp_path, monkeypatch,
-                                          SHRINK_WORKER, initial_size=3,
-                                          parent_port=31991,
-                                          watcher_poll=0.5)
+    """Workers run as plain subprocesses (no watcher — so no SIGTERM can
+    race the removed worker's detachment observation; the watcher's kill
+    path is covered by test_launcher)."""
+    script = tmp_path / "worker.py"
+    script.write_text(SHRINK_WORKER)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+
+    hl = HostList.parse("127.0.0.1:4")
+    cluster = Cluster.from_hostlist(hl, 3)
+    srv = ConfigServer().start()
+    procs = []
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        version, cluster = fetch_config(srv.url)
+        for w in cluster.workers:
+            proc = job.new_proc(w, cluster, version,
+                                PeerID("127.0.0.1", 31991))
+            proc.env["TEST_OUT"] = str(out_dir)
+            proc.start()
+            procs.append(proc)
+        deadline = time.time() + 60
+        while any(pr.poll() is None for pr in procs):
+            assert time.time() < deadline, "workers did not finish"
+            time.sleep(0.2)
+        assert all(pr.poll() == 0 for pr in procs), [pr.poll()
+                                                     for pr in procs]
+    finally:
+        for pr in procs:
+            pr.kill()
+        srv.stop()
+
+    files = {f: int((out_dir / f).read_text())
+             for f in os.listdir(out_dir)}
+    versions = sorted({int(k.split(".")[0][1:]) for k in files
+                       if k.startswith("v")})
+    assert len(versions) == 2, files
+    first = {k: v for k, v in files.items()
+             if k.startswith(f"v{versions[0]}.")}
+    second = {k: v for k, v in files.items()
+              if k.startswith(f"v{versions[1]}.")}
     assert len(first) == 3 and set(first.values()) == {3}, files
     assert len(second) == 2 and set(second.values()) == {2}, files
     # exactly one worker observed detachment (the removed rank 2)

@@ -260,3 +260,36 @@ def test_single_peer_degenerate():
 
 def test_mst_adaptation():
     _spawn(_w_mst, 4)
+
+
+def _w_async_pair_avg(rank, peers, q, selection):
+    """TRUE-async AD-PSGD: local SGD on a shared quadratic + store-based
+    pair averaging (reference: PairAveragingOptimizer over the Go store)."""
+    from kungfu_tpu.native import NativePeer
+    from kungfu_tpu.optimizers import AsyncPairAverager
+    try:
+        n = len(peers)
+        with NativePeer(rank, peers) as p:
+            import jax.numpy as jnp
+            target = jnp.asarray([3.0, -2.0, 1.0, 4.0])
+            # divergent inits: averaging must pull them together
+            params = {"w": jnp.full(4, float(rank * 10))}
+            avg = AsyncPairAverager(p, selection=selection)
+            avg.save(params)
+            p.barrier(name="init")  # reference: step-0 store init barrier
+            for step in range(60):
+                params = avg.mix(params)
+                grad = {"w": 2.0 * (params["w"] - target)}
+                params = {"w": params["w"] - 0.1 * grad["w"]}
+                avg.save(params)
+            p.barrier(name="trained")
+            err = float(jnp.abs(params["w"] - target).max())
+            assert err < 0.5, f"rank {rank} err {err}"
+            q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+@pytest.mark.parametrize("selection", ["random", "roundrobin"])
+def test_async_pair_averaging(selection):
+    _spawn(_w_async_pair_avg, 3, selection)
