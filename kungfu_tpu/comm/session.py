@@ -85,6 +85,7 @@ class Session:
         self.strategy = auto_select(peers) if strategy == Strategy.AUTO else strategy
         self._pairs: List[GraphPair] = generate(self.strategy, peers)
         self._stats: Dict[str, StrategyStat] = {}
+        self._adapt_idx = 0  # fallback-rotation cursor (auto_adapt)
         self._fn_cache: Dict[tuple, Callable] = {}
         self._lock = threading.Lock()
 
@@ -301,3 +302,45 @@ class Session:
             if s.reference_rate and s.throughput < threshold * s.reference_rate:
                 return True
         return False
+
+    def auto_adapt(self, threshold: float = 0.8,
+                   fallbacks: Optional[Sequence[Strategy]] = None) -> bool:
+        """Close the reference's monitor→adapt loop in one call
+        (reference flow: CheckInterference vote → SetGlobalStrategy,
+        adaptiveStrategies.go + adaptation.go).  Call between steps (e.g.
+        each monitoring period):
+
+        - stats without a reference rate yet snapshot one from the current
+          window (so each strategy — initial or post-switch — earns its
+          own baseline on the first call after traffic flows);
+        - when any monitored collective then drops below ``threshold`` ×
+          its reference, rotate to the next fallback strategy (a cursor
+          walks the list so successive switches try every entry before
+          revisiting one) and reset the windows.
+
+        Returns True when a switch happened.
+        """
+        for s in self._stats.values():
+            if s.reference_rate is None and s.count:
+                s.snapshot_reference()
+        if not self.check_interference(threshold):
+            return False
+        order = list(fallbacks) if fallbacks is not None else [
+            Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
+        cur = self.strategy
+        nxt = None
+        for k in range(len(order)):
+            cand = order[(self._adapt_idx + k) % len(order)]
+            if cand != cur:
+                nxt = cand
+                self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
+                break
+        if nxt is None:
+            return False
+        self.set_strategy(nxt)
+        for s in self._stats.values():
+            # fresh start: the new strategy must earn its own reference
+            # rate, not inherit the degraded one that triggered the switch
+            s.reference_rate = None
+            s.reset_window()
+        return True

@@ -87,6 +87,11 @@ def _run_elastic(tmp_path, monkeypatch, script_body, initial_size,
         assert rc == 0
     finally:
         srv.stop()
+    return _parse_records(out_dir)
+
+
+def _parse_records(out_dir):
+    """Record files -> (all files, per-membership-version epoch dicts)."""
     files = {f: int((out_dir / f).read_text())
              for f in os.listdir(out_dir)}
     versions = sorted({int(k.split(".")[0][1:]) for k in files
@@ -146,7 +151,7 @@ record(f"v{p.token}", got[0])
 """
 
 
-def test_shrink_detaches_removed_worker(tmp_path, monkeypatch):
+def test_shrink_detaches_removed_worker(tmp_path):
     """Workers run as plain subprocesses (no watcher — so no SIGTERM can
     race the removed worker's detachment observation; the watcher's kill
     path is covered by test_launcher)."""
@@ -181,15 +186,7 @@ def test_shrink_detaches_removed_worker(tmp_path, monkeypatch):
             pr.kill()
         srv.stop()
 
-    files = {f: int((out_dir / f).read_text())
-             for f in os.listdir(out_dir)}
-    versions = sorted({int(k.split(".")[0][1:]) for k in files
-                       if k.startswith("v")})
-    assert len(versions) == 2, files
-    first = {k: v for k, v in files.items()
-             if k.startswith(f"v{versions[0]}.")}
-    second = {k: v for k, v in files.items()
-              if k.startswith(f"v{versions[1]}.")}
+    files, (first, second) = _parse_records(out_dir)
     assert len(first) == 3 and set(first.values()) == {3}, files
     assert len(second) == 2 and set(second.values()) == {2}, files
     # exactly one worker observed detachment (the removed rank 2)

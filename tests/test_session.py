@@ -166,3 +166,41 @@ def test_monitoring_stats():
     assert "GiB/s" in sess.log_stats()
     assert not sess.check_interference()
     sess.stats()["g"].snapshot_reference()
+
+
+def test_auto_adapt_switches_on_interference():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    x = np.ones((n, 4096), dtype=np.float32)
+
+    def collapse():
+        """Fake an 80%+ throughput drop in the current window."""
+        st = sess.stats()["g"]
+        st.reset_window()
+        st.update(nbytes=1024, seconds=1024 / (0.1 * st.reference_rate))
+
+    sess.all_reduce(x, name="g")
+    # first call snapshots the reference from live traffic: no switch
+    assert sess.auto_adapt() is False
+    assert sess.stats()["g"].reference_rate is not None
+    first = sess.strategy
+
+    collapse()
+    assert sess.check_interference()
+    assert sess.auto_adapt() is True
+    second = sess.strategy
+    assert second != first
+    # window + reference were reset: no immediate re-trigger
+    assert sess.auto_adapt() is False
+
+    # the loop stays closed: the new strategy earns its own reference,
+    # and a second collapse rotates to a strategy not yet tried
+    sess.all_reduce(x, name="g")
+    assert sess.auto_adapt() is False
+    collapse()
+    assert sess.auto_adapt() is True
+    assert sess.strategy not in (first, second)
+
+    # collectives still work under the adapted strategy
+    out = np.asarray(sess.all_reduce(x, name="g"))
+    np.testing.assert_allclose(out, n)
