@@ -297,9 +297,12 @@ class Session:
 
     def check_interference(self, threshold: float = 0.8) -> bool:
         """True when current throughput dropped below threshold × reference
-        rate (reference: adaptiveStrategies.go:61-121 CheckInterference)."""
+        rate (reference: adaptiveStrategies.go:61-121 CheckInterference).
+        Windows with no traffic are skipped — an idle period is not
+        interference."""
         for s in self._stats.values():
-            if s.reference_rate and s.throughput < threshold * s.reference_rate:
+            if (s.count and s.reference_rate
+                    and s.throughput < threshold * s.reference_rate):
                 return True
         return False
 
@@ -310,20 +313,28 @@ class Session:
         adaptiveStrategies.go + adaptation.go).  Call between steps (e.g.
         each monitoring period):
 
-        - stats without a reference rate yet snapshot one from the current
-          window (so each strategy — initial or post-switch — earns its
-          own baseline on the first call after traffic flows);
-        - when any monitored collective then drops below ``threshold`` ×
-          its reference, rotate to the next fallback strategy (a cursor
+        - each call evaluates ONE monitoring window (the traffic since the
+          previous call) and then rolls the window, so detection latency
+          is one period, not a share of total uptime;
+        - a healthy window raises the reference rate (best observed);
+        - when any monitored collective's window drops below ``threshold``
+          × its reference, rotate to the next fallback strategy (a cursor
           walks the list so successive switches try every entry before
-          revisiting one) and reset the windows.
+          revisiting one) and start fresh windows + references.
 
         Returns True when a switch happened.
         """
-        for s in self._stats.values():
-            if s.reference_rate is None and s.count:
-                s.snapshot_reference()
         if not self.check_interference(threshold):
+            # healthy (or idle) window: fold it into the baseline and roll.
+            # EMA rather than best-ever keeps the reference tracking the
+            # CURRENT healthy rate, so ordinary load variance does not
+            # creep toward spurious interference verdicts
+            for s in self._stats.values():
+                if s.count:
+                    tp = s.throughput
+                    s.reference_rate = (tp if s.reference_rate is None else
+                                        0.8 * s.reference_rate + 0.2 * tp)
+                    s.reset_window()
             return False
         order = list(fallbacks) if fallbacks is not None else [
             Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]

@@ -59,6 +59,9 @@ struct Msg {
 bool write_all(int fd, const void *buf, size_t n);
 bool read_all(int fd, void *buf, size_t n);
 bool send_msg(int fd, const Msg &m);
+// Zero-copy variant: frame + name from m, body written straight from the
+// caller's buffer (no Msg::body staging copy on the hot collective path).
+bool send_msg_ref(int fd, const Msg &m, const void *body, size_t nbytes);
 bool recv_msg(int fd, Msg *m);
 
 // ------------------------------------------------------------------ queue

@@ -54,6 +54,7 @@ class Peer {
         }
         int one = 1;
         ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        tune_buffers(listen_fd_);  // inherited by accepted sockets
         sockaddr_in addr{};
         addr.sin_family = AF_INET;
         addr.sin_addr.s_addr = INADDR_ANY;
@@ -489,9 +490,23 @@ class Peer {
         }
     }
 
-    bool handshake_in(const std::shared_ptr<Conn> &conn) {
+    // Large buffers keep bulk model transfers streaming instead of
+    // ping-ponging on the default window.  Buffer sizes must be set
+    // BEFORE connect()/listen() to influence the TCP window-scale
+    // negotiation (man 7 tcp); accepted sockets inherit the listener's.
+    static void tune_buffers(int fd) {
+        int sz = 4 << 20;
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+    }
+
+    static void tune_socket(int fd) {
         int one = 1;
-        ::setsockopt(conn->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+
+    bool handshake_in(const std::shared_ptr<Conn> &conn) {
+        tune_socket(conn->fd);
         Msg hello;
         if (!recv_msg(conn->fd, &hello) || hello.cls != CLS_HELLO ||
             hello.body.size() < 4)
@@ -663,6 +678,7 @@ class Peer {
             rejected = false;
             int fd = ::socket(AF_INET, SOCK_STREAM, 0);
             if (fd < 0) break;
+            tune_buffers(fd);  // before connect(): window-scale negotiation
             sockaddr_in addr{};
             addr.sin_family = AF_INET;
             addr.sin_port = htons(uint16_t(pa.port));
@@ -677,8 +693,7 @@ class Peer {
             }
             if (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
                           sizeof(addr)) == 0) {
-                int one = 1;
-                ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+                tune_socket(fd);
                 Msg hello;
                 hello.cls = CLS_HELLO;
                 hello.token = token_.load();
@@ -727,10 +742,8 @@ class Peer {
         m.cls = CLS_COLLECTIVE;
         m.token = token_.load();
         m.name = name;
-        m.body.assign(static_cast<const uint8_t *>(data),
-                      static_cast<const uint8_t *>(data) + nbytes);
         std::lock_guard<std::mutex> wg(conn->write_mu);
-        if (!send_msg(conn->fd, m)) {
+        if (!send_msg_ref(conn->fd, m, data, nbytes)) {
             set_error("send to peer " + std::to_string(dest) + " failed");
             drop_conn(dest, CLS_COLLECTIVE);
             return false;

@@ -53,13 +53,16 @@ struct WireHeader {
 static_assert(sizeof(WireHeader) == 24, "wire header layout");
 
 bool send_msg(int fd, const Msg &m) {
+    return send_msg_ref(fd, m, m.body.data(), m.body.size());
+}
+
+bool send_msg_ref(int fd, const Msg &m, const void *body, size_t nbytes) {
     WireHeader h{MSG_MAGIC, m.cls, m.flags, 0, m.token,
-                 uint32_t(m.name.size()), uint64_t(m.body.size())};
+                 uint32_t(m.name.size()), uint64_t(nbytes)};
     if (!write_all(fd, &h, sizeof(h))) return false;
     if (!m.name.empty() && !write_all(fd, m.name.data(), m.name.size()))
         return false;
-    if (!m.body.empty() && !write_all(fd, m.body.data(), m.body.size()))
-        return false;
+    if (nbytes && !write_all(fd, body, nbytes)) return false;
     return true;
 }
 

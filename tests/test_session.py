@@ -172,20 +172,27 @@ def test_auto_adapt_switches_on_interference():
     n = 4
     sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
     x = np.ones((n, 4096), dtype=np.float32)
+    st = None
 
-    def collapse():
-        """Fake an 80%+ throughput drop in the current window."""
-        st = sess.stats()["g"]
+    def window(rate_fraction):
+        """Fabricate one monitoring window at a fraction of the reference
+        (deterministic — real timing would make the test load-sensitive)."""
         st.reset_window()
-        st.update(nbytes=1024, seconds=1024 / (0.1 * st.reference_rate))
+        st.update(nbytes=1024,
+                  seconds=1024 / (rate_fraction * st.reference_rate))
 
     sess.all_reduce(x, name="g")
-    # first call snapshots the reference from live traffic: no switch
+    st = sess.stats()["g"]
+    # first period: healthy traffic becomes the reference; window rolls
     assert sess.auto_adapt() is False
-    assert sess.stats()["g"].reference_rate is not None
+    assert st.reference_rate is not None
+    assert st.count == 0  # window rolled per period
     first = sess.strategy
 
-    collapse()
+    # an idle period is NOT interference
+    assert sess.auto_adapt() is False
+
+    window(0.1)
     assert sess.check_interference()
     assert sess.auto_adapt() is True
     second = sess.strategy
@@ -197,9 +204,20 @@ def test_auto_adapt_switches_on_interference():
     # and a second collapse rotates to a strategy not yet tried
     sess.all_reduce(x, name="g")
     assert sess.auto_adapt() is False
-    collapse()
+    window(0.1)
     assert sess.auto_adapt() is True
     assert sess.strategy not in (first, second)
+
+    # detection latency is one period: healthy windows (with ordinary
+    # variance) only nudge the EMA reference, then a single degraded
+    # window triggers immediately
+    sess.all_reduce(x, name="g")
+    assert sess.auto_adapt() is False
+    for frac in (1.0, 0.9, 1.1, 0.95, 1.05):
+        window(frac)
+        assert sess.auto_adapt() is False
+    window(0.1)
+    assert sess.auto_adapt() is True
 
     # collectives still work under the adapted strategy
     out = np.asarray(sess.all_reduce(x, name="g"))
