@@ -316,7 +316,9 @@ class Session:
         - each call evaluates ONE monitoring window (the traffic since the
           previous call) and then rolls the window, so detection latency
           is one period, not a share of total uptime;
-        - a healthy window raises the reference rate (best observed);
+        - a healthy window folds its throughput into an EMA reference (so
+          the baseline tracks the current healthy rate both up and down —
+          gradual drift is absorbed, only a sharp per-window drop trips);
         - when any monitored collective's window drops below ``threshold``
           × its reference, rotate to the next fallback strategy (a cursor
           walks the list so successive switches try every entry before
@@ -347,6 +349,10 @@ class Session:
                 self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
                 break
         if nxt is None:
+            # no alternative to switch to: still roll the window so the
+            # degraded sample doesn't wedge every later period's verdict
+            for s in self._stats.values():
+                s.reset_window()
             return False
         self.set_strategy(nxt)
         for s in self._stats.values():

@@ -50,3 +50,18 @@ def test_two_process_global_psum(tmp_path):
         cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert out.stdout.count("DIST_OK") == 2, out.stdout
+
+
+def test_multihost_dp_example(tmp_path):
+    """The full multi-host training example converges with identical
+    parameters on every process."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.launcher", "-np", "2", "--",
+         sys.executable, os.path.join(REPO, "examples",
+                                      "multihost_data_parallel.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("final loss") == 2, out.stdout
