@@ -32,7 +32,7 @@ from jax.experimental import multihost_utils
 
 import kungfu_tpu as kft
 import kungfu_tpu.optimizers as kfopt
-from kungfu_tpu.comm.mesh import flat_mesh
+from kungfu_tpu.comm.mesh import flat_mesh, peer_sharding
 from kungfu_tpu.training import (broadcast_variables, build_train_step,
                                  init_opt_state, replicate)
 
@@ -59,8 +59,7 @@ def main():
     st = init_opt_state(opt, sp, mesh)
     step = build_train_step(loss_fn, opt, mesh)
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    data_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+    data_sharding = peer_sharding(mesh)
     per_dev_batch = 32
     data_rng = np.random.RandomState(100 + rank)  # local data differs
 
