@@ -27,6 +27,21 @@ import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 360.0  # 8xV100 NCCL ResNet-50, per GPU
 
+_WATCHDOG = {"disarm": lambda: None}  # armed in __main__
+
+
+def _cpu_reexec(reason: str) -> None:
+    """Last resort: produce the round's JSON line from the CPU path."""
+    import os
+    if os.environ.get("KFT_BENCH_NO_WATCHDOG") == "1":
+        # already the CPU fallback — re-exec'ing again would loop forever
+        raise RuntimeError(f"bench CPU fallback failed: {reason}")
+    print(f"bench: {reason}; re-running on CPU", file=sys.stderr)
+    sys.stderr.flush()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KFT_BENCH_NO_WATCHDOG="1")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+              env)
+
 
 def main():
     import optax
@@ -92,6 +107,7 @@ def main():
     }
     print(json.dumps(out))
     sys.stdout.flush()  # the result must outlive a watchdog re-exec
+    _WATCHDOG["disarm"]()  # immediately: a late re-exec would double-print
 
 
 def _arm_watchdog(seconds: int = 480):
@@ -110,15 +126,10 @@ def _arm_watchdog(seconds: int = 480):
         if not done.wait(seconds):
             if done.is_set():  # finished in the window between wait+exec
                 return
-            print("bench watchdog: TPU run hung; re-running on CPU",
-                  file=sys.stderr)
-            sys.stderr.flush()
-            env = dict(os.environ, JAX_PLATFORMS="cpu",
-                       KFT_BENCH_NO_WATCHDOG="1")
-            os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+            _cpu_reexec("watchdog: TPU run hung")
 
     threading.Thread(target=watch, daemon=True).start()
+    _WATCHDOG["disarm"] = done.set
     return done.set
 
 
@@ -127,15 +138,19 @@ if __name__ == "__main__":
     # transiently; one retry keeps the harness from losing the round's
     # measurement to a blip.  Each attempt gets its own watchdog budget
     # so the retry can't be preempted by the first attempt's timer.
-    _disarm = _arm_watchdog()
+    _arm_watchdog()
     try:
         main()
-        _disarm()
     except Exception as e:  # noqa: BLE001
-        _disarm()
+        _WATCHDOG["disarm"]()
         print(f"bench attempt 1 failed ({type(e).__name__}); retrying",
               file=sys.stderr)
         time.sleep(10)
-        _disarm2 = _arm_watchdog()
-        main()
-        _disarm2()
+        _arm_watchdog()
+        try:
+            main()
+        except Exception as e2:  # noqa: BLE001
+            # persistent non-hang failure: the CPU path still owes the
+            # harness its one JSON line
+            _WATCHDOG["disarm"]()
+            _cpu_reexec(f"retry failed too ({type(e2).__name__})")

@@ -159,9 +159,21 @@ class Session:
             out = fn(x)
             out.block_until_ready()
         dt = time.perf_counter() - t0
-        stat = self._stats.setdefault(name or "default", StrategyStat())
-        stat.update(x.nbytes, dt)
+        self.record(name or "default", x.nbytes, dt)
         return out
+
+    def record(self, name: str, nbytes: int, seconds: float) -> None:
+        """Feed one sample into the named throughput stat — used by the
+        eager collectives and by monitor.StepMonitor around jitted steps."""
+        stat = self._stats.setdefault(name, StrategyStat())
+        stat.update(nbytes, seconds)
+
+    def wire_algorithm(self) -> str:
+        """The on-wire cost family of the current strategy (for
+        monitor.allreduce_bytes_on_wire)."""
+        if self.strategy == Strategy.RING:
+            return "ring"
+        return "tree"  # star/tree families all move ~2x payload/participant
 
     def all_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
         """Eager allreduce of a peer-stacked array (axis 0 = peers)."""

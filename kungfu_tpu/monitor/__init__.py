@@ -113,6 +113,51 @@ class Monitor:
         return "\n".join(lines) + "\n"
 
 
+class StepMonitor:
+    """Feed per-step wall time + collective volume into a Session's
+    throughput stats, making interference detection / ``auto_adapt`` work
+    around JITTED train steps (whose in-step psum the Python layer cannot
+    observe; the reference instruments the op itself —
+    KungfuMonitoredAllReduce).
+
+    ``nbytes`` is the per-step collective payload (e.g. the gradient byte
+    count for sync SGD; ``grad_bytes(params)``).  Usage::
+
+        mon = StepMonitor(session, nbytes=grad_bytes(params))
+        for batch in data:
+            with mon:
+                params, state, loss = step(params, state, batch)
+                np.asarray(loss)   # host sync inside the timed region
+            session.auto_adapt()   # once per monitoring period
+    """
+
+    def __init__(self, session, name: str = "train_step", nbytes: int = 0):
+        self._session = session
+        self._name = name
+        self.nbytes = int(nbytes)
+        self._t0 = 0.0
+
+    def __enter__(self) -> "StepMonitor":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if exc[0] is None:
+            dt = time.perf_counter() - self._t0
+            self._session.record(self._name, self.nbytes, dt)
+            get_monitor().egress(allreduce_bytes_on_wire(
+                self.nbytes, self._session.size,
+                self._session.wire_algorithm()))
+        return False
+
+
+def grad_bytes(params) -> int:
+    """Bytes of one full gradient pytree (= sync-SGD allreduce payload)."""
+    import jax
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(params))
+
+
 class MetricsServer:
     """HTTP /metrics endpoint on a background thread."""
 

@@ -80,3 +80,38 @@ class TestMonitor:
             assert 'kungfu_tpu_ingress_bytes_total{target="ici"} 999' in body
         finally:
             srv.stop()
+
+
+def test_step_monitor_feeds_session_stats():
+    from kungfu_tpu.comm.mesh import flat_mesh
+    from kungfu_tpu.comm.session import Session
+    from kungfu_tpu.monitor import StepMonitor, grad_bytes
+    from kungfu_tpu.plan import PeerID, PeerList
+
+    import jax.numpy as jnp
+    import numpy as np
+    import time as _time
+
+    n = 4
+    peers = PeerList([PeerID("127.0.0.1", 11000 + i, i) for i in range(n)])
+    sess = Session(peers=peers, mesh=flat_mesh(n=n))
+    params = {"w": jnp.zeros((256, 4))}
+    assert grad_bytes(params) == 256 * 4 * 4
+
+    mon = StepMonitor(sess, nbytes=grad_bytes(params))
+    for _ in range(3):
+        with mon:
+            _time.sleep(0.002)  # stands in for a jitted step
+    assert sess.calc_stats()["train_step"] > 0
+    assert sess.stats()["train_step"].count == 3
+    # a period evaluation sees the fed data and rolls the window
+    assert sess.auto_adapt() is False
+    assert sess.stats()["train_step"].count == 0
+    assert sess.stats()["train_step"].reference_rate is not None
+    # an exception inside the step is not recorded as a sample
+    try:
+        with mon:
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert sess.stats()["train_step"].count == 0
