@@ -1,5 +1,7 @@
 """Synthetic allreduce benchmark CLI (reference: v1/benchmarks/__main__.py)."""
 import subprocess
+
+import numpy as np
 import sys
 
 import pytest
@@ -87,3 +89,29 @@ def test_gpt_decode_bench_emits_json(capsys):
     assert d["metric"] == "gpt_decode_tokens_per_sec_per_chip"
     assert d["value"] > 0
     assert d["new_tokens"] == 24
+
+
+def test_gpt_bench_chunked_ce(capsys):
+    import json
+
+    from kungfu_tpu.benchmarks.gpt import main as gpt_main
+
+    rc = gpt_main(["--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+                   "--d-ff", "64", "--vocab", "128", "--seq", "32",
+                   "--batch", "2", "--steps", "2", "--warmup-steps", "1",
+                   "--chunked-ce", "64"])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["metric"] == "gpt_tokens_per_sec_per_chip"
+    assert np.isfinite(d["loss"])
+
+
+def test_gpt_bench_decode_rejects_training_flags():
+    import pytest
+
+    from kungfu_tpu.benchmarks.gpt import main as gpt_main
+
+    with pytest.raises(SystemExit, match="training"):
+        gpt_main(["--decode", "--chunked-ce", "64", "--d-model", "32",
+                  "--n-heads", "2", "--n-layers", "1", "--vocab", "64",
+                  "--seq", "32"])
