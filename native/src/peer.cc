@@ -9,8 +9,10 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
+#include <cstddef>
 #include <cstdio>
 #include <cstdlib>
 #include <sstream>
@@ -29,6 +31,24 @@ static double env_double(const char *key, double dflt) {
 static int env_int(const char *key, int dflt) {
     const char *v = std::getenv(key);
     return v ? std::atoi(v) : dflt;
+}
+
+static bool env_bool(const char *key, bool dflt) {
+    const char *v = std::getenv(key);
+    if (!v) return dflt;
+    return std::string(v) == "1" || std::string(v) == "true" ||
+           std::string(v) == "True";
+}
+
+// Abstract-namespace unix address for a colocated peer's port (no
+// filesystem cleanup needed; Linux-specific, gated by KFT_CONFIG_USE_UNIX).
+static socklen_t unix_addr_for_port(int port, sockaddr_un *addr) {
+    std::memset(addr, 0, sizeof(*addr));
+    addr->sun_family = AF_UNIX;
+    std::string name = "kft-" + std::to_string(port);
+    addr->sun_path[0] = '\0';
+    std::memcpy(addr->sun_path + 1, name.data(), name.size());
+    return socklen_t(offsetof(sockaddr_un, sun_path) + 1 + name.size());
 }
 
 class Peer {
@@ -68,8 +88,28 @@ class Peer {
             listen_fd_ = -1;
             return false;
         }
+        // colocated peers talk over abstract unix sockets (reference:
+        // composed TCP+unix server, server/composed.go + UseUnixSock)
+        if (env_bool("KFT_CONFIG_USE_UNIX", true)) {
+            unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+            if (unix_listen_fd_ >= 0) {
+                sockaddr_un ua;
+                socklen_t ulen = unix_addr_for_port(peers_[rank_].port, &ua);
+                if (::bind(unix_listen_fd_,
+                           reinterpret_cast<sockaddr *>(&ua), ulen) != 0 ||
+                    ::listen(unix_listen_fd_, 128) != 0) {
+                    ::close(unix_listen_fd_);  // fall back to TCP-only
+                    unix_listen_fd_ = -1;
+                }
+            }
+        }
         running_ = true;
-        accept_thread_ = std::thread([this] { accept_loop(); });
+        accept_thread_ = std::thread([this] { accept_loop(listen_fd_); });
+        if (unix_listen_fd_ >= 0) {
+            int ufd = unix_listen_fd_.load();
+            unix_accept_thread_ =
+                std::thread([this, ufd] { accept_loop(ufd); });
+        }
         service_thread_ = std::thread([this] { service_loop(); });
         return true;
     }
@@ -81,6 +121,11 @@ class Peer {
             ::close(listen_fd_);
             listen_fd_ = -1;
         }
+        int ufd = unix_listen_fd_.exchange(-1);
+        if (ufd >= 0) {
+            ::shutdown(ufd, SHUT_RDWR);
+            ::close(ufd);
+        }
         endpoint_.close_all();
         {
             std::lock_guard<std::mutex> g(conns_mu_);
@@ -88,6 +133,7 @@ class Peer {
             for (auto &c : in_conns_) close_conn(c);
         }
         if (accept_thread_.joinable()) accept_thread_.join();
+        if (unix_accept_thread_.joinable()) unix_accept_thread_.join();
         if (service_thread_.joinable()) service_thread_.join();
         {
             std::lock_guard<std::mutex> g(conns_mu_);
@@ -454,9 +500,9 @@ class Peer {
 
   private:
     // ------------------------------------------------------------- server
-    void accept_loop() {
+    void accept_loop(int lfd) {
         while (running_) {
-            int fd = ::accept(listen_fd_, nullptr, nullptr);
+            int fd = ::accept(lfd, nullptr, nullptr);
             if (fd < 0) break;
             auto conn = std::make_shared<Conn>();
             conn->fd = fd;
@@ -676,23 +722,47 @@ class Peer {
         for (int attempt = 0; attempt < conn_retries_; attempt++) {
             if (!running_) break;
             rejected = false;
-            int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-            if (fd < 0) break;
-            tune_buffers(fd);  // before connect(): window-scale negotiation
-            sockaddr_in addr{};
-            addr.sin_family = AF_INET;
-            addr.sin_port = htons(uint16_t(pa.port));
-            if (::inet_pton(AF_INET, pa.host.c_str(), &addr.sin_addr) != 1) {
-                hostent *he = ::gethostbyname(pa.host.c_str());
-                if (!he) {
-                    ::close(fd);
-                    set_error("cannot resolve " + pa.host);
-                    return nullptr;
+            int fd = -1;
+            bool connected = false;
+            // colocated peer: abstract unix socket first (reference:
+            // connection.go:60-64), TCP as the fallback
+            if (unix_listen_fd_ >= 0 && pa.host == peers_[rank_].host) {
+                fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+                if (fd >= 0) {
+                    sockaddr_un ua;
+                    socklen_t ulen = unix_addr_for_port(pa.port, &ua);
+                    if (::connect(fd, reinterpret_cast<sockaddr *>(&ua),
+                                  ulen) == 0) {
+                        connected = true;
+                    } else {
+                        ::close(fd);
+                        fd = -1;
+                    }
                 }
-                std::memcpy(&addr.sin_addr, he->h_addr, 4);
             }
-            if (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
-                          sizeof(addr)) == 0) {
+            if (!connected) {
+                fd = ::socket(AF_INET, SOCK_STREAM, 0);
+                if (fd < 0) break;
+                tune_buffers(fd);  // pre-connect: window-scale negotiation
+                sockaddr_in addr{};
+                addr.sin_family = AF_INET;
+                addr.sin_port = htons(uint16_t(pa.port));
+                if (::inet_pton(AF_INET, pa.host.c_str(),
+                                &addr.sin_addr) != 1) {
+                    hostent *he = ::gethostbyname(pa.host.c_str());
+                    if (!he) {
+                        ::close(fd);
+                        set_error("cannot resolve " + pa.host);
+                        return nullptr;
+                    }
+                    std::memcpy(&addr.sin_addr, he->h_addr, 4);
+                }
+                connected = ::connect(
+                    fd, reinterpret_cast<sockaddr *>(&addr),
+                    sizeof(addr)) == 0;
+                // on failure the common not-connected branch closes fd
+            }
+            if (connected) {
                 tune_socket(fd);
                 Msg hello;
                 hello.cls = CLS_HELLO;
@@ -766,7 +836,10 @@ class Peer {
     std::atomic<uint32_t> token_;
     std::atomic<bool> running_{false};
     int listen_fd_ = -1;
-    std::thread accept_thread_, service_thread_;
+    // atomic: dial() threads read it as the "unix enabled" gate while
+    // stop() writes -1 concurrently
+    std::atomic<int> unix_listen_fd_{-1};
+    std::thread accept_thread_, unix_accept_thread_, service_thread_;
     CollectiveEndpoint endpoint_;
     BlobStore store_;
     EgressMonitor monitor_;

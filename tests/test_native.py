@@ -293,3 +293,31 @@ def _w_async_pair_avg(rank, peers, q, selection):
 @pytest.mark.parametrize("selection", ["random", "roundrobin"])
 def test_async_pair_averaging(selection):
     _spawn(_w_async_pair_avg, 3, selection)
+
+
+def test_allreduce_tcp_only_fallback(monkeypatch):
+    """KFT_CONFIG_USE_UNIX=0 forces colocated peers onto TCP (the
+    cross-host path); results must be identical to the default unix-socket
+    transport (reference: UseUnixSock toggle, config.go:11-19)."""
+    monkeypatch.setenv("KFT_CONFIG_USE_UNIX", "0")
+    _spawn(_w_allreduce, 3, "RING")
+
+
+def _w_unix_listener(rank, peers, q):
+    from kungfu_tpu.native import NativePeer
+    try:
+        with NativePeer(rank, peers) as p:
+            port = int(peers[rank].rsplit(":", 1)[1])
+            with open("/proc/net/unix") as f:
+                names = f.read()
+            assert f"@kft-{port}" in names, "unix listener missing"
+            p.barrier()
+            q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def test_unix_listener_present(monkeypatch):
+    """Default transport registers the abstract unix socket."""
+    monkeypatch.setenv("KFT_CONFIG_USE_UNIX", "1")  # isolate from ambient
+    _spawn(_w_unix_listener, 2)
