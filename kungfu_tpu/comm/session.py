@@ -61,6 +61,29 @@ class StrategyStat:
         self.count = 0
 
 
+def _reduce_over(stacked, mask, op: str):
+    """Reduce ``stacked`` [n, ...] over the lanes selected by ``mask``."""
+    m = jnp.reshape(mask, (-1,) + (1,) * (stacked.ndim - 1))
+    if op == "MEAN":
+        s = jnp.sum(jnp.where(m, stacked, jnp.zeros_like(stacked)), 0)
+        return s / jnp.sum(mask).astype(s.dtype)
+    if op == "SUM":
+        return jnp.sum(jnp.where(m, stacked, jnp.zeros_like(stacked)), 0)
+    if op == "PROD":
+        return jnp.prod(jnp.where(m, stacked, jnp.ones_like(stacked)), 0)
+    if op == "MAX":
+        lo = jnp.full_like(stacked, jnp.finfo(stacked.dtype).min
+                           if jnp.issubdtype(stacked.dtype, jnp.floating)
+                           else jnp.iinfo(stacked.dtype).min)
+        return jnp.max(jnp.where(m, stacked, lo), 0)
+    if op == "MIN":
+        hi = jnp.full_like(stacked, jnp.finfo(stacked.dtype).max
+                           if jnp.issubdtype(stacked.dtype, jnp.floating)
+                           else jnp.iinfo(stacked.dtype).max)
+        return jnp.min(jnp.where(m, stacked, hi), 0)
+    raise ValueError(f"unknown op {op}")
+
+
 class Session:
     """One communication session over a fixed mesh + membership version."""
 
@@ -219,6 +242,65 @@ class Session:
         out = fn(x)
         out.block_until_ready()
         return out
+
+    # ------------------------------------------- hierarchical (host-scoped)
+    def _host_layout(self):
+        """(group_id per lane, is_master per lane) from the peer list —
+        the local/cross scopes of the reference session (strategy.go
+        local/cross strategy lists).  Master = PeerList.local_masters()
+        (the same definition the graph strategies use)."""
+        host_order = list(dict.fromkeys(p.host for p in self.peers))
+        gid_of = {h: i for i, h in enumerate(host_order)}
+        masters_set = set(self.peers.local_masters())
+        gids = np.asarray([gid_of[p.host] for p in self.peers], np.int32)
+        masters = np.asarray([p in masters_set for p in self.peers])
+        return gids, masters
+
+    def local_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
+        """Reduce within each host onto its local master lane; other lanes
+        zero-filled (reference: LocalReduce, session.go:92-176)."""
+        gids, masters = self._host_layout()
+
+        def body(v):
+            g = C.all_gather(v, self.axis, axis=0, tiled=True)  # [n, ...]
+            i = jax.lax.axis_index(self.axis)
+            mine = jnp.asarray(gids) == jnp.asarray(gids)[i]
+            red = _reduce_over(g, mine, op)
+            return jnp.where(jnp.asarray(masters)[i], red,
+                             jnp.zeros_like(red))[None]
+        return self._run(name or "local_reduce", jnp.asarray(x), body,
+                         ("lred", op))
+
+    def local_broadcast(self, x, name: str = "") -> jax.Array:
+        """Every lane receives its host master's value (reference:
+        LocalBroadcast)."""
+        gids, masters = self._host_layout()
+        # master lane index for each group
+        master_of_group = {}
+        for i, (g, m) in enumerate(zip(gids, masters)):
+            if m:
+                master_of_group[int(g)] = i
+        src = np.asarray([master_of_group[int(g)] for g in gids], np.int32)
+
+        def body(v):
+            g = C.all_gather(v, self.axis, axis=0, tiled=True)
+            i = jax.lax.axis_index(self.axis)
+            return g[jnp.asarray(src)[i]][None]
+        return self._run(name or "local_broadcast", jnp.asarray(x), body,
+                         ("lbc",))
+
+    def cross_all_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
+        """Allreduce among the local masters only; non-master lanes pass
+        through unchanged (reference: CrossAllReduce, allreduce.go)."""
+        gids, masters = self._host_layout()
+
+        def body(v):
+            g = C.all_gather(v, self.axis, axis=0, tiled=True)
+            i = jax.lax.axis_index(self.axis)
+            red = _reduce_over(g, jnp.asarray(masters), op)
+            return jnp.where(jnp.asarray(masters)[i], red, v[0])[None]
+        return self._run(name or "cross_all_reduce", jnp.asarray(x), body,
+                         ("xar", op))
 
     def all_gather_transform(self, x, transform, name: str = ""):
         """All-gather then apply ``transform(stacked)`` on the host

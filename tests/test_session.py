@@ -222,3 +222,45 @@ def test_auto_adapt_switches_on_interference():
     # collectives still work under the adapted strategy
     out = np.asarray(sess.all_reduce(x, name="g"))
     np.testing.assert_allclose(out, n)
+
+
+class TestHierarchicalScopes:
+    """LocalReduce / LocalBroadcast / CrossAllReduce (session.go:92-176)."""
+
+    def setup_method(self):
+        # 2 hosts x 2 slots: lanes 0,1 on h0 (master 0), lanes 2,3 on h1
+        # (master 2)
+        self.sess = Session(peers=make_peers(4, hosts=2),
+                            mesh=flat_mesh(n=4))
+
+    def test_local_reduce(self):
+        x = np.arange(4, dtype=np.float32).reshape(4, 1) + 1  # 1,2,3,4
+        out = np.asarray(self.sess.local_reduce(x))
+        np.testing.assert_allclose(out[:, 0], [1 + 2, 0, 3 + 4, 0])
+
+    def test_local_broadcast(self):
+        x = np.arange(4, dtype=np.float32).reshape(4, 1) + 1
+        out = np.asarray(self.sess.local_broadcast(x))
+        np.testing.assert_allclose(out[:, 0], [1, 1, 3, 3])
+
+    def test_cross_all_reduce(self):
+        x = np.arange(4, dtype=np.float32).reshape(4, 1) + 1
+        out = np.asarray(self.sess.cross_all_reduce(x))
+        # masters 0 and 2 allreduce (1+3); others pass through
+        np.testing.assert_allclose(out[:, 0], [4, 2, 4, 4])
+
+    def test_hierarchical_composition_matches_global(self):
+        """local_reduce -> cross_all_reduce -> local_broadcast == global
+        allreduce (the reference's hierarchical path)."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16).astype(np.float32)
+        lr = self.sess.local_reduce(x)
+        xc = self.sess.cross_all_reduce(lr)
+        out = np.asarray(self.sess.local_broadcast(xc))
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (4, 1)),
+                                   rtol=1e-5)
+
+    def test_local_reduce_max(self):
+        x = np.asarray([[5.], [9.], [2.], [7.]], np.float32)
+        out = np.asarray(self.sess.local_reduce(x, op="MAX"))
+        np.testing.assert_allclose(out[:, 0], [9, 0, 7, 0])
