@@ -9,12 +9,7 @@ from kungfu_tpu.plan import (Cluster, Graph, HostList, HostSpec, PeerID,
                              even_partition, generate, stripe)
 
 
-def peers_on(hosts):
-    ps = []
-    for h, k in hosts:
-        for s in range(k):
-            ps.append(PeerID(h, 31100 + s, s))
-    return PeerList(ps)
+from testutil import peers_on  # noqa: E402
 
 
 class TestPeerList:

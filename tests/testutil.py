@@ -11,3 +11,13 @@ def tree_allclose(a, b, rtol=2e-4, atol=2e-5):
     for x, y in zip(fa, fb):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=rtol, atol=atol)
+
+
+def peers_on(hosts):
+    """PeerList from [(host, slots), ...] (shared by plan/property tests)."""
+    from kungfu_tpu.plan import PeerID, PeerList
+    ps = []
+    for h, k in hosts:
+        for s in range(k):
+            ps.append(PeerID(h, 31100 + s, s))
+    return PeerList(ps)
