@@ -81,7 +81,18 @@ class Monitor:
     def __init__(self) -> None:
         self._egress: Dict[str, RateCounter] = {}
         self._ingress: Dict[str, RateCounter] = {}
+        self._providers = []  # extra metric-line sources (native counters)
         self._lock = threading.Lock()
+
+    def add_provider(self, fn) -> None:
+        """Register a zero-arg callable returning extra metrics lines."""
+        with self._lock:
+            self._providers.append(fn)
+
+    def remove_provider(self, fn) -> None:
+        with self._lock:
+            if fn in self._providers:
+                self._providers.remove(fn)
 
     def _get(self, table: Dict[str, RateCounter], key: str) -> RateCounter:
         with self._lock:
@@ -110,6 +121,13 @@ class Monitor:
             lines.append(f'kungfu_tpu_egress_bytes_total{{target="{k}"}} {c.total()}')
         for k, c in sorted(ig.items()):
             lines.append(f'kungfu_tpu_ingress_bytes_total{{target="{k}"}} {c.total()}')
+        with self._lock:
+            providers = list(self._providers)
+        for fn in providers:
+            try:
+                lines.extend(fn())
+            except Exception:  # a dead provider must not break /metrics
+                pass
         return "\n".join(lines) + "\n"
 
 

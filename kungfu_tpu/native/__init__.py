@@ -142,13 +142,14 @@ class NativePeer:
         self._peers = list(peers)
         self._forest_cache = {}
         self._pool = None
+        self._metrics_server = None
+        self._metrics_provider = None
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "NativePeer":
         _check(self._lib.kft_peer_start(self._h), "start")
         self._started = True
-        if os.environ.get("KFT_CONFIG_ENABLE_STALL_DETECTION", "") in (
-                "1", "true", "True"):
+        if _env_true("KFT_CONFIG_ENABLE_STALL_DETECTION"):
             self.set_stall_threshold(30.0)
         return self
 
@@ -159,6 +160,15 @@ class NativePeer:
 
     def close(self) -> None:
         self.stop()
+        if self._metrics_provider is not None:
+            # unregister BEFORE freeing the handle: a late /metrics render
+            # must never call into a dead native peer
+            from .. import monitor as M
+            M.get_monitor().remove_provider(self._metrics_provider)
+            self._metrics_provider = None
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -416,6 +426,7 @@ def resize_from_url(timeout: float = 5.0):
         # install only after a successful start — a failed rebuild leaves
         # no peer installed (callers can retry) rather than a dead handle
         newp = NativePeer(new_rank, specs, token=version).start()
+        _maybe_start_metrics(newp, we.self_spec.port)
         use_peer(newp)
         changed = True
         # re-fetch before returning: a further resize may have landed
@@ -451,4 +462,38 @@ def default_peer() -> Optional[NativePeer]:
     peers = [f"{p.host}:{p.port}" for p in we.peers]
     _default_peer = NativePeer(we.rank(), peers,
                                token=we.cluster_version).start()
+    _maybe_start_metrics(_default_peer, we.self_spec.port)
     return _default_peer
+
+
+def _env_true(key: str) -> bool:
+    return os.environ.get(key, "") in ("1", "true", "True")
+
+
+def _maybe_start_metrics(p: NativePeer, worker_port: int) -> None:
+    """When KFT_CONFIG_ENABLE_MONITORING is set, serve /metrics at worker
+    port + 10000 including the native runtime's per-peer egress counters
+    (reference: monitor.StartServer in Peer.Start, peer.go:92-100;
+    endpoint monitor.go:58-104)."""
+    from .. import monitor as M
+    from ..launcher import env as E
+    if not _env_true(E.ENABLE_MONITORING):
+        return
+
+    def native_lines():
+        lines = []
+        for j in range(p.size):
+            if j == p.rank:
+                continue
+            lines.append('kft_peer_egress_bytes_total{peer="%d"} %d'
+                         % (j, p.egress_bytes(j)))
+        return lines
+
+    try:
+        srv = M.MetricsServer(M.get_monitor(),
+                              port=worker_port + M.MONITOR_PORT_OFFSET)
+        p._metrics_server = srv.start()
+    except OSError:  # port taken: monitoring is best-effort
+        return
+    p._metrics_provider = native_lines
+    M.get_monitor().add_provider(native_lines)

@@ -115,3 +115,43 @@ def test_step_monitor_feeds_session_stats():
     except RuntimeError:
         pass
     assert sess.stats()["train_step"].count == 0
+
+
+def test_metrics_endpoint_autostart(tmp_path):
+    """KFT_CONFIG_ENABLE_MONITORING starts /metrics at worker port+10000
+    serving the native per-peer egress counters (reference: peer.go:92-100
+    + monitor.go /metrics)."""
+    import os
+    import subprocess
+    import sys
+
+    from kungfu_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native lib unavailable")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "w.py"
+    worker.write_text("""
+import urllib.request
+import numpy as np
+from kungfu_tpu import native
+from kungfu_tpu.launcher import env as E
+
+we = E.from_env()
+p = native.default_peer()
+p.all_reduce(np.ones(1024, np.float32), name="g")
+p.barrier(name="traffic")
+url = f"http://127.0.0.1:{we.self_spec.port + 10000}/metrics"
+body = urllib.request.urlopen(url, timeout=5).read().decode()
+assert "kft_peer_egress_bytes_total" in body, body
+print("METRICS_OK")
+p.barrier(name="done")
+""")
+    env = dict(os.environ, KFT_CONFIG_ENABLE_MONITORING="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.launcher", "-np", "2", "--",
+         sys.executable, str(worker)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("METRICS_OK") == 2, out.stdout
