@@ -1,0 +1,121 @@
+// Native unit self-test: reduce kernels, f16 conversion, wire framing,
+// stores, and the waitqueue — no sockets, plain asserts.
+//
+// Mirrors the reference's C++ unit-test layer
+// (tests/cpp/unit/test_{kungfu,operations}.cpp) without a gtest
+// dependency.  Run: make test
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+#include "internal.h"
+
+using namespace kft;
+
+static void test_reduce_ops() {
+    float a[4] = {1, 2, 3, 4}, b[4] = {4, 3, 2, 1};
+    reduce_inplace(a, b, 4, KFT_F32, KFT_SUM);
+    assert(a[0] == 5 && a[3] == 5);
+    int32_t c[3] = {7, -2, 0}, d[3] = {3, -5, 9};
+    reduce_inplace(c, d, 3, KFT_I32, KFT_MAX);
+    assert(c[0] == 7 && c[1] == -2 && c[2] == 9);
+    reduce_inplace(c, d, 3, KFT_I32, KFT_MIN);
+    assert(c[0] == 3 && c[1] == -5 && c[2] == 9);
+    double e[2] = {2, 3}, f[2] = {5, 7};
+    reduce_inplace(e, f, 2, KFT_F64, KFT_PROD);
+    assert(e[0] == 10 && e[1] == 21);
+    std::printf("reduce ops ok\n");
+}
+
+static void test_f16_roundtrip() {
+    // f16 sum via the typed kernel: 0.5 + 0.25 = 0.75 exactly in fp16
+    uint16_t h1[1] = {0x3800};  // 0.5
+    uint16_t h2[1] = {0x3400};  // 0.25
+    reduce_inplace(h1, h2, 1, KFT_F16, KFT_SUM);
+    assert(h1[0] == 0x3A00);  // 0.75
+    std::printf("f16 kernel ok\n");
+}
+
+static void test_framing_roundtrip() {
+    int fds[2];
+    assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, fds) == 0);
+    Msg m;
+    m.cls = CLS_COLLECTIVE;
+    m.flags = 3;
+    m.token = 42;
+    m.name = "grad:layer0";
+    m.body = {1, 2, 3, 4, 5};
+    std::thread w([&] { assert(send_msg(fds[1], m)); });
+    Msg got;
+    assert(recv_msg(fds[0], &got));
+    w.join();
+    assert(got.cls == m.cls && got.flags == m.flags && got.token == 42);
+    assert(got.name == m.name && got.body == m.body);
+    // zero-copy variant frames identically
+    const char big[9] = "12345678";
+    std::thread w2([&] { assert(send_msg_ref(fds[1], m, big, 8)); });
+    assert(recv_msg(fds[0], &got));
+    w2.join();
+    assert(got.body.size() == 8 && got.body[0] == '1' && got.body[7] == '8');
+    ::close(fds[0]);
+    ::close(fds[1]);
+    std::printf("framing ok\n");
+}
+
+static void test_blob_store_gc() {
+    BlobStore st(2);  // window of 2 versions
+    uint8_t v[4] = {9, 9, 9, 9};
+    for (int64_t ver = 1; ver <= 4; ver++) {
+        v[0] = uint8_t(ver);
+        assert(st.save("m", ver, v, 4));
+    }
+    Bytes out;
+    assert(!st.load("m", 1, &out));  // GC'd
+    assert(!st.load("m", 2, &out));  // GC'd
+    assert(st.load("m", 3, &out) && out[0] == 3);
+    assert(st.load("m", 4, &out) && out[0] == 4);
+    // size conflict rejected
+    uint8_t w[2] = {0, 0};
+    assert(!st.save("m", 4, w, 2));
+    // unversioned (-1) slot: load(version<0) = latest; the slot itself
+    // does not count against the GC window
+    BlobStore st2(2);
+    uint8_t u[4] = {77, 0, 0, 0};
+    assert(st2.save("n", -1, u, 4));
+    assert(st2.load("n", -1, &out) && out[0] == 77);  // only -1 -> itself
+    for (int64_t ver = 5; ver <= 9; ver++) {
+        v[0] = uint8_t(ver);
+        assert(st2.save("n", ver, v, 4));
+    }
+    assert(st2.load("n", -1, &out) && out[0] == 9);   // latest wins
+    assert(st2.load("n", 8, &out) && out[0] == 8);    // window holds 8,9
+    assert(!st2.load("n", 7, &out));                  // GC'd despite -1 slot
+    std::printf("blob store ok\n");
+}
+
+static void test_endpoint_rendezvous() {
+    CollectiveEndpoint ep;
+    Bytes out;
+    std::thread t([&] { assert(ep.recv(1, "x", &out, 5.0)); });
+    ep.push(1, "x", Bytes{7, 8});
+    t.join();
+    assert(out.size() == 2 && out[0] == 7);
+    // timeout on a channel nobody feeds
+    assert(!ep.recv(2, "never", &out, 0.05));
+    std::printf("endpoint ok\n");
+}
+
+int main() {
+    test_reduce_ops();
+    test_f16_roundtrip();
+    test_framing_roundtrip();
+    test_blob_store_gc();
+    test_endpoint_rendezvous();
+    std::printf("ALL NATIVE SELFTESTS PASSED\n");
+    return 0;
+}

@@ -1,0 +1,13 @@
+"""C++ unit self-test (reference: tests/cpp/unit/) — standalone binary,
+runs even when libkft_comm.so is unavailable."""
+import os
+import subprocess
+
+
+def test_cpp_selftest():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(["make", "-C", os.path.join(repo, "native"),
+                          "test"], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL NATIVE SELFTESTS PASSED" in out.stdout
