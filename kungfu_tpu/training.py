@@ -71,7 +71,8 @@ def broadcast_variables(stacked, mesh: Optional[Mesh] = None, root: int = 0):
 def build_train_step(loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      mesh: Optional[Mesh] = None,
-                     donate: bool = True) -> Callable:
+                     donate: bool = True,
+                     accum_steps: int = 1) -> Callable:
     """Compile a distributed train step.
 
     ``loss_fn(params, batch) -> scalar``.  The returned function has
@@ -80,15 +81,53 @@ def build_train_step(loss_fn: Callable,
     leading axis is sharded across lanes.  All collective communication
     happens inside the optimizer's update and compiles into this one XLA
     program.
+
+    ``accum_steps > 1`` enables gradient accumulation: each lane's batch
+    shard is split into that many microbatches, gradients accumulate over
+    a ``lax.scan`` (activation memory = one microbatch), and the optimizer
+    — and therefore the gradient allreduce — runs ONCE on the mean.  The
+    trajectory equals a single big-batch step.
     """
     mesh = mesh or flat_mesh()
     axis = mesh.axis_names[0]
     spec = _stack_spec(mesh)
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+
+    def grads_of(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if leaf.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-lane batch {leaf.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}")
+        micro = jax.tree_util.tree_map(
+            lambda t: t.reshape((accum_steps, t.shape[0] // accum_steps)
+                                + t.shape[1:]), batch)
+
+        def acc_body(carry, mb):
+            loss_acc, grad_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            return (loss_acc + loss,
+                    jax.tree_util.tree_map(jnp.add, grad_acc, grads)), None
+
+        # carries must carry the mesh-varying axis the per-microbatch
+        # loss/grads have inside shard_map (see shard_map#scan-vma):
+        # zeros_like(params) inherits it from the sharded params; the
+        # literal scalar loss carry needs an explicit cast
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        loss0 = jax.lax.pcast(jnp.zeros(()), axis, to="varying")
+        (loss_sum, grad_sum), _ = jax.lax.scan(acc_body, (loss0, zeros),
+                                               micro)
+        k = float(accum_steps)
+        return loss_sum / k, jax.tree_util.tree_map(
+            lambda g: g / k, grad_sum)
 
     def body(stacked_params, stacked_state, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
         state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = grads_of(params, batch)
         updates, state = optimizer.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         mean_loss = jax.lax.pmean(loss, axis)
