@@ -52,6 +52,8 @@ def parse_args(argv=None):
     p.add_argument("--chunked-ce", type=int, default=0, metavar="CHUNK",
                    help="compute the loss with chunked-vocab cross-entropy "
                         "(no [B,T,V] logits tensor); value = vocab chunk")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation microbatches per step")
     p.add_argument("--prompt-len", type=int, default=128,
                    help="decode mode: prompt length to prefill")
     return p.parse_args(argv)
@@ -91,8 +93,10 @@ def main(argv=None) -> int:
     n_params = param_count(params)
 
     if args.decode:
-        if args.attn != "auto" or args.remat or args.chunked_ce:
-            raise SystemExit("--attn/--remat/--chunked-ce apply to training "
+        if (args.attn != "auto" or args.remat or args.chunked_ce
+                or args.accum != 1):
+            raise SystemExit("--attn/--remat/--chunked-ce/--accum apply to "
+                             "training "
                              "only; the decode loop always runs dense "
                              "per-token attention over the KV cache")
         return _decode_bench(args, cfg, params, n_params)
@@ -124,7 +128,8 @@ def main(argv=None) -> int:
     opt = kfopt.synchronous_sgd(optax.adamw(3e-4))
     sp = replicate(params, mesh)
     st = init_opt_state(opt, sp, mesh)
-    step = build_train_step(loss_fn, opt, mesh, donate=False)
+    step = build_train_step(loss_fn, opt, mesh, donate=False,
+                            accum_steps=args.accum)
 
     for _ in range(args.warmup_steps):
         sp, st, loss = step(sp, st, (toks, tgts))
