@@ -89,6 +89,10 @@ def main(argv=None) -> int:
                     rope=args.rope,
                     mlp="swiglu" if args.swiglu else "gelu")
 
+    if args.accum < 1 or (not args.decode and args.batch % args.accum):
+        raise SystemExit(f"--accum {args.accum} must be >= 1 and divide "
+                         f"--batch {args.batch}")
+
     params = init_params(jax.random.PRNGKey(0), cfg)
     n_params = param_count(params)
 
