@@ -68,6 +68,66 @@ def broadcast_variables(stacked, mesh: Optional[Mesh] = None, root: int = 0):
     return fn(stacked)
 
 
+def _accum_grads_fn(loss_fn: Callable, axis: str, accum_steps: int,
+                    has_aux: bool) -> Callable:
+    """Microbatch gradient accumulation shared by the step builders.
+
+    Returns ``grads_of(params, batch)`` (has_aux=False) or
+    ``grads_of(params, mstate, batch)`` (has_aux=True, threading the model
+    state sequentially through the scan).  Gradients and loss are averaged
+    over ``accum_steps`` equal microbatches; the optimizer (and so the
+    gradient allreduce) runs once on the result.
+    """
+    vg = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    def split(batch):
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if leaf.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-lane batch {leaf.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}")
+        return jax.tree_util.tree_map(
+            lambda t: t.reshape((accum_steps, t.shape[0] // accum_steps)
+                                + t.shape[1:]), batch)
+
+    def scan(params, micro, aux0):
+        def acc_body(carry, mb):
+            loss_acc, grad_acc, aux = carry
+            if has_aux:
+                (loss, aux), grads = vg(params, aux, mb)
+            else:
+                loss, grads = vg(params, mb)
+            return (loss_acc + loss,
+                    jax.tree_util.tree_map(jnp.add, grad_acc, grads),
+                    aux), None
+
+        # carries must carry the mesh-varying axis the per-microbatch
+        # loss/grads have inside shard_map (see shard_map#scan-vma):
+        # zeros_like(params) inherits it from the sharded params; the
+        # literal scalar loss carry needs an explicit cast
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        loss0 = jax.lax.pcast(jnp.zeros(()), axis, to="varying")
+        (loss_sum, grad_sum, aux), _ = jax.lax.scan(
+            acc_body, (loss0, zeros, aux0), micro)
+        k = float(accum_steps)
+        mean_grads = jax.tree_util.tree_map(lambda g: g / k, grad_sum)
+        return loss_sum / k, mean_grads, aux
+
+    if has_aux:
+        def grads_of(params, mstate, batch):
+            if accum_steps == 1:
+                return vg(params, mstate, batch)
+            loss, grads, ms = scan(params, split(batch), mstate)
+            return (loss, ms), grads
+    else:
+        def grads_of(params, batch):
+            if accum_steps == 1:
+                return vg(params, batch)
+            loss, grads, _ = scan(params, split(batch), ())
+            return loss, grads
+    return grads_of
+
+
 def build_train_step(loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      mesh: Optional[Mesh] = None,
@@ -94,35 +154,7 @@ def build_train_step(loss_fn: Callable,
     if accum_steps < 1:
         raise ValueError("accum_steps must be >= 1")
 
-    def grads_of(params, batch):
-        if accum_steps == 1:
-            return jax.value_and_grad(loss_fn)(params, batch)
-        for leaf in jax.tree_util.tree_leaves(batch):
-            if leaf.shape[0] % accum_steps:
-                raise ValueError(
-                    f"per-lane batch {leaf.shape[0]} not divisible by "
-                    f"accum_steps={accum_steps}")
-        micro = jax.tree_util.tree_map(
-            lambda t: t.reshape((accum_steps, t.shape[0] // accum_steps)
-                                + t.shape[1:]), batch)
-
-        def acc_body(carry, mb):
-            loss_acc, grad_acc = carry
-            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
-            return (loss_acc + loss,
-                    jax.tree_util.tree_map(jnp.add, grad_acc, grads)), None
-
-        # carries must carry the mesh-varying axis the per-microbatch
-        # loss/grads have inside shard_map (see shard_map#scan-vma):
-        # zeros_like(params) inherits it from the sharded params; the
-        # literal scalar loss carry needs an explicit cast
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-        loss0 = jax.lax.pcast(jnp.zeros(()), axis, to="varying")
-        (loss_sum, grad_sum), _ = jax.lax.scan(acc_body, (loss0, zeros),
-                                               micro)
-        k = float(accum_steps)
-        return loss_sum / k, jax.tree_util.tree_map(
-            lambda g: g / k, grad_sum)
+    grads_of = _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=False)
 
     def body(stacked_params, stacked_state, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
@@ -150,22 +182,31 @@ def build_train_step_with_state(loss_fn: Callable,
                                 optimizer: optax.GradientTransformation,
                                 mesh: Optional[Mesh] = None,
                                 sync_model_state: bool = True,
-                                donate: bool = True) -> Callable:
+                                donate: bool = True,
+                                accum_steps: int = 1) -> Callable:
     """Like build_train_step, for models with non-trained state (BatchNorm
     running stats).  ``loss_fn(params, model_state, batch) -> (loss,
     new_model_state)``.  When ``sync_model_state`` is set the new state is
     cross-replica averaged each step (the reference broadcasts BN stats with
-    the rest of the variables on sync points)."""
+    the rest of the variables on sync points).
+
+    ``accum_steps > 1``: gradients accumulate over a microbatch scan as in
+    :func:`build_train_step`; the model state threads through the scan
+    sequentially (each microbatch sees the previous one's BN stats, the
+    same as running the microbatches as separate steps)."""
     mesh = mesh or flat_mesh()
     axis = mesh.axis_names[0]
     spec = _stack_spec(mesh)
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+
+    grads_of = _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=True)
 
     def body(stacked_params, stacked_state, stacked_mstate, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
         state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
         mstate = jax.tree_util.tree_map(lambda t: t[0], stacked_mstate)
-        (loss, new_mstate), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, mstate, batch)
+        (loss, new_mstate), grads = grads_of(params, mstate, batch)
         updates, state = optimizer.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         if sync_model_state:

@@ -144,3 +144,55 @@ def test_noise_scale_on_real_model():
         sp, st, loss = step(sp, st, (x, y))
     ns = np.asarray(st.noise_scale)
     assert np.isfinite(ns).all()
+
+
+def test_resnet_accumulation_matches_sequential_microbatches():
+    """With-state accumulation: grads average over microbatches, BN stats
+    thread sequentially — exactly what running the microbatches by hand
+    produces (single lane; with BatchNorm, microbatching is NOT equal to
+    one big batch, because train-mode BN normalizes per microbatch)."""
+    model = ResNet(stage_sizes=[1], num_classes=4, num_filters=8,
+                   dtype=jnp.float32, small_inputs=True)
+    mesh = flat_mesh(n=1)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 8, 8, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 4, size=8))
+    variables = model.init(jax.random.PRNGKey(0), x[:2])
+    params, bstats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, ms, batch):
+        bx, by = batch
+        logits, upd = model.apply({"params": p, "batch_stats": ms}, bx,
+                                  train=True, mutable=["batch_stats"])
+        return (optax.softmax_cross_entropy_with_integer_labels(
+            logits, by).mean(), upd["batch_stats"])
+
+    # oracle: two sequential microbatches by hand, mean grads, one update
+    ms = bstats
+    grads_sum = None
+    for k in range(2):
+        mb = (x[k * 4:(k + 1) * 4], y[k * 4:(k + 1) * 4])
+        (_, ms), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, ms, mb)
+        grads_sum = g if grads_sum is None else jax.tree_util.tree_map(
+            jnp.add, grads_sum, g)
+    base = optax.sgd(0.1)
+    up, _ = base.update(jax.tree_util.tree_map(lambda t: t / 2, grads_sum),
+                        base.init(params), params)
+    ref_params = optax.apply_updates(params, up)
+
+    opt = kfopt.synchronous_sgd(optax.sgd(0.1))
+    sp = replicate(params, mesh)
+    sms = replicate(bstats, mesh)
+    st = init_opt_state(opt, sp, mesh)
+    step = build_train_step_with_state(loss_fn, opt, mesh, donate=False,
+                                       accum_steps=2)
+    sp2, st2, sms2, loss2 = step(sp, st, sms, (x, y))
+
+    from testutil import tree_allclose
+    tree_allclose(jax.tree_util.tree_map(lambda t: np.asarray(t)[0], sp2),
+                  ref_params)
+    # BN stats equal the oracle's sequentially-threaded result
+    tree_allclose(jax.tree_util.tree_map(lambda t: np.asarray(t)[0], sms2),
+                  ms)
+    assert np.isfinite(float(np.asarray(loss2)[0]))
