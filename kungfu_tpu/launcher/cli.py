@@ -34,7 +34,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-H", dest="hosts", default="",
                    help="host list, e.g. 10.0.0.1:4,10.0.0.2:4")
     p.add_argument("-hostfile", default="", help="hostfile path")
-    p.add_argument("-self", dest="self_host", default="127.0.0.1",
+    p.add_argument("-nic", default="",
+                   help="network interface for self-IP inference; an "
+                        "explicit -self wins over it (reference: "
+                        "kungfu-run -nic)")
+    p.add_argument("-self", dest="self_host", default=None,
                    help="this runner's host address")
     p.add_argument("-strategy", default="AUTO",
                    help="allreduce strategy (STAR|RING|...|AUTO)")
@@ -66,6 +70,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if prog and prog[0] == "--":
         prog = prog[1:]
 
+    # resolve the self host ONCE, ahead of any host-list handling:
+    # explicit -self wins, then -nic inference, else loopback (the
+    # TPU-pod branch below may still refine the loopback default)
+    explicit_self = args.self_host is not None
+    if not explicit_self:
+        if args.nic:
+            from .discovery import infer_self_ipv4
+            args.self_host = infer_self_ipv4(nic=args.nic)
+        else:
+            args.self_host = "127.0.0.1"
+
     if args.hostfile:
         with open(args.hostfile) as f:
             hl = HostList.parse_hostfile(f.read())
@@ -86,7 +101,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         if pod is not None and pod.num_hosts > 1:
             hl = pod.hosts
-            if args.self_host == "127.0.0.1":
+            if not explicit_self and not args.nic:
                 args.self_host = pod.self_host
         else:
             hl = HostList.parse(f"{args.self_host}:{max(args.np, 1)}")

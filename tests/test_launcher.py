@@ -215,3 +215,30 @@ class TestWatcherRegressions:
             w.retry_pending()
         assert w.all_local_done()
         assert w.alive() == 0
+
+
+def test_nic_flag_infers_self_ip(tmp_path):
+    """-nic resolves the self IP before host-list handling (reference:
+    kungfu-run -nic), so it composes with -H; an explicit -self wins over
+    it.  Loopback 'lo' keeps the test hermetic."""
+    script = tmp_path / "w.py"
+    script.write_text("import os; print(os.environ['KFT_SELF_SPEC'])")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    # -nic with -H: the inferred lo address matches the host list
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.launcher", "-nic", "lo",
+         "-H", "127.0.0.1:2", "-np", "2", "--", sys.executable,
+         str(script)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("127.0.0.1:") == 2, out.stdout
+
+    # explicit -self wins: a bogus -nic must never be consulted
+    out = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.launcher", "-self", "127.0.0.1",
+         "-nic", "definitely-not-a-nic0", "-np", "1", "--",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, cwd=repo)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "127.0.0.1:" in out.stdout
