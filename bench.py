@@ -83,7 +83,7 @@ def main():
     sp = replicate(params, mesh)
     sms = replicate(bstats, mesh)
     st = init_opt_state(opt, sp, mesh)
-    step = build_train_step_with_state(loss_fn, opt, mesh, donate=False)
+    step = build_train_step_with_state(loss_fn, opt, mesh, donate=True)
 
     # NOTE: under remote-tunnelled TPU runtimes block_until_ready may not
     # actually block; fetching the loss scalar to host is the reliable sync.

@@ -40,8 +40,13 @@ def parse_args(argv=None):
     p.add_argument("--warmup-steps", type=int, default=3)
     p.add_argument("--rope", action="store_true")
     p.add_argument("--swiglu", action="store_true")
-    p.add_argument("--remat", action="store_true",
-                   help="per-layer rematerialization")
+    p.add_argument("--remat", nargs="?", const="full", default="",
+                   choices=["", "none", "full", "attn", "ffn"],
+                   help="per-layer rematerialization: 'full' saves only "
+                        "each block's input; 'attn' additionally saves "
+                        "the attention output so the backward never "
+                        "re-runs the flash kernel; 'ffn' recomputes only "
+                        "the norm+FFN sub-block")
     p.add_argument("--attn", default="auto",
                    help="auto | flash | dense")
     p.add_argument("--f32", action="store_true",
@@ -97,8 +102,8 @@ def main(argv=None) -> int:
     n_params = param_count(params)
 
     if args.decode:
-        if (args.attn != "auto" or args.remat or args.chunked_ce
-                or args.accum != 1):
+        if (args.attn != "auto" or args.remat not in ("", "none")
+                or args.chunked_ce or args.accum != 1):
             raise SystemExit("--attn/--remat/--chunked-ce/--accum apply to "
                              "training "
                              "only; the decode loop always runs dense "
@@ -119,7 +124,11 @@ def main(argv=None) -> int:
             bt, by = batch
             feats = forward_features(p, bt, cfg, attn=args.attn,
                                      remat=args.remat)
-            return chunked_cross_entropy(feats, p["lm_head"], by,
+            # head in the model dtype: bf16 x bf16 chunk matmuls hit the
+            # fast MXU path (f32 accumulation via preferred_element_type
+            # inside the op); the f32 master weight stays in params
+            head = p["lm_head"].astype(cfg.dtype)
+            return chunked_cross_entropy(feats, head, by,
                                          args.chunked_ce).mean()
     else:
         def loss_fn(p, batch):
@@ -132,8 +141,9 @@ def main(argv=None) -> int:
     opt = kfopt.synchronous_sgd(optax.adamw(3e-4))
     sp = replicate(params, mesh)
     st = init_opt_state(opt, sp, mesh)
-    step = build_train_step(loss_fn, opt, mesh, donate=False,
-                            accum_steps=args.accum)
+    step = build_train_step(loss_fn, opt, mesh, donate=True,
+                            accum_steps=args.accum,
+                            compute_dtype=None if args.f32 else cfg.dtype)
 
     for _ in range(args.warmup_steps):
         sp, st, loss = step(sp, st, (toks, tgts))

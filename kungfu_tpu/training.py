@@ -98,14 +98,22 @@ def _accum_grads_fn(loss_fn: Callable, axis: str, accum_steps: int,
             else:
                 loss, grads = vg(params, mb)
             return (loss_acc + loss,
-                    jax.tree_util.tree_map(jnp.add, grad_acc, grads),
+                    jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(a.dtype), grad_acc, grads),
                     aux), None
 
         # carries must carry the mesh-varying axis the per-microbatch
         # loss/grads have inside shard_map (see shard_map#scan-vma):
         # zeros_like(params) inherits it from the sharded params; the
-        # literal scalar loss carry needs an explicit cast
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # literal scalar loss carry needs an explicit cast.  The gradient
+        # accumulator is ALWAYS f32 — with bf16 compute params, summing
+        # microbatch grads in bf16 would truncate contributions once the
+        # running sum outgrows them (8-bit mantissa)
+        zeros = jax.tree_util.tree_map(
+            lambda t: jnp.zeros_like(
+                t, dtype=jnp.float32
+                if jnp.issubdtype(t.dtype, jnp.floating) else None),
+            params)
         loss0 = jax.lax.pcast(jnp.zeros(()), axis, to="varying")
         (loss_sum, grad_sum, aux), _ = jax.lax.scan(
             acc_body, (loss0, zeros, aux0), micro)
@@ -128,11 +136,19 @@ def _accum_grads_fn(loss_fn: Callable, axis: str, accum_steps: int,
     return grads_of
 
 
+def _cast_params(params, dtype):
+    """f32 leaves -> ``dtype`` (non-float leaves untouched)."""
+    return jax.tree_util.tree_map(
+        lambda t: t.astype(dtype)
+        if jnp.issubdtype(t.dtype, jnp.floating) else t, params)
+
+
 def build_train_step(loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      mesh: Optional[Mesh] = None,
                      donate: bool = True,
-                     accum_steps: int = 1) -> Callable:
+                     accum_steps: int = 1,
+                     compute_dtype=None) -> Callable:
     """Compile a distributed train step.
 
     ``loss_fn(params, batch) -> scalar``.  The returned function has
@@ -147,6 +163,13 @@ def build_train_step(loss_fn: Callable,
     a ``lax.scan`` (activation memory = one microbatch), and the optimizer
     — and therefore the gradient allreduce — runs ONCE on the mean.  The
     trajectory equals a single big-batch step.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``): mixed-precision master
+    weights — f32 params are cast ONCE per step, the loss/grads run in
+    that dtype (the model's own per-use ``astype`` becomes a no-op), and
+    the f32 master is updated with upcast gradients.  Without it, a model
+    that casts weights inline re-pays the f32 read + cast on every
+    microbatch of the accumulation scan.
     """
     mesh = mesh or flat_mesh()
     axis = mesh.axis_names[0]
@@ -159,7 +182,13 @@ def build_train_step(loss_fn: Callable,
     def body(stacked_params, stacked_state, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
         state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
-        loss, grads = grads_of(params, batch)
+        if compute_dtype is not None:
+            cp = _cast_params(params, compute_dtype)
+            loss, grads = grads_of(cp, batch)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), grads, params)
+        else:
+            loss, grads = grads_of(params, batch)
         updates, state = optimizer.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         mean_loss = jax.lax.pmean(loss, axis)

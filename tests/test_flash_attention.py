@@ -100,3 +100,47 @@ def test_flash_rejects_unpaddable_sequence():
     q, k, v = _qkv(T=100)
     with pytest.raises(ValueError, match="no block divisor"):
         flash_attention(q, k, v, False, 64, 64)
+
+
+@pytest.mark.parametrize("kv_groups", [2, 4])
+def test_flash_gqa_compact_kv_gradients(kv_groups):
+    """kv_groups>1: k/v enter COMPACT and expand inside the VJP; the
+    compact k/v gradient must equal the group-sum of the expanded-input
+    gradient (the adjoint of the repeat)."""
+    B, T, H, D = 2, 32, 4, 16
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    kc = jnp.asarray(rng.randn(B, T, H // kv_groups, D).astype(np.float32))
+    vc = jnp.asarray(rng.randn(B, T, H // kv_groups, D).astype(np.float32))
+
+    def loss_compact(q, kc, vc):
+        return jnp.sum(flash_attention(q, kc, vc, True, 16, 16,
+                                       kv_groups=kv_groups) ** 2)
+
+    def loss_expanded(q, ke, ve):
+        return jnp.sum(reference_attention(q, ke, ve, causal=True) ** 2)
+
+    expand = lambda t: jnp.repeat(t, kv_groups, axis=2)
+    gq, gk, gv = jax.grad(loss_compact, argnums=(0, 1, 2))(q, kc, vc)
+    eq, ek, ev = jax.grad(loss_expanded, argnums=(0, 1, 2))(
+        q, expand(kc), expand(vc))
+    compact = lambda t: t.reshape(B, T, H // kv_groups, kv_groups, D).sum(3)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(eq),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(compact(ek)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(compact(ev)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gqa_forward_matches_expanded():
+    B, T, H, D, g = 2, 32, 4, 16, 2
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    kc = jnp.asarray(rng.randn(B, T, H // g, D).astype(np.float32))
+    vc = jnp.asarray(rng.randn(B, T, H // g, D).astype(np.float32))
+    got = flash_attention(q, kc, vc, True, 16, 16, kv_groups=g)
+    want = reference_attention(q, jnp.repeat(kc, g, axis=2),
+                               jnp.repeat(vc, g, axis=2), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

@@ -223,3 +223,37 @@ def test_gradient_accumulation_rejects_bad_split():
     x = jnp.zeros((16, 4))  # 4 rows/lane, not divisible by 3
     with pytest.raises(ValueError, match="not divisible"):
         step(sp, st, (x, jnp.zeros((16, 2))))
+
+
+def test_compute_dtype_master_weights_accumulate_f32():
+    """compute_dtype=bf16: params cast once per step, grads accumulated
+    in f32 across microbatches, f32 master updated — the result stays
+    close to the all-f32 trajectory (bf16 forward noise only), and the
+    master params remain f32."""
+    n = 2
+    mesh = flat_mesh(n=n)
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.randn(8, 2).astype(np.float32))}
+    x = rng.randn(4 * n * 4, 8).astype(np.float32)
+    y = rng.randn(4 * n * 4, 2).astype(np.float32)
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((bx.astype(p["w"].dtype) @ p["w"] - by.astype(
+            p["w"].dtype)).astype(jnp.float32) ** 2)
+
+    opt = kfopt.synchronous_sgd(optax.sgd(0.05))
+
+    def run(compute_dtype):
+        sp = replicate(params, mesh)
+        st = init_opt_state(opt, sp, mesh)
+        step = build_train_step(loss_fn, opt, mesh, donate=False,
+                                accum_steps=4, compute_dtype=compute_dtype)
+        for _ in range(3):
+            sp, st, loss = step(sp, st, (jnp.asarray(x), jnp.asarray(y)))
+        return jax.tree_util.tree_map(lambda t: np.asarray(t)[0], sp)
+
+    got = run(jnp.bfloat16)
+    ref = run(None)
+    assert got["w"].dtype == np.float32  # master stays f32
+    np.testing.assert_allclose(got["w"], ref["w"], rtol=2e-2, atol=2e-2)

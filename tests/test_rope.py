@@ -90,7 +90,7 @@ def test_llama_style_full_stack(devices):
     cfg = _cfg(n_kv_heads=2, mlp="swiglu")
     tokens, targets = _data(cfg)
     params = G.init_params(jax.random.PRNGKey(5), cfg)
-    assert params["layers"][0]["wi"].shape == (16, 32, 2)
+    assert params["layers"][0]["wi"].shape == (16, 2, 32)
     ref = float(G.loss_fn(params, tokens, targets, cfg))
 
     mesh = T3.mesh_3d(2, 2, 2, devices)
