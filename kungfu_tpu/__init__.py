@@ -162,8 +162,13 @@ def uid() -> str:
     (reference: peer.go:121-125 UID, exposed via python/__init__.py uid)."""
     we = _worker_env()
     if we.singleton:
+        import os as _os
+
         import jax
-        return f"localhost:0:{jax.process_index()}"
+        # pid disambiguates concurrent single-process runs on one host —
+        # the reference's host:port:initVersion triple is unique because
+        # port is; singleton mode has no port, so borrow the pid
+        return f"localhost:{_os.getpid()}:{jax.process_index()}"
     p = we.self_spec
     return f"{p.host}:{p.port}:{we.cluster_version}"
 

@@ -188,8 +188,9 @@ class Session:
     def record(self, name: str, nbytes: int, seconds: float) -> None:
         """Feed one sample into the named throughput stat — used by the
         eager collectives and by monitor.StepMonitor around jitted steps."""
-        stat = self._stats.setdefault(name, StrategyStat())
-        stat.update(nbytes, seconds)
+        with self._lock:
+            stat = self._stats.setdefault(name, StrategyStat())
+            stat.update(nbytes, seconds)
 
     def wire_algorithm(self) -> str:
         """The on-wire cost family of the current strategy (for
@@ -343,13 +344,22 @@ class Session:
         if x.shape[0] != self.n:
             raise ValueError("consensus input must be peer-stacked")
         v = x.reshape(self.n, -1)
-        if not jnp.issubdtype(v.dtype, jnp.floating):
-            v = v.astype(jnp.float32)
+        # BIT-exact comparison (the reference compares bytes,
+        # session.go:120-151): floats are bitcast to same-width unsigned
+        # ints — a float cast would alias int values differing only past
+        # the mantissa (e.g. int32 at 2^25) and miss -0.0 vs +0.0 or NaN
+        # payload divergence
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            bits = jnp.finfo(v.dtype).bits
+            v = jax.lax.bitcast_convert_type(
+                v, jnp.dtype(f"uint{bits}"))
+        elif v.dtype == jnp.bool_:
+            v = v.astype(jnp.uint8)
 
         def body(t):
             mn = C.all_reduce(t, self.axis, "MIN")
             mx = C.all_reduce(t, self.axis, "MAX")
-            return jnp.all(mn == mx).astype(jnp.float32).reshape(1, 1) * jnp.ones((1, 1), v.dtype)
+            return jnp.all(mn == mx).astype(jnp.float32).reshape(1, 1)
 
         fn = self._shard_fn(body, ("consensus", v.shape, str(v.dtype)))
         out = fn(v)
@@ -377,16 +387,20 @@ class Session:
 
     # ------------------------------------------------------------ monitoring
     def stats(self) -> Dict[str, StrategyStat]:
-        return dict(self._stats)
+        with self._lock:
+            return dict(self._stats)
 
     def calc_stats(self) -> Dict[str, float]:
         """Throughput per named op window (reference:
         adaptiveStrategies.go CalcStats)."""
-        return {k: s.throughput for k, s in self._stats.items()}
+        with self._lock:
+            return {k: s.throughput for k, s in self._stats.items()}
 
     def log_stats(self) -> str:
-        lines = [f"{k}: {s.throughput / 1e9:.3f} GiB/s over {s.count} ops"
-                 for k, s in self._stats.items()]
+        with self._lock:
+            lines = [f"{k}: {s.throughput / 1e9:.3f} GiB/s over "
+                     f"{s.count} ops"
+                     for k, s in self._stats.items()]
         return "\n".join(lines)
 
     def check_interference(self, threshold: float = 0.8) -> bool:
@@ -394,6 +408,10 @@ class Session:
         rate (reference: adaptiveStrategies.go:61-121 CheckInterference).
         Windows with no traffic are skipped — an idle period is not
         interference."""
+        with self._lock:
+            return self._check_interference_locked(threshold)
+
+    def _check_interference_locked(self, threshold: float) -> bool:
         for s in self._stats.values():
             if (s.count and s.reference_rate
                     and s.throughput < threshold * s.reference_rate):
@@ -419,39 +437,50 @@ class Session:
           revisiting one) and start fresh windows + references.
 
         Returns True when a switch happened.
+
+        NOTE (monitor-fed stats around JITTED steps): ``set_strategy``
+        changes the session's eager/graph collectives only — a compiled
+        train step's in-XLA psum schedule is fixed at compile time, so
+        for StepMonitor-fed stats a "switch" re-baselines the windows but
+        cannot reroute the compiled program.  Plumb the returned True
+        into a step-rebuild (recompile) callback when the compiled path
+        should follow the strategy change.
         """
-        if not self.check_interference(threshold):
-            # healthy (or idle) window: fold it into the baseline and roll.
-            # EMA rather than best-ever keeps the reference tracking the
-            # CURRENT healthy rate, so ordinary load variance does not
-            # creep toward spurious interference verdicts
-            for s in self._stats.values():
-                if s.count:
-                    tp = s.throughput
-                    s.reference_rate = (tp if s.reference_rate is None else
-                                        0.8 * s.reference_rate + 0.2 * tp)
+        with self._lock:
+            if not self._check_interference_locked(threshold):
+                # healthy (or idle) window: fold it into the baseline and
+                # roll.  EMA rather than best-ever keeps the reference
+                # tracking the CURRENT healthy rate, so ordinary load
+                # variance does not creep toward spurious verdicts
+                for s in self._stats.values():
+                    if s.count:
+                        tp = s.throughput
+                        s.reference_rate = (
+                            tp if s.reference_rate is None else
+                            0.8 * s.reference_rate + 0.2 * tp)
+                        s.reset_window()
+                return False
+            order = list(fallbacks) if fallbacks is not None else [
+                Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
+            cur = self.strategy
+            nxt = None
+            for k in range(len(order)):
+                cand = order[(self._adapt_idx + k) % len(order)]
+                if cand != cur:
+                    nxt = cand
+                    self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
+                    break
+            if nxt is None:
+                # no alternative to switch to: still roll the window so
+                # the degraded sample doesn't wedge later verdicts
+                for s in self._stats.values():
                     s.reset_window()
-            return False
-        order = list(fallbacks) if fallbacks is not None else [
-            Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
-        cur = self.strategy
-        nxt = None
-        for k in range(len(order)):
-            cand = order[(self._adapt_idx + k) % len(order)]
-            if cand != cur:
-                nxt = cand
-                self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
-                break
-        if nxt is None:
-            # no alternative to switch to: still roll the window so the
-            # degraded sample doesn't wedge every later period's verdict
+                return False
+        self.set_strategy(nxt)  # takes the lock itself
+        with self._lock:
             for s in self._stats.values():
+                # fresh start: the new strategy must earn its own
+                # reference rate, not inherit the degraded one
+                s.reference_rate = None
                 s.reset_window()
-            return False
-        self.set_strategy(nxt)
-        for s in self._stats.values():
-            # fresh start: the new strategy must earn its own reference
-            # rate, not inherit the degraded one that triggered the switch
-            s.reference_rate = None
-            s.reset_window()
         return True

@@ -138,15 +138,25 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     # reference runner likewise takes Stage{version} from the server)
     version0 = 0
     if config_url:
+        import json as _json
+        import urllib.error
         for _ in range(10):  # brief retry: server may be starting up
             try:
                 version0, initial = fetch_config(config_url)
                 break
-            except Exception:
+            except (urllib.error.URLError, OSError, ValueError,
+                    KeyError, _json.JSONDecodeError) as e:
+                # expected while the server boots (conn refused) or
+                # before any PUT (404); anything else should surface
+                last_err = e
                 time.sleep(0.2)
-        # still unseeded: spawn from the provided cluster at version 0; a
-        # later PUT of the same cluster costs the workers one benign
-        # in-process rebuild (resize_from_url), nothing more
+        else:
+            # still unseeded: spawn from the provided cluster at version
+            # 0; a later PUT of the same cluster costs the workers one
+            # benign in-process rebuild (resize_from_url), nothing more.
+            # Logged so a persistently broken server isn't silent.
+            print(f"kft-run: config server {config_url} unreadable "
+                  f"({last_err}); starting at version 0", flush=True)
     w.update(version0, initial)
     global_size = initial.size()
     while True:

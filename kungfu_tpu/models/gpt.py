@@ -219,9 +219,11 @@ def _layer_qkv(layer, x, cfg: GPTConfig, pos=None):
 
 def _expand_kv(t, cfg: GPTConfig):
     """[B, T, kv_heads(/tp), Dh] -> [B, T, n_heads(/tp), Dh]: each KV
-    head serves kv_groups query heads."""
-    g = cfg.kv_groups
-    return t if g == 1 else jnp.repeat(t, g, axis=2)
+    head serves kv_groups query heads (single definition shared with the
+    flash kernel's VJP so the repeat layout and its adjoint never
+    drift)."""
+    from ..ops.flash_attention import _expand_kv_heads
+    return _expand_kv_heads(t, cfg.kv_groups)
 
 
 def _dense_ffn(layer, h, cfg: GPTConfig, tp_axis: Optional[str] = None):
@@ -290,9 +292,10 @@ def _attend(q, kk, v, attn: str, sp_axis: Optional[str],
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, kk, v, causal=True, kv_groups=kv_groups)
     if attn == "dense":
-        expand = (lambda t: t) if kv_groups == 1 else (
-            lambda t: jnp.repeat(t, kv_groups, axis=2))
-        return reference_attention(q, expand(kk), expand(v), causal=True)
+        from ..ops.flash_attention import _expand_kv_heads
+        return reference_attention(q, _expand_kv_heads(kk, kv_groups),
+                                   _expand_kv_heads(v, kv_groups),
+                                   causal=True)
     raise ValueError(f"unknown attention mode {attn!r}")
 
 

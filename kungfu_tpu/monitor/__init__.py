@@ -147,6 +147,16 @@ class StepMonitor:
                 params, state, loss = step(params, state, batch)
                 np.asarray(loss)   # host sync inside the timed region
             session.auto_adapt()   # once per monitoring period
+
+    CAVEAT: for a jitted step, ``auto_adapt``'s strategy switch changes
+    only the session's eager/graph collectives — the compiled step's
+    in-XLA psum schedule is fixed at compile time, so the "switch"
+    re-baselines the monitoring windows without rerouting the step.  To
+    make the compiled path follow, rebuild the step when ``auto_adapt``
+    returns True (recompile picks up e.g. a new hierarchical mesh)::
+
+        if session.auto_adapt():
+            step = build_train_step(loss_fn, opt, session.mesh)
     """
 
     def __init__(self, session, name: str = "train_step", nbytes: int = 0):

@@ -142,6 +142,7 @@ class NativePeer:
         self._peers = list(peers)
         self._forest_cache = {}
         self._pool = None
+        self._pool_lock = threading.Lock()
         self._metrics_server = None
         self._metrics_provider = None
 
@@ -205,13 +206,16 @@ class NativePeer:
 
     def _stripe_pool(self):
         """Shared executor for concurrent chunk stripes (capped; created
-        once per peer rather than per call)."""
-        if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._pool = ThreadPoolExecutor(
-                max_workers=min(16, max(2, self.size)),
-                thread_name_prefix="kft-stripe")
-        return self._pool
+        once per peer rather than per call).  Created under a lock — two
+        threads racing the lazy init would each build a pool and leak one
+        (its threads live until process exit)."""
+        with self._pool_lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(16, max(2, self.size)),
+                    thread_name_prefix="kft-stripe")
+            return self._pool
 
     def _strategy_forests(self, strategy: str):
         """Lower a host-structured strategy to reduce-forest father arrays
@@ -409,6 +413,16 @@ def resize_from_url(timeout: float = 5.0):
         if p is not None and version <= p.token:
             return changed, False
         specs = [f"{w.host}:{w.port}" for w in cluster.workers]
+        if p is not None:
+            # digest-consensus loop on the OLD membership before anyone
+            # rebuilds (reference: peer.go:238-255): two quick PUTs can
+            # leave peers holding different versions of the config — a
+            # peer that rebuilt at v1 while others went to v2 is fenced
+            # off by their new token and deadlocks.  Re-fetch until every
+            # old-membership peer holds the same (version, cluster).
+            payload = (f"{version}:{','.join(specs)}").encode()
+            if not p.consensus(payload, name=f"resize-digest@{p.token}"):
+                continue
         if me not in specs:
             use_peer(None)  # uninstall BEFORE close: no NULL-handle default
             if p is not None:
@@ -429,11 +443,19 @@ def resize_from_url(timeout: float = 5.0):
         _maybe_start_metrics(newp, we.self_spec.port)
         use_peer(newp)
         changed = True
-        # re-fetch before returning: a further resize may have landed
-        # while we rebuilt — a peer acting on this stale membership would
-        # rendezvous with nobody (peers fence on token = version).  No
-        # explicit barrier otherwise: the next collective rendezvouses
-        # the membership (connection retries cover peers still rebuilding).
+        # deterministic fence: barrier on the NEW membership before
+        # reporting the resize (reference barriers after every session
+        # rebuild, peer.go:160).  Connection retries absorb peers still
+        # rebuilding.  Self-healing: if the barrier fails (a peer raced
+        # to a later version and fences this token), tear down and
+        # re-fetch rather than crashing the worker — the loop converges
+        # on the final version.
+        try:
+            newp.barrier(name=f"resize:{version}")
+        except NativeError:
+            use_peer(None)
+            newp.close()
+            continue
 
 
 def use_peer(p: Optional[NativePeer]) -> None:
@@ -463,6 +485,30 @@ def default_peer() -> Optional[NativePeer]:
     _default_peer = NativePeer(we.rank(), peers,
                                token=we.cluster_version).start()
     _maybe_start_metrics(_default_peer, we.self_spec.port)
+    # every peer barriers at its cluster version on startup (reference:
+    # Peer.Start -> Update -> Barrier, peer.go:87-104,160) — this is the
+    # partner rendezvous for the post-rebuild barrier in resize_from_url:
+    # a freshly spawned worker at version v meets the survivors that just
+    # rebuilt at v.  NOTE: this makes the first default_peer() call a
+    # collective — every member of the cluster must construct its peer
+    # (the reference's Peer.Start is likewise a rendezvous).  Retries
+    # cover partners that poll their resize loop slowly; set
+    # KFT_CONFIG_STARTUP_BARRIER=0 to opt out (the next collective then
+    # performs the rendezvous instead).
+    if os.environ.get("KFT_CONFIG_STARTUP_BARRIER", "1") != "0":
+        last = None
+        for _ in range(3):
+            try:
+                _default_peer.barrier(name=f"resize:{we.cluster_version}")
+                break
+            except NativeError as e:
+                last = e
+        else:
+            p, _default_peer = _default_peer, None
+            p.close()
+            raise NativeError(
+                f"startup barrier at version {we.cluster_version} never "
+                f"completed (partners unreachable): {last}")
     return _default_peer
 
 

@@ -118,7 +118,11 @@ def _accum_grads_fn(loss_fn: Callable, axis: str, accum_steps: int,
         (loss_sum, grad_sum, aux), _ = jax.lax.scan(
             acc_body, (loss0, zeros, aux0), micro)
         k = float(accum_steps)
-        mean_grads = jax.tree_util.tree_map(lambda g: g / k, grad_sum)
+        # cast the f32-accumulated mean back to each param's dtype so the
+        # accum path hands the optimizer the same grad dtypes as the
+        # accum_steps=1 path (one rounding at the end, not k along the way)
+        mean_grads = jax.tree_util.tree_map(
+            lambda g, p: (g / k).astype(p.dtype), grad_sum, params)
         return loss_sum / k, mean_grads, aux
 
     if has_aux:

@@ -264,3 +264,26 @@ class TestHierarchicalScopes:
         x = np.asarray([[5.], [9.], [2.], [7.]], np.float32)
         out = np.asarray(self.sess.local_reduce(x, op="MAX"))
         np.testing.assert_allclose(out[:, 0], [9, 0, 7, 0])
+
+
+def test_consensus_is_bit_exact_for_ints():
+    """int32 values differing only beyond the f32 mantissa (2^25) must
+    NOT alias equal — the check is bit-exact (reference compares bytes,
+    session.go:120-151)."""
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    base = np.full((n, 3), 1 << 25, dtype=np.int32)
+    assert sess.consensus(base)
+    diff = base.copy()
+    diff[1, 0] += 1  # f32 rounds 2^25 and 2^25+1 to the same value
+    assert not sess.consensus(diff)
+
+
+def test_consensus_float_bit_exactness():
+    n = 4
+    sess = Session(peers=make_peers(n), mesh=flat_mesh(n=n))
+    same = np.ones((n, 2), dtype=np.float32)
+    assert sess.consensus(same)
+    zeros = np.zeros((n, 2), dtype=np.float32)
+    zeros[2, 1] = -0.0  # bitwise different, == equal
+    assert not sess.consensus(zeros)

@@ -19,8 +19,11 @@ def make_peers(n):
 
 
 def test_uid_singleton(monkeypatch):
+    import os
     monkeypatch.delenv(E.SELF_SPEC, raising=False)
-    assert kf.uid() == "localhost:0:0"
+    # pid disambiguates concurrent singleton runs on one host (the
+    # reference's uniqueness comes from the port; singleton has none)
+    assert kf.uid() == f"localhost:{os.getpid()}:0"
 
 
 def test_uid_worker(monkeypatch):
