@@ -196,14 +196,22 @@ def propose_new_size(new_size: int) -> bool:
         return False
 
 
-def check_interference(threshold: float = 0.8) -> bool:
-    """Interference check: any monitored collective's throughput below
-    ``threshold`` x its reference rate (reference: python/__init__.py
-    check_interference, session/adaptiveStrategies.go:61-121).  In the
-    single-controller lane model the controller's view already IS the
-    cluster view, so the reference's cross-peer majority vote reduces to
-    this local threshold test."""
-    return _ensure_session().check_interference(threshold)
+def check_interference(threshold: float = 0.8, vote: bool = False) -> bool:
+    """Interference check (reference: python/__init__.py
+    check_interference, session/adaptiveStrategies.go:61-121).
+
+    Default: the LOCAL threshold test — any monitored collective's
+    throughput below ``threshold`` x its reference rate.  Safe to call
+    from any single process (logging, dashboards).
+
+    ``vote=True`` (multi-controller jobs): cluster-wide MAJORITY vote
+    over the host plane — more than half the processes must observe
+    interference, so one slow process cannot flip the whole cluster.
+    This is a COLLECTIVE: every process must make the matching call."""
+    s = _ensure_session()
+    if vote:
+        return s.check_interference_global(threshold)
+    return s.check_interference(threshold)
 
 
 def calc_stats():

@@ -61,6 +61,20 @@ class StrategyStat:
         self.count = 0
 
 
+def _host_peer():
+    """The process's installed host-plane NativePeer, or None.
+
+    Deliberately does NOT construct one (default_peer() performs a
+    cluster-wide startup rendezvous); fenced adaptation falls back to
+    local behavior until the worker has brought up its native runtime.
+    """
+    try:
+        from ..native import installed_peer
+        return installed_peer()
+    except Exception:
+        return None
+
+
 def _reduce_over(stacked, mask, op: str):
     """Reduce ``stacked`` [n, ...] over the lanes selected by ``mask``."""
     m = jnp.reshape(mask, (-1,) + (1,) * (stacked.ndim - 1))
@@ -145,6 +159,68 @@ class Session:
             self.strategy = None  # custom
             self._pairs = [GraphPair(g, g.reverse())]
             self._fn_cache.clear()
+
+    # ----------------------------------------- multi-controller fencing
+    def _fence_install(self, peer, payload: bytes, install) -> bool:
+        """Barrier + digest consensus + install + barrier (reference:
+        adaptation.go:8-28 SetGlobalStrategy fencing).  ``peer`` is the
+        host-plane NativePeer shared by all controller processes; the
+        consensus verdict is collective, so either every process installs
+        or none does — two controllers can never compile divergent
+        topologies and deadlock the next collective."""
+        peer.barrier(name="kft-adapt")
+        if not peer.consensus(payload, name="kft-adapt-digest"):
+            return False
+        install()
+        peer.barrier(name="kft-adapt-done")
+        return True
+
+    def set_strategy_fenced(self, strategy: Strategy, peer=None) -> bool:
+        """Consensus-fenced strategy switch across controller processes.
+
+        Every process must call this collectively with its proposal; the
+        switch happens atomically everywhere iff all proposals agree
+        (returns True).  With no host-plane peer (single controller) it
+        degenerates to a plain :meth:`set_strategy`.
+        """
+        peer = peer if peer is not None else _host_peer()
+        if peer is None or peer.size <= 1:
+            self.set_strategy(strategy)
+            return True
+        payload = f"strategy:{getattr(strategy, 'name', strategy)}".encode()
+        return self._fence_install(peer, payload,
+                                   lambda: self.set_strategy(strategy))
+
+    def set_tree_fenced(self, father: Sequence[int], peer=None) -> bool:
+        """Consensus-fenced :meth:`set_tree` (reference:
+        SimpleSetGlobalStrategy under the same adaptation fence)."""
+        peer = peer if peer is not None else _host_peer()
+        if peer is None or peer.size <= 1:
+            self.set_tree(father)
+            return True
+        payload = b"tree:" + np.asarray(list(father),
+                                        dtype=np.int32).tobytes()
+        return self._fence_install(peer, payload,
+                                   lambda: self.set_tree(father))
+
+    def check_interference_global(self, threshold: float = 0.8,
+                                  peer=None) -> bool:
+        """Cluster-wide MAJORITY vote on interference (reference:
+        adaptiveStrategies.go:61-121 — one slow peer must not flip the
+        whole cluster's topology; more than half must agree).
+
+        Collective over the host plane: every controller process calls
+        this at its monitoring period; the summed vote is identical on
+        all of them, so the verdict is too.  Falls back to the local
+        check when there is no host-plane peer."""
+        local = self.check_interference(threshold)
+        peer = peer if peer is not None else _host_peer()
+        if peer is None or peer.size <= 1:
+            return local
+        votes = peer.all_reduce(
+            np.asarray([1.0 if local else 0.0], np.float32),
+            op="SUM", name="kft-interference-vote")
+        return float(votes[0]) * 2 > peer.size
 
     def adapt_tree_from_latencies(self, latency_matrix, root: int = 0) -> List[int]:
         """Install the minimum-latency spanning tree as the collective
@@ -419,7 +495,8 @@ class Session:
         return False
 
     def auto_adapt(self, threshold: float = 0.8,
-                   fallbacks: Optional[Sequence[Strategy]] = None) -> bool:
+                   fallbacks: Optional[Sequence[Strategy]] = None,
+                   fenced: bool = False, peer=None) -> bool:
         """Close the reference's monitor→adapt loop in one call
         (reference flow: CheckInterference vote → SetGlobalStrategy,
         adaptiveStrategies.go + adaptation.go).  Call between steps (e.g.
@@ -445,42 +522,88 @@ class Session:
         cannot reroute the compiled program.  Plumb the returned True
         into a step-rebuild (recompile) callback when the compiled path
         should follow the strategy change.
+
+        ``fenced=True`` (multi-controller jobs): the interference check
+        becomes a cluster-wide MAJORITY vote and the switch is wrapped in
+        barrier + digest consensus over the host plane, so every process
+        either switches to the same topology or none does (reference:
+        adaptiveStrategies.go vote + adaptation.go fencing).  All
+        processes must then call auto_adapt collectively each period —
+        every process reaches the fence on an interference verdict, even
+        one with no candidate strategy, so a divergently-configured
+        process cannot strand the others in the barrier.
         """
+        if not fenced:
+            # single-controller: verdict and window-fold stay atomic
+            # under ONE lock acquisition — a degraded sample landing
+            # between an unlocked check and the fold would poison the
+            # EMA baseline
+            with self._lock:
+                if not self._check_interference_locked(threshold):
+                    self._fold_healthy_locked()
+                    return False
+                nxt = self._pick_next_locked(fallbacks)
+                if nxt is None:
+                    return False
+            self.set_strategy(nxt)  # takes the lock itself
+            self._reset_references()
+            return True
+
+        fence_peer = peer if peer is not None else _host_peer()
+        if fence_peer is None or fence_peer.size <= 1:
+            return self.auto_adapt(threshold, fallbacks)  # degenerate
+        if not self.check_interference_global(threshold, fence_peer):
+            with self._lock:
+                self._fold_healthy_locked()
+            return False
         with self._lock:
-            if not self._check_interference_locked(threshold):
-                # healthy (or idle) window: fold it into the baseline and
-                # roll.  EMA rather than best-ever keeps the reference
-                # tracking the CURRENT healthy rate, so ordinary load
-                # variance does not creep toward spurious verdicts
-                for s in self._stats.values():
-                    if s.count:
-                        tp = s.throughput
-                        s.reference_rate = (
-                            tp if s.reference_rate is None else
-                            0.8 * s.reference_rate + 0.2 * tp)
-                        s.reset_window()
-                return False
-            order = list(fallbacks) if fallbacks is not None else [
-                Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
-            cur = self.strategy
-            nxt = None
-            for k in range(len(order)):
-                cand = order[(self._adapt_idx + k) % len(order)]
-                if cand != cur:
-                    nxt = cand
-                    self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
-                    break
-            if nxt is None:
-                # no alternative to switch to: still roll the window so
-                # the degraded sample doesn't wedge later verdicts
-                for s in self._stats.values():
-                    s.reset_window()
-                return False
-        self.set_strategy(nxt)  # takes the lock itself
+            nxt = self._pick_next_locked(fallbacks)
+        # ALWAYS reach the fence after a (collective, hence uniform)
+        # interference verdict: a process with no candidate proposes
+        # "none"; agreement on "none" aborts everywhere, disagreement
+        # fails consensus everywhere — nobody is left waiting
+        payload = f"strategy:{getattr(nxt, 'name', nxt)}".encode()
+        ok = self._fence_install(
+            fence_peer, payload,
+            (lambda: self.set_strategy(nxt)) if nxt is not None
+            else (lambda: None))
+        if not ok or nxt is None:
+            return False
+        self._reset_references()
+        return True
+
+    def _fold_healthy_locked(self) -> None:
+        """Healthy (or idle) window: fold it into the baseline and roll.
+        EMA rather than best-ever keeps the reference tracking the
+        CURRENT healthy rate, so ordinary load variance does not creep
+        toward spurious interference verdicts."""
+        for s in self._stats.values():
+            if s.count:
+                tp = s.throughput
+                s.reference_rate = (tp if s.reference_rate is None else
+                                    0.8 * s.reference_rate + 0.2 * tp)
+                s.reset_window()
+
+    def _pick_next_locked(self, fallbacks) -> Optional[Strategy]:
+        """Rotate the fallback cursor to the next strategy != current;
+        None when there is no alternative (windows still rolled so the
+        degraded sample doesn't wedge every later verdict)."""
+        order = list(fallbacks) if fallbacks is not None else [
+            Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
+        cur = self.strategy
+        for k in range(len(order)):
+            cand = order[(self._adapt_idx + k) % len(order)]
+            if cand != cur:
+                self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
+                return cand
+        for s in self._stats.values():
+            s.reset_window()
+        return None
+
+    def _reset_references(self) -> None:
         with self._lock:
             for s in self._stats.values():
                 # fresh start: the new strategy must earn its own
                 # reference rate, not inherit the degraded one
                 s.reference_rate = None
                 s.reset_window()
-        return True

@@ -1,0 +1,116 @@
+"""Multi-process consensus-fenced adaptation (reference:
+adaptation.go:8-28 barrier+consensus fencing; adaptiveStrategies.go:61-121
+majority-vote interference check).
+
+Three launcher workers each hold a Session; interference is faked by
+seeding throughput stats directly.  A minority observation (1/3) must NOT
+switch anyone; a majority (2/3) must switch everyone to the SAME strategy
+atomically, and the host plane must still be usable afterwards.
+"""
+import os
+import sys
+
+import pytest
+
+from kungfu_tpu import native
+from kungfu_tpu.launcher.cli import main
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from kungfu_tpu import native
+from kungfu_tpu.comm.mesh import flat_mesh
+from kungfu_tpu.comm.session import Session, StrategyStat
+from kungfu_tpu.plan import PeerID, PeerList
+from kungfu_tpu.plan.topology import Strategy
+
+out = os.environ["TEST_OUT"]
+p = native.default_peer()
+sess = Session(peers=PeerList([PeerID("127.0.0.1", 29000)]),
+               mesh=flat_mesh(n=1))
+
+def fake(session, interfered):
+    st = StrategyStat()
+    st.reference_rate = 100.0
+    # 10 B/s (far below 0.8 x 100) vs 100 kB/s (healthy)
+    st.update(1000, 100.0 if interfered else 0.01)
+    session._stats = {"grad": st}
+
+def record(phase, switched):
+    with open(os.path.join(out, f"{phase}.{p.rank}"), "w") as f:
+        f.write(f"{int(switched)}:{sess.strategy}")
+
+# phase 1: only rank 0 observes interference -> minority, nobody switches
+fake(sess, interfered=(p.rank == 0))
+switched = sess.auto_adapt(fenced=True)
+record("minority", switched)
+assert not switched, "minority vote must not switch"
+
+# the host plane still agrees and works after the aborted adaptation
+got = p.all_reduce(np.ones(1, np.float32), name="post-minority")
+assert got[0] == p.size
+
+# phase 2: ranks 0 and 1 observe interference -> majority, all switch
+fake(sess, interfered=(p.rank in (0, 1)))
+switched = sess.auto_adapt(fenced=True)
+record("majority", switched)
+assert switched, "majority vote must switch"
+
+got = p.all_reduce(np.ones(1, np.float32), name="post-majority")
+assert got[0] == p.size
+
+# phase 3: majority interference again, but rank 2 is configured with no
+# alternative strategy (fallbacks == its current one).  It proposes
+# "none" at the fence; the consensus fails EVERYWHERE — nobody switches
+# and, crucially, nobody is left stranded in the barrier.
+fake(sess, interfered=True)
+fb = [sess.strategy] if p.rank == 2 else None
+switched = sess.auto_adapt(fenced=True, fallbacks=fb)
+record("divergent", switched)
+assert not switched, "divergent fallbacks must abort everywhere"
+
+got = p.all_reduce(np.ones(1, np.float32), name="post-divergent")
+assert got[0] == p.size
+"""
+
+
+def test_minority_holds_majority_switches(tmp_path, monkeypatch):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+
+    rc = main(["-np", "3", "--", sys.executable, str(script)])
+    assert rc == 0
+
+    minority = {f: (out / f).read_text() for f in os.listdir(out)
+                if f.startswith("minority")}
+    majority = {f: (out / f).read_text() for f in os.listdir(out)
+                if f.startswith("majority")}
+    assert len(minority) == 3 and len(majority) == 3
+
+    # nobody switched on the minority vote; strategies identical
+    assert {v.split(":", 1)[0] for v in minority.values()} == {"0"}
+    assert len({v.split(":", 1)[1] for v in minority.values()}) == 1
+    before = next(iter(minority.values())).split(":", 1)[1]
+
+    # everybody switched on the majority vote — atomically, to ONE
+    # strategy, different from the original
+    assert {v.split(":", 1)[0] for v in majority.values()} == {"1"}
+    after = {v.split(":", 1)[1] for v in majority.values()}
+    assert len(after) == 1 and next(iter(after)) != before
+
+    # divergent-fallback round aborted everywhere without a deadlock,
+    # leaving every process on the phase-2 strategy
+    divergent = {f: (out / f).read_text() for f in os.listdir(out)
+                 if f.startswith("divergent")}
+    assert len(divergent) == 3
+    assert {v.split(":", 1)[0] for v in divergent.values()} == {"0"}
+    assert {v.split(":", 1)[1] for v in divergent.values()} == after
