@@ -61,9 +61,9 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def log_detailed_result(value, error, attrs):
+def log_detailed_result(value, error, attrs, unit="GiB/s"):
     attr_str = json.dumps(attrs, separators=(",", ":"))
-    print("RESULT: %f +-%f (%s) %s" % (value, error, "GiB/s", attr_str))
+    print("RESULT: %f +-%f (%s) %s" % (value, error, unit, attr_str))
 
 
 def _sizes_for(args):

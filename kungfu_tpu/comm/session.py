@@ -552,12 +552,20 @@ class Session:
         fence_peer = peer if peer is not None else _host_peer()
         if fence_peer is None or fence_peer.size <= 1:
             return self.auto_adapt(threshold, fallbacks)  # degenerate
-        if not self.check_interference_global(threshold, fence_peer):
-            with self._lock:
-                self._fold_healthy_locked()
-            return False
         with self._lock:
-            nxt = self._pick_next_locked(fallbacks)
+            # the vote runs INSIDE the verdict lock: a sample landing
+            # during the (tiny, 4-byte) host-plane allreduce would
+            # otherwise be folded into the EMA baseline by a verdict
+            # that never saw it — the same check+fold atomicity the
+            # unfenced path keeps
+            local = self._check_interference_locked(threshold)
+            votes = fence_peer.all_reduce(
+                np.asarray([1.0 if local else 0.0], np.float32),
+                op="SUM", name="kft-interference-vote")
+            if float(votes[0]) * 2 <= fence_peer.size:
+                self._fold_healthy_locked()
+                return False
+            nxt, nxt_idx = self._peek_next_locked(fallbacks)
         # ALWAYS reach the fence after a (collective, hence uniform)
         # interference verdict: a process with no candidate proposes
         # "none"; agreement on "none" aborts everywhere, disagreement
@@ -567,10 +575,19 @@ class Session:
             fence_peer, payload,
             (lambda: self.set_strategy(nxt)) if nxt is not None
             else (lambda: None))
-        if not ok or nxt is None:
-            return False
-        self._reset_references()
-        return True
+        with self._lock:
+            if ok and nxt is not None:
+                # commit the cursor only on success — advancing it on a
+                # failed consensus would desynchronize the processes'
+                # rotations and livelock every later adaptation
+                self._adapt_idx = nxt_idx
+                self._reset_references_locked()
+                return True
+            # aborted round: still roll the degraded window so the same
+            # stale sample doesn't re-trip the vote every period
+            for s in self._stats.values():
+                s.reset_window()
+        return False
 
     def _fold_healthy_locked(self) -> None:
         """Healthy (or idle) window: fold it into the baseline and roll.
@@ -584,26 +601,39 @@ class Session:
                                     0.8 * s.reference_rate + 0.2 * tp)
                 s.reset_window()
 
-    def _pick_next_locked(self, fallbacks) -> Optional[Strategy]:
-        """Rotate the fallback cursor to the next strategy != current;
-        None when there is no alternative (windows still rolled so the
-        degraded sample doesn't wedge every later verdict)."""
+    def _peek_next_locked(self, fallbacks):
+        """Next strategy != current plus the cursor position to commit
+        AFTER a successful install; ``(None, current_cursor)`` when there
+        is no alternative.  Never mutates — a failed fenced round must
+        leave every process's rotation untouched."""
         order = list(fallbacks) if fallbacks is not None else [
             Strategy.BINARY_TREE_STAR, Strategy.RING, Strategy.STAR]
         cur = self.strategy
         for k in range(len(order)):
             cand = order[(self._adapt_idx + k) % len(order)]
             if cand != cur:
-                self._adapt_idx = (self._adapt_idx + k + 1) % len(order)
-                return cand
+                return cand, (self._adapt_idx + k + 1) % len(order)
+        return None, self._adapt_idx
+
+    def _pick_next_locked(self, fallbacks) -> Optional[Strategy]:
+        """Rotate the fallback cursor to the next strategy != current;
+        None when there is no alternative (windows still rolled so the
+        degraded sample doesn't wedge every later verdict)."""
+        cand, idx = self._peek_next_locked(fallbacks)
+        if cand is None:
+            for s in self._stats.values():
+                s.reset_window()
+            return None
+        self._adapt_idx = idx
+        return cand
+
+    def _reset_references_locked(self) -> None:
         for s in self._stats.values():
+            # fresh start: the new strategy must earn its own
+            # reference rate, not inherit the degraded one
+            s.reference_rate = None
             s.reset_window()
-        return None
 
     def _reset_references(self) -> None:
         with self._lock:
-            for s in self._stats.values():
-                # fresh start: the new strategy must earn its own
-                # reference rate, not inherit the degraded one
-                s.reference_rate = None
-                s.reset_window()
+            self._reset_references_locked()

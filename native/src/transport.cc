@@ -129,6 +129,10 @@ static float f16_to_f32(uint16_t h) {
 }
 
 static uint16_t f32_to_f16(float f) {
+    // round-to-nearest-even, matching _mm256_cvtps_ph: the SIMD body and
+    // this scalar tail/fallback must produce identical bits or the same
+    // reduce gives different results by element index / host ISA,
+    // breaking bit-exact consensus across peers
     uint32_t bits;
     std::memcpy(&bits, &f, 4);
     uint16_t sign = uint16_t((bits >> 16) & 0x8000);
@@ -139,13 +143,27 @@ static uint16_t f32_to_f16(float f) {
         if (exp < -10) return sign;  // underflow to zero
         man |= 0x800000;
         uint32_t shift = uint32_t(14 - exp);
-        return uint16_t(sign | (man >> shift));
+        uint32_t out = man >> shift;
+        uint32_t rem = man & ((1u << shift) - 1);
+        uint32_t half = 1u << (shift - 1);
+        if (rem > half || (rem == half && (out & 1))) out++;  // RNE
+        // a carry out of the subnormal mantissa lands in exponent 1 —
+        // the bit layout makes that the correct normal number
+        return uint16_t(sign | out);
     }
-    return uint16_t(sign | (uint32_t(exp) << 10) | (man >> 13));
+    uint32_t combined = (uint32_t(exp) << 10) | (man >> 13);
+    uint32_t rem = man & 0x1FFF;
+    if (rem > 0x1000 || (rem == 0x1000 && (combined & 1)))
+        combined++;  // RNE; carry may bump the exponent (incl. to inf)
+    return uint16_t(sign | combined);
 }
 
+// __restrict: the accumulator and incoming buffers never alias (acc is
+// this peer's recv buffer, in is a freshly read message body), which is
+// what lets -O3 auto-vectorize these loops into full-width SIMD.
 template <typename T>
-static void reduce_loop(T *acc, const T *in, int64_t n, kft_op op) {
+static void reduce_loop(T *__restrict acc, const T *__restrict in,
+                        int64_t n, kft_op op) {
     switch (op) {
         case KFT_SUM:
             for (int64_t i = 0; i < n; i++) acc[i] = T(acc[i] + in[i]);
@@ -164,8 +182,49 @@ static void reduce_loop(T *acc, const T *in, int64_t n, kft_op op) {
     }
 }
 
-static void reduce_f16(uint16_t *acc, const uint16_t *in, int64_t n,
+#if defined(__F16C__) && defined(__AVX__)
+#include <immintrin.h>
+// 8-wide f16 reduce via hardware half<->float converts (the scalar
+// bit-twiddling fallback below costs ~20 ops per element either way).
+static void reduce_f16_simd(uint16_t *__restrict acc,
+                            const uint16_t *__restrict in, int64_t n,
+                            kft_op op) {
+    int64_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        __m256 a = _mm256_cvtph_ps(
+            _mm_loadu_si128(reinterpret_cast<const __m128i *>(acc + i)));
+        __m256 b = _mm256_cvtph_ps(
+            _mm_loadu_si128(reinterpret_cast<const __m128i *>(in + i)));
+        __m256 r;
+        switch (op) {
+            case KFT_SUM: r = _mm256_add_ps(a, b); break;
+            case KFT_MIN: r = _mm256_min_ps(a, b); break;
+            case KFT_MAX: r = _mm256_max_ps(a, b); break;
+            default: r = _mm256_mul_ps(a, b); break;
+        }
+        _mm_storeu_si128(
+            reinterpret_cast<__m128i *>(acc + i),
+            _mm256_cvtps_ph(r, _MM_FROUND_TO_NEAREST_INT));
+    }
+    for (; i < n; i++) {
+        float a = f16_to_f32(acc[i]), b = f16_to_f32(in[i]), r = 0;
+        switch (op) {
+            case KFT_SUM: r = a + b; break;
+            case KFT_MIN: r = b < a ? b : a; break;
+            case KFT_MAX: r = b > a ? b : a; break;
+            case KFT_PROD: r = a * b; break;
+        }
+        acc[i] = f32_to_f16(r);
+    }
+}
+#endif
+
+static void reduce_f16(uint16_t *__restrict acc,
+                       const uint16_t *__restrict in, int64_t n,
                        kft_op op) {
+#if defined(__F16C__) && defined(__AVX__)
+    reduce_f16_simd(acc, in, n, op);
+#else
     for (int64_t i = 0; i < n; i++) {
         float a = f16_to_f32(acc[i]), b = f16_to_f32(in[i]), r = 0;
         switch (op) {
@@ -176,6 +235,7 @@ static void reduce_f16(uint16_t *acc, const uint16_t *in, int64_t n,
         }
         acc[i] = f32_to_f16(r);
     }
+#endif
 }
 
 void reduce_inplace(void *acc, const void *in, int64_t count, kft_dtype dt,

@@ -321,3 +321,27 @@ def test_unix_listener_present(monkeypatch):
     """Default transport registers the abstract unix socket."""
     monkeypatch.setenv("KFT_CONFIG_USE_UNIX", "1")  # isolate from ambient
     _spawn(_w_unix_listener, 2)
+
+
+def _f16_rounding_worker(rank, peers, q):
+    try:
+        with native.NativePeer(rank, peers) as p:
+            # 1.0 + 2.0009765625 needs f16 mantissa rounding; 11 elements
+            # exercise the SIMD body (0..7) AND the scalar tail (8..10)
+            x = np.full(11, 1.0 if rank == 0 else np.float16(2.0009765625),
+                        np.float16)
+            got = p.all_reduce(x, op="SUM", name="f16rne")
+            q.put((rank, got.view(np.uint16).tolist()))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {e!r}"))
+
+
+def test_f16_reduce_simd_tail_bit_identical():
+    """SIMD body (elements 0..7) and scalar tail (8..10) of the f16
+    reduce must produce IDENTICAL bits — both round to nearest-even, so
+    the result cannot depend on element index or host ISA (bit-exact
+    consensus relies on this)."""
+    results = _spawn(_f16_rounding_worker, 2)
+    for bits in results.values():
+        assert len(set(bits)) == 1, bits
+    assert results[0] == results[1]
