@@ -187,13 +187,20 @@ def propose_new_size(new_size: int) -> bool:
     from .elastic import config_server as _cs
     try:
         version, cluster = _cs.fetch_config(url)
+        resized = cluster.resize(int(new_size))
         # CAS on the fetched version: a concurrent proposal (409) loses
         # cleanly instead of silently overwriting the winner's layout
-        _cs.put_config(url, cluster.resize(int(new_size)),
-                       if_version=version)
-        return True
+        new_version = _cs.put_config(url, resized, if_version=version)
     except (urllib.error.URLError, OSError, TimeoutError):
         return False
+    # push the new stage straight to every runner (reference: propose
+    # notifies runners over ConnControl, peer.go:190-209) — the resize
+    # then lands in one TCP round trip instead of a poll interval;
+    # unreachable runners still converge via their config-server poll
+    if we.runners:
+        from .launcher.control import push_stage
+        push_stage(we.runners, new_version, resized)
+    return True
 
 
 def check_interference(threshold: float = 0.8, vote: bool = False) -> bool:

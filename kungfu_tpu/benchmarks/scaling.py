@@ -239,8 +239,6 @@ def main(argv=None) -> int:
     if args.worker:
         return _worker_main(args)
     do_both = not args.sweep and not args.predict
-    from ..models.fake_model import MODEL_SIZES
-    model_bytes = 4 * sum(MODEL_SIZES[args.model])
 
     if args.sweep or do_both:
         sizes = [int(s) for s in args.sizes.split(",")]

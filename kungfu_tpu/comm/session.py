@@ -552,19 +552,33 @@ class Session:
         fence_peer = peer if peer is not None else _host_peer()
         if fence_peer is None or fence_peer.size <= 1:
             return self.auto_adapt(threshold, fallbacks)  # degenerate
+        # snapshot-and-roll under the lock, vote OUTSIDE it: holding the
+        # lock across a cross-process collective would deadlock against a
+        # training thread blocked on record()/_shard_fn while the remote
+        # peer waits for it inside another collective.  Rolling the
+        # windows at snapshot time keeps verdict+fold atomic anyway —
+        # samples landing during the vote belong to the NEXT window and
+        # are never folded into this one's baseline.
         with self._lock:
-            # the vote runs INSIDE the verdict lock: a sample landing
-            # during the (tiny, 4-byte) host-plane allreduce would
-            # otherwise be folded into the EMA baseline by a verdict
-            # that never saw it — the same check+fold atomicity the
-            # unfenced path keeps
-            local = self._check_interference_locked(threshold)
-            votes = fence_peer.all_reduce(
-                np.asarray([1.0 if local else 0.0], np.float32),
-                op="SUM", name="kft-interference-vote")
-            if float(votes[0]) * 2 <= fence_peer.size:
-                self._fold_healthy_locked()
-                return False
+            snap = [(s, s.throughput) for s in self._stats.values()
+                    if s.count]
+            local = any(
+                s.reference_rate and tp < threshold * s.reference_rate
+                for s, tp in snap)
+            for s in self._stats.values():
+                s.reset_window()
+        votes = fence_peer.all_reduce(
+            np.asarray([1.0 if local else 0.0], np.float32),
+            op="SUM", name="kft-interference-vote")
+        if float(votes[0]) * 2 <= fence_peer.size:
+            with self._lock:
+                for s, tp in snap:
+                    # EMA fold of the snapshot (see _fold_healthy_locked)
+                    s.reference_rate = (tp if s.reference_rate is None
+                                        else 0.8 * s.reference_rate
+                                        + 0.2 * tp)
+            return False
+        with self._lock:
             nxt, nxt_idx = self._peek_next_locked(fallbacks)
         # ALWAYS reach the fence after a (collective, hence uniform)
         # interference verdict: a process with no candidate proposes
@@ -575,19 +589,17 @@ class Session:
             fence_peer, payload,
             (lambda: self.set_strategy(nxt)) if nxt is not None
             else (lambda: None))
+        if not ok or nxt is None:
+            # aborted round: the degraded window was already rolled at
+            # snapshot time, so the stale sample cannot re-trip the vote
+            return False
         with self._lock:
-            if ok and nxt is not None:
-                # commit the cursor only on success — advancing it on a
-                # failed consensus would desynchronize the processes'
-                # rotations and livelock every later adaptation
-                self._adapt_idx = nxt_idx
-                self._reset_references_locked()
-                return True
-            # aborted round: still roll the degraded window so the same
-            # stale sample doesn't re-trip the vote every period
-            for s in self._stats.values():
-                s.reset_window()
-        return False
+            # commit the cursor only on success — advancing it on a
+            # failed consensus would desynchronize the processes'
+            # rotations and livelock every later adaptation
+            self._adapt_idx = nxt_idx
+            self._reset_references_locked()
+        return True
 
     def _fold_healthy_locked(self) -> None:
         """Healthy (or idle) window: fold it into the baseline and roll.

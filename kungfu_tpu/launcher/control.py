@@ -1,0 +1,117 @@
+"""Push-based runner control plane.
+
+Reference: the runner serves a ConnControl channel and workers push
+versioned ``Stage{Version, Cluster}`` "update" (and "exit") messages to
+EVERY runner directly (srcs/go/kungfu/runner/handler.go:19-36,91-115;
+worker side peer.go:190-209).  Resize latency is then one TCP round trip
+instead of the runner's config-server poll interval, and the config
+server stops being the only path membership changes can take (polling
+stays as the fallback for runners the push cannot reach).
+
+Wire format: one JSON object per connection, newline-terminated:
+``{"type": "update", "version": 3, "cluster": {...}}`` or
+``{"type": "exit"}``.  Version dedup lives in Watcher.update (stale
+versions are ignored), matching the reference handler's dedup.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Callable, Iterable, Optional
+
+from ..plan.cluster import Cluster
+from ..plan.peer import PeerID
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):  # one message per connection
+        try:
+            line = self.rfile.readline(1 << 20)
+            msg = json.loads(line.decode())
+        except (ValueError, UnicodeDecodeError):
+            self.wfile.write(b'{"ok": false}\n')
+            return
+        srv: "ControlServer" = self.server.control  # type: ignore
+        ok = srv._dispatch(msg)
+        self.wfile.write(b'{"ok": true}\n' if ok else b'{"ok": false}\n')
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ControlServer:
+    """Runner-side listener for pushed Stage updates.
+
+    ``on_update(version, cluster)`` runs on a server thread; ``on_exit``
+    likewise.  Both callbacks must be thread-safe (Watcher.update is).
+    """
+
+    def __init__(self, port: int,
+                 on_update: Callable[[int, Cluster], None],
+                 on_exit: Optional[Callable[[], None]] = None,
+                 host: str = "0.0.0.0"):
+        self._on_update = on_update
+        self._on_exit = on_exit
+        self._srv = _TCP((host, port), _Handler)
+        self._srv.control = self  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="kft-control", daemon=True)
+
+    def start(self) -> "ControlServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def _dispatch(self, msg) -> bool:
+        t = msg.get("type")
+        if t == "update":
+            try:
+                version = int(msg["version"])
+                cluster = Cluster.from_json(json.dumps(msg["cluster"]))
+            except (KeyError, ValueError, TypeError):
+                return False
+            self._on_update(version, cluster)
+            return True
+        if t == "exit":
+            if self._on_exit:
+                self._on_exit()
+            return True
+        return False
+
+
+def _push(addr: PeerID, payload: bytes, timeout: float) -> bool:
+    try:
+        with socket.create_connection((addr.host, addr.port),
+                                      timeout=timeout) as s:
+            s.sendall(payload)
+            s.shutdown(socket.SHUT_WR)
+            resp = s.makefile().readline()
+        return json.loads(resp).get("ok", False)
+    except (OSError, ValueError):
+        return False
+
+
+def push_stage(runners: Iterable[PeerID], version: int, cluster: Cluster,
+               timeout: float = 2.0) -> int:
+    """Push ``Stage{version, cluster}`` to every runner; returns how many
+    acknowledged.  Unreachable runners are skipped — they converge via
+    the config-server poll fallback."""
+    payload = (json.dumps({"type": "update", "version": version,
+                           "cluster": json.loads(cluster.to_json())})
+               + "\n").encode()
+    return sum(_push(r, payload, timeout) for r in runners)
+
+
+def push_exit(runners: Iterable[PeerID], timeout: float = 2.0) -> int:
+    """Tell every runner to leave watch mode (reference: the "exit"
+    ConnControl message)."""
+    payload = b'{"type": "exit"}\n'
+    return sum(_push(r, payload, timeout) for r in runners)

@@ -130,8 +130,39 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     A host whose local share is transiently zero keeps running — it may
     receive workers on a later grow (the reference runner likewise only
     exits when the whole cluster is gone).
+
+    Stage updates arrive two ways: PUSHED by workers to this runner's
+    control port (reference ConnControl, handler.go:91-115 — resize
+    latency is one TCP round trip) with config-server polling as the
+    fallback for pushes that never arrive.
     """
     w = Watcher(job, host, parent, pool)
+    wake = threading.Event()
+    exited = threading.Event()
+    pushed_size = [None]  # global size from the last pushed stage
+
+    def on_push(version: int, cluster: Cluster) -> None:
+        w.update(version, cluster)
+        # record the pushed global size only if this stage is the newest
+        # the watcher has seen — a delayed stale push must not drive the
+        # stop_when_empty decision with an old (e.g. empty) cluster
+        if version >= w.version:
+            pushed_size[0] = cluster.size()
+        wake.set()
+
+    def on_exit() -> None:
+        exited.set()
+        wake.set()
+
+    control = None
+    try:
+        from .control import ControlServer
+        control = ControlServer(parent.port, on_push, on_exit).start()
+    except OSError as e:
+        # port taken (e.g. two runners on one host misconfigured to the
+        # same parent id): run pull-only rather than dying
+        print(f"kft-run: control port {parent.port} unavailable ({e}); "
+              f"falling back to config-server polling", flush=True)
     # align the initial stage version with the config server's counter —
     # spawned workers carry the version as their fencing token, so a skew
     # here makes them mistake the CURRENT config for a resize (the
@@ -157,22 +188,33 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             # Logged so a persistently broken server isn't silent.
             print(f"kft-run: config server {config_url} unreadable "
                   f"({last_err}); starting at version 0", flush=True)
-    w.update(version0, initial)
-    global_size = initial.size()
-    while True:
-        w.reap()
-        if w.failed is not None:  # check before retrying: a crashed worker
-            w.drain()             # must not be respawned on its way out
-            return w.failed
-        w.retry_pending()
-        if config_url:
-            try:
-                version, cluster = fetch_config(config_url)
-                global_size = cluster.size()
-                w.update(version, cluster)
-            except Exception:
-                pass  # config server transient failure: keep current procs
-        if stop_when_empty and w.alive() == 0 and (
-                not config_url or global_size == 0 or w.all_local_done()):
-            return 0
-        time.sleep(poll_interval)
+    try:
+        w.update(version0, initial)
+        global_size = initial.size()
+        while True:
+            w.reap()
+            if w.failed is not None:  # check before retrying: a crashed
+                w.drain()             # worker must not be respawned
+                return w.failed
+            if exited.is_set():       # pushed "exit": leave watch mode
+                w.drain()
+                return 0
+            w.retry_pending()
+            if pushed_size[0] is not None:
+                global_size = pushed_size[0]
+            if config_url:
+                try:
+                    version, cluster = fetch_config(config_url)
+                    global_size = cluster.size()
+                    w.update(version, cluster)
+                except Exception:
+                    pass  # config server transient failure: keep procs
+            if stop_when_empty and w.alive() == 0 and (
+                    not config_url or global_size == 0
+                    or w.all_local_done()):
+                return 0
+            wake.clear()
+            wake.wait(poll_interval)  # a push cuts the wait short
+    finally:
+        if control is not None:
+            control.stop()

@@ -138,6 +138,9 @@ static uint16_t f32_to_f16(float f) {
     uint16_t sign = uint16_t((bits >> 16) & 0x8000);
     int32_t exp = int32_t((bits >> 23) & 0xFF) - 127 + 15;
     uint32_t man = bits & 0x7FFFFF;
+    if (((bits >> 23) & 0xFF) == 0xFF && man)  // NaN: quiet, keep top
+        return uint16_t(sign | 0x7C00 | 0x200 |  // payload bits — the
+                        (man >> 13));            // cvtps_ph convention
     if (exp >= 0x1F) return uint16_t(sign | 0x7C00);  // inf/overflow
     if (exp <= 0) {
         if (exp < -10) return sign;  // underflow to zero
@@ -210,8 +213,11 @@ static void reduce_f16_simd(uint16_t *__restrict acc,
         float a = f16_to_f32(acc[i]), b = f16_to_f32(in[i]), r = 0;
         switch (op) {
             case KFT_SUM: r = a + b; break;
-            case KFT_MIN: r = b < a ? b : a; break;
-            case KFT_MAX: r = b > a ? b : a; break;
+            // match _mm256_min_ps/max_ps exactly: (a OP b) ? a : b —
+            // unordered (NaN) and equal-magnitude (+0/-0) operands pick
+            // b, so SIMD body and scalar tail emit identical bits
+            case KFT_MIN: r = a < b ? a : b; break;
+            case KFT_MAX: r = a > b ? a : b; break;
             case KFT_PROD: r = a * b; break;
         }
         acc[i] = f32_to_f16(r);
@@ -229,8 +235,9 @@ static void reduce_f16(uint16_t *__restrict acc,
         float a = f16_to_f32(acc[i]), b = f16_to_f32(in[i]), r = 0;
         switch (op) {
             case KFT_SUM: r = a + b; break;
-            case KFT_MIN: r = b < a ? b : a; break;
-            case KFT_MAX: r = b > a ? b : a; break;
+            // same compare direction as the F16C path (see above)
+            case KFT_MIN: r = a < b ? a : b; break;
+            case KFT_MAX: r = a > b ? a : b; break;
             case KFT_PROD: r = a * b; break;
         }
         acc[i] = f32_to_f16(r);

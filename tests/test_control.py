@@ -1,0 +1,129 @@
+"""Push-based runner control plane (reference: ConnControl Stage push,
+runner/handler.go:19-36,91-115; worker-side notify peer.go:190-209)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import native  # noqa: E402
+from kungfu_tpu.launcher.control import (ControlServer, push_exit,  # noqa: E402
+                                         push_stage)
+from kungfu_tpu.plan import Cluster, HostList, PeerID  # noqa: E402
+
+
+def _cluster(n):
+    return Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), n)
+
+
+def test_push_update_and_exit_roundtrip():
+    got = []
+    exited = threading.Event()
+    srv = ControlServer(0, lambda v, c: got.append((v, c.size())),
+                        on_exit=exited.set, host="127.0.0.1").start()
+    try:
+        me = PeerID("127.0.0.1", srv.port)
+        assert push_stage([me], 3, _cluster(2)) == 1
+        assert got == [(3, 2)]
+        assert push_exit([me]) == 1
+        assert exited.wait(5)
+    finally:
+        srv.stop()
+
+
+def test_push_unreachable_runner_skipped():
+    # nothing listens on this port: push reports 0 acks, no exception
+    dead = PeerID("127.0.0.1", 1)
+    assert push_stage([dead], 1, _cluster(1), timeout=0.5) == 0
+    assert push_exit([dead], timeout=0.5) == 0
+
+
+def test_malformed_message_rejected():
+    got = []
+    srv = ControlServer(0, lambda v, c: got.append(v),
+                        host="127.0.0.1").start()
+    try:
+        import json
+        import socket
+        for payload in (b"not json\n",
+                        b'{"type": "update", "version": "x"}\n',
+                        b'{"type": "bogus"}\n'):
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=2) as s:
+                s.sendall(payload)
+                s.shutdown(socket.SHUT_WR)
+                resp = json.loads(s.makefile().readline())
+            assert resp["ok"] is False
+        assert got == []
+    finally:
+        srv.stop()
+
+
+WORKER = r"""
+import os, sys, time
+import numpy as np
+import kungfu_tpu as kf
+from kungfu_tpu import native
+from kungfu_tpu.launcher import env as E
+
+out_dir = os.environ["TEST_OUT"]
+we = E.from_env()
+p = native.default_peer()
+t0 = float(os.environ["TEST_T0"])
+
+got = p.all_reduce(np.ones(2, np.float32), name=f"step@{p.token}")
+if p.size == 2:
+    if p.rank == 0:
+        assert kf.propose_new_size(3)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        changed, detached = native.resize_from_url()
+        if changed:
+            break
+        time.sleep(0.05)
+    else:
+        sys.exit(3)
+    p = native.installed_peer()
+    got = p.all_reduce(np.ones(2, np.float32), name=f"step@{p.token}")
+    with open(os.path.join(out_dir, f"done.{we.self_spec.port}"), "w") as f:
+        f.write(f"{int(got[0])}:{time.time() - t0:.2f}")
+"""
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_resize_propagates_without_poll_interval(tmp_path, monkeypatch):
+    """With a 25 s runner poll interval, the grow can only complete
+    within the workers' 20 s budget if the pushed Stage reaches the
+    runner — polling alone would exceed every deadline."""
+    from kungfu_tpu.elastic import ConfigServer, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+    monkeypatch.setenv("TEST_T0", repr(time.time()))
+
+    cluster = _cluster(2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        t0 = time.time()
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31950),
+                       cluster, srv.url, poll_interval=25.0)
+        elapsed = time.time() - t0
+        assert rc == 0
+        # the final drain check may consume one poll interval; the GROW
+        # itself must have finished within the workers' 20s deadlines
+        done = [f for f in os.listdir(out) if f.startswith("done")]
+        assert len(done) == 2  # both survivors allreduced the 3-cluster
+        assert elapsed < 120
+    finally:
+        srv.stop()
