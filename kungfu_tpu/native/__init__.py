@@ -32,6 +32,10 @@ _DTYPES = {
     np.dtype(np.float32): 6, np.dtype(np.float64): 7,
 }
 OPS = {"SUM": 0, "MIN": 1, "MAX": 2, "PROD": 3}
+
+# done-callback signature of the async C ABI (kft.h kft_done_cb); the
+# native worker thread acquires the GIL through ctypes to run it
+DONE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int)
 STRATEGIES = {"STAR": 0, "RING": 1, "BINARY_TREE": 2, "CLIQUE": 3, "AUTO": 4}
 
 # Host-structured strategies (reference: topology.go local-master graphs) are
@@ -102,6 +106,11 @@ def _load():
         lib.kft_consensus.argtypes = [c, ctypes.c_void_p, i64, cstr]
         lib.kft_save.argtypes = [c, cstr, ctypes.c_void_p, i64, i64]
         lib.kft_request.argtypes = [c, i32, cstr, ctypes.c_void_p, i64, i64]
+        lib.kft_all_reduce_async.argtypes = [
+            c, ctypes.c_void_p, ctypes.c_void_p, i64, i32, i32, i32, cstr,
+            DONE_CB, ctypes.c_void_p]
+        lib.kft_request_async.argtypes = [c, i32, cstr, ctypes.c_void_p,
+                                          i64, i64, DONE_CB, ctypes.c_void_p]
         lib.kft_egress_bytes.argtypes = [c, i32]
         lib.kft_egress_bytes.restype = i64
         lib.kft_egress_rate.argtypes = [c, i32]
@@ -143,6 +152,11 @@ class NativePeer:
         self._forest_cache = {}
         self._pool = None
         self._pool_lock = threading.Lock()
+        # in-flight async ops: id -> (callback, buffers, future).  The
+        # entries ANCHOR the ctypes trampoline + pinned numpy buffers on
+        # the peer (a mere closure cycle is cyclic-GC-collectable while
+        # the native thread still writes the buffers)
+        self._pending = {}
         self._metrics_server = None
         self._metrics_provider = None
 
@@ -161,6 +175,16 @@ class NativePeer:
 
     def close(self) -> None:
         self.stop()
+        # kft_peer_stop drained the NATIVE async pool (its callbacks have
+        # fired); Python-side async work (host-structured wrappers on
+        # their own threads) may still be touching the handle — wait for
+        # every pending future before freeing it.  Post-stop they fail
+        # fast, so this converges quickly.
+        import concurrent.futures as _cf
+        pending = [fut for *_ , fut in list(self._pending.values())]
+        if pending:
+            _cf.wait(pending, timeout=30.0)
+        self._pending.clear()
         if self._metrics_provider is not None:
             # unregister BEFORE freeing the handle: a late /metrics render
             # must never call into a dead native peer
@@ -328,6 +352,92 @@ class NativePeer:
         if rc < 0:
             _check(rc, "consensus")
         return rc == 1
+
+    # -------------------------------------------------------------- async
+    def _async_op(self, submit, keepalive, result):
+        """Shared future plumbing for the async C ABI: ``submit(cb)``
+        issues the native call with the ctypes callback; ``keepalive``
+        are the buffers the native thread writes — anchored in
+        ``self._pending`` (NOT a closure cycle: cyclic GC may collect an
+        unrooted cycle while the native op still runs); ``result()``
+        builds the future's value on success."""
+        from concurrent.futures import Future
+        fut: Future = Future()
+        key = id(fut)
+
+        def done(_arg, status):
+            try:
+                if status == 0:
+                    fut.set_result(result())
+                else:
+                    err = self._lib.kft_last_error().decode()
+                    fut.set_exception(NativeError(err or "async op failed"))
+            finally:
+                self._pending.pop(key, None)
+
+        cb = DONE_CB(done)
+        self._pending[key] = (cb, keepalive, fut)
+        try:
+            submit(cb)
+        except BaseException:
+            self._pending.pop(key, None)
+            raise
+        return fut
+
+    def _thread_future(self, fn):
+        """Run a blocking op on its OWN daemon thread and return a
+        Future.  Not the stripe pool: a pooled wrapper that itself
+        submits stripe tasks to the same pool can exhaust it and
+        deadlock."""
+        from concurrent.futures import Future
+        fut: Future = Future()
+        key = id(fut)
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # surfaced via the future
+                fut.set_exception(e)
+            finally:
+                self._pending.pop(key, None)
+
+        t = threading.Thread(target=run, daemon=True, name="kft-async")
+        self._pending[key] = (None, None, fut)
+        t.start()
+        return fut
+
+    def all_reduce_async(self, x: np.ndarray, op: str = "SUM",
+                         strategy: str = "AUTO", name: str = "allreduce"):
+        """Future-returning allreduce (reference: the async C ABI
+        variants with done callbacks, libkungfu-comm/collective.go:16-157).
+        The op runs on a native worker thread; the returned
+        ``concurrent.futures.Future`` resolves to the reduced array.
+        """
+        x = np.ascontiguousarray(x)
+        if x.dtype not in _DTYPES:
+            raise TypeError(f"unsupported dtype {x.dtype}")
+        if strategy in _HOST_STRUCTURED:
+            return self._thread_future(
+                lambda: self.all_reduce(x, op, strategy, name))
+        out = np.empty_like(x)
+        return self._async_op(
+            lambda cb: _check(self._lib.kft_all_reduce_async(
+                self._h, x.ctypes.data, out.ctypes.data, x.size,
+                _DTYPES[x.dtype], OPS[op], STRATEGIES[strategy],
+                name.encode(), cb, None), "all_reduce_async"),
+            (x, out), lambda: out)
+
+    def request_async(self, target: int, name: str, like: np.ndarray,
+                      version: int = -1):
+        """Future-returning p2p model pull — the building block of the
+        prefetching pair averager (reference: AsyncRequestModel's
+        prefetch double-buffer, peer_to_peer.cpp:8-524)."""
+        out = np.empty_like(np.ascontiguousarray(like))
+        return self._async_op(
+            lambda cb: _check(self._lib.kft_request_async(
+                self._h, target, name.encode(), out.ctypes.data,
+                out.nbytes, version, cb, None), "request_async"),
+            (out,), lambda: out)
 
     # ---------------------------------------------------------------- p2p
     def save(self, name: str, x: np.ndarray, version: int = -1) -> None:

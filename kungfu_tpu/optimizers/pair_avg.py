@@ -51,13 +51,16 @@ class AsyncPairAverager:
     """
 
     def __init__(self, peer, selection: str = "random", mix: float = 0.5,
-                 name: str = "model", seed: Optional[int] = None):
+                 name: str = "model", seed: Optional[int] = None,
+                 prefetch: bool = False):
         import numpy as np
 
         from ..plan.mst import RoundRobin
         self._peer = peer
         self._mix = float(mix)
         self._name = name
+        self._prefetch = bool(prefetch)
+        self._inflight = None  # Future pulling the NEXT peer's model
         self._mask = [r != peer.rank for r in range(peer.size)]
         if selection == "roundrobin":
             rr = RoundRobin()
@@ -74,7 +77,29 @@ class AsyncPairAverager:
     _unravel = None
 
     def _flat(self, tree):
+        """Model pytree -> contiguous f32-ish numpy vector.
+
+        All-numpy trees take a pure-numpy path: routing host-resident
+        models through jax's ravel_pytree would stage them onto the
+        accelerator and fetch them back — on a tunnelled TPU runtime
+        that copy costs ORDERS of magnitude more than the exchange
+        itself.  Device trees still use ravel_pytree (the D2H staging is
+        then inherent, as in the reference's GPU path)."""
         import numpy as np
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if leaves and all(isinstance(l, np.ndarray) for l in leaves):
+            metas = [(l.shape, l.dtype, int(l.size)) for l in leaves]
+
+            def unravel(flat):
+                out, off = [], 0
+                for shape, dt, sz in metas:
+                    out.append(np.asarray(flat[off:off + sz],
+                                          dtype=dt).reshape(shape))
+                    off += sz
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            self._unravel = unravel
+            return np.concatenate([np.ravel(l) for l in leaves])
         from jax.flatten_util import ravel_pytree
         flat, unravel = ravel_pytree(tree)
         self._unravel = unravel  # same treedef every step: cache it
@@ -95,14 +120,52 @@ class AsyncPairAverager:
     def mix(self, tree, version: int = -1):
         """Pull one peer's model and average it into ``tree``."""
         mixed = self._mix_flat(self._flat(tree), version)
-        return self._unravel(jnp.asarray(mixed))
+        return self._unravel(mixed)
 
     def mix_and_save(self, tree, version: int = -1):
         """``mix`` then ``save`` with a single flatten of the model —
-        the per-step fast path."""
-        mixed = self._mix_flat(self._flat(tree), version)
+        the per-step fast path.
+
+        With ``prefetch=True`` the peer model consumed here was pulled
+        DURING the preceding local step (double buffer — the reference's
+        AsyncRequestModel prefetch, peer_to_peer.cpp:8-524): after
+        mixing, the next pull is issued immediately so it overlaps the
+        caller's next compute instead of stalling the loop."""
+        flat = self._flat(tree)
+        if not self._prefetch:
+            mixed = self._mix_flat(flat, version)
+            self._peer.save(self._name, mixed, version=version)
+            return self._unravel(mixed)
+        if version != -1:
+            # the in-flight pull was issued during the PREVIOUS step and
+            # can only ask for the peer's LATEST model; an explicit
+            # version would silently bind to the prior step's number
+            raise ValueError("prefetch mode exchanges latest models "
+                             "(version=-1); use prefetch=False for "
+                             "explicit-version pulls")
+        if self._inflight is None:  # cold start: no overlap this once
+            self._start_prefetch(flat)
+        inflight, self._inflight = self._inflight, None
+        theirs = None
+        if inflight is not None:
+            try:
+                theirs = inflight.result()
+            except Exception as e:  # peer died/fenced: skip this round's
+                # mix rather than wedging on a cached exception forever
+                import sys
+                print(f"kft: pair-averaging prefetch failed ({e}); "
+                      f"skipping this round's mix", file=sys.stderr)
+        mixed = flat if theirs is None else (
+            (1.0 - self._mix) * flat + self._mix * theirs)
         self._peer.save(self._name, mixed, version=version)
-        return self._unravel(jnp.asarray(mixed))
+        self._start_prefetch(mixed)
+        return self._unravel(mixed)
+
+    def _start_prefetch(self, like, version: int = -1) -> None:
+        target = self._pick()
+        self._inflight = (self._peer.request_async(
+            target, self._name, like, version=version)
+            if target >= 0 else None)
 
 
 def pair_averaging(base: optax.GradientTransformation,

@@ -92,6 +92,19 @@ int kft_all_gather(kft_peer *, const void *sendbuf, int64_t nbytes,
 int kft_consensus(kft_peer *, const void *buf, int64_t nbytes,
                   const char *name);
 
+/* ---- async variants (reference: callback-on-completion async ops,
+ * libkungfu-comm/collective.go:16-157, callOP main.go:163-179).  The op
+ * runs on a library worker thread; `cb(arg, status)` fires when it
+ * completes (status 0 = ok).  Caller keeps the buffers alive until then. */
+typedef void (*kft_done_cb)(void *arg, int status);
+int kft_all_reduce_async(kft_peer *, const void *sendbuf, void *recvbuf,
+                         int64_t count, kft_dtype dtype, kft_op op,
+                         kft_strategy strategy, const char *name,
+                         kft_done_cb cb, void *arg);
+int kft_request_async(kft_peer *, int target, const char *name, void *buf,
+                      int64_t nbytes, int64_t version, kft_done_cb cb,
+                      void *arg);
+
 /* ---- p2p versioned model store (reference: srcs/go/store/) ---- */
 int kft_save(kft_peer *, const char *name, const void *buf, int64_t nbytes,
              int64_t version); /* version < 0: unversioned slot */

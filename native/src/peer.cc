@@ -15,6 +15,8 @@
 #include <cstddef>
 #include <cstdio>
 #include <cstdlib>
+#include <deque>
+#include <functional>
 #include <sstream>
 
 #include "internal.h"
@@ -115,7 +117,14 @@ class Peer {
     }
 
     void stop() {
-        if (!running_.exchange(false)) return;
+        bool was_running = running_.exchange(false);
+        if (!was_running) {
+            // never started / already stopped: the async pool may still
+            // hold workers (async_submit spawns regardless) — they must
+            // be joined here or ~Peer destroys joinable std::threads
+            drain_async_pool();
+            return;
+        }
         if (listen_fd_ >= 0) {
             ::shutdown(listen_fd_, SHUT_RDWR);
             ::close(listen_fd_);
@@ -149,6 +158,38 @@ class Peer {
             in_conns_.clear();
             graveyard_.clear();
         }
+        // drain the async pool LAST: closing the endpoints/conns above
+        // unblocked any in-flight async op, so the remaining queued tasks
+        // fail fast (running_ is false) and their callbacks still fire
+        drain_async_pool();
+    }
+
+    void drain_async_pool() {
+        std::vector<std::thread> workers;
+        {
+            std::lock_guard<std::mutex> g(async_mu_);
+            async_stop_ = true;
+            workers.swap(async_workers_);
+            async_cv_.notify_all();
+        }
+        for (auto &t : workers)
+            if (t.joinable()) t.join();
+    }
+
+    // ---- async dispatch --------------------------------------------------
+    // Reference: every collective/p2p op has an async variant that runs on
+    // a library thread and invokes a done callback on completion
+    // (libkungfu-comm/collective.go:16-157, callOP main.go:163-179).  A
+    // small worker pool stands in for the reference's goroutine-per-op.
+    void async_submit(std::function<void()> task) {
+        std::lock_guard<std::mutex> g(async_mu_);
+        if (async_workers_.empty()) {
+            async_stop_ = false;
+            for (int i = 0; i < 4; i++)
+                async_workers_.emplace_back([this] { async_loop(); });
+        }
+        async_q_.push_back(std::move(task));
+        async_cv_.notify_one();
     }
 
     // Elastic fencing: adopt new version, drop outbound pool
@@ -848,6 +889,27 @@ class Peer {
     std::map<std::pair<int, int>, std::shared_ptr<Conn>> out_conns_;
     std::vector<std::shared_ptr<Conn>> in_conns_;
     std::vector<std::shared_ptr<Conn>> graveyard_;
+    std::mutex async_mu_;
+    std::condition_variable async_cv_;
+    std::deque<std::function<void()>> async_q_;
+    std::vector<std::thread> async_workers_;
+    bool async_stop_ = false;
+
+    void async_loop() {
+        for (;;) {
+            std::function<void()> task;
+            {
+                std::unique_lock<std::mutex> g(async_mu_);
+                async_cv_.wait(g, [this] {
+                    return async_stop_ || !async_q_.empty();
+                });
+                if (async_q_.empty()) return;  // stop requested + drained
+                task = std::move(async_q_.front());
+                async_q_.pop_front();
+            }
+            task();
+        }
+    }
     double recv_timeout_;
     int conn_retries_;
     int conn_retry_ms_;
@@ -941,6 +1003,30 @@ int kft_all_gather(kft_peer *p, const void *s, int64_t nbytes, void *r,
 int kft_consensus(kft_peer *p, const void *buf, int64_t nbytes,
                   const char *name) {
     return p->impl.consensus(buf, nbytes, name ? name : "consensus");
+}
+
+int kft_all_reduce_async(kft_peer *p, const void *s, void *r, int64_t count,
+                         kft_dtype dt, kft_op op, kft_strategy strat,
+                         const char *name, kft_done_cb cb, void *arg) {
+    std::string n = name ? name : "allreduce";
+    kft::Peer *impl = &p->impl;
+    impl->async_submit([impl, s, r, count, dt, op, strat, n, cb, arg] {
+        int rc = impl->all_reduce(s, r, count, dt, op, strat, n) ? 0 : -1;
+        if (cb) cb(arg, rc);
+    });
+    return 0;
+}
+
+int kft_request_async(kft_peer *p, int target, const char *name, void *buf,
+                      int64_t nbytes, int64_t version, kft_done_cb cb,
+                      void *arg) {
+    std::string n = name ? name : "";
+    kft::Peer *impl = &p->impl;
+    impl->async_submit([impl, target, n, buf, nbytes, version, cb, arg] {
+        int rc = impl->request(target, n, buf, nbytes, version) ? 0 : -1;
+        if (cb) cb(arg, rc);
+    });
+    return 0;
 }
 
 int kft_save(kft_peer *p, const char *name, const void *buf, int64_t nbytes,
