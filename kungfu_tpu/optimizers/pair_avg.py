@@ -7,15 +7,20 @@ reference picks peers randomly/round-robin via an asynchronous p2p store.
 
 TPU-native redesign: asynchronous point-to-point pulls do not exist inside
 an XLA program, so the pairing becomes a *scheduled* collective_permute:
-step t uses the shift ``1 + (t mod (n-1))``, a round-robin tournament in
-which every peer both sends and receives exactly one model per step and
-meets every other peer every n-1 steps.  This preserves AD-PSGD's gossip
-mixing (doubly-stochastic averaging matrix per step) while riding ICI at
-full bandwidth.  The deviation from true asynchrony is documented: there is
-no stale-model window; the mixing schedule is deterministic.  The
-TRUE-asynchronous store-backed variant for multi-controller setups is
-:class:`AsyncPairAverager` below (native p2p store, random/roundrobin
-peer selection).
+step t exchanges with the peer at distance ``2^(t mod ceil(log2 n))`` —
+hypercube gossip.  Every peer both sends and receives exactly one model
+per step; one cycle of the ceil(log2 n) shifts spreads every lane's value
+to all n lanes (any distance has a binary expansion), so variance
+contracts per cycle while the compiled program holds only log2(n)
+ppermute branches (a shift-per-peer round-robin was O(n^2) program text
+at 256 lanes).  This preserves AD-PSGD's gossip mixing (doubly-stochastic
+averaging matrix per step) while riding ICI at full bandwidth.  The
+deviation from true asynchrony is documented: there is no stale-model
+window; the mixing schedule is deterministic and a lane directly meets
+ceil(log2 n) distinct partners per cycle (indirect mixing covers the
+rest).  The TRUE-asynchronous store-backed variant for multi-controller
+setups is :class:`AsyncPairAverager` below (native p2p store,
+random/roundrobin peer selection, optional prefetch double-buffer).
 """
 from __future__ import annotations
 
@@ -191,10 +196,20 @@ def pair_averaging(base: optax.GradientTransformation,
         local_updates, base_state = base.update(updates, state["base"], params)
         if n == 1:
             return local_updates, {"base": base_state, "step": step + 1}
-        # round-robin shift cycle 1..n-1; every (i, i+shift) pair averages.
-        n_shifts = n - 1
+        # POWER-OF-TWO shift schedule: step t exchanges with the peer at
+        # distance 2^(t mod ceil(log2 n)) — hypercube gossip.  Each round
+        # applies the doubly-stochastic W_s = (1-mix)I + mix*P_s, and one
+        # full cycle of the log2(n) shifts spreads every lane's value to
+        # all n lanes (any distance has a binary expansion), so variance
+        # contracts per cycle just like the n-1-shift round-robin — but
+        # the compiled program holds ceil(log2 n) ppermute branches
+        # instead of n-1 (255 branches at 256 lanes was O(n^2) program
+        # text in perm entries; this is O(n log n)).
+        import math
+        k = max(1, math.ceil(math.log2(n)))
         branches = []
-        for s in range(1, n):
+        for j in range(k):
+            s = (2 ** j) % n
             perm = [(i, (i + s) % n) for i in range(n)]
 
             def make(perm):
@@ -203,7 +218,7 @@ def pair_averaging(base: optax.GradientTransformation,
                         lambda t: lax.ppermute(t, axis_name, perm=perm), p)
                 return f
             branches.append(make(perm))
-        peer_params = lax.switch(step % n_shifts, branches, params)
+        peer_params = lax.switch(step % k, branches, params)
         pull = jax.tree_util.tree_map(lambda q, p: mix * (q - p),
                                       peer_params, params)
         merged = jax.tree_util.tree_map(lambda u, d: u + d, local_updates, pull)
