@@ -1,0 +1,148 @@
+"""Minimal torch-compatible numpy tensors — the bridge's stand-in.
+
+The torch bridge (ops.py / optimizers.py) touches only a narrow tensor
+surface: dtype/device introspection, contiguity, zero-copy flat views,
+clone/copy_/view_as, ``from_numpy``, ``no_grad``, and a dynamically
+subclassable optimizer.  This module implements exactly that surface over
+numpy so the bridge's dispatch tables, in-place reduction paths, and
+optimizer grafts can execute — and be validated — in images without
+torch (reference intent: dtype-keyed dispatch with feature detection,
+srcs/python/kungfu/torch/ops/clib.py:12-36).  Inject with::
+
+    from kungfu_tpu.torch import ops
+    from kungfu_tpu.torch import numpy_compat
+    ops.use_torch(numpy_compat)
+
+NOT a torch replacement: no autograd, no nn, CPU only.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+# dtype singletons — np.dtype instances, so Tensor.dtype (also np.dtype)
+# hashes/compares correctly as dispatch-table keys
+float16 = np.dtype(np.float16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+uint8 = np.dtype(np.uint8)
+
+
+class _Device:
+    type = "cpu"
+
+    def __repr__(self):
+        return "cpu"
+
+
+_CPU = _Device()
+
+
+class Tensor:
+    """numpy-backed tensor sharing memory with its views."""
+
+    def __init__(self, array, requires_grad: bool = False):
+        self._a = np.asarray(array)
+        self.requires_grad = requires_grad
+        self.grad = None
+
+    # -- introspection
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def device(self):
+        return _CPU
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def numel(self) -> int:
+        return int(self._a.size)
+
+    def is_contiguous(self) -> bool:
+        return bool(self._a.flags["C_CONTIGUOUS"])
+
+    # -- views & copies (sharing semantics match torch where the bridge
+    # relies on them)
+    def detach(self) -> "Tensor":
+        return Tensor(self._a)  # shares memory, like torch detach
+
+    def view(self, *shape) -> "Tensor":
+        if not self.is_contiguous():
+            raise RuntimeError("view on non-contiguous tensor")
+        return Tensor(self._a.reshape(shape))  # shares memory
+
+    def view_as(self, other: "Tensor") -> "Tensor":
+        return self.view(*other.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._a  # shared, torch-style for CPU tensors
+
+    def contiguous(self) -> "Tensor":
+        return self if self.is_contiguous() else Tensor(
+            np.ascontiguousarray(self._a))
+
+    def clone(self) -> "Tensor":
+        return Tensor(self._a.copy(), requires_grad=self.requires_grad)
+
+    def copy_(self, other: "Tensor") -> "Tensor":
+        np.copyto(self._a, other._a)
+        return self
+
+    # -- minimal arithmetic used by test drivers
+    def __iadd__(self, v):
+        self._a += v._a if isinstance(v, Tensor) else v
+        return self
+
+    def __repr__(self):
+        return f"numpy_compat.Tensor({self._a!r})"
+
+
+class Parameter(Tensor):
+    def __init__(self, array):
+        super().__init__(array, requires_grad=True)
+
+
+def from_numpy(a: np.ndarray) -> Tensor:
+    return Tensor(a)  # shares memory, like torch.from_numpy
+
+
+def full(shape, value, dtype=float32) -> Tensor:
+    return Tensor(np.full(shape, value, dtype))
+
+
+def zeros(*shape, dtype=float32) -> Tensor:
+    return Tensor(np.zeros(shape, dtype))
+
+
+@contextlib.contextmanager
+def no_grad():
+    yield
+
+
+class optim:
+    """Namespace mirroring ``torch.optim`` far enough for the grafts."""
+
+    class SGD:
+        """Plain-SGD over Parameter objects (no autograd: callers set
+        ``p.grad`` themselves, as a backward pass would)."""
+
+        def __init__(self, params, lr: float = 0.01):
+            self.params = list(params)
+            self.lr = float(lr)
+
+        def zero_grad(self) -> None:
+            for p in self.params:
+                p.grad = None
+
+        def step(self, closure=None):
+            for p in self.params:
+                if p.grad is not None:
+                    p._a -= self.lr * np.reshape(p.grad._a, p._a.shape)
+            return None

@@ -12,9 +12,22 @@ import numpy as np
 
 # torch dtype -> numpy dtype the native runtime can reduce
 _SUPPORTED: Optional[Dict] = None
+_TORCH_MODULE = None
+
+
+def use_torch(module) -> None:
+    """Inject a torch-compatible module — e.g.
+    ``kungfu_tpu.torch.numpy_compat`` — so every dispatch/copy path in
+    this bridge runs (and is testable) in images without torch.  Pass
+    ``None`` to restore the real import.  Resets the dtype table."""
+    global _TORCH_MODULE, _SUPPORTED
+    _TORCH_MODULE = module
+    _SUPPORTED = None
 
 
 def _torch():
+    if _TORCH_MODULE is not None:
+        return _TORCH_MODULE
     import torch
     return torch
 

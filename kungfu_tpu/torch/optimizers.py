@@ -13,7 +13,8 @@ from typing import Optional
 
 import numpy as np
 
-from .ops import _peer, _view, inplace_all_reduce_op, inplace_broadcast_op
+from .ops import (_peer, _torch, _view, inplace_all_reduce_op,
+                  inplace_broadcast_op)
 
 
 def SynchronousSGDOptimizer(optimizer, named_parameters, op: str = "avg"):
@@ -80,7 +81,7 @@ def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
         n = peer.size
         if n > 1:
             target = self._kf_select(n, peer.rank)
-            import torch
+            torch = _torch()
             with torch.no_grad():
                 for name, p in self._kf_params():
                     v = _view(p if p.is_contiguous() else p.contiguous())
