@@ -75,29 +75,6 @@ def _host_peer():
         return None
 
 
-def _reduce_over(stacked, mask, op: str):
-    """Reduce ``stacked`` [n, ...] over the lanes selected by ``mask``."""
-    m = jnp.reshape(mask, (-1,) + (1,) * (stacked.ndim - 1))
-    if op == "MEAN":
-        s = jnp.sum(jnp.where(m, stacked, jnp.zeros_like(stacked)), 0)
-        return s / jnp.sum(mask).astype(s.dtype)
-    if op == "SUM":
-        return jnp.sum(jnp.where(m, stacked, jnp.zeros_like(stacked)), 0)
-    if op == "PROD":
-        return jnp.prod(jnp.where(m, stacked, jnp.ones_like(stacked)), 0)
-    if op == "MAX":
-        lo = jnp.full_like(stacked, jnp.finfo(stacked.dtype).min
-                           if jnp.issubdtype(stacked.dtype, jnp.floating)
-                           else jnp.iinfo(stacked.dtype).min)
-        return jnp.max(jnp.where(m, stacked, lo), 0)
-    if op == "MIN":
-        hi = jnp.full_like(stacked, jnp.finfo(stacked.dtype).max
-                           if jnp.issubdtype(stacked.dtype, jnp.floating)
-                           else jnp.iinfo(stacked.dtype).max)
-        return jnp.min(jnp.where(m, stacked, hi), 0)
-    raise ValueError(f"unknown op {op}")
-
-
 class Session:
     """One communication session over a fixed mesh + membership version."""
 
@@ -333,49 +310,144 @@ class Session:
         masters = np.asarray([p in masters_set for p in self.peers])
         return gids, masters
 
+    def _group_orders(self):
+        """Per host group: lane order [master, member, member, ...] —
+        the static schedule base for the intra-host trees."""
+        gids, masters = self._host_layout()
+        groups: Dict[int, List[int]] = {}
+        for i, g in enumerate(gids):
+            groups.setdefault(int(g), []).append(i)
+        order = {}
+        for g, lanes in groups.items():
+            m = next(i for i in lanes if masters[i])
+            order[g] = [m] + [i for i in lanes if i != m]
+        return order, masters
+
+    def _binomial_rounds(self, order):
+        """Binomial-tree combine rounds: round r sends group-local index
+        j (j ≡ 2^r mod 2^(r+1)) to j - 2^r.  Returns
+        [(perm, recv_lane_mask)] — all static, so each round is ONE
+        ppermute; total payload per lane is O(log(group)) messages of the
+        array size, not the n-times-stacked all-gather."""
+        max_sz = max(len(v) for v in order.values())
+        rounds = []
+        shift = 1
+        while shift < max_sz:
+            perm, recv = [], np.zeros(self.n, bool)
+            for lanes in order.values():
+                for j in range(shift, len(lanes), 2 * shift):
+                    perm.append((lanes[j], lanes[j - shift]))
+                    recv[lanes[j - shift]] = True
+            if perm:
+                rounds.append((tuple(perm), recv))
+            shift *= 2
+        return rounds
+
+    @staticmethod
+    def _down_rounds(rounds, n):
+        """Reverse the combine tree into its broadcast schedule:
+        [(down_perm, gets_mask)], all static."""
+        down = []
+        for perm, _recv in reversed(rounds):
+            dperm = tuple((dst, src) for (src, dst) in perm)
+            gets = np.zeros(n, bool)
+            for _, d in dperm:
+                gets[d] = True
+            down.append((dperm, gets))
+        return down
+
+    @staticmethod
+    def _combine(op: str):
+        if op in ("SUM", "MEAN"):
+            return jnp.add
+        if op == "MIN":
+            return jnp.minimum
+        if op == "MAX":
+            return jnp.maximum
+        if op == "PROD":
+            return jnp.multiply
+        raise ValueError(f"unsupported op {op}")
+
     def local_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
         """Reduce within each host onto its local master lane; other lanes
-        zero-filled (reference: LocalReduce, session.go:92-176)."""
-        gids, masters = self._host_layout()
+        zero-filled (reference: LocalReduce, session.go:92-176).
+
+        Binomial combine tree per host group — log2(host size) ppermute
+        rounds, each moving ONE array per participating lane (the old
+        all-gather-then-mask form moved and materialized the full
+        n-stacked array on every lane)."""
+        order, masters = self._group_orders()
+        rounds = self._binomial_rounds(order)
+        sizes = np.zeros(self.n, np.int64)
+        for lanes in order.values():
+            for i in lanes:
+                sizes[i] = len(lanes)
+        comb = self._combine(op)
 
         def body(v):
-            g = C.all_gather(v, self.axis, axis=0, tiled=True)  # [n, ...]
+            val = v[0]
             i = jax.lax.axis_index(self.axis)
-            mine = jnp.asarray(gids) == jnp.asarray(gids)[i]
-            red = _reduce_over(g, mine, op)
-            return jnp.where(jnp.asarray(masters)[i], red,
-                             jnp.zeros_like(red))[None]
+            for perm, recv in rounds:
+                r = jax.lax.ppermute(val, self.axis, list(perm))
+                val = jnp.where(jnp.asarray(recv)[i], comb(val, r), val)
+            if op == "MEAN":
+                val = val / jnp.asarray(sizes)[i].astype(val.dtype)
+            keep = jnp.asarray(np.asarray(masters))[i]
+            return jnp.where(keep, val, jnp.zeros_like(val))[None]
         return self._run(name or "local_reduce", jnp.asarray(x), body,
                          ("lred", op))
 
     def local_broadcast(self, x, name: str = "") -> jax.Array:
         """Every lane receives its host master's value (reference:
-        LocalBroadcast)."""
-        gids, masters = self._host_layout()
-        # master lane index for each group
-        master_of_group = {}
-        for i, (g, m) in enumerate(zip(gids, masters)):
-            if m:
-                master_of_group[int(g)] = i
-        src = np.asarray([master_of_group[int(g)] for g in gids], np.int32)
+        LocalBroadcast) — the combine tree run in reverse (binomial
+        broadcast), log2(host size) ppermute rounds."""
+        order, _ = self._group_orders()
+        down = self._down_rounds(self._binomial_rounds(order), self.n)
 
         def body(v):
-            g = C.all_gather(v, self.axis, axis=0, tiled=True)
+            val = v[0]
             i = jax.lax.axis_index(self.axis)
-            return g[jnp.asarray(src)[i]][None]
+            for dperm, gets in down:
+                r = jax.lax.ppermute(val, self.axis, list(dperm))
+                val = jnp.where(jnp.asarray(gets)[i], r, val)
+            return val[None]
         return self._run(name or "local_broadcast", jnp.asarray(x), body,
                          ("lbc",))
 
     def cross_all_reduce(self, x, op: str = "SUM", name: str = "") -> jax.Array:
         """Allreduce among the local masters only; non-master lanes pass
-        through unchanged (reference: CrossAllReduce, allreduce.go)."""
-        gids, masters = self._host_layout()
+        through unchanged (reference: CrossAllReduce, allreduce.go).
+
+        Binomial reduce to the FIRST master then binomial broadcast back
+        (2*ceil(log2 M) ppermute rounds, masters only).  A rotate-and-add
+        ring would be fewer lines but gives each master a different fp
+        accumulation ORDER — last-ulp divergence that breaks the
+        bit-exact consensus contract; reducing at one lane and fanning
+        the identical bits back out keeps every master bitwise equal."""
+        _gids, masters = self._host_layout()
+        mlanes = [i for i in range(self.n) if masters[i]]
+        M = len(mlanes)
+        comb = self._combine(op)
+        rounds = self._binomial_rounds({0: mlanes})
+        down = self._down_rounds(rounds, self.n)
 
         def body(v):
-            g = C.all_gather(v, self.axis, axis=0, tiled=True)
-            i = jax.lax.axis_index(self.axis)
-            red = _reduce_over(g, jnp.asarray(masters), op)
-            return jnp.where(jnp.asarray(masters)[i], red, v[0])[None]
+            val = v[0]
+            if M > 1:
+                i = jax.lax.axis_index(self.axis)
+                acc = val
+                for perm, recv in rounds:
+                    r = jax.lax.ppermute(acc, self.axis, list(perm))
+                    acc = jnp.where(jnp.asarray(recv)[i], comb(acc, r),
+                                    acc)
+                if op == "MEAN":
+                    acc = acc / jnp.asarray(float(M), acc.dtype)
+                for dperm, gets in down:
+                    r = jax.lax.ppermute(acc, self.axis, list(dperm))
+                    acc = jnp.where(jnp.asarray(gets)[i], r, acc)
+                val = jnp.where(jnp.asarray(np.asarray(masters))[i],
+                                acc, val)
+            return val[None]
         return self._run(name or "cross_all_reduce", jnp.asarray(x), body,
                          ("xar", op))
 
@@ -389,7 +461,14 @@ class Session:
 
     def gather(self, x, root: int = 0, name: str = "") -> jax.Array:
         """Gather shards to ``root`` lane; others zero-filled
-        (reference: session.go:185-207)."""
+        (reference: session.go:185-207).
+
+        COST NOTE: implemented as all-gather-then-mask — the root must
+        hold n shards anyway, but every OTHER lane also materializes the
+        [n, ...] stack transiently.  Fine for control-plane payloads
+        (latencies, digests, counters); for model-sized arrays prefer
+        reduce()/all_reduce or the native host plane's gather, which
+        collects at the root only."""
         def body(v):
             g = C.all_gather(v, self.axis, axis=0, tiled=True)[None]
             idx = jax.lax.axis_index(self.axis)
