@@ -356,6 +356,22 @@ class Session:
             down.append((dperm, gets))
         return down
 
+    def _tree_sweep(self, val, rounds, i, comb):
+        """Up-sweep: one ppermute + masked combine per round (shared by
+        local_reduce and cross_all_reduce)."""
+        for perm, recv in rounds:
+            r = jax.lax.ppermute(val, self.axis, list(perm))
+            val = jnp.where(jnp.asarray(recv)[i], comb(val, r), val)
+        return val
+
+    def _tree_fanout(self, val, down, i):
+        """Down-sweep: one ppermute + masked replace per round (shared by
+        local_broadcast and cross_all_reduce)."""
+        for dperm, gets in down:
+            r = jax.lax.ppermute(val, self.axis, list(dperm))
+            val = jnp.where(jnp.asarray(gets)[i], r, val)
+        return val
+
     @staticmethod
     def _combine(op: str):
         if op in ("SUM", "MEAN"):
@@ -385,11 +401,8 @@ class Session:
         comb = self._combine(op)
 
         def body(v):
-            val = v[0]
             i = jax.lax.axis_index(self.axis)
-            for perm, recv in rounds:
-                r = jax.lax.ppermute(val, self.axis, list(perm))
-                val = jnp.where(jnp.asarray(recv)[i], comb(val, r), val)
+            val = self._tree_sweep(v[0], rounds, i, comb)
             if op == "MEAN":
                 val = val / jnp.asarray(sizes)[i].astype(val.dtype)
             keep = jnp.asarray(np.asarray(masters))[i]
@@ -405,12 +418,8 @@ class Session:
         down = self._down_rounds(self._binomial_rounds(order), self.n)
 
         def body(v):
-            val = v[0]
             i = jax.lax.axis_index(self.axis)
-            for dperm, gets in down:
-                r = jax.lax.ppermute(val, self.axis, list(dperm))
-                val = jnp.where(jnp.asarray(gets)[i], r, val)
-            return val[None]
+            return self._tree_fanout(v[0], down, i)[None]
         return self._run(name or "local_broadcast", jnp.asarray(x), body,
                          ("lbc",))
 
@@ -435,16 +444,10 @@ class Session:
             val = v[0]
             if M > 1:
                 i = jax.lax.axis_index(self.axis)
-                acc = val
-                for perm, recv in rounds:
-                    r = jax.lax.ppermute(acc, self.axis, list(perm))
-                    acc = jnp.where(jnp.asarray(recv)[i], comb(acc, r),
-                                    acc)
+                acc = self._tree_sweep(val, rounds, i, comb)
                 if op == "MEAN":
                     acc = acc / jnp.asarray(float(M), acc.dtype)
-                for dperm, gets in down:
-                    r = jax.lax.ppermute(acc, self.axis, list(dperm))
-                    acc = jnp.where(jnp.asarray(gets)[i], r, acc)
+                acc = self._tree_fanout(acc, down, i)
                 val = jnp.where(jnp.asarray(np.asarray(masters))[i],
                                 acc, val)
             return val[None]

@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-devices-per-worker", type=int, default=0,
                    help="KFT_NUM_LOCAL_DEVICES for each worker")
     p.add_argument("-logdir", default="", help="per-worker log directory")
+    p.add_argument("-debug-port", type=int, default=0,
+                   help="watch mode only: serve the runner's Stage "
+                        "history + worker state as JSON on this port "
+                        "(reference: kungfu-run -debug-port, "
+                        "handler.go:117-122)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("prog", nargs=argparse.REMAINDER,
                    help="worker command line")
@@ -136,7 +141,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         if args.watch:
             return watch_run(job, args.self_host, parent, cluster, config_url,
-                             pool=pool)
+                             pool=pool, debug_port=args.debug_port)
+        if args.debug_port:
+            print("kft-run: -debug-port is watch-mode only (add -w); "
+                  "no debug server started", file=sys.stderr)
         procs = job.create_procs(cluster, args.self_host, parent, pool=pool)
         if not procs:
             print(f"no local workers on {args.self_host}", file=sys.stderr)

@@ -9,6 +9,7 @@ TPU-VM preemption notices can inject updates the same way).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -23,6 +24,8 @@ from .proc import Proc
 class Watcher:
     """Per-host process reconciler."""
 
+    HISTORY_LIMIT = 64
+
     def __init__(self, job: Job, host: str, parent: PeerID,
                  pool: Optional[ChipPool] = None):
         self.job = job
@@ -35,6 +38,9 @@ class Watcher:
         self.failed: Optional[int] = None
         self._last_cluster: Optional[Cluster] = None
         self._done: set = set()  # peers that exited cleanly this version
+        # applied Stage history for the debug endpoint (reference: the
+        # runner's -debug-port dump, handler.go:117-122)
+        self.history: List[Dict] = []
         self._lock = threading.Lock()
 
     def local_workers(self, cluster: Cluster) -> List[PeerID]:
@@ -57,6 +63,13 @@ class Watcher:
                 self._spawn(peer, cluster, version)
             self.version = version
             self._last_cluster = cluster
+            self.history.append({
+                "version": version,
+                "time": time.time(),
+                "cluster_size": cluster.size(),
+                "local": [str(w) for w in sorted(want)],
+            })
+            del self.history[:-self.HISTORY_LIMIT]
 
     def _spawn(self, peer: PeerID, cluster: Cluster, version: int) -> bool:
         """Spawn one worker; False when the chip pool is exhausted (the
@@ -120,10 +133,48 @@ class Watcher:
             return len(self.current)
 
 
+def _start_debug_server(w: "Watcher", port: int):
+    """HTTP endpoint dumping the runner's applied Stage history + live
+    worker state (reference: runner -debug-port, handler.go:117-122)."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler
+
+    from ..utils.http import BackgroundHTTPServer
+
+    def factory(_srv):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                with w._lock:
+                    body = _json.dumps({
+                        "host": w.host,
+                        "version": w.version,
+                        "alive": {str(p): proc.poll() is None
+                                  for p, proc in w.current.items()},
+                        "failed": w.failed,
+                        "history": list(w.history),
+                    }, indent=2).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+        return Handler
+
+    # loopback like every other embedded server (the reference's debug
+    # endpoint is likewise an operator-local tool); set KFT_DEBUG_BIND to
+    # widen deliberately
+    bind = os.environ.get("KFT_DEBUG_BIND", "127.0.0.1")
+    return BackgroundHTTPServer(factory, host=bind, port=port).start()
+
+
 def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
               config_url: Optional[str], poll_interval: float = 0.5,
               pool: Optional[ChipPool] = None,
-              stop_when_empty: bool = True) -> int:
+              stop_when_empty: bool = True,
+              debug_port: int = 0) -> int:
     """Run the elastic watch loop until the *global* cluster drains or a
     local worker fails (reference: watch.go:106-135 WatchRun).
 
@@ -154,6 +205,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
         exited.set()
         wake.set()
 
+    debug = _start_debug_server(w, debug_port) if debug_port else None
     control = None
     try:
         from .control import ControlServer
@@ -218,3 +270,5 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     finally:
         if control is not None:
             control.stop()
+        if debug is not None:
+            debug.stop()

@@ -127,3 +127,59 @@ def test_resize_propagates_without_poll_interval(tmp_path, monkeypatch):
         assert elapsed < 120
     finally:
         srv.stop()
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_debug_endpoint_serves_stage_history(tmp_path, monkeypatch):
+    """The -debug-port endpoint must expose the applied Stage history and
+    live worker state while a watch run is in flight (reference: runner
+    -debug-port, handler.go:117-122)."""
+    import json
+    import socket as _socket
+    import urllib.request
+
+    from kungfu_tpu.elastic import ConfigServer, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text("import time; time.sleep(4)")
+    s = _socket.socket(); s.bind(("127.0.0.1", 0))
+    dbg_port = s.getsockname()[1]; s.close()
+
+    cluster = _cluster(2)
+    srv = ConfigServer().start()
+    result = {}
+
+    def run():
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        result["rc"] = watch_run(job, "127.0.0.1",
+                                 PeerID("127.0.0.1", 31940), cluster,
+                                 srv.url, poll_interval=0.2,
+                                 debug_port=dbg_port)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        snap = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{dbg_port}/", timeout=2) as r:
+                    snap = json.loads(r.read())
+                if snap["history"] and len(snap["alive"]) == 2:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert snap is not None and snap["history"], snap
+        assert snap["history"][-1]["cluster_size"] == 2
+        assert len(snap["history"][-1]["local"]) == 2
+        assert snap["failed"] is None
+    finally:
+        t.join(timeout=30)
+        srv.stop()
+    assert result.get("rc") == 0
