@@ -83,6 +83,11 @@ def main():
     sp = replicate(params, mesh)
     sms = replicate(bstats, mesh)
     st = init_opt_state(opt, sp, mesh)
+    # NOTE: no compute_dtype here — measured 20% SLOWER for ResNet-50
+    # (25M params: the upfront cast pass breaks XLA's fuse-cast-into-conv
+    # pattern and saves nothing).  Mixed-precision master weights pay off
+    # for GPT-class models whose weight bytes rival the activations
+    # (benchmarks/gpt.py uses it); they are not a universal win.
     step = build_train_step_with_state(loss_fn, opt, mesh, donate=True)
 
     # NOTE: under remote-tunnelled TPU runtimes block_until_ready may not

@@ -147,6 +147,27 @@ def _cast_params(params, dtype):
         if jnp.issubdtype(t.dtype, jnp.floating) else t, params)
 
 
+def _mixed_precision(grads_of: Callable, compute_dtype, has_aux: bool):
+    """Wrap a grads_of so the loss/grads run on a cast copy of the params
+    while the caller keeps updating the f32 master (shared by both step
+    builders — the casting rules must never diverge between them)."""
+    if compute_dtype is None:
+        return grads_of
+    upcast = lambda grads, params: jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, params)
+    if has_aux:
+        def wrapped(params, mstate, batch):
+            out, grads = grads_of(_cast_params(params, compute_dtype),
+                                  mstate, batch)
+            return out, upcast(grads, params)
+    else:
+        def wrapped(params, batch):
+            loss, grads = grads_of(_cast_params(params, compute_dtype),
+                                   batch)
+            return loss, upcast(grads, params)
+    return wrapped
+
+
 def build_train_step(loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      mesh: Optional[Mesh] = None,
@@ -181,18 +202,14 @@ def build_train_step(loss_fn: Callable,
     if accum_steps < 1:
         raise ValueError("accum_steps must be >= 1")
 
-    grads_of = _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=False)
+    grads_of = _mixed_precision(
+        _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=False),
+        compute_dtype, has_aux=False)
 
     def body(stacked_params, stacked_state, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)
         state = jax.tree_util.tree_map(lambda t: t[0], stacked_state)
-        if compute_dtype is not None:
-            cp = _cast_params(params, compute_dtype)
-            loss, grads = grads_of(cp, batch)
-            grads = jax.tree_util.tree_map(
-                lambda g, p: g.astype(p.dtype), grads, params)
-        else:
-            loss, grads = grads_of(params, batch)
+        loss, grads = grads_of(params, batch)
         updates, state = optimizer.update(grads, state, params)
         params = optax.apply_updates(params, updates)
         mean_loss = jax.lax.pmean(loss, axis)
@@ -216,7 +233,8 @@ def build_train_step_with_state(loss_fn: Callable,
                                 mesh: Optional[Mesh] = None,
                                 sync_model_state: bool = True,
                                 donate: bool = True,
-                                accum_steps: int = 1) -> Callable:
+                                accum_steps: int = 1,
+                                compute_dtype=None) -> Callable:
     """Like build_train_step, for models with non-trained state (BatchNorm
     running stats).  ``loss_fn(params, model_state, batch) -> (loss,
     new_model_state)``.  When ``sync_model_state`` is set the new state is
@@ -233,7 +251,9 @@ def build_train_step_with_state(loss_fn: Callable,
     if accum_steps < 1:
         raise ValueError("accum_steps must be >= 1")
 
-    grads_of = _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=True)
+    grads_of = _mixed_precision(
+        _accum_grads_fn(loss_fn, axis, accum_steps, has_aux=True),
+        compute_dtype, has_aux=True)
 
     def body(stacked_params, stacked_state, stacked_mstate, batch):
         params = jax.tree_util.tree_map(lambda t: t[0], stacked_params)

@@ -269,6 +269,10 @@ def _w_async_pair_avg(rank, peers, q, selection):
     from kungfu_tpu.optimizers import AsyncPairAverager
     try:
         n = len(peers)
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # host-plane test: the
+        # tiny per-step jnp math must not ride the TPU tunnel (60 steps of
+        # remote dispatch made this flaky under full-suite load)
         with NativePeer(rank, peers) as p:
             import jax.numpy as jnp
             target = jnp.asarray([3.0, -2.0, 1.0, 4.0])

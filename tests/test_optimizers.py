@@ -322,3 +322,33 @@ def test_pair_averaging_execution_converges():
     w = np.asarray(sp["w"]).ravel()
     assert w.std() < 0.05 * np.arange(n).std(), w
     np.testing.assert_allclose(w.mean(), np.arange(n).mean(), rtol=1e-5)
+
+
+def test_with_state_compute_dtype_master_stays_f32():
+    """build_train_step_with_state(compute_dtype=bf16): f32 master
+    updated from bf16-compute grads; BN-style model state still synced."""
+    n = 2
+    mesh = flat_mesh(n=n)
+    params = {"w": jnp.ones((4, 2), jnp.float32)}
+    mstate = {"count": jnp.zeros((), jnp.float32)}
+
+    def loss_fn(p, ms, batch):
+        bx, by = batch
+        pred = (bx.astype(p["w"].dtype) @ p["w"]).astype(jnp.float32)
+        return jnp.mean((pred - by) ** 2), {"count": ms["count"] + 1}
+
+    opt = kfopt.synchronous_sgd(optax.sgd(0.1))
+    sp = replicate(params, mesh)
+    sms = replicate(mstate, mesh)
+    st = init_opt_state(opt, sp, mesh)
+    from kungfu_tpu.training import build_train_step_with_state
+    step = build_train_step_with_state(loss_fn, opt, mesh, donate=False,
+                                       compute_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(3)
+    x = rng.randn(2 * n, 4).astype(np.float32)
+    y = rng.randn(2 * n, 2).astype(np.float32)
+    sp, st, sms, loss = step(sp, st, sms, (jnp.asarray(x), jnp.asarray(y)))
+    w = np.asarray(sp["w"])
+    assert w.dtype == np.float32
+    assert not np.allclose(w[0], 1.0)  # actually updated
+    np.testing.assert_allclose(np.asarray(sms["count"])[0], 1.0)
