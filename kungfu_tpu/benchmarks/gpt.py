@@ -192,6 +192,10 @@ def _decode_bench(args, cfg, params, n_params) -> int:
         rng.randint(0, cfg.vocab_size, (args.batch, args.prompt_len)),
         jnp.int32)
 
+    # NOTE: serving bf16-cast params measured ~30% SLOWER than the f32
+    # masters here (11.9k -> 8.4k tok/s at batch 16) — XLA already hoists
+    # the per-use bf16 casts out of the decode scan, and the pre-cast
+    # form loses the fusion.  Don't "optimize" this without re-measuring.
     gen = jax.jit(lambda p, t: generate(p, cfg, t, n_new,
                                         max_len=args.seq))
     out = np.asarray(gen(params, prompt))  # compile + warm
