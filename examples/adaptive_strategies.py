@@ -64,7 +64,40 @@ def main():
             print(f"  {s:12s} {dt * 1e3:7.2f} ms/allreduce "
                   f"({mibs:6.1f} MiB/s)  {'<- adapt to this' if s == best else ''}")
 
-    # 3. monitoring: egress accounting per peer
+    # 3. consensus-fenced strategy switch (reference: adaptation.go:8-28
+    # — barrier + digest consensus so every process switches atomically
+    # or none does).  Every process derives the SAME winner from the
+    # shared bench results, so the digest consensus commits.
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from kungfu_tpu.comm.mesh import flat_mesh
+    from kungfu_tpu.comm.session import Session
+    from kungfu_tpu.plan import Strategy
+
+    sess = Session(mesh=flat_mesh(n=1))  # this controller's 1-lane view
+    best_named = min((s for s in results if s != "MST"),
+                     key=results.get)
+    ok = sess.set_strategy_fenced(Strategy.parse(best_named))
+    if rank == 0:
+        print(f"fenced switch to {best_named}: "
+              f"{'committed' if ok else 'aborted'} on all {p.size} "
+              f"processes")
+
+    # 4. majority-vote interference check over REAL samples
+    # (adaptiveStrategies.go:61-121 — one slow process cannot flip the
+    # cluster).  Feed the measured bench windows into the session stats,
+    # fold the first (healthy) window into the EMA baseline, then vote.
+    for s, dt in results.items():
+        sess.record(f"bench-{s}", 1 << 20, dt)
+    sess.auto_adapt(fenced=True)        # healthy window -> baseline
+    for s, dt in results.items():       # second window, same rates
+        sess.record(f"bench-{s}", 1 << 20, dt)
+    vote = sess.check_interference_global()
+    if rank == 0:
+        print(f"cluster interference vote: "
+              f"{'interference' if vote else 'healthy'}")
+
+    # 5. monitoring: egress accounting per peer
     total = p.egress_bytes()
     p.barrier(name="done")
     print(f"rank {rank}: sent {total / (1 << 20):.1f} MiB during the run")
