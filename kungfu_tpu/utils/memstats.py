@@ -1,0 +1,93 @@
+"""Compile-time memory analysis for jitted steps.
+
+The round-2 perf work lived and died by XLA's memory analysis (selective
+remat looked cheap by residual count but its TEMP allocations tripled the
+footprint); this exposes that workflow as a utility so a user can answer
+"will this step fit / where does the HBM go?" before burning a real-chip
+OOM.  No reference analogue — the reference's memory story is CUDA's
+allocator; under XLA the budget is decided at compile time, which is
+exactly when this reads it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class MemStats:
+    """Bytes as XLA's compiled-program analysis reports them."""
+    argument_bytes: int
+    output_bytes: int
+    alias_bytes: int      # donated/aliased in+out (counted once on device)
+    temp_bytes: int       # activations, residuals, scratch
+    generated_code_bytes: int
+
+    @property
+    def peak_bytes(self) -> int:
+        """Approximate device footprint: arguments + temps + generated
+        code (+ outputs not aliased onto arguments)."""
+        return (self.argument_bytes + self.temp_bytes
+                + self.generated_code_bytes
+                + max(0, self.output_bytes - self.alias_bytes))
+
+    def summary(self) -> str:
+        gib = 1 << 30
+        return (f"args {self.argument_bytes / gib:.2f} GiB | "
+                f"temps {self.temp_bytes / gib:.2f} GiB | "
+                f"outputs {self.output_bytes / gib:.2f} GiB "
+                f"(aliased {self.alias_bytes / gib:.2f}) | "
+                f"code {self.generated_code_bytes / gib:.2f} GiB | "
+                f"~peak {self.peak_bytes / gib:.2f} GiB")
+
+
+def memory_analysis(fn: Callable, *args,
+                    static_argnums=(), **kwargs) -> MemStats:
+    """Compile ``fn`` for ``args`` WITHOUT running it and return its
+    memory analysis.
+
+    ``fn`` may already be jitted (its lower() is used directly) or a
+    plain function (wrapped in jax.jit here).  Works with sharded inputs
+    — pass exactly what you would pass to the step.
+    """
+    if hasattr(fn, "lower"):
+        if static_argnums:
+            raise ValueError("fn is already jitted; its own static_argnums "
+                             "apply — passing them here would be ignored")
+        jitted = fn
+    else:
+        jitted = jax.jit(fn, static_argnums=static_argnums)
+    ma = jitted.lower(*args, **kwargs).compile().memory_analysis()
+    if ma is None or not hasattr(ma, "argument_size_in_bytes"):
+        # unknown must be LOUD: an all-zero MemStats would make
+        # will_fit() bless a step that OOMs on chip
+        raise RuntimeError(
+            "this backend's compiled.memory_analysis() reports nothing; "
+            "memory_analysis() cannot answer here")
+    return MemStats(
+        argument_bytes=int(ma.argument_size_in_bytes),
+        output_bytes=int(ma.output_size_in_bytes),
+        alias_bytes=int(ma.alias_size_in_bytes),
+        temp_bytes=int(ma.temp_size_in_bytes),
+        generated_code_bytes=int(ma.generated_code_size_in_bytes),
+    )
+
+
+def will_fit(fn: Callable, *args, hbm_bytes: Optional[int] = None,
+             margin: float = 0.9, **kwargs) -> bool:
+    """True when the compiled step's approximate peak stays under
+    ``margin`` x device memory (defaults to the first device's reported
+    memory; pass ``hbm_bytes`` explicitly when that is unavailable)."""
+    if hbm_bytes is None:
+        stats: Dict[str, Any] = {}
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        hbm_bytes = stats.get("bytes_limit", 0)
+        if not hbm_bytes:
+            raise ValueError("device memory unknown; pass hbm_bytes=")
+    ms = memory_analysis(fn, *args, **kwargs)
+    return ms.peak_bytes <= margin * hbm_bytes

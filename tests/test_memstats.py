@@ -1,0 +1,33 @@
+"""Compile-time memory analysis utility (utils/memstats.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_memory_analysis_reports_step_footprint():
+    """memstats: compile-only analysis of a jitted fn, no execution."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.utils.memstats import MemStats, memory_analysis, will_fit
+
+    def fn(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    x = jnp.ones((64, 128))
+    w = jnp.ones((128, 256))
+    ms = memory_analysis(fn, x, w)
+    assert isinstance(ms, MemStats)
+    assert ms.argument_bytes >= x.nbytes + w.nbytes
+    assert ms.peak_bytes >= ms.argument_bytes
+    assert "GiB" in ms.summary()
+    # a pre-jitted fn works too
+    ms2 = memory_analysis(jax.jit(fn), x, w)
+    assert ms2.argument_bytes == ms.argument_bytes
+    assert will_fit(fn, x, w, hbm_bytes=64 << 30)
+    assert not will_fit(fn, x, w, hbm_bytes=1024)
+    import pytest
+    with pytest.raises(ValueError, match="already jitted"):
+        memory_analysis(jax.jit(fn, static_argnums=()), x, w,
+                        static_argnums=(1,))
