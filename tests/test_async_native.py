@@ -44,16 +44,22 @@ def _spawn(target, n, *extra):
     for p in procs:
         p.start()
     results = {}
-    for _ in range(n):
-        r, val = q.get(timeout=180)
-        if isinstance(val, str) and val.startswith("ERROR"):
-            for p in procs:
+    try:
+        for _ in range(n):
+            r, val = q.get(timeout=180)
+            if isinstance(val, str) and val.startswith("ERROR"):
+                raise AssertionError(f"worker {r}: {val}")
+            results[r] = val
+        for p in procs:
+            p.join(timeout=30)
+            assert p.exitcode == 0
+    finally:
+        # ALWAYS reap: a worker hung in native code would otherwise be
+        # joined forever by multiprocessing's atexit handler, turning a
+        # failed hang-regression test into a hung pytest session
+        for p in procs:
+            if p.is_alive():
                 p.terminate()
-            raise AssertionError(f"worker {r}: {val}")
-        results[r] = val
-    for p in procs:
-        p.join(timeout=30)
-        assert p.exitcode == 0
     return results
 
 

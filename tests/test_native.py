@@ -44,16 +44,22 @@ def _spawn(target, n, *extra):
     for p in procs:
         p.start()
     results = {}
-    for _ in range(n):
-        r, val = q.get(timeout=120)
-        if isinstance(val, str) and val.startswith("ERROR"):
-            for p in procs:
+    try:
+        for _ in range(n):
+            r, val = q.get(timeout=120)
+            if isinstance(val, str) and val.startswith("ERROR"):
+                raise AssertionError(f"worker {r}: {val}")
+            results[r] = val
+        for p in procs:
+            p.join(timeout=30)
+            assert p.exitcode == 0
+    finally:
+        # ALWAYS reap: a worker hung in native code would otherwise be
+        # joined forever by multiprocessing's atexit handler, turning a
+        # failed hang-regression test into a hung pytest session
+        for p in procs:
+            if p.is_alive():
                 p.terminate()
-            raise AssertionError(f"worker {r}: {val}")
-        results[r] = val
-    for p in procs:
-        p.join(timeout=30)
-        assert p.exitcode == 0
     return results
 
 
@@ -349,3 +355,41 @@ def test_f16_reduce_simd_tail_bit_identical():
     for bits in results.values():
         assert len(set(bits)) == 1, bits
     assert results[0] == results[1]
+
+
+def _w_dead_peer(rank, peers, q):
+    import os
+    import time
+    os.environ["KFT_RECV_TIMEOUT_S"] = "3"
+    os.environ["KFT_CONN_RETRIES"] = "10"  # dead-peer dials give up in ~2s
+    from kungfu_tpu.native import NativeError, NativePeer
+    try:
+        with NativePeer(rank, peers) as p:
+            p.barrier(name="up")
+            if rank == 2:
+                q.put((rank, "ok"))  # simulate a crash: vanish mid-job
+                q.close()
+                q.join_thread()  # flush the feeder BEFORE the hard exit
+                os._exit(0)
+            t0 = time.time()
+            try:
+                p.all_reduce(np.ones(4, np.float32), name="doomed")
+                q.put((rank, "ERROR collective succeeded without peer 2"))
+                return
+            except NativeError:
+                pass
+            dt = time.time() - t0
+            # fail FAST and CLEANLY: bounded by the configured recv
+            # timeout (+ margin), never a hang
+            assert dt < 30, dt
+            q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def test_dead_peer_fails_collectives_cleanly():
+    """Failure detection (SURVEY §5): when a peer dies, survivors' next
+    collective raises NativeError within the configured receive timeout
+    instead of hanging (reference: bounded conn retries + recv deadlines,
+    config.go:16-19)."""
+    _spawn(_w_dead_peer, 3)
