@@ -393,3 +393,47 @@ def test_dead_peer_fails_collectives_cleanly():
     instead of hanging (reference: bounded conn retries + recv deadlines,
     config.go:16-19)."""
     _spawn(_w_dead_peer, 3)
+
+
+def _w_stall(rank, peers, q):
+    import os
+    import time
+    from kungfu_tpu.native import NativePeer
+    try:
+        with NativePeer(rank, peers) as p:
+            p.set_stall_threshold(1.0)
+            p.barrier(name="up")
+            if rank == 1:
+                time.sleep(4)  # make rank 0's collective pend > threshold
+                p.all_reduce(np.ones(2, np.float32), name="slow")
+                q.put((rank, "ok"))
+                return
+            # capture the C++ runtime's stderr (fd 2): the stall report
+            # is an fprintf from the service thread
+            cap = os.path.join(os.environ["STALL_OUT"], f"err.{rank}")
+            fd = os.open(cap, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+            saved = os.dup(2)
+            os.dup2(fd, 2)
+            try:
+                p.all_reduce(np.ones(2, np.float32), name="slow")
+                time.sleep(0.5)
+            finally:
+                os.dup2(saved, 2)
+                os.close(fd)
+                os.close(saved)
+            with open(cap) as f:
+                text = f.read()
+            q.put((rank, "ok" if "STALL" in text else
+                   f"ERROR no stall report in: {text!r}"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def test_stall_detector_reports_pending_op(tmp_path, monkeypatch):
+    """An op pending past the stall threshold is reported by the service
+    loop while it is still in flight (reference: InstallStallDetector,
+    libkungfu-comm/main.go:165-175, gated KUNGFU_CONFIG_ENABLE_STALL_
+    DETECTION — here kft_set_stall_threshold / KFT_CONFIG_ENABLE_STALL_
+    DETECTION)."""
+    monkeypatch.setenv("STALL_OUT", str(tmp_path))
+    _spawn(_w_stall, 2)
