@@ -129,9 +129,12 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
     from ..ops.flash_attention import flash_attention_with_lse
 
-    o0, lse0 = flash_attention_with_lse(q, _expand_groups(k, kv_groups),
-                                        _expand_groups(v, kv_groups),
-                                        causal, block_q, block_k)
+    # KV stays COMPACT end-to-end under GQA: transported compact over the
+    # ring AND handed to the kernel compact (its VJP expands internally
+    # and keeps compact residuals) — kv_groups-times less inter-chip
+    # traffic and saved-activation memory per chunk
+    o0, lse0 = flash_attention_with_lse(q, k, v, causal, block_q, block_k,
+                                        kv_groups=kv_groups)
     acc = o0.astype(jnp.float32)
     lse_acc = lse0                       # [B, H, T_local] f32
 
@@ -140,8 +143,7 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
         kc = lax.ppermute(kc, axis_name, perm=perm)
         vc = lax.ppermute(vc, axis_name, perm=perm)
         oi, lsei = flash_attention_with_lse(
-            q, _expand_groups(kc, kv_groups), _expand_groups(vc, kv_groups),
-            False, block_q, block_k)
+            q, kc, vc, False, block_q, block_k, kv_groups=kv_groups)
         if causal:
             # wrapped chunks (src rank > this rank) are future: weight 0
             lsei = jnp.where(rank >= s, lsei, NEG_INF)
