@@ -1,0 +1,79 @@
+"""KV-cache autoregressive generation with the GPT family.
+
+Single-device greedy + sampled decoding through the jittable
+prefill/generate path (models/gpt.py) — the same loop the
+tensor-parallel decoder drives with sharded caches
+(parallel/threed.make_tp_generate).  Runs anywhere:
+
+    python examples/gpt_generate.py            # TPU if present, else CPU
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu.models.gpt import (GPTConfig, generate, init_params,
+                                   loss_fn)
+
+
+def main():
+    cfg = GPTConfig(vocab_size=512, d_model=128, n_heads=4, n_layers=4,
+                    d_ff=512, max_seq=256, dtype=jnp.bfloat16,
+                    n_kv_heads=2, rope=True, mlp="swiglu")
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+
+    # a tiny next-token structure to learn: t+1 = (5*t + 7) mod 509
+    # (prime modulus -> long orbits, no fixed-point collapse); a few SGD
+    # steps teach greedy decoding to continue it
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 509, (16, 128)).astype(np.int32)
+    for j in range(1, toks.shape[1]):  # sequential: a REAL recurrence
+        toks[:, j] = (5 * toks[:, j - 1] + 7) % 509
+
+    grad = jax.jit(jax.grad(
+        lambda p, t: loss_fn(p, t[:, :-1], t[:, 1:], cfg)))
+    step = jax.jit(lambda p, g: jax.tree_util.tree_map(
+        lambda a, b: a - 0.5 * b, p, g))
+    for i in range(60):
+        params = step(params, grad(params, jnp.asarray(toks)))
+    final = float(loss_fn(params, jnp.asarray(toks[:, :-1]),
+                          jnp.asarray(toks[:, 1:]), cfg))
+    print(f"trained 60 steps, loss={final:.4f}")
+
+    prompt = jnp.asarray(toks[:2, :64])
+    greedy = np.asarray(jax.jit(
+        lambda p, t: generate(p, cfg, t, 12))(params, prompt))
+
+    # oracle check: KV-cache incremental decoding must reproduce the
+    # full teacher-forced forward rolled out token by token
+    from kungfu_tpu.models.gpt import forward
+    ctx = np.asarray(prompt)
+    for j in range(4):  # each length is its own compile; 4 is plenty
+        logits = forward(params, jnp.asarray(ctx), cfg)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        assert (greedy[:, j] == nxt).all(), (j, greedy[:, j], nxt)
+        ctx = np.concatenate([ctx, nxt[:, None]], axis=1)
+    print("KV-cache decode == dense forward rollout (first 4 tokens)")
+
+    want = np.asarray(prompt[:, -1])
+    hits = 0
+    for j in range(greedy.shape[1]):
+        want = (5 * want + 7) % 509
+        hits += int((greedy[:, j] == want).all())
+    print(f"greedy continuation follows the learned recurrence on "
+          f"{hits}/{greedy.shape[1]} steps")
+
+    sampled = np.asarray(jax.jit(
+        lambda p, t: generate(p, cfg, t, 12, temperature=4.0,
+                              rng=jax.random.PRNGKey(7)))(params, prompt))
+    print(f"sampled continuation (T=4.0), first row: "
+          f"{sampled[0].tolist()}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
