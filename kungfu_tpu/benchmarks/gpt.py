@@ -25,8 +25,36 @@ import time
 import numpy as np
 
 
+# one-flag reproductions of the README's headline rows; every field can
+# still be overridden by an explicit flag AFTER --preset
+PRESETS = {
+    "164m": ["--seq", "2048", "--batch", "64", "--n-kv-heads", "4",
+             "--rope", "--swiglu", "--accum", "16",
+             "--chunked-ce", "16384"],
+    "470m": ["--d-model", "1024", "--n-layers", "24", "--n-heads", "16",
+             "--n-kv-heads", "4", "--d-ff", "4096", "--seq", "2048",
+             "--batch", "64", "--rope", "--swiglu", "--accum", "32",
+             "--chunked-ce", "16384"],
+    "164m-long": ["--seq", "8192", "--batch", "16", "--n-kv-heads", "4",
+                  "--rope", "--swiglu", "--accum", "16",
+                  "--chunked-ce", "8192"],
+}
+
+
 def parse_args(argv=None):
+    if argv is None:
+        import sys as _sys
+        argv = _sys.argv[1:]
+    # pre-parse --preset (handles both "--preset X" and "--preset=X")
+    # and splice its flags FIRST so explicit flags win
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--preset", choices=list(PRESETS))
+    known, rest = pre.parse_known_args(list(argv))
+    argv = (PRESETS[known.preset] + rest) if known.preset else rest
     p = argparse.ArgumentParser(description="GPT training throughput")
+    p.add_argument("--preset", choices=list(PRESETS), default=None,
+                   help="flag bundle reproducing a README benchmark row "
+                        "(applied before other flags, which override it)")
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--d-model", type=int, default=768)
     p.add_argument("--n-layers", type=int, default=12)
