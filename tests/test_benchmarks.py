@@ -112,3 +112,26 @@ def test_gpt_bench_decode_rejects_training_flags():
         gpt_main(["--decode", "--chunked-ce", "64", "--d-model", "32",
                   "--n-heads", "2", "--n-layers", "1", "--vocab", "64",
                   "--seq", "32"])
+
+
+def test_gpt_preset_expansion_and_override():
+    """--preset splices the README row's flags; explicit flags win; both
+    --preset X and --preset=X forms parse; bad names are rejected."""
+    from kungfu_tpu.benchmarks.gpt import PRESETS, parse_args
+
+    a = parse_args(["--preset", "470m"])
+    assert (a.d_model, a.n_layers, a.accum, a.chunked_ce) == \
+        (1024, 24, 32, 16384)
+    assert a.rope and a.swiglu
+
+    b = parse_args(["--preset=164m"])
+    assert (b.d_model, b.batch, b.accum) == (768, 64, 16)
+
+    # explicit flag overrides the preset value
+    c = parse_args(["--preset", "470m", "--accum", "8"])
+    assert c.accum == 8 and c.d_model == 1024
+
+    import pytest
+    with pytest.raises(SystemExit):
+        parse_args(["--preset", "bogus"])
+    assert set(PRESETS) == {"164m", "470m", "164m-long"}
