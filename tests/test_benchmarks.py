@@ -131,7 +131,6 @@ def test_gpt_preset_expansion_and_override():
     c = parse_args(["--preset", "470m", "--accum", "8"])
     assert c.accum == 8 and c.d_model == 1024
 
-    import pytest
     with pytest.raises(SystemExit):
         parse_args(["--preset", "bogus"])
     assert set(PRESETS) == {"164m", "470m", "164m-long"}
