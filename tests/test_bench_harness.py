@@ -1,0 +1,158 @@
+"""The staged bench watchdog: per-stage deadlines, hang/error taxonomy.
+
+Round-2 verdict: the bench watchdog had exactly two rungs (one TPU try,
+then CPU re-exec) and recorded nothing about *where* a hang happened.
+These tests drive the orchestrator's ``run_staged`` with scripted fake
+workers to pin the taxonomy: ok / hang@<stage> / error@<stage>.
+"""
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def _fake_worker(tmp_path, body):
+    """Write a fake worker script that takes --status like the real one."""
+    p = tmp_path / "fake_worker.py"
+    p.write_text(textwrap.dedent("""
+        import argparse, json, os, sys, time
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--status", default="")
+        args, _ = ap.parse_known_args()
+        def stage(s):
+            with open(args.status, "a") as f:
+                f.write(s + "\\n")
+                f.flush()
+                os.fsync(f.fileno())
+    """) + textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def test_ok_path_returns_result(tmp_path):
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        stage("compile")
+        stage("measure")
+        stage("result " + json.dumps({"metric": "m", "value": 1.0}))
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "ok"
+    assert result == {"metric": "m", "value": 1.0}
+
+
+def test_hang_is_attributed_to_its_stage(tmp_path):
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        stage("compile")
+        time.sleep(60)
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 1, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "hang@compile"
+    assert result is None
+    assert elapsed < 30  # killed at the stage budget, not a global timer
+
+
+def test_hang_before_first_stage_write_uses_init_budget(tmp_path):
+    cmd = _fake_worker(tmp_path, """
+        time.sleep(60)
+    """)
+    outcome, _, elapsed, _ = bench.run_staged(
+        cmd, {"device_init": 1, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "hang@spawn"
+    assert elapsed < 30
+
+
+def test_error_is_attributed_with_stderr_tail(tmp_path):
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        print("boom diagnostics", file=sys.stderr)
+        sys.exit(3)
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "error@device_init"
+    assert "boom diagnostics" in err
+
+
+def test_cpu_env_strips_axon_plugin(monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site:/other/path")
+    env = bench._cpu_env()
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "axon" not in env["PYTHONPATH"]
+    assert "/other/path" in env["PYTHONPATH"]
+
+
+def test_real_worker_cpu_fallback_leg(tmp_path):
+    """The actual CPU-fallback rung end to end: real worker, cpu env."""
+    cmd = [sys.executable, os.path.join(bench.REPO_ROOT, "bench.py"),
+           "--worker", "--batch", "16", "--iters", "2", "--warmup", "1",
+           "--donate", "0"]
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 120, "compile": 180, "measure": 120},
+        env=bench._cpu_env(), poll_interval=0.2)
+    assert outcome == "ok", err
+    assert result["metric"] == "resnet_tiny_images_per_sec_cpu_fallback"
+    assert result["value"] > 0
+
+
+def test_result_survives_teardown_hang(tmp_path):
+    """A worker that finishes the measurement but wedges in teardown
+    (the tunnel-hang class) must not lose the number."""
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        stage("result " + json.dumps({"metric": "m", "value": 2.0}))
+        time.sleep(60)
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "ok"
+    assert result == {"metric": "m", "value": 2.0}
+    assert elapsed < 70  # killed at the done-grace, number kept
+
+
+def test_torn_result_line_retried_not_fatal(tmp_path):
+    """A mid-write read of the result line must not crash the
+    orchestrator; the next poll sees the complete line."""
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        # simulate a torn write: partial json first, complete line later
+        with open(args.status, "a") as f:
+            f.write('result {"metric": "m"')
+            f.flush(); os.fsync(f.fileno())
+        time.sleep(0.5)
+        with open(args.status, "a") as f:
+            f.write(', "value": 3.0}\\n')
+            f.flush(); os.fsync(f.fileno())
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "ok"
+    assert result == {"metric": "m", "value": 3.0}
+
+
+def test_result_survives_nonzero_teardown_exit(tmp_path):
+    """Same class as the teardown hang: a PJRT segfault after the result
+    line must not discard the measurement."""
+    cmd = _fake_worker(tmp_path, """
+        stage("device_init")
+        stage("result " + json.dumps({"metric": "m", "value": 4.0}))
+        sys.exit(139)
+    """)
+    outcome, result, elapsed, err = bench.run_staged(
+        cmd, {"device_init": 10, "compile": 10, "measure": 10},
+        poll_interval=0.05)
+    assert outcome == "ok"
+    assert result == {"metric": "m", "value": 4.0}
