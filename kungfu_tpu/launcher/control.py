@@ -9,13 +9,23 @@ server stops being the only path membership changes can take (polling
 stays as the fallback for runners the push cannot reach).
 
 Wire format: one JSON object per connection, newline-terminated:
-``{"type": "update", "version": 3, "cluster": {...}}`` or
-``{"type": "exit"}``.  Version dedup lives in Watcher.update (stale
-versions are ignored), matching the reference handler's dedup.
+``{"type": "update", "version": 3, "cluster": {...}, "token": "..."}`` or
+``{"type": "exit", "token": "..."}``.  Version dedup lives in
+Watcher.update (stale versions are ignored), matching the reference
+handler's dedup.
+
+Authentication: the launcher mints a shared secret (``KFT_CONTROL_TOKEN``)
+and propagates it to every worker through the env ABI; the server rejects
+messages whose token does not match.  Without it, any host that can reach
+the runner port could kill the job or wedge the version counter with a
+forged very-large version.  ``KFT_CONTROL_BIND`` narrows the listen
+address (default 0.0.0.0 — workers on other hosts must reach it; the
+token is the line of defense, the bind knob is belt-and-braces).
 """
 from __future__ import annotations
 
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -23,6 +33,32 @@ from typing import Callable, Iterable, Optional
 
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID
+
+CONTROL_TOKEN_ENV = "KFT_CONTROL_TOKEN"
+CONTROL_BIND_ENV = "KFT_CONTROL_BIND"
+
+
+def _env_token() -> Optional[str]:
+    return os.environ.get(CONTROL_TOKEN_ENV) or None
+
+
+def _resolve_token(token: Optional[str]) -> Optional[str]:
+    """The one place the convention lives: ``None`` means "use the env
+    secret", empty string means "deliberately open"."""
+    return _env_token() if token is None else (token or None)
+
+
+def ensure_control_token() -> str:
+    """Return the deployment's control-plane secret, minting one into
+    this process's env if the operator didn't set it.  Every launch path
+    (local watch mode, kft-distribute fan-out) calls this so the token
+    derivation lives in exactly one place."""
+    tok = os.environ.get(CONTROL_TOKEN_ENV)
+    if not tok:
+        import secrets
+        tok = secrets.token_hex(16)
+        os.environ[CONTROL_TOKEN_ENV] = tok
+    return tok
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -53,9 +89,15 @@ class ControlServer:
     def __init__(self, port: int,
                  on_update: Callable[[int, Cluster], None],
                  on_exit: Optional[Callable[[], None]] = None,
-                 host: str = "0.0.0.0"):
+                 host: Optional[str] = None,
+                 token: Optional[str] = None):
         self._on_update = on_update
         self._on_exit = on_exit
+        # token=None falls back to the env secret; pass token="" to run
+        # deliberately open (tests, trusted single-host setups)
+        self._token = _resolve_token(token)
+        if host is None:
+            host = os.environ.get(CONTROL_BIND_ENV, "0.0.0.0")
         self._srv = _TCP((host, port), _Handler)
         self._srv.control = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
@@ -71,6 +113,12 @@ class ControlServer:
         self._srv.server_close()
 
     def _dispatch(self, msg) -> bool:
+        if self._token is not None:
+            import hmac
+            got = msg.get("token")
+            if not (isinstance(got, str)
+                    and hmac.compare_digest(got, self._token)):
+                return False
         t = msg.get("type")
         if t == "update":
             try:
@@ -100,18 +148,26 @@ def _push(addr: PeerID, payload: bytes, timeout: float) -> bool:
 
 
 def push_stage(runners: Iterable[PeerID], version: int, cluster: Cluster,
-               timeout: float = 2.0) -> int:
+               timeout: float = 2.0, token: Optional[str] = None) -> int:
     """Push ``Stage{version, cluster}`` to every runner; returns how many
     acknowledged.  Unreachable runners are skipped — they converge via
     the config-server poll fallback."""
-    payload = (json.dumps({"type": "update", "version": version,
-                           "cluster": json.loads(cluster.to_json())})
-               + "\n").encode()
+    msg = {"type": "update", "version": version,
+           "cluster": json.loads(cluster.to_json())}
+    tok = _resolve_token(token)
+    if tok is not None:
+        msg["token"] = tok
+    payload = (json.dumps(msg) + "\n").encode()
     return sum(_push(r, payload, timeout) for r in runners)
 
 
-def push_exit(runners: Iterable[PeerID], timeout: float = 2.0) -> int:
+def push_exit(runners: Iterable[PeerID], timeout: float = 2.0,
+              token: Optional[str] = None) -> int:
     """Tell every runner to leave watch mode (reference: the "exit"
     ConnControl message)."""
-    payload = b'{"type": "exit"}\n'
+    msg = {"type": "exit"}
+    tok = _resolve_token(token)
+    if tok is not None:
+        msg["token"] = tok
+    payload = (json.dumps(msg) + "\n").encode()
     return sum(_push(r, payload, timeout) for r in runners)

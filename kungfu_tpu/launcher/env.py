@@ -32,9 +32,13 @@ ENABLE_STALL_DETECTION = "KFT_CONFIG_ENABLE_STALL_DETECTION"
 ENABLE_TRACE = "KFT_CONFIG_ENABLE_TRACE"
 MONITORING_PERIOD = "KFT_CONFIG_MONITORING_PERIOD_MS"
 LOG_LEVEL = "KFT_CONFIG_LOG_LEVEL"
+# control-plane shared secret — minted by the launcher, required by the
+# ControlServer; a worker without it cannot push Stage updates
+CONTROL_TOKEN = "KFT_CONTROL_TOKEN"
 
 CONFIG_ENV_KEYS = [ENABLE_MONITORING, ENABLE_STALL_DETECTION,
-                   ENABLE_TRACE, MONITORING_PERIOD, LOG_LEVEL]
+                   ENABLE_TRACE, MONITORING_PERIOD, LOG_LEVEL,
+                   CONTROL_TOKEN]
 
 
 @dataclasses.dataclass

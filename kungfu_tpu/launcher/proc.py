@@ -24,19 +24,30 @@ class Proc:
     """One worker subprocess with env merge and log tee."""
 
     def __init__(self, name: str, args: List[str], env: Dict[str, str],
-                 color_idx: int = 0, log_dir: Optional[str] = None):
+                 color_idx: int = 0, log_dir: Optional[str] = None,
+                 stdin_data: Optional[str] = None):
         self.name = name
         self.args = args
         self.env = {**os.environ, **env}
         self.color_idx = color_idx
         self.log_dir = log_dir
+        # written to the child's stdin at start, then closed — the
+        # secrets path for remote launches (a secret on the command line
+        # would be world-readable via ps on every host)
+        self.stdin_data = stdin_data
         self.popen: Optional[subprocess.Popen] = None
         self._threads: List[threading.Thread] = []
 
     def start(self) -> None:
         self.popen = subprocess.Popen(
             self.args, env=self.env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, bufsize=1)
+            stderr=subprocess.PIPE, text=True, bufsize=1,
+            stdin=subprocess.PIPE if self.stdin_data is not None
+            else subprocess.DEVNULL)
+        if self.stdin_data is not None:
+            # small payload: fits the pipe buffer, no reader deadlock
+            self.popen.stdin.write(self.stdin_data)
+            self.popen.stdin.close()
         logf = None
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)

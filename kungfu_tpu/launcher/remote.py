@@ -31,27 +31,51 @@ def _ssh_argv(host: str, user: str, remote_cmd: str) -> List[str]:
     return shlex.split(ssh) + [target, remote_cmd]
 
 
-def _remote_script(args: List[str], env: Optional[Dict[str, str]] = None) -> str:
+def _remote_script(args: List[str], env: Optional[Dict[str, str]] = None,
+                   stdin_secrets: Optional[List[str]] = None) -> str:
     """Single shell line: ``env K=V ... prog args`` (reference
-    proc.Script)."""
+    proc.Script).
+
+    ``stdin_secrets`` names env vars whose VALUES arrive on the remote
+    command's stdin (one line each, in order) instead of the command
+    line — a secret in argv would be world-readable via ``ps`` on both
+    the launch host and the remote."""
+    pre = ""
+    if stdin_secrets:
+        pre = "; ".join(f"IFS= read -r {k} && export {k}"
+                        for k in stdin_secrets) + "; "
     parts = []
     if env:
         parts.append("env")
         parts += [f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())]
     parts += [shlex.quote(a) for a in args]
-    return " ".join(parts)
+    return pre + " ".join(parts)
 
 
 def distribute(hosts: HostList, args: List[str], user: str = "",
-               log_dir: Optional[str] = None) -> int:
+               log_dir: Optional[str] = None,
+               env: Optional[Dict[str, str]] = None) -> int:
     """Run ``args`` once on every host, in parallel; non-zero exit of any
-    task kills the rest (reference kungfu-distribute)."""
+    task kills the rest (reference kungfu-distribute).
+
+    One ``KFT_CONTROL_TOKEN`` is minted here (unless the operator set one)
+    and shipped to EVERY host — over each ssh session's stdin, never on
+    the command line (ps-visible): when the distributed command is a
+    watch-mode launcher, all runners must share the secret or workers'
+    Stage pushes would be rejected by every runner but their own parent
+    and resizes would fall back to the slow config-server poll."""
+    from .control import ensure_control_token
+    fwd = dict(env or {})
+    tok = fwd.pop(E.CONTROL_TOKEN, None) or ensure_control_token()
     procs = []
     for i, h in enumerate(hosts):
         target = h.public_addr or h.host
-        procs.append(Proc(name=target, args=_ssh_argv(target, user,
-                                                      _remote_script(args)),
-                          env={}, color_idx=i, log_dir=log_dir))
+        script = _remote_script(args, fwd,
+                                stdin_secrets=[E.CONTROL_TOKEN])
+        procs.append(Proc(name=target,
+                          args=_ssh_argv(target, user, script),
+                          env={}, color_idx=i, log_dir=log_dir,
+                          stdin_data=tok + "\n"))
     return run_all(procs)
 
 
@@ -78,6 +102,12 @@ def remote_run_static(hosts: HostList, np: int, args: List[str],
         # PYTHONPATH points at this machine's checkout; the remote host may
         # have its own installation — drop it and trust the remote env.
         env.pop("PYTHONPATH", None)
+        # the control secret (forwarded by worker_env when set) rides
+        # stdin, not the ps-visible command line
+        tok = env.pop(E.CONTROL_TOKEN, None)
+        secrets_kw = {}
+        if tok is not None:
+            secrets_kw = {"stdin_secrets": [E.CONTROL_TOKEN]}
         target = None
         for h in hosts:
             if h.host == w.host:
@@ -86,6 +116,9 @@ def remote_run_static(hosts: HostList, np: int, args: List[str],
         name = f"{target}:{rank}"
         procs.append(Proc(name=name,
                           args=_ssh_argv(target, user,
-                                         _remote_script(args, env)),
-                          env={}, color_idx=rank, log_dir=log_dir))
+                                         _remote_script(args, env,
+                                                        **secrets_kw)),
+                          env={}, color_idx=rank, log_dir=log_dir,
+                          stdin_data=(tok + "\n") if tok is not None
+                          else None))
     return run_all(procs)

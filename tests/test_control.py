@@ -62,6 +62,63 @@ def test_malformed_message_rejected():
         srv.stop()
 
 
+def test_token_auth_rejects_forged_and_accepts_matching(monkeypatch):
+    """ADVICE r2 (medium): an unauthenticated control port lets any host
+    that can reach it kill the job or wedge the version counter.  With a
+    token configured, only matching pushes land."""
+    got = []
+    exited = threading.Event()
+    srv = ControlServer(0, lambda v, c: got.append(v), on_exit=exited.set,
+                        host="127.0.0.1", token="s3cret").start()
+    try:
+        me = PeerID("127.0.0.1", srv.port)
+        # no token / wrong token: rejected, no callback, no exit
+        monkeypatch.delenv("KFT_CONTROL_TOKEN", raising=False)
+        assert push_stage([me], 7, _cluster(2)) == 0
+        assert push_exit([me]) == 0
+        assert push_stage([me], 7, _cluster(2), token="wrong") == 0
+        assert got == [] and not exited.is_set()
+        # matching token: accepted
+        assert push_stage([me], 7, _cluster(2), token="s3cret") == 1
+        assert got == [7]
+        assert push_exit([me], token="s3cret") == 1
+        assert exited.wait(5)
+    finally:
+        srv.stop()
+
+
+def test_token_defaults_from_env_on_both_sides(monkeypatch):
+    """The launcher mints KFT_CONTROL_TOKEN; server and pusher both read
+    it from the env, so workers spawned with the forwarded env Just Work."""
+    monkeypatch.setenv("KFT_CONTROL_TOKEN", "envtok")
+    got = []
+    srv = ControlServer(0, lambda v, c: got.append(v),
+                        host="127.0.0.1").start()
+    try:
+        me = PeerID("127.0.0.1", srv.port)
+        assert push_stage([me], 1, _cluster(1)) == 1  # env token on both ends
+        assert got == [1]
+        monkeypatch.setenv("KFT_CONTROL_TOKEN", "different")
+        assert push_stage([me], 2, _cluster(1)) == 0  # env mismatch rejected
+        assert got == [1]
+    finally:
+        srv.stop()
+
+
+def test_control_token_forwarded_to_worker_env(monkeypatch):
+    """The env ABI must carry the secret to workers (job.go:94-100
+    ConfigEnvKeys analogue) or worker pushes would all be rejected."""
+    from kungfu_tpu.launcher import env as E
+    from kungfu_tpu.plan import PeerList
+    monkeypatch.setenv("KFT_CONTROL_TOKEN", "fwd-me")
+    peers = PeerList.parse("127.0.0.1:31100:0")
+    env = E.worker_env(peers[0], peers, PeerList.parse(""), 0,
+                       __import__("kungfu_tpu.plan.topology",
+                                  fromlist=["Strategy"]).Strategy.AUTO,
+                       None, PeerID("127.0.0.1", 31905))
+    assert env["KFT_CONTROL_TOKEN"] == "fwd-me"
+
+
 WORKER = r"""
 import os, sys, time
 import numpy as np

@@ -69,3 +69,39 @@ def test_remote_script_quotes_env():
     from kungfu_tpu.launcher.remote import _remote_script
     s = _remote_script(["echo", "a b"], {"K": "v w", "X": "1"})
     assert s == "env K='v w' X=1 echo 'a b'"
+
+
+def test_distribute_forwards_one_control_token(fake_ssh, tmp_path,
+                                               monkeypatch):
+    """Every host must receive the SAME KFT_CONTROL_TOKEN, or workers'
+    Stage pushes would be rejected by all runners but their parent and
+    resizes would degrade to the poll fallback."""
+    from kungfu_tpu.launcher.remote import distribute
+    from kungfu_tpu.plan.hostspec import HostList
+    monkeypatch.delenv("KFT_CONTROL_TOKEN", raising=False)
+    logdir = tmp_path / "logs"
+    rc = distribute(HostList.parse("hostA:1,hostB:1"),
+                    ["sh", "-c", "echo tok=$KFT_CONTROL_TOKEN"],
+                    log_dir=str(logdir))
+    assert rc == 0
+    toks = set()
+    for f in os.listdir(logdir):
+        line = [l for l in (logdir / f).read_text().splitlines()
+                if l.startswith("tok=")][0]
+        toks.add(line)
+    assert len(toks) == 1  # one deployment-wide secret
+    assert toks.pop() != "tok="  # actually minted
+
+
+def test_distribute_respects_operator_token(fake_ssh, tmp_path,
+                                            monkeypatch):
+    from kungfu_tpu.launcher.remote import distribute
+    from kungfu_tpu.plan.hostspec import HostList
+    monkeypatch.setenv("KFT_CONTROL_TOKEN", "operator-set")
+    logdir = tmp_path / "logs"
+    rc = distribute(HostList.parse("hostA:1"),
+                    ["sh", "-c", "echo tok=$KFT_CONTROL_TOKEN"],
+                    log_dir=str(logdir))
+    assert rc == 0
+    f = os.listdir(logdir)[0]
+    assert "tok=operator-set" in (logdir / f).read_text()
