@@ -55,6 +55,9 @@ def parse_args(argv=None):
     p.add_argument("--preset", choices=list(PRESETS), default=None,
                    help="flag bundle reproducing a README benchmark row "
                         "(applied before other flags, which override it)")
+    # the pre-parser consumed --preset from argv; carry the value through
+    # so args.preset records which README row actually ran
+    p.set_defaults(preset=known.preset)
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--d-model", type=int, default=768)
     p.add_argument("--n-layers", type=int, default=12)

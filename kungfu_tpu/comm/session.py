@@ -653,12 +653,18 @@ class Session:
             np.asarray([1.0 if local else 0.0], np.float32),
             op="SUM", name="kft-interference-vote")
         if float(votes[0]) * 2 <= fence_peer.size:
-            with self._lock:
-                for s, tp in snap:
-                    # EMA fold of the snapshot (see _fold_healthy_locked)
-                    s.reference_rate = (tp if s.reference_rate is None
-                                        else 0.8 * s.reference_rate
-                                        + 0.2 * tp)
+            # fold the snapshot into the EMA baseline only on processes
+            # whose OWN window was healthy (matching the unfenced path):
+            # a minority-interference process folding its degraded
+            # sample would drag its baseline down 0.2/period until the
+            # interference masks itself and it can never vote again
+            if not local:
+                with self._lock:
+                    for s, tp in snap:
+                        # EMA fold (see _fold_healthy_locked)
+                        s.reference_rate = (tp if s.reference_rate is None
+                                            else 0.8 * s.reference_rate
+                                            + 0.2 * tp)
             return False
         with self._lock:
             nxt, nxt_idx = self._peek_next_locked(fallbacks)

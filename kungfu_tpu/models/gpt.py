@@ -54,6 +54,13 @@ class GPTConfig:
     # max_seq-bound position parameters; the LLaMA-style configuration
     # together with bias-free blocks + GQA)
     rope: bool = False
+    # dtype for the RoPE cos/sin rotation math.  None = activation dtype
+    # (fast: no extra HBM pass).  With a bf16 activation dtype the 8-bit
+    # mantissa makes the rotation error grow with absolute position —
+    # fine at seq 2k-8k, a silent quality risk far past that; set
+    # rope_dtype=jnp.float32 for long-context runs to opt back into
+    # full-precision rotation (costs one f32 round-trip on [B,T,H,Dh])
+    rope_dtype: Any = None
     # FFN nonlinearity: "gelu" (GPT-2 style) or "swiglu" (LLaMA style;
     # wi holds gate and up projections as [D, 2, d_ff] — gate/up packed
     # into ONE [D, 2*d_ff] matmul at apply time (a free reshape; d_ff
@@ -190,14 +197,16 @@ def _rope_rotate(t, pos, cfg: GPTConfig):
     half = cfg.head_dim // 2
     freqs = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
     ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # [T, half]
-    # angles/cos/sin in f32 (position precision), the big tensor math in
-    # the activation dtype — the f32 round-trip on [B, T, H, Dh] costs
-    # two full extra HBM passes per projection otherwise
-    cos = jnp.cos(ang)[None, :, None, :].astype(t.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(t.dtype)
-    t1, t2 = t[..., :half], t[..., half:]
+    # angles/cos/sin in f32 (position precision); the big tensor math
+    # runs in rope_dtype — default the activation dtype (an f32
+    # round-trip on [B, T, H, Dh] costs two full extra HBM passes per
+    # projection), opt-in f32 for long contexts (GPTConfig.rope_dtype)
+    rd = cfg.rope_dtype or t.dtype
+    cos = jnp.cos(ang)[None, :, None, :].astype(rd)
+    sin = jnp.sin(ang)[None, :, None, :].astype(rd)
+    t1, t2 = t[..., :half].astype(rd), t[..., half:].astype(rd)
     return jnp.concatenate([t1 * cos - t2 * sin,
-                            t1 * sin + t2 * cos], axis=-1)
+                            t1 * sin + t2 * cos], axis=-1).astype(t.dtype)
 
 
 def _layer_qkv(layer, x, cfg: GPTConfig, pos=None):

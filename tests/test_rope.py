@@ -118,3 +118,30 @@ def test_rope_cache_can_exceed_max_seq():
     cfg = _cfg()
     cache = G.init_kv_cache(cfg, 2, max_len=cfg.max_seq * 2)
     assert cache[0]["k"].shape[1] == cfg.max_seq * 2
+
+
+def test_rope_dtype_escape_hatch_recovers_f32_precision():
+    """ADVICE r2: bf16 cos/sin rotation error grows with absolute
+    position.  rope_dtype=float32 must (a) keep the activation dtype on
+    the output, (b) match a reference f32 rotation at large positions
+    where bf16 rotation visibly diverges."""
+    cfg16 = _cfg(dtype=jnp.bfloat16, max_seq=1 << 16)
+    cfg32 = _cfg(dtype=jnp.bfloat16, max_seq=1 << 16,
+                 rope_dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    t = jnp.asarray(rng.randn(1, 4, 4, cfg16.head_dim), jnp.bfloat16)
+    pos = jnp.asarray([60000, 60001, 60002, 60003], jnp.int32)
+
+    out16 = G._rope_rotate(t, pos, cfg16)
+    out32 = G._rope_rotate(t, pos, cfg32)
+    assert out16.dtype == jnp.bfloat16 and out32.dtype == jnp.bfloat16
+
+    # reference: full-f32 rotation
+    ref = G._rope_rotate(t.astype(jnp.float32), pos,
+                         _cfg(dtype=jnp.float32, max_seq=1 << 16))
+    err16 = float(jnp.abs(out16.astype(jnp.float32) - ref).max())
+    err32 = float(jnp.abs(out32.astype(jnp.float32) - ref).max())
+    # f32 rotation path only pays the final bf16 quantization; the bf16
+    # path additionally quantizes cos/sin and both products
+    assert err32 <= err16
+    assert err32 < 0.04  # one bf16 ulp of the output magnitude
