@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-devices-per-worker", type=int, default=0,
                    help="KFT_NUM_LOCAL_DEVICES for each worker")
     p.add_argument("-logdir", default="", help="per-worker log directory")
+    p.add_argument("-no-preempt-recover", dest="preempt_recover",
+                   action="store_false",
+                   help="fail the job on any worker death (reference "
+                        "watch.go semantics) instead of absorbing "
+                        "preemption-class deaths as elastic shrinks")
     p.add_argument("-debug-port", type=int, default=0,
                    help="watch mode only: serve the runner's Stage "
                         "history + worker state as JSON on this port "
@@ -140,8 +145,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         if args.watch:
+            # mint the control-plane secret unless one arrived from the
+            # operator or from kft-distribute (remote.distribute forwards
+            # a deployment-wide token so every host's runner shares it);
+            # multi-host runs launched any other way must set
+            # KFT_CONTROL_TOKEN uniformly across runners themselves
+            from .control import ensure_control_token
+            ensure_control_token()
             return watch_run(job, args.self_host, parent, cluster, config_url,
-                             pool=pool, debug_port=args.debug_port)
+                             pool=pool, debug_port=args.debug_port,
+                             preempt_recover=args.preempt_recover)
         if args.debug_port:
             print("kft-run: -debug-port is watch-mode only (add -w); "
                   "no debug server started", file=sys.stderr)

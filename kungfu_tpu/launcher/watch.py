@@ -3,9 +3,12 @@
 Reference: srcs/go/kungfu/runner/watch.go:42-135 — the runner keeps a map
 of current local workers; on every Stage{version, cluster} update it diffs
 the local membership, kills removed workers, spawns added ones, and exits
-when the cluster drains.  Stage updates here come from polling the elastic
-config server (the reference's ConnControl TCP push is replaced by pull;
-TPU-VM preemption notices can inject updates the same way).
+when the cluster drains.  Stage updates arrive two ways, exactly like the
+reference: PUSHED to this runner's control port (launcher/control.py, the
+ConnControl analogue — one TCP round trip) with config-server polling as
+the fallback for pushes that never arrive.  TPU-VM preemption notices
+inject updates through the same two paths (see preemption handling in
+watch_run).
 """
 from __future__ import annotations
 
@@ -15,10 +18,17 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..plan.cluster import Cluster
-from ..plan.peer import PeerID
-from ..elastic.config_server import fetch_config
+from ..plan.peer import PeerID, PeerList
+from ..elastic.config_server import fetch_config, put_config
 from .job import ChipPool, Job
 from .proc import Proc
+
+
+# Popen returncodes that mean "killed by an eviction-class signal":
+# negative values are direct signal deaths, 128+N their shell encodings.
+# SIGTERM is what TPU-VM preemption (and the watcher's own reconcile
+# kills) delivers; SIGKILL follows when the VM is torn down hard.
+_PREEMPT_CODES = {-15, -9, 143, 137}
 
 
 class Watcher:
@@ -27,15 +37,20 @@ class Watcher:
     HISTORY_LIMIT = 64
 
     def __init__(self, job: Job, host: str, parent: PeerID,
-                 pool: Optional[ChipPool] = None):
+                 pool: Optional[ChipPool] = None,
+                 preempt_recover: bool = False):
         self.job = job
         self.host = host
         self.parent = parent
         self.pool = pool
+        self.preempt_recover = preempt_recover
         self.current: Dict[PeerID, Proc] = {}
         self._chip_of: Dict[PeerID, int] = {}
         self.version = -1
         self.failed: Optional[int] = None
+        # workers that died by a preemption-class signal, awaiting a
+        # shrink proposal (drained by watch_run outside reap's lock)
+        self.preempted: List[PeerID] = []
         self._last_cluster: Optional[Cluster] = None
         self._done: set = set()  # peers that exited cleanly this version
         # applied Stage history for the debug endpoint (reference: the
@@ -107,7 +122,12 @@ class Watcher:
             return bool(want) and want <= self._done
 
     def reap(self) -> None:
-        """Collect exited workers; record failures."""
+        """Collect exited workers; record failures.  With
+        ``preempt_recover``, a worker killed by a preemption-class
+        signal is queued for a shrink proposal instead of failing the
+        job (reference contrast: watch.go:144-149 cancels the runner on
+        ANY worker death; the BASELINE north star asks preemption to be
+        absorbed elastically instead)."""
         with self._lock:
             for peer, proc in list(self.current.items()):
                 code = proc.poll()
@@ -117,10 +137,12 @@ class Watcher:
                 chip = self._chip_of.pop(peer, None)
                 if chip is not None and self.pool:
                     self.pool.put(chip)
-                if code != 0 and self.failed is None:
-                    self.failed = code
-                elif code == 0:
+                if code == 0:
                     self._done.add(peer)
+                elif self.preempt_recover and code in _PREEMPT_CODES:
+                    self.preempted.append(peer)
+                elif self.failed is None:
+                    self.failed = code
 
     def drain(self) -> None:
         with self._lock:
@@ -131,6 +153,45 @@ class Watcher:
     def alive(self) -> int:
         with self._lock:
             return len(self.current)
+
+
+def propose_exclusion(config_url: str, dead: set, retries: int = 8
+                      ) -> Optional[int]:
+    """Convert dead/evacuating workers into a shrink: CAS-remove them
+    from the config server's cluster and push the new Stage to every
+    runner (reference shape: a membership change proposed to the config
+    server, peer.go:227-263, then pushed over ConnControl,
+    peer.go:190-209 — here the RUNNER originates it because the dying
+    worker cannot).
+
+    Returns the new version, the current version when another runner
+    already absorbed the deaths (lost the CAS race benignly), or None
+    when removing them would empty the cluster (caller should fail)."""
+    import sys as _sys
+    import urllib.error
+    from .control import push_stage
+    for _ in range(retries):
+        version, cluster = fetch_config(config_url)
+        workers = [w for w in cluster.workers if w not in dead]
+        if len(workers) == len(cluster.workers):
+            return version  # already absorbed by a concurrent proposal
+        if not workers:
+            return None
+        shrunk = Cluster(cluster.runners, PeerList(workers))
+        try:
+            new_version = put_config(config_url, shrunk,
+                                     if_version=version)
+        except urllib.error.HTTPError as e:
+            if e.code == 409:  # lost a CAS race: re-fetch and retry
+                continue
+            raise
+        acked = push_stage(list(cluster.runners), new_version, shrunk)
+        print(f"kft-run: preemption shrink v{new_version}: removed "
+              f"{sorted(str(d) for d in dead)}, {len(workers)} workers "
+              f"remain ({acked} runners acked the push)",
+              file=_sys.stderr, flush=True)
+        return new_version
+    return None
 
 
 def _start_debug_server(w: "Watcher", port: int):
@@ -174,7 +235,8 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
               config_url: Optional[str], poll_interval: float = 0.5,
               pool: Optional[ChipPool] = None,
               stop_when_empty: bool = True,
-              debug_port: int = 0) -> int:
+              debug_port: int = 0,
+              preempt_recover: bool = True) -> int:
     """Run the elastic watch loop until the *global* cluster drains or a
     local worker fails (reference: watch.go:106-135 WatchRun).
 
@@ -186,11 +248,29 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     control port (reference ConnControl, handler.go:91-115 — resize
     latency is one TCP round trip) with config-server polling as the
     fallback for pushes that never arrive.
+
+    Preemption handling (``preempt_recover``, default on): a worker
+    killed by a preemption-class signal becomes a shrink proposal —
+    survivors keep training on the reduced cluster (see
+    native.recover_from_failure for the worker side).  A SIGTERM to the
+    RUNNER itself (TPU-VM eviction notice) evacuates this host: its
+    workers are CAS-removed from the cluster, the Stage is pushed to the
+    other runners, and the runner exits 0.
     """
-    w = Watcher(job, host, parent, pool)
+    import signal as _signal
+    w = Watcher(job, host, parent, pool,
+                preempt_recover=preempt_recover and bool(config_url))
     wake = threading.Event()
     exited = threading.Event()
+    evacuate = threading.Event()
     pushed_size = [None]  # global size from the last pushed stage
+    prev_term = None
+    if (preempt_recover and config_url
+            and threading.current_thread() is threading.main_thread()):
+        def _on_term(signum, frame):
+            evacuate.set()
+            wake.set()
+        prev_term = _signal.signal(_signal.SIGTERM, _on_term)
 
     def on_push(version: int, cluster: Cluster) -> None:
         w.update(version, cluster)
@@ -251,6 +331,42 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             if exited.is_set():       # pushed "exit": leave watch mode
                 w.drain()
                 return 0
+            if evacuate.is_set():     # runner SIGTERM = host eviction
+                with w._lock:
+                    mine = (set(w.local_workers(w._last_cluster))
+                            if w._last_cluster else set())
+                if mine and config_url:
+                    try:
+                        propose_exclusion(config_url, mine)
+                    except (OSError, ValueError):
+                        # config server unreachable while we are being
+                        # evicted: nothing more this host can do — the
+                        # survivors' runners will shrink the dead peers
+                        # away when their collectives fail
+                        pass
+                w.drain()
+                return 0
+            if w.preempted:           # dead worker(s) -> shrink proposal
+                with w._lock:
+                    dead, w.preempted = set(w.preempted), []
+                nv = None
+                if config_url:
+                    try:
+                        nv = propose_exclusion(config_url, dead)
+                    except (OSError, ValueError):
+                        # transient config-server failure (the ordinary
+                        # poll below tolerates the same): re-queue and
+                        # retry next loop instead of crashing the runner
+                        # and orphaning the surviving workers
+                        with w._lock:
+                            w.preempted.extend(dead)
+                        nv = -1  # sentinel: not a terminal verdict
+                if nv is None:
+                    # cluster would be empty (or no config server):
+                    # preemption recovery cannot apply — fail like the
+                    # reference runner does on worker death
+                    w.failed = 1
+                    continue
             w.retry_pending()
             if pushed_size[0] is not None:
                 global_size = pushed_size[0]
@@ -268,6 +384,8 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             wake.clear()
             wake.wait(poll_interval)  # a push cuts the wait short
     finally:
+        if prev_term is not None:
+            _signal.signal(_signal.SIGTERM, prev_term)
         if control is not None:
             control.stop()
         if debug is not None:

@@ -531,8 +531,16 @@ def resize_from_url(timeout: float = 5.0):
             # off by their new token and deadlocks.  Re-fetch until every
             # old-membership peer holds the same (version, cluster).
             payload = (f"{version}:{','.join(specs)}").encode()
-            if not p.consensus(payload, name=f"resize-digest@{p.token}"):
-                continue
+            try:
+                if not p.consensus(payload,
+                                   name=f"resize-digest@{p.token}"):
+                    continue
+            except NativeError:
+                # a dead OLD-membership peer (preemption shrink) cannot
+                # vote; proceed to the rebuild — survivors that race to
+                # different versions are fenced by the new token and
+                # self-heal through the post-rebuild barrier retry below
+                pass
         if me not in specs:
             use_peer(None)  # uninstall BEFORE close: no NULL-handle default
             if p is not None:
@@ -566,6 +574,42 @@ def resize_from_url(timeout: float = 5.0):
             use_peer(None)
             newp.close()
             continue
+
+
+def recover_from_failure(timeout: float = 60.0, poll: float = 0.1
+                         ) -> Optional[NativePeer]:
+    """Survivor-side preemption recovery: after a collective raised
+    :class:`NativeError` (a peer died — TPU-VM preemption, OOM kill),
+    poll the config server until the runner's shrink proposal lands (a
+    new cluster version excluding the dead peer), rebuild over the new
+    membership, and return the new peer.
+
+    Reference: the runner converts a worker death into a Stage update
+    (this framework's watcher preemption handling; reference
+    runner/watch.go:144-149 reacts to the death, peer/peer.go:227-263
+    absorbs the membership change).  Returns ``None`` when THIS worker
+    was itself removed by the shrink (detached — caller should exit).
+    Raises :class:`NativeError` if no new cluster version arrives within
+    ``timeout`` (e.g. the failure was not a membership event)."""
+    import time as _time
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        try:
+            changed, detached = resize_from_url()
+        except OSError:
+            # transient config-server failure — the deadline exists
+            # precisely to ride out this window; keep polling
+            _time.sleep(poll)
+            continue
+        if detached:
+            return None
+        if changed:
+            return installed_peer()
+        _time.sleep(poll)
+    raise NativeError(
+        f"recover_from_failure: no membership change within {timeout}s "
+        f"(dead peer not shrunk away — is the runner's preemption "
+        f"recovery enabled?)")
 
 
 def use_peer(p: Optional[NativePeer]) -> None:

@@ -1,0 +1,175 @@
+"""Preemption-driven elastic training, end to end.
+
+The BASELINE north star names it: "resize_cluster handles TPU-VM
+preemption for elastic training."  A worker killed by SIGTERM (the
+preemption signal) must become a shrink proposal — the runner CAS-removes
+it from the config server and pushes the Stage (reference shape:
+runner/watch.go:144-149 reacts to the death; peer/peer.go:227-263 absorbs
+the membership change) — and the survivors must detect the dead peer,
+resize, re-sync progress, and KEEP TRAINING to the original target.
+"""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import native  # noqa: E402
+from kungfu_tpu.plan import Cluster, HostList, PeerID  # noqa: E402
+
+
+# Per worker-step each live worker contributes B "samples" via an
+# allreduce-SUM; the victim dies after DIE_STEP steps; training stops
+# when the synced global counter reaches TARGET.
+WORKER = r"""
+import os, signal, sys, time
+import numpy as np
+from kungfu_tpu import native
+from kungfu_tpu.launcher import env as E
+
+B, DIE_STEP, TARGET = 32, 5, 1000
+out_dir = os.environ["TEST_OUT"]
+we = E.from_env()
+p = native.default_peer()
+victim = (p.rank == p.size - 1)
+
+trained = 0
+step = 0
+recovered = False
+while trained < TARGET:
+    step += 1
+    try:
+        counts = p.all_reduce(np.asarray([float(B)], np.float32),
+                              name=f"train@{p.token}:{step}")
+    except native.NativeError:
+        p = native.recover_from_failure(timeout=60)
+        if p is None:
+            sys.exit(0)  # we were shrunk away
+        synced = p.all_reduce(np.asarray([float(trained)], np.float32),
+                              op="MAX", name=f"sync@{p.token}")
+        trained = int(synced[0])
+        recovered = True
+        step = 0  # collective names restart under the new token
+        continue
+    trained += int(counts[0])
+    if victim and step == DIE_STEP:
+        with open(os.path.join(out_dir, "victim"), "w") as f:
+            f.write(f"{trained}")
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(30)  # the signal is fatal; never reached
+
+with open(os.path.join(out_dir, f"done.{we.self_spec.port}"), "w") as f:
+    f.write(f"{p.size}:{trained}:{int(recovered)}")
+"""
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_sigterm_worker_becomes_shrink_and_training_continues(
+        tmp_path, monkeypatch):
+    from kungfu_tpu.elastic import ConfigServer, fetch_config, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+    # dead-peer dials must give up fast or the survivors' failed
+    # collective takes minutes to surface
+    monkeypatch.setenv("KFT_RECV_TIMEOUT_S", "3")
+    monkeypatch.setenv("KFT_CONN_RETRIES", "10")
+
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), 4)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31960),
+                       cluster, srv.url, poll_interval=0.2,
+                       preempt_recover=True)
+        assert rc == 0  # the job SUCCEEDED despite the preemption
+
+        # the victim recorded its progress, then died
+        victim_trained = int((out / "victim").read_text())
+        assert victim_trained == 4 * 32 * 5  # 4 workers x B x DIE_STEP
+
+        # exactly 3 survivors finished, all on the 3-cluster, all
+        # recovered, and none lost the pre-death progress
+        done = sorted(f for f in os.listdir(out) if f.startswith("done"))
+        assert len(done) == 3, done
+        finals = []
+        for f in done:
+            size, trained, recovered = map(
+                int, (out / f).read_text().split(":"))
+            assert size == 3
+            assert recovered == 1
+            assert trained >= 1000
+            finals.append(trained)
+        assert len(set(finals)) == 1  # sync training: identical counters
+        # progress preserved: survivors resumed FROM the victim-era count
+        # (640 pre-death + k*96 post-death, never restarted from 0)
+        assert (finals[0] - victim_trained) % (3 * 32) == 0
+
+        # the config server converged on the 3-worker cluster
+        _, final_cluster = fetch_config(srv.url)
+        assert final_cluster.size() == 3
+    finally:
+        srv.stop()
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_non_signal_crash_still_fails_the_job(tmp_path, monkeypatch):
+    """Only preemption-class deaths are absorbed; a worker crashing with
+    a plain nonzero exit (program bug) fails the job like the reference
+    runner (watch.go:144-149)."""
+    from kungfu_tpu.elastic import ConfigServer, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text("import sys; sys.exit(7)")
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:2"), 2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31961),
+                       cluster, srv.url, poll_interval=0.2,
+                       preempt_recover=True)
+        assert rc == 7
+    finally:
+        srv.stop()
+
+
+def test_propose_exclusion_cas_and_empty(monkeypatch):
+    """propose_exclusion removes exactly the dead peers, survives a lost
+    CAS race, and refuses to empty the cluster."""
+    from kungfu_tpu.elastic import ConfigServer, fetch_config, put_config
+    from kungfu_tpu.launcher.watch import propose_exclusion
+
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:4"), 4)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        dead = {cluster.workers[1]}
+        nv = propose_exclusion(srv.url, dead)
+        assert nv is not None
+        v, c = fetch_config(srv.url)
+        assert v == nv and c.size() == 3
+        assert cluster.workers[1] not in list(c.workers)
+
+        # idempotent: re-proposing the same death is a no-op
+        assert propose_exclusion(srv.url, dead) == nv
+        v2, c2 = fetch_config(srv.url)
+        assert (v2, c2.size()) == (nv, 3)
+
+        # refusing to empty the cluster
+        assert propose_exclusion(srv.url, set(c2.workers)) is None
+    finally:
+        srv.stop()
