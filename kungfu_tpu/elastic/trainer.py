@@ -76,6 +76,15 @@ class ElasticTrainer:
         self.config_version = -1  # last applied config-server version
         self.trained_samples = 0
         self.step_count = 0
+        # resize-cost instrumentation (SURVEY §7 names the recompile as
+        # the dominant elastic risk; these let callers measure it)
+        self.last_resize_seconds: Optional[float] = None
+        self.last_resize_compiled = False  # True: new step fn was built
+        # persistent XLA cache: a respawned/grown worker pays a disk
+        # deserialisation instead of a recompile (KFT_COMPILE_CACHE=off
+        # to disable)
+        from ..utils.compile_cache import enable_compile_cache
+        enable_compile_cache()
         self._host_params = jax.tree_util.tree_map(
             lambda t: np.broadcast_to(np.asarray(t)[None],
                                       (self.n,) + np.asarray(t).shape).copy(),
@@ -133,6 +142,8 @@ class ElasticTrainer:
             raise RuntimeError("resize proposal diverged across peers")
         # begin is logged after the fence so begin/end events always pair
         log_event(f"resize-begin:{self.n}->{new_size}")
+        t0 = time.perf_counter()
+        self.last_resize_compiled = new_size not in self._step_cache
         self._host_params = jax.tree_util.tree_map(
             lambda t: np.asarray(t), self.params)
         host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
@@ -142,7 +153,14 @@ class ElasticTrainer:
         self._install(new_size, fresh_opt=False)
         self.opt_state = _restack(host_opt, new_size, self.mesh)
         self.session.barrier()
+        # NOTE: jit compilation is lazy — the FIRST step at the new size
+        # pays the (possibly cached) compile; measure resize cost as
+        # last_resize_seconds + (first-step - steady-step) latency, as
+        # benchmarks/resize_cost.py does
+        self.last_resize_seconds = time.perf_counter() - t0
         log_event(f"resize-end:{new_size}")
+        log_event(f"resize-cost:{self.last_resize_seconds:.3f}s"
+                  f"{':new-step-fn' if self.last_resize_compiled else ''}")
         return True
 
     def resize_from_url(self, timeout: float = 30.0) -> Tuple[bool, bool]:

@@ -214,3 +214,52 @@ class TestReviewRegressions:
             assert not changed and tr.n == 6  # must NOT revert to 4
         finally:
             srv.stop()
+
+
+def test_resize_records_cost_instrumentation():
+    """SURVEY §7's dominant risk must be measurable: resize records its
+    wall seconds and whether a new step function was built."""
+    import optax
+
+    import kungfu_tpu.optimizers as kfopt
+    from kungfu_tpu.elastic import ElasticTrainer
+
+    tr = ElasticTrainer(
+        lambda p, b: ((b[0] @ p["w"] - b[1]) ** 2).mean(),
+        optimizer_factory=lambda n: kfopt.synchronous_sgd(
+            optax.sgd(0.1)),
+        init_params={"w": jnp.zeros((8, 2))},
+        init_size=8)
+    assert tr.last_resize_seconds is None
+    assert tr.resize(4)
+    assert tr.last_resize_seconds > 0
+    assert tr.last_resize_compiled  # 4 was an unseen size
+    assert tr.resize(8)
+    assert not tr.last_resize_compiled  # back to a cached size
+
+
+def test_resize_cost_harness_two_pass(tmp_path):
+    """The resize-cost benchmark runs both cache passes and the warm
+    pass's artifact has the same schema (the cache SPEEDUP itself is a
+    timing property asserted loosely — CI boxes are noisy)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    out = tmp_path / "rc.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.benchmarks.resize_cost",
+         "--d-model", "32", "--n-layers", "2", "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-800:]
+    doc = json.loads(out.read_text())
+    assert doc["devices"] == 8 and doc["schedule"] == [4, 8]
+    for name in ("cold", "warm"):
+        rows = doc[name]
+        assert [row["transition"] for row in rows] == \
+            ["start@8", "->4", "->8"]
+        assert rows[1]["compiled_new_step"] is True
+        assert rows[2]["compiled_new_step"] is False  # in-process cache
