@@ -42,12 +42,23 @@ static bool env_bool(const char *key, bool dflt) {
            std::string(v) == "True";
 }
 
-// Abstract-namespace unix address for a colocated peer's port (no
-// filesystem cleanup needed; Linux-specific, gated by KFT_CONFIG_USE_UNIX).
-static socklen_t unix_addr_for_port(int port, sockaddr_un *addr) {
+// Abstract-namespace unix address for a colocated peer (no filesystem
+// cleanup needed; Linux-specific, gated by KFT_CONFIG_USE_UNIX).  The
+// name carries host AND port: distinct loopback-alias "hosts"
+// (127.0.0.2 / 127.0.0.3 in multi-host tests) may reuse port numbers on
+// one machine.
+static socklen_t unix_addr_for(const std::string &host, int port,
+                               sockaddr_un *addr) {
     std::memset(addr, 0, sizeof(*addr));
     addr->sun_family = AF_UNIX;
-    std::string name = "kft-" + std::to_string(port);
+    std::string name = "kft-" + host + "-" + std::to_string(port);
+    if (name.size() > sizeof(addr->sun_path) - 2) {
+        // long FQDN self-specs: hash the host so the name always fits
+        // sun_path (108 bytes) — both bind and dial sides hash the same
+        // way, so colocated peers still rendezvous
+        name = "kft-h" + std::to_string(std::hash<std::string>{}(host)) +
+               "-" + std::to_string(port);
+    }
     addr->sun_path[0] = '\0';
     std::memcpy(addr->sun_path + 1, name.data(), name.size());
     return socklen_t(offsetof(sockaddr_un, sun_path) + 1 + name.size());
@@ -79,11 +90,32 @@ class Peer {
         tune_buffers(listen_fd_);  // inherited by accepted sockets
         sockaddr_in addr{};
         addr.sin_family = AF_INET;
+        // bind the self-spec's address, so distinct host IPs (real
+        // NICs, or loopback aliases in multi-host tests) can share a
+        // port number on one machine.  Non-IP hostnames, NAT/bridged
+        // setups where the advertised address is not local (bind fails
+        // EADDRNOTAVAIL — retried as INADDR_ANY below), and
+        // KFT_BIND_ALL=1 use the wildcard.
         addr.sin_addr.s_addr = INADDR_ANY;
+        bool specific = false;
+        if (!env_bool("KFT_BIND_ALL", false)) {
+            in_addr self_ip{};
+            if (::inet_pton(AF_INET, peers_[rank_].host.c_str(),
+                            &self_ip) == 1) {
+                addr.sin_addr = self_ip;
+                specific = true;
+            }
+        }
         addr.sin_port = htons(uint16_t(peers_[rank_].port));
-        if (::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
-                   sizeof(addr)) != 0 ||
-            ::listen(listen_fd_, 128) != 0) {
+        int brc = ::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
+                         sizeof(addr));
+        if (brc != 0 && specific) {
+            // advertised IP not assigned locally (NAT): wildcard retry
+            addr.sin_addr.s_addr = INADDR_ANY;
+            brc = ::bind(listen_fd_, reinterpret_cast<sockaddr *>(&addr),
+                         sizeof(addr));
+        }
+        if (brc != 0 || ::listen(listen_fd_, 128) != 0) {
             set_error("bind/listen failed on port " +
                       std::to_string(peers_[rank_].port));
             ::close(listen_fd_);
@@ -96,7 +128,8 @@ class Peer {
             unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
             if (unix_listen_fd_ >= 0) {
                 sockaddr_un ua;
-                socklen_t ulen = unix_addr_for_port(peers_[rank_].port, &ua);
+                socklen_t ulen = unix_addr_for(peers_[rank_].host,
+                                               peers_[rank_].port, &ua);
                 if (::bind(unix_listen_fd_,
                            reinterpret_cast<sockaddr *>(&ua), ulen) != 0 ||
                     ::listen(unix_listen_fd_, 128) != 0) {
@@ -771,7 +804,7 @@ class Peer {
                 fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
                 if (fd >= 0) {
                     sockaddr_un ua;
-                    socklen_t ulen = unix_addr_for_port(pa.port, &ua);
+                    socklen_t ulen = unix_addr_for(pa.host, pa.port, &ua);
                     if (::connect(fd, reinterpret_cast<sockaddr *>(&ua),
                                   ulen) == 0) {
                         connected = true;

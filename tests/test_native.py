@@ -317,10 +317,12 @@ def _w_unix_listener(rank, peers, q):
     from kungfu_tpu.native import NativePeer
     try:
         with NativePeer(rank, peers) as p:
-            port = int(peers[rank].rsplit(":", 1)[1])
+            host, port = peers[rank].rsplit(":", 1)
             with open("/proc/net/unix") as f:
                 names = f.read()
-            assert f"@kft-{port}" in names, "unix listener missing"
+            # abstract name carries host AND port so loopback-alias
+            # "hosts" can reuse ports on one machine
+            assert f"@kft-{host}-{port}" in names, "unix listener missing"
             p.barrier()
             q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover
