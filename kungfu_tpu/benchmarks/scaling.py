@@ -136,6 +136,49 @@ def predict_table(model_bytes: int, compute_s: float,
     return rows
 
 
+def predict_asymptote(model_bytes: int, compute_s: float,
+                      link: LinkModel = LinkModel()) -> float:
+    """Closed-form n→∞ limit of the SyncSGD weak-scaling efficiency.
+
+    Ring bytes-on-wire 2·payload·(n−1)/n saturates at 2·payload, so the
+    step time converges to
+    ``compute + (1−overlap)·2·payload·((l−1)/l / ici + 1/dcn)`` with
+    ``l = chips_per_host`` — the model's floor for ANY cluster size.
+    Every finite prediction must lie between this and 1.0 (a model
+    property a test can pin without blessing the default parameters)."""
+    l = link.chips_per_host
+    comm = 2.0 * model_bytes * (
+        ((l - 1) / l) / (link.ici_gbps * 1e9)
+        + 1.0 / (link.dcn_gbps * 1e9))
+    return compute_s / (compute_s + (1.0 - link.overlap) * comm)
+
+
+def sensitivity_table(model_bytes: int, compute_s: float,
+                      n_chips: int = 256,
+                      overlaps: Sequence[float] = (0.0, 0.25, 0.5,
+                                                   0.75, 0.9),
+                      dcns: Sequence[float] = (12.5, 25.0, 50.0)
+                      ) -> Dict:
+    """Efficiency at ``n_chips`` across the two assumptions the defaults
+    can't justify from measurement: comm/compute overlap and DCN
+    bandwidth (VERDICT r2: publish the range, not a point estimate).
+
+    Returns {"grid": [{overlap, dcn_gbps, ssgd_eff}...],
+             "range": [min, max]}."""
+    grid = []
+    for ov in overlaps:
+        for dcn in dcns:
+            link = LinkModel(overlap=ov, dcn_gbps=dcn)
+            grid.append({
+                "overlap": ov, "dcn_gbps": dcn,
+                "ssgd_eff": round(predict_efficiency(
+                    n_chips, model_bytes, compute_s, "ssgd", link), 4),
+            })
+    effs = [g["ssgd_eff"] for g in grid]
+    return {"chips": n_chips, "grid": grid,
+            "range": [min(effs), max(effs)]}
+
+
 # --------------------------------------------------------- measured sweep
 _WORKER_FLAG = "--_scaling-worker"
 
@@ -263,7 +306,11 @@ def main(argv=None) -> int:
             log_detailed_result(r["pairavg_eff"], 0.0, {
                 "bench": "predict-pairavg", "chips": r["chips"]},
                 unit="efficiency")
+        sens = sensitivity_table(gpt_bytes, compute_s)
         print(json.dumps({"prediction": rows,
+                          "asymptote_ssgd": round(predict_asymptote(
+                              gpt_bytes, compute_s), 4),
+                          "sensitivity_256": sens,
                           "link": dataclasses.asdict(LinkModel()),
                           "model_bytes": gpt_bytes,
                           "compute_s": compute_s}))

@@ -27,14 +27,17 @@ _DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
 
 
 def enable_compile_cache(path: Optional[str] = None,
-                         min_compile_time_secs: float = 0.0) -> Optional[str]:
+                         min_compile_time_secs: Optional[float] = None
+                         ) -> Optional[str]:
     """Point jax's persistent compilation cache at ``path`` (default:
     ``$KFT_COMPILE_CACHE`` or ``~/.cache/kungfu_tpu/xla``).  Returns the
     directory in use, or None when disabled via the env toggle.
 
-    ``min_compile_time_secs=0`` caches every program — the right setting
-    for elastic training, where even sub-second step compiles add up
-    across a fleet of respawned workers."""
+    The default threshold (0: cache every program) is right for elastic
+    training, where even sub-second step compiles add up across a fleet
+    of respawned workers.  A ``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS``
+    env var takes precedence over the default, but an EXPLICIT
+    ``min_compile_time_secs`` argument wins over both."""
     env = os.environ.get(CACHE_ENV, "").strip().lower()
     if env in ("0", "off", "none", "disable"):
         return None
@@ -49,8 +52,18 @@ def enable_compile_cache(path: Optional[str] = None,
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_enable_compilation_cache", True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                      min_compile_time_secs)
-    # cache autotuning/kernel artifacts too where the backend supports it
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # precedence: explicit argument > user env var > our default (0)
+    if min_compile_time_secs is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_time_secs)
+    elif "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if "JAX_COMPILATION_CACHE_MAX_SIZE" not in os.environ:
+        # bound the on-disk cache (LRU eviction) so caching every
+        # program can't grow ~/.cache without limit
+        jax.config.update("jax_compilation_cache_max_size",
+                          4 * 1024 * 1024 * 1024)
     return cache_dir
