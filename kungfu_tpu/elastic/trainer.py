@@ -30,7 +30,7 @@ from ..comm.session import Session
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID, PeerList
 from ..plan.topology import Strategy
-from ..training import build_train_step
+from ..training import build_train_step, build_train_step_with_state
 from . import state as _flags
 from .config_server import fetch_config
 
@@ -65,7 +65,14 @@ class ElasticTrainer:
                  init_params,
                  init_size: Optional[int] = None,
                  config_server_url: Optional[str] = None,
-                 max_size: Optional[int] = None):
+                 max_size: Optional[int] = None,
+                 init_model_state=None):
+        """``init_model_state`` switches on non-trained model state
+        (BatchNorm running stats): ``loss_fn(params, model_state, batch) ->
+        (loss, new_model_state)`` and the state rides every resize /
+        checkpoint alongside the params (the reference broadcasts BN stats
+        with the rest of the variables on sync points —
+        experimental/hook/elastic.py:62-84)."""
         self.loss_fn = loss_fn
         self.optimizer_factory = optimizer_factory
         self.config_server_url = config_server_url
@@ -85,10 +92,14 @@ class ElasticTrainer:
         # to disable)
         from ..utils.compile_cache import enable_compile_cache
         enable_compile_cache()
-        self._host_params = jax.tree_util.tree_map(
+        stack = lambda tree: jax.tree_util.tree_map(
             lambda t: np.broadcast_to(np.asarray(t)[None],
                                       (self.n,) + np.asarray(t).shape).copy(),
-            init_params)
+            tree)
+        self.has_model_state = init_model_state is not None
+        self._host_params = stack(init_params)
+        self._host_mstate = (stack(init_model_state)
+                             if self.has_model_state else None)
         self._step_cache: Dict[int, Callable] = {}
         self._install(self.n, fresh_opt=True)
 
@@ -98,21 +109,28 @@ class ElasticTrainer:
         self.session = Session(mesh=self.mesh, version=self.version)
         self.optimizer = self.optimizer_factory(n)
         self.params = _restack(self._host_params, n, self.mesh)
+        if self.has_model_state:
+            self.model_state = _restack(self._host_mstate, n, self.mesh)
         if fresh_opt:
             from ..training import init_opt_state
             self.opt_state = init_opt_state(self.optimizer, self.params,
                                             self.mesh)
         if n not in self._step_cache:
-            self._step_cache[n] = build_train_step(self.loss_fn,
-                                                   self.optimizer, self.mesh,
-                                                   donate=False)
+            build = (build_train_step_with_state if self.has_model_state
+                     else build_train_step)
+            self._step_cache[n] = build(self.loss_fn, self.optimizer,
+                                        self.mesh, donate=False)
         self._step = self._step_cache[n]
         self.n = n
 
     def step(self, global_batch) -> float:
         """One training step; batch leading axis sharded over current lanes."""
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, global_batch)
+        if self.has_model_state:
+            self.params, self.opt_state, self.model_state, loss = self._step(
+                self.params, self.opt_state, self.model_state, global_batch)
+        else:
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, global_batch)
         self.step_count += 1
         bs = jax.tree_util.tree_leaves(global_batch)[0].shape[0]
         self.trained_samples += int(bs)
@@ -146,6 +164,9 @@ class ElasticTrainer:
         self.last_resize_compiled = new_size not in self._step_cache
         self._host_params = jax.tree_util.tree_map(
             lambda t: np.asarray(t), self.params)
+        if self.has_model_state:
+            self._host_mstate = jax.tree_util.tree_map(
+                lambda t: np.asarray(t), self.model_state)
         host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
                                           self.opt_state)
         self.version += 1
@@ -210,6 +231,13 @@ class ElasticTrainer:
         return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
                                       self.params)
 
+    def current_model_state(self, lane: int = 0):
+        """One lane's non-trained model state (BN running stats) for eval."""
+        if not self.has_model_state:
+            raise ValueError("trainer was built without model state")
+        return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
+                                      self.model_state)
+
     # ------------------------------------------------------------ checkpoints
     def save_checkpoint(self, ckpt, force: bool = False) -> bool:
         """Write lane-0 model + optimizer state and progress counters.
@@ -224,6 +252,8 @@ class ElasticTrainer:
                 lambda t: np.asarray(np.asarray(t)[0]),  # 0-d stays ndarray
                 self.opt_state),
         }
+        if self.has_model_state:
+            state["mstate"] = self.current_model_state(0)
         meta = {"trained_samples": self.trained_samples,
                 "step_count": self.step_count,
                 "size": self.n}
@@ -238,17 +268,25 @@ class ElasticTrainer:
             lambda t: jax.ShapeDtypeStruct(t.shape[1:], t.dtype), tree)
         like = {"model": lane_template(self.params),
                 "opt": lane_template(self.opt_state)}
+        if self.has_model_state:
+            like["mstate"] = lane_template(self.model_state)
         step, state, meta = ckpt.restore(like=like, step=step)
         one = lambda tree: jax.tree_util.tree_map(
             lambda t: np.asarray(t)[None], tree)
         params = _restack(one(state["model"]), self.n, self.mesh)
         opt_state = _restack(one(state["opt"]), self.n, self.mesh)
-        # assign only after both restacks succeeded (keeps the n-lane
+        mstate = (_restack(one(state["mstate"]), self.n, self.mesh)
+                  if self.has_model_state else None)
+        # assign only after all restacks succeeded (keeps the n-lane
         # invariant of _host_params if an incompatible checkpoint raises)
         self.params = params
         self.opt_state = opt_state
         self._host_params = jax.tree_util.tree_map(
             lambda t: np.asarray(t), self.params)
+        if self.has_model_state:
+            self.model_state = mstate
+            self._host_mstate = jax.tree_util.tree_map(
+                lambda t: np.asarray(t), self.model_state)
         if meta:
             self.trained_samples = int(meta.get("trained_samples", 0))
             self.step_count = int(meta.get("step_count", step))

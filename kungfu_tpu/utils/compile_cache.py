@@ -26,12 +26,41 @@ _DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
                             "kungfu_tpu", "xla")
 
 
+def _host_fingerprint() -> str:
+    """Short digest of the host's ISA surface + jax version.
+
+    XLA:CPU AOT blobs bake in the *compiling* host's machine features; a
+    cache shared across heterogeneous machines loads blobs the current
+    CPU may not support (cpu_aot_loader warns "could lead to SIGILL").
+    The jax cache key does not fully cover this, so the cache directory
+    is partitioned per host type instead."""
+    import hashlib
+    import platform
+    import jax
+    bits = [platform.machine(), platform.processor(), jax.__version__]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                # x86 lists ISA extensions under "flags", aarch64 under
+                # "Features"; take whichever appears first
+                if ln.startswith(("flags", "Features")):
+                    bits.append(" ".join(sorted(set(
+                        ln.split(":", 1)[-1].split()))))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(path: Optional[str] = None,
                          min_compile_time_secs: Optional[float] = None
                          ) -> Optional[str]:
-    """Point jax's persistent compilation cache at ``path`` (default:
-    ``$KFT_COMPILE_CACHE`` or ``~/.cache/kungfu_tpu/xla``).  Returns the
-    directory in use, or None when disabled via the env toggle.
+    """Point jax's persistent compilation cache at a ``host-<digest>``
+    subdirectory of ``path`` (default: ``$KFT_COMPILE_CACHE`` or
+    ``~/.cache/kungfu_tpu/xla``) — blobs are partitioned per host type
+    because XLA:CPU AOT code baked for one machine's ISA can SIGILL on
+    another.  Returns the directory in use (the subdirectory, not the
+    base), or None when disabled via the env toggle.
 
     The default threshold (0: cache every program) is right for elastic
     training, where even sub-second step compiles add up across a fleet
@@ -48,7 +77,8 @@ def enable_compile_cache(path: Optional[str] = None,
                 or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
     if path is None and CACHE_ENV not in os.environ and existing:
         return existing
-    cache_dir = path or os.environ.get(CACHE_ENV) or _DEFAULT_DIR
+    base_dir = path or os.environ.get(CACHE_ENV) or _DEFAULT_DIR
+    cache_dir = os.path.join(base_dir, "host-" + _host_fingerprint())
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_enable_compilation_cache", True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -1,0 +1,150 @@
+"""Convergence-evidence machinery tests.
+
+Guards the pieces behind the README convergence table: elastic training
+with non-trained model state (BatchNorm) across resizes and checkpoints,
+and the learnable-MLM data used by examples/convergence_bert.py.
+Reference analogue: the convergence study of README.md:190-199 plus the
+elastic schedule tests of scripts/tests/run-elastic-test.sh.
+"""
+import os
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import kungfu_tpu.optimizers as kfopt
+from kungfu_tpu.checkpoint import Checkpointer
+from kungfu_tpu.elastic import ElasticTrainer, StepSchedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TinyBN(nn.Module):
+    """Smallest model with BatchNorm state: Dense -> BN -> Dense."""
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Dense(8)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.Dense(4)(x)
+
+
+def make_bn_trainer(n=4):
+    model = TinyBN()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 6)),
+                           train=False)
+
+    def loss_fn(p, mstate, batch):
+        x, y = batch
+        out, upd = model.apply({"params": p, "batch_stats": mstate}, x,
+                               train=True, mutable=["batch_stats"])
+        return ((out - y) ** 2).mean(), upd["batch_stats"]
+
+    tr = ElasticTrainer(
+        loss_fn,
+        optimizer_factory=lambda n: kfopt.synchronous_sgd(optax.sgd(0.05)),
+        init_params=variables["params"],
+        init_model_state=variables["batch_stats"],
+        init_size=n,
+    )
+    return model, tr
+
+
+def bn_batch(trainer, bs_per=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(trainer.n * bs_per, 6).astype(np.float32)
+    y = np.tanh(x[:, :4]).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestElasticModelState:
+    def test_state_rides_resizes(self):
+        _, tr = make_bn_trainer(n=4)
+        assert tr.has_model_state
+        first = tr.step(bn_batch(tr, seed=0))
+        for _ in range(5):
+            tr.step(bn_batch(tr, seed=tr.step_count))
+        # BN means must have moved off the zero init
+        mean0 = np.asarray(
+            jax.tree_util.tree_leaves(tr.current_model_state(0))[0])
+        assert np.abs(mean0).max() > 0
+
+        before = tr.current_model_state(0)
+        tr.resize(2)
+        after = tr.current_model_state(0)
+        # survivor lane keeps its running stats bit-exactly
+        for a, b in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        tr.resize(8)
+        # newcomer lanes cloned from lane 0
+        grown = jax.tree_util.tree_leaves(tr.model_state)[0]
+        g = np.asarray(grown)
+        np.testing.assert_array_equal(g[0], g[7])
+
+        for _ in range(5):
+            last = tr.step(bn_batch(tr, seed=tr.step_count))
+        assert np.isfinite(last)
+        assert last < first
+
+    def test_checkpoint_roundtrip_with_mstate(self, tmp_path):
+        model, tr = make_bn_trainer(n=4)
+        for _ in range(4):
+            tr.step(bn_batch(tr, seed=tr.step_count))
+        with Checkpointer(str(tmp_path)) as ck:
+            assert tr.save_checkpoint(ck, force=True)
+            ck.wait()
+            want_p = tr.current_params(0)
+            want_m = tr.current_model_state(0)
+
+            _, tr2 = make_bn_trainer(n=2)
+            step = tr2.restore_checkpoint(ck)
+        assert step == tr.step_count
+        assert tr2.trained_samples == tr.trained_samples
+        for a, b in zip(jax.tree_util.tree_leaves(want_p),
+                        jax.tree_util.tree_leaves(tr2.current_params(0))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(want_m),
+                        jax.tree_util.tree_leaves(
+                            tr2.current_model_state(0))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and it still trains at the new size
+        assert np.isfinite(tr2.step(bn_batch(tr2, seed=99)))
+
+    def test_stateless_trainer_rejects_mstate_accessor(self):
+        tr = ElasticTrainer(
+            lambda p, b: ((b[0] @ p["w"] - b[1]) ** 2).mean(),
+            lambda n: kfopt.synchronous_sgd(optax.sgd(0.1)),
+            {"w": jnp.zeros((4, 1))}, init_size=2)
+        assert not tr.has_model_state
+        with pytest.raises(ValueError):
+            tr.current_model_state(0)
+
+
+class TestLearnableMLMData:
+    def test_templates_are_learnable(self):
+        """The masked tokens are a deterministic function of the template
+        — verify the bank has no colliding contexts that would put a floor
+        under the loss."""
+        sys.path.insert(0, os.path.join(REPO, "examples"))
+        try:
+            from convergence_bert import (MASK_ID, sample_batch,
+                                          template_bank)
+        finally:
+            sys.path.pop(0)
+        bank = template_bank()
+        # templates pairwise distinct in enough positions that any 85%
+        # visible context identifies the row
+        diff = (bank[:, None, :] != bank[None, :, :]).sum(-1)
+        np.fill_diagonal(diff, bank.shape[1])
+        assert diff.min() > bank.shape[1] // 2
+        tokens, masked, is_masked = sample_batch(
+            bank, np.random.RandomState(0), 16)
+        assert ((masked == MASK_ID) == (is_masked > 0)).all()
+        # unmasked positions preserved
+        keep = is_masked == 0
+        assert (masked[keep] == tokens[keep]).all()

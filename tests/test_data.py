@@ -88,6 +88,22 @@ def test_synthetic_is_learnable():
     assert (pred == y).mean() > 0.9
 
 
+def test_train_test_share_the_task():
+    """Synthetic train/test splits must describe the SAME classification
+    task: a nearest-mean classifier fit on TRAIN must transfer to TEST.
+    (Regression: the splits once drew independent mean banks, so models
+    that fit train perfectly scored chance on test.)"""
+    from kungfu_tpu.data import cifar10, mnist
+    for loader in (mnist, cifar10):
+        (xtr, ytr), (xte, yte) = loader(None)
+        k = int(ytr.max()) + 1
+        means = np.stack([xtr[ytr == c].mean(axis=0) for c in range(k)])
+        flat = lambda a: a.reshape(len(a), -1)
+        pred = np.argmin(
+            ((flat(xte)[:, None] - flat(means)[None]) ** 2).sum(-1), axis=1)
+        assert (pred == yte).mean() > 0.9, loader.__name__
+
+
 def test_missing_dir_raises():
     import pytest
     with pytest.raises(FileNotFoundError):
