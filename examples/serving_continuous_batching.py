@@ -1,0 +1,112 @@
+"""Continuous batching vs static batching on a mixed-length workload.
+
+The serving engine's value proposition measured: N requests with widely
+varying prompt and output lengths run (a) through the continuous-batching
+``DecodeEngine`` (slots refill as sequences finish) and (b) as one static
+padded batch through ``models.gpt.generate`` (everyone decodes until the
+LONGEST request finishes — the no-serving baseline).  Same weights, same
+greedy tokens; the engine wins on wasted-step count, and the gap grows
+with length variance.
+
+CPU demo (tiny model):
+
+    JAX_PLATFORMS=cpu python examples/serving_continuous_batching.py
+
+TPU (bigger model, real throughput numbers):
+
+    python examples/serving_continuous_batching.py --preset tpu
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from kungfu_tpu.utils.platform import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.serving import DecodeEngine, Request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=["cpu", "tpu"], default="cpu")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.preset == "tpu":
+        cfg = G.GPTConfig(vocab_size=32768, d_model=1024, n_heads=16,
+                          n_kv_heads=4, n_layers=12, d_ff=4096,
+                          max_seq=2048, rope=True, mlp="swiglu",
+                          dtype=jnp.bfloat16)
+        block, blocks, buckets = 64, 512, (128, 512)
+        pmin, pmax, omin, omax = 16, 500, 8, 512
+    else:
+        cfg = G.GPTConfig(vocab_size=256, d_model=64, n_heads=4,
+                          n_kv_heads=2, n_layers=2, d_ff=128, max_seq=256,
+                          rope=True, dtype=jnp.float32)
+        block, blocks, buckets = 16, 128, (16, 64)
+        pmin, pmax, omin, omax = 4, 60, 4, 64
+
+    params = G.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(args.seed)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, cfg.vocab_size,
+                                       rng.randint(pmin, pmax + 1)).tolist(),
+                    max_new=int(rng.randint(omin, omax + 1)))
+            for i in range(args.requests)]
+
+    # ---- continuous batching
+    eng = DecodeEngine(params, cfg, num_slots=args.slots, block_size=block,
+                       num_blocks=blocks, prompt_buckets=buckets)
+    res = eng.run(reqs)          # first run includes compiles
+    eng.stats.reset()
+    res = eng.run(reqs)          # timed run, warm
+    cb = eng.stats.summary()
+    print("continuous batching:", json.dumps(cb))
+
+    # ---- static batching baseline: pad everyone to the longest prompt,
+    # decode until the longest output finishes (then truncate per request)
+    tmax = max(len(r.prompt) for r in reqs)
+    nmax = max(r.max_new for r in reqs)
+    total_tokens = sum(r.max_new for r in reqs)
+    batch = np.zeros((len(reqs), tmax), np.int32)
+    for i, r in enumerate(reqs):
+        batch[i, :len(r.prompt)] = r.prompt   # right-pad: positions differ!
+    # NOTE right-padding changes absolute positions vs solo runs, so the
+    # static baseline is measured for THROUGHPUT only, not token parity
+    # (left-padding would need attention-mask plumbing generate() lacks —
+    # exactly the bookkeeping the engine's paged cache does properly).
+    gen = jax.jit(lambda p, t: G.generate(p, cfg, t, nmax))
+    out = gen(params, jnp.asarray(batch))
+    jax.block_until_ready(out)                # compile
+    t0 = time.perf_counter()
+    out = gen(params, jnp.asarray(batch))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    static = {"tokens_out": len(reqs) * nmax,
+              "useful_tokens": total_tokens,
+              "wall_s": round(dt, 3),
+              "useful_tok_per_s": round(total_tokens / dt, 1)}
+    print("static batching:   ", json.dumps(static))
+
+    speedup = cb["tok_per_s"] / static["useful_tok_per_s"] \
+        if static["useful_tok_per_s"] else float("nan")
+    print(f"continuous/static useful-throughput: {speedup:.2f}x "
+          f"(occupancy {cb['occupancy']:.0%}, "
+          f"{cb['preemptions']} preemptions)")
+
+
+if __name__ == "__main__":
+    main()

@@ -191,19 +191,22 @@ def rms_norm(x, scale, eps=1e-5):
 
 def _rope_rotate(t, pos, cfg: GPTConfig):
     """Rotary position embedding on [B, T, heads, Dh] with GLOBAL
-    positions ``pos`` [T] — under sequence parallelism each shard rotates
-    by its own global offsets, so ring/Ulysses attention needs no other
-    change."""
+    positions ``pos`` — [T] (shared across the batch; under sequence
+    parallelism each shard rotates by its own global offsets, so
+    ring/Ulysses attention needs no other change) or [B, T] (per-row
+    positions — the continuous-batching decode path, where every slot
+    sits at a different depth)."""
     half = cfg.head_dim // 2
     freqs = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # [T, half]
+    ang = pos.astype(jnp.float32)[..., None] * freqs  # [(B,) T, half]
     # angles/cos/sin in f32 (position precision); the big tensor math
     # runs in rope_dtype — default the activation dtype (an f32
     # round-trip on [B, T, H, Dh] costs two full extra HBM passes per
     # projection), opt-in f32 for long contexts (GPTConfig.rope_dtype)
     rd = cfg.rope_dtype or t.dtype
-    cos = jnp.cos(ang)[None, :, None, :].astype(rd)
-    sin = jnp.sin(ang)[None, :, None, :].astype(rd)
+    # [(B,) T, 1, half] broadcasts over batch and heads either way
+    cos = jnp.cos(ang)[..., None, :].astype(rd)
+    sin = jnp.sin(ang)[..., None, :].astype(rd)
     t1, t2 = t[..., :half].astype(rd), t[..., half:].astype(rd)
     return jnp.concatenate([t1 * cos - t2 * sin,
                             t1 * sin + t2 * cos], axis=-1).astype(t.dtype)
@@ -474,7 +477,10 @@ def init_kv_cache(cfg: GPTConfig, batch: int, max_len: Optional[int] = None):
 
 def _decode_attend(q, kc, vc, pos):
     """q [B, 1, H, Dh] vs cache [B, L, H, Dh] (GQA callers repeat-expand
-    the compact cache at the call site); positions > pos masked.
+    the compact cache at the call site); positions > pos masked.  ``pos``
+    is a scalar (whole batch at one depth — the plain generate loop) or
+    [B] (each row at its own depth — the continuous-batching engine,
+    serving/cache.py paged_decode_attend).
 
     NOTE on GQA bandwidth: the cache itself stays compact ([.., kv_heads,
     ..]); the repeat happens at this read and XLA fuses it into the
@@ -486,7 +492,9 @@ def _decode_attend(q, kc, vc, pos):
     L = kc.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
-    mask = (jnp.arange(L) <= pos)[None, None, None, :]
+    # scalar pos -> [1]; [B] pos stays — either broadcasts over the batch
+    mask = (jnp.arange(L)[None, :]
+            <= jnp.atleast_1d(pos)[:, None])[:, None, None, :]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,

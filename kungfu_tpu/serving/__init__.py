@@ -1,0 +1,18 @@
+"""Serving: continuous-batching decode over a paged KV cache.
+
+TPU-native extension beyond the reference's training-only envelope —
+the decode-serving gap called out as explicit future work in round 2.
+
+    from kungfu_tpu.serving import DecodeEngine, Request
+    eng = DecodeEngine(params, cfg, num_slots=8, block_size=32,
+                       num_blocks=256)
+    results = eng.run([Request(uid=0, prompt=[...], max_new=64), ...])
+    print(eng.stats.summary())
+"""
+from .cache import (init_paged_pools, paged_decode_attend, paged_gather,
+                    paged_write_prompt, paged_write_token)
+from .engine import DecodeEngine, EngineStats, Request
+
+__all__ = ["DecodeEngine", "EngineStats", "Request", "init_paged_pools",
+           "paged_decode_attend", "paged_gather", "paged_write_prompt",
+           "paged_write_token"]

@@ -1,0 +1,100 @@
+"""Paged KV cache: a block pool + per-slot block tables, all static shapes.
+
+The reference framework stops at training; its decode story is the plain
+contiguous cache (models/gpt.py init_kv_cache).  Serving-grade decode
+needs two more things the contiguous cache can't give:
+
+* **memory sharing across requests of different lengths** — a slot that
+  generates 40 tokens must not pin ``max_seq`` worth of cache, and
+* **slot reuse without reallocation** — finished sequences hand their
+  memory to waiting requests mid-flight (continuous batching).
+
+The TPU-native shape of this is vLLM's paged attention re-thought for
+XLA's static-shape world:
+
+* one **pool** per layer, ``[num_blocks, block_size, kv_heads, head_dim]``
+  — a fixed device-resident allocation, donated through every step so
+  XLA updates it in place;
+* a **block table** ``int32 [slots, max_blocks_per_slot]`` mapping each
+  slot's logical positions to pool blocks.  Tables are tiny and live on
+  the host (the scheduler mutates them freely); they ride into the
+  jitted step as an ordinary argument, so admitting / finishing /
+  preempting a request NEVER recompiles anything;
+* block 0 is a **scratch block**: the table rows of inactive slots and
+  the write positions of padding tokens all point at it, so masked lanes
+  scatter their garbage harmlessly and the jitted program needs no
+  conditionals.
+
+Reads gather whole blocks (``pool[tables]``) — on TPU this is a
+sequential HBM sweep of exactly the bytes a contiguous cache would read,
+so paging costs bandwidth-nothing; writes are a batched one-token-per-slot
+scatter.  Everything is ``lax``-friendly: no dynamic shapes anywhere.
+"""
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt import GPTConfig
+
+
+def init_paged_pools(cfg: GPTConfig, num_blocks: int,
+                     block_size: int) -> List[dict]:
+    """Per-layer K/V pools ``[num_blocks, block_size, kv_heads, Dh]`` in
+    the model dtype (GQA keeps the pool compact, kv_groups-times smaller
+    than MHA).  Block 0 is reserved as the scratch block."""
+    if num_blocks < 2:
+        raise ValueError("need >= 2 blocks (block 0 is scratch)")
+    shape = (num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def lookup_blocks(tables, pos, block_size: int):
+    """Physical (block, offset) for each slot's write position ``pos``
+    [S].  ``tables`` [S, max_blocks] int32."""
+    sidx = jnp.arange(tables.shape[0])
+    return tables[sidx, pos // block_size], pos % block_size
+
+
+def paged_write_token(pool, blk, off, kv):
+    """Scatter one token per slot into the pool: ``kv`` [S, kv_heads, Dh]
+    lands at ``(blk[s], off[s])``.  Slots routed to the scratch block may
+    collide — by construction nothing reads scratch contents."""
+    return pool.at[blk, off].set(kv)
+
+
+def paged_write_prompt(pool, table_row, kv, t_real, block_size: int):
+    """Scatter a whole prompt's K or V ``kv`` [T, kv_heads, Dh] into one
+    slot's blocks.  Positions ``>= t_real`` (right padding of the prompt
+    bucket) are routed to the scratch block, so the dense-prefill values
+    for padding never land in real cache."""
+    T = kv.shape[0]
+    p = jnp.arange(T)
+    real = p < t_real
+    blk = jnp.where(real, table_row[p // block_size], 0)
+    off = p % block_size
+    return pool.at[blk, off].set(kv)
+
+
+def paged_gather(pool, tables):
+    """[S, max_blocks * block_size, kv_heads, Dh] logical view of every
+    slot's cache (a whole-block HBM gather; unallocated table entries
+    read the scratch block and are masked out by the attend)."""
+    S = tables.shape[0]
+    g = pool[tables]                       # [S, MB, bs, H, Dh]
+    return g.reshape(S, -1, g.shape[-2], g.shape[-1])
+
+
+def paged_decode_attend(q, kc, vc, pos):
+    """Per-slot masked decode attention: ``q`` [S, 1, H, Dh]; ``kc``/``vc``
+    [S, L, H, Dh] (already GQA-expanded); ``pos`` [S] — each slot attends
+    to its own prefix ``<= pos[s]``.
+
+    ONE implementation with the plain decode loop: delegates to
+    ``models.gpt._decode_attend`` (which the GQA-bandwidth measurement
+    note lives on), passing per-row positions instead of its scalar."""
+    from ..models.gpt import _decode_attend
+    return _decode_attend(q, kc, vc, pos)

@@ -1,0 +1,337 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Closes the gap between "has a KV cache" and a serving story for the GPT
+family (the reference framework is training-only; this is a TPU-native
+extension).  Design:
+
+* a fixed set of **slots** (the decode batch dimension, static forever);
+* ONE jitted decode step for the whole engine lifetime — per-slot
+  positions, the paged block tables, and the active mask are ordinary
+  array arguments, so requests joining/leaving/preempting never touch
+  the compiler;
+* **bucketed dense prefill**: a new request's prompt runs through the
+  dense causal forward (matmul-heavy, MXU-friendly — NOT T incremental
+  steps) padded to a small set of bucket lengths, writing K/V for all
+  positions at once.  Right padding is exact under causal masking: real
+  positions never attend to pad.  One compile per bucket, ever;
+* **on-demand block allocation**: a slot holds only the blocks its
+  tokens actually fill.  When the pool runs dry the youngest slot is
+  preempted back to the queue (its blocks freed) and replayed later —
+  deterministic under greedy decoding;
+* host scheduler does admission (FCFS), harvest (EOS / max_new), and
+  bookkeeping in numpy; the device only ever sees static shapes.
+
+The per-request oracle is ``models.gpt.generate`` — the engine must
+produce exactly the tokens the plain whole-batch decoder produces
+(tests/test_serving.py).
+"""
+import collections
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import gpt as G
+from ..models.gpt import GPTConfig
+from .cache import (init_paged_pools, lookup_blocks, paged_decode_attend,
+                    paged_gather, paged_write_prompt, paged_write_token)
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: List[int]
+    max_new: int
+    eos: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _Running:
+    req: Request
+    slot: int
+    blocks: List[int]            # pool blocks owned, in logical order
+    out: List[int]               # generated tokens so far
+
+
+class EngineStats:
+    def __init__(self, slots: int = 0):
+        self._slots = slots
+        self.reset()
+
+    def reset(self):
+        """Zero the counters (e.g. after a warm-up run); keeps the slot
+        count the occupancy metric divides by."""
+        self.decode_steps = 0
+        self.slot_steps = 0          # sum over steps of active slots
+        self.tokens_out = 0          # tokens DELIVERED (preempted work
+        self.prefills = 0            # is subtracted when discarded)
+        self.preemptions = 0
+        self.wall_s = 0.0
+
+    @property
+    def occupancy(self):
+        tot = self.decode_steps * self._slots if self.decode_steps else 0
+        return self.slot_steps / tot if tot else 0.0
+
+    def summary(self):
+        return {"tokens_out": self.tokens_out,
+                "decode_steps": self.decode_steps,
+                "prefills": self.prefills,
+                "preemptions": self.preemptions,
+                "occupancy": round(self.occupancy, 3),
+                "wall_s": round(self.wall_s, 3),
+                "tok_per_s": round(self.tokens_out / self.wall_s, 1)
+                if self.wall_s else 0.0}
+
+
+def _make_decode_step(cfg: GPTConfig, block_size: int):
+    """One engine-wide decode step: feed every slot its last token at its
+    own position, scatter K/V through the block tables, sample greedily.
+    Pools are donated — XLA updates them in place."""
+
+    def step(params, pools, tables, pos, tokens):
+        x = G.embed(params, tokens[:, None], pos[:, None], cfg)
+        # inactive slots have zeroed table rows and pos 0, so their
+        # writes land in the scratch block — no conditionals needed
+        blk, off = lookup_blocks(tables, pos, block_size)
+        new_pools = []
+        for layer, pool in zip(params["layers"], pools):
+            q, kk, v = G._layer_qkv(layer, x, cfg, pos=pos[:, None])
+            kp = paged_write_token(pool["k"], blk, off, kk[:, 0])
+            vp = paged_write_token(pool["v"], blk, off, v[:, 0])
+            new_pools.append({"k": kp, "v": vp})
+            kc = G._expand_kv(paged_gather(kp, tables), cfg)
+            vc = G._expand_kv(paged_gather(vp, tables), cfg)
+            o = paged_decode_attend(q, kc, vc, pos)
+            x = G._layer_finish(layer, x, o, cfg)
+        x = G.rms_norm(x, params["lnf"])
+        logits = G._head(params, x)                     # [S, V] f32
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, new_pools
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def _make_prefill(cfg: GPTConfig, block_size: int):
+    """Bucketed dense prefill for ONE request: causal forward over the
+    padded prompt (one matmul-heavy pass — the MXU path, not T scan
+    steps), K/V scatter into the slot's blocks, greedy first token from
+    the hidden state at the true last position.  ``t_real`` is a traced
+    scalar: every prompt length in a bucket shares the compile."""
+
+    def prefill(params, pools, table_row, tokens, t_real):
+        T = tokens.shape[0]
+        pos = jnp.arange(T)
+        x = G.embed(params, tokens[None], pos, cfg)      # [1, T, D]
+        new_pools = []
+        for layer, pool in zip(params["layers"], pools):
+            q, kk, v = G._layer_qkv(layer, x, cfg, pos=pos)
+            kp = paged_write_prompt(pool["k"], table_row, kk[0], t_real,
+                                    block_size)
+            vp = paged_write_prompt(pool["v"], table_row, v[0], t_real,
+                                    block_size)
+            new_pools.append({"k": kp, "v": vp})
+            o = G._attend(q, kk, v, "dense", None, kv_groups=cfg.kv_groups)
+            x = G._layer_finish(layer, x, o, cfg)
+        x = G.rms_norm(x, params["lnf"])
+        h_last = jnp.take_along_axis(
+            x, (t_real - 1)[None, None, None], axis=1)   # [1, 1, D]
+        logits = G._head(params, h_last)                 # [1, V]
+        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), new_pools
+
+    return jax.jit(prefill, donate_argnums=(1,))
+
+
+class DecodeEngine:
+    """Continuous-batching serving loop.
+
+    ``num_blocks`` * ``block_size`` tokens of KV cache are shared by all
+    slots; ``max_len`` bounds any single sequence (its table width).
+    ``prompt_buckets`` are the static prefill lengths (ascending).
+    """
+
+    def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
+                 block_size: int = 32, num_blocks: int = 64,
+                 max_len: Optional[int] = None,
+                 prompt_buckets=(32, 128, 512)):
+        self.params = params
+        self.cfg = cfg
+        self.S = num_slots
+        self.bs = block_size
+        self.max_len = max_len or cfg.max_seq
+        if not cfg.rope and self.max_len > cfg.max_seq:
+            raise ValueError("max_len beyond wpe table")
+        self.max_blocks = -(-self.max_len // block_size)
+        self.buckets = tuple(sorted(b for b in prompt_buckets
+                                    if b <= self.max_len))
+        if not self.buckets:
+            raise ValueError("no prompt bucket fits max_len")
+        self.pools = init_paged_pools(cfg, num_blocks, block_size)
+        self._total_blocks = num_blocks - 1      # block 0 is scratch
+        self._free = collections.deque(range(1, num_blocks))
+        self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
+        self._pos = np.zeros(num_slots, np.int32)
+        self._tok = np.zeros(num_slots, np.int32)
+        self._running: List[Optional[_Running]] = [None] * num_slots
+        self._queue: "collections.deque[Request]" = collections.deque()
+        self._admit_order: List[int] = []    # slots, oldest first
+        self._results: Dict[int, List[int]] = {}
+        self._decode = _make_decode_step(cfg, block_size)
+        self._prefill = _make_prefill(cfg, block_size)
+        self.stats = EngineStats(num_slots)
+
+    # ------------------------------------------------------------- admin
+    def submit(self, req: Request) -> None:
+        if not req.prompt or req.max_new < 1:
+            raise ValueError(f"request {req.uid}: needs a non-empty "
+                             f"prompt and max_new >= 1")
+        need = len(req.prompt) + req.max_new
+        if need > self.max_len:
+            raise ValueError(f"request {req.uid}: prompt+max_new {need} "
+                             f"exceeds max_len {self.max_len}")
+        if -(-need // self.bs) > self._total_blocks:
+            raise ValueError(f"request {req.uid}: needs more KV blocks "
+                             f"than the whole pool holds")
+        if len(req.prompt) > self.buckets[-1]:
+            raise ValueError(f"request {req.uid}: prompt longer than the "
+                             f"largest prefill bucket {self.buckets[-1]}")
+        self._queue.append(req)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise AssertionError  # submit() validated
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        if len(self._free) < n:
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def _free_slot(self, slot: int) -> None:
+        run = self._running[slot]
+        self._free.extend(run.blocks)
+        self._running[slot] = None
+        self._tables[slot] = 0
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+        self._admit_order.remove(slot)
+
+    def _admit(self) -> None:
+        while self._queue:
+            slot = next((i for i in range(self.S)
+                         if self._running[i] is None), None)
+            if slot is None:
+                return
+            req = self._queue[0]
+            t_real = len(req.prompt)
+            blocks = self._alloc(-(-t_real // self.bs))
+            if blocks is None:
+                return                      # FCFS: wait for memory
+            self._queue.popleft()
+            run = _Running(req=req, slot=slot, blocks=blocks, out=[])
+            self._tables[slot] = 0
+            self._tables[slot, :len(blocks)] = blocks
+            Tb = self._bucket(t_real)
+            toks = np.zeros(Tb, np.int32)
+            toks[:t_real] = req.prompt
+            tok0, self.pools = self._prefill(
+                self.params, self.pools,
+                jnp.asarray(self._tables[slot]), jnp.asarray(toks),
+                jnp.int32(t_real))
+            self.stats.prefills += 1
+            tok0 = int(tok0)
+            run.out.append(tok0)
+            self.stats.tokens_out += 1
+            self._running[slot] = run
+            self._admit_order.append(slot)
+            if self._finished(run):
+                self._harvest(slot)
+                continue
+            self._pos[slot] = t_real        # next write position
+            self._tok[slot] = tok0
+
+    def _finished(self, run: _Running) -> bool:
+        return (len(run.out) >= run.req.max_new
+                or (run.req.eos is not None and run.out
+                    and run.out[-1] == run.req.eos))
+
+    def _harvest(self, slot: int) -> None:
+        run = self._running[slot]
+        self._results[run.req.uid] = run.out
+        self._free_slot(slot)
+
+    def _preempt_youngest(self, needy_slot: int) -> bool:
+        """Free the most recently admitted slot (other than the one that
+        needs memory); its request replays from the queue head —
+        deterministic under greedy decoding."""
+        for slot in reversed(self._admit_order):
+            if slot == needy_slot:
+                continue
+            run = self._running[slot]
+            self._queue.appendleft(run.req)
+            # its generated-so-far tokens are discarded and will be
+            # regenerated on replay: don't count them twice
+            self.stats.tokens_out -= len(run.out)
+            self._free_slot(slot)
+            self.stats.preemptions += 1
+            return True
+        return False
+
+    def _ensure_blocks(self) -> None:
+        """Every active slot is about to write position ``pos``; make
+        sure the block holding it exists, preempting if the pool is
+        dry."""
+        for slot in list(self._admit_order):
+            run = self._running[slot]
+            if run is None:
+                continue
+            bi = int(self._pos[slot]) // self.bs
+            while bi >= len(run.blocks):
+                got = self._alloc(1)
+                if got is not None:
+                    run.blocks.extend(got)
+                    self._tables[slot, len(run.blocks) - 1] = got[0]
+                elif not self._preempt_youngest(slot):
+                    raise RuntimeError(
+                        "KV pool exhausted with a single active request "
+                        "— increase num_blocks")
+
+    # -------------------------------------------------------------- run
+    def step(self) -> bool:
+        """One scheduler tick: admit, guarantee memory, one fused decode
+        step for all active slots, harvest.  Returns False when idle."""
+        self._admit()
+        self._ensure_blocks()
+        active = [s for s in range(self.S) if self._running[s] is not None]
+        if not active:
+            return bool(self._queue)
+        nxt, self.pools = self._decode(
+            self.params, self.pools, jnp.asarray(self._tables),
+            jnp.asarray(self._pos), jnp.asarray(self._tok))
+        nxt = np.asarray(nxt)
+        self.stats.decode_steps += 1
+        self.stats.slot_steps += len(active)
+        for slot in active:
+            run = self._running[slot]
+            run.out.append(int(nxt[slot]))
+            self.stats.tokens_out += 1
+            self._pos[slot] += 1
+            self._tok[slot] = int(nxt[slot])
+            if self._finished(run):
+                self._harvest(slot)
+        return True
+
+    def run(self, requests) -> Dict[int, List[int]]:
+        """Drain ``requests`` through the engine; returns uid -> tokens."""
+        t0 = time.perf_counter()
+        for r in requests:
+            self.submit(r)
+        while self.step():
+            pass
+        self.stats.wall_s += time.perf_counter() - t0
+        out, self._results = self._results, {}
+        return out
