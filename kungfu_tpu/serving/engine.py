@@ -228,7 +228,13 @@ class DecodeEngine:
                 return
             req = self._queue[0]
             t_real = len(req.prompt)
-            blocks = self._alloc(-(-t_real // self.bs))
+            need = -(-t_real // self.bs)
+            # +1 growth headroom: admitting with only exactly the prompt's
+            # blocks free would preempt (and waste the prefill) within at
+            # most block_size decode steps under steady pressure
+            if len(self._free) < need + 1 and self._admit_order:
+                return                      # FCFS: wait for memory
+            blocks = self._alloc(need)
             if blocks is None:
                 return                      # FCFS: wait for memory
             self._queue.popleft()
@@ -264,22 +270,28 @@ class DecodeEngine:
         self._results[run.req.uid] = run.out
         self._free_slot(slot)
 
-    def _preempt_youngest(self, needy_slot: int) -> bool:
-        """Free the most recently admitted slot (other than the one that
-        needs memory); its request replays from the queue head —
-        deterministic under greedy decoding."""
-        for slot in reversed(self._admit_order):
-            if slot == needy_slot:
-                continue
-            run = self._running[slot]
-            self._queue.appendleft(run.req)
-            # its generated-so-far tokens are discarded and will be
-            # regenerated on replay: don't count them twice
-            self.stats.tokens_out -= len(run.out)
-            self._free_slot(slot)
-            self.stats.preemptions += 1
-            return True
-        return False
+    def _preempt_for(self, needy_slot: int) -> bool:
+        """Free a slot admitted AFTER the needy one (youngest first); if
+        the needy slot is itself the youngest, it preempts ITSELF.  Older
+        slots are never the victim, so the oldest request always runs to
+        completion — guaranteed progress, and the most-progressed work is
+        never the work discarded.  Replays are deterministic under greedy
+        decoding.  Returns False only when the needy slot is the sole
+        active one (the pool is simply too small)."""
+        order = self._admit_order
+        younger = [s for s in order[order.index(needy_slot) + 1:]]
+        victim = younger[-1] if younger else (
+            needy_slot if len(order) > 1 else None)
+        if victim is None:
+            return False
+        run = self._running[victim]
+        self._queue.appendleft(run.req)
+        # its generated-so-far tokens are discarded and will be
+        # regenerated on replay: don't count them twice
+        self.stats.tokens_out -= len(run.out)
+        self._free_slot(victim)
+        self.stats.preemptions += 1
+        return True
 
     def _ensure_blocks(self) -> None:
         """Every active slot is about to write position ``pos``; make
@@ -287,15 +299,15 @@ class DecodeEngine:
         dry."""
         for slot in list(self._admit_order):
             run = self._running[slot]
-            if run is None:
+            if run is None or self._running[slot] is not run:
                 continue
             bi = int(self._pos[slot]) // self.bs
-            while bi >= len(run.blocks):
+            while self._running[slot] is run and bi >= len(run.blocks):
                 got = self._alloc(1)
                 if got is not None:
                     run.blocks.extend(got)
                     self._tables[slot, len(run.blocks) - 1] = got[0]
-                elif not self._preempt_youngest(slot):
+                elif not self._preempt_for(slot):
                     raise RuntimeError(
                         "KV pool exhausted with a single active request "
                         "— increase num_blocks")

@@ -85,6 +85,21 @@ def test_error_is_attributed_with_stderr_tail(tmp_path):
     assert "boom diagnostics" in err
 
 
+def test_tpu_plugin_presence_is_detected_without_a_tunnel_client(
+        monkeypatch):
+    """The orchestrator must decide TPU-vs-CPU WITHOUT creating a tunnel
+    client (a successful probe leaves the chip granted for minutes and
+    the first real attempt would queue behind it)."""
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("PYTHONPATH", "/other/path")
+    assert not bench.tpu_plugin_present()
+    monkeypatch.setenv("PYTHONPATH", "/root/.axon_site:/other/path")
+    assert bench.tpu_plugin_present()
+    monkeypatch.setenv("PYTHONPATH", "")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert bench.tpu_plugin_present()
+
+
 def test_cpu_env_strips_axon_plugin(monkeypatch):
     monkeypatch.setenv("PYTHONPATH", "/root/.axon_site:/other/path")
     env = bench._cpu_env()
