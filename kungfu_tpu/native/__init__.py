@@ -115,6 +115,8 @@ def _load():
         lib.kft_egress_bytes.restype = i64
         lib.kft_egress_rate.argtypes = [c, i32]
         lib.kft_egress_rate.restype = dbl
+        lib.kft_shm_bytes.argtypes = [c]
+        lib.kft_shm_bytes.restype = i64
         lib.kft_ping.argtypes = [c, i32, ctypes.POINTER(dbl)]
         lib.kft_set_stall_threshold.argtypes = [c, dbl]
         lib.kft_last_error.restype = cstr
@@ -459,6 +461,11 @@ class NativePeer:
 
     def egress_rate(self, peer: int = -1) -> float:
         return self._lib.kft_egress_rate(self._h, peer)
+
+    def shm_bytes(self) -> int:
+        """Payload bytes that crossed the colocated shared-memory lane
+        (``KFT_SHM_MB`` sizes the per-connection ring; 0 disables)."""
+        return self._lib.kft_shm_bytes(self._h)
 
     def ping(self, peer: int) -> float:
         rtt = ctypes.c_double()

@@ -279,7 +279,7 @@ class DecodeEngine:
         decoding.  Returns False only when the needy slot is the sole
         active one (the pool is simply too small)."""
         order = self._admit_order
-        younger = [s for s in order[order.index(needy_slot) + 1:]]
+        younger = order[order.index(needy_slot) + 1:]
         victim = younger[-1] if younger else (
             needy_slot if len(order) > 1 else None)
         if victim is None:
@@ -299,7 +299,7 @@ class DecodeEngine:
         dry."""
         for slot in list(self._admit_order):
             run = self._running[slot]
-            if run is None or self._running[slot] is not run:
+            if run is None:
                 continue
             bi = int(self._pos[slot]) // self.bs
             while self._running[slot] is run and bi >= len(run.blocks):

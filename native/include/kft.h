@@ -115,6 +115,9 @@ int kft_request(kft_peer *, int target, const char *name, void *buf,
 
 /* ---- monitoring (reference: srcs/go/monitor/) ---- */
 int64_t kft_egress_bytes(const kft_peer *, int peer /* -1: total */);
+/* payload bytes that crossed the colocated shared-memory lane instead of
+ * the socket (KFT_SHM_MB sizes the per-connection ring; 0 disables) */
+int64_t kft_shm_bytes(const kft_peer *);
 double kft_egress_rate(const kft_peer *, int peer /* -1: total */);
 int kft_ping(kft_peer *, int peer, double *rtt_ms);
 /* Log any op pending longer than `seconds` (reference: InstallStallDetector);

@@ -42,6 +42,9 @@ enum MsgFlags : uint8_t {
     FLAG_RESPONSE = 1 << 0,
     FLAG_FAILED = 1 << 1,
     FLAG_SAVE = 1 << 2,  // CLS_P2P: save request (else: fetch request)
+    // body on the wire is a 24-byte {data_off, len, advance} descriptor;
+    // the payload itself sits in the connection's shared-memory ring
+    FLAG_SHM = 1 << 3,
 };
 
 constexpr uint32_t MSG_MAGIC = 0x4B465431;  // "KFT1"
@@ -277,6 +280,74 @@ class StallTracker {
 };
 
 // ------------------------------------------------------------- connection
+// ------------------------------------------------------------- shm ring
+// Single-producer single-consumer shared-memory ring for COLOCATED peers:
+// the bulk payload of a frame crosses /dev/shm with two user-space
+// memcpys and zero per-chunk syscalls, while the (tiny) frame itself
+// still rides the unix socket — which thereby stays the ordering channel,
+// so ring consumption order equals frame order by construction.  This is
+// the transport the loopback-bound measurements were missing: the TCP
+// path pays two kernel copies plus per-64KiB syscall round trips.
+//
+// Layout: [ShmHdr | data bytes].  head/tail are MONOTONIC byte counters
+// (offset = counter % size); producer owns head, consumer owns tail.
+// Allocations are contiguous: a frame that would straddle the end pads
+// to the boundary (advance covers the pad).  The producer never blocks —
+// a full ring falls back to the socket body path for that frame.
+struct ShmHdr {
+    std::atomic<uint64_t> head;   // bytes produced (pad included)
+    std::atomic<uint64_t> tail;   // bytes consumed (pad included)
+    uint64_t size = 0;            // data-area bytes
+    uint8_t pad[64 - 3 * 8];      // keep the data area cache-aligned
+};
+
+class ShmRing {
+  public:
+    static constexpr uint64_t NO_SPACE = ~uint64_t(0);
+
+    // Producer side: create + map a fresh segment (O_EXCL).
+    static std::unique_ptr<ShmRing> create(const std::string &name,
+                                           uint64_t data_bytes);
+    // Consumer side: map an existing segment by name.
+    static std::unique_ptr<ShmRing> attach(const std::string &name);
+    ~ShmRing();
+
+    // Producer: reserve len contiguous bytes.  Returns the data offset to
+    // write at (NO_SPACE if the ring is too full) and sets *advance to
+    // the head delta that publish() must apply (len + any end-pad).
+    uint64_t alloc(uint64_t len, uint64_t *advance);
+    void publish(uint64_t advance) {
+        hdr_->head.fetch_add(advance, std::memory_order_release);
+    }
+    // Consumer: retire a frame's bytes after copying them out.
+    void consume(uint64_t advance) {
+        hdr_->tail.fetch_add(advance, std::memory_order_release);
+    }
+    uint8_t *data(uint64_t off) { return data_ + off; }
+    uint64_t size() const { return hdr_->size; }
+    // Consumer-side visibility handshake: an acquire load of head
+    // synchronizes with the producer's release publish(), making the
+    // payload bytes it covers visible to this thread.
+    uint64_t produced_acquire() const {
+        return hdr_->head.load(std::memory_order_acquire);
+    }
+    uint64_t consumed() const {
+        return hdr_->tail.load(std::memory_order_relaxed);
+    }
+    // Creator unlinks the name once the consumer confirmed its mapping;
+    // the segment then lives exactly as long as the two mappings.
+    void unlink_name();
+
+  private:
+    ShmRing() = default;
+    ShmHdr *hdr_ = nullptr;
+    uint8_t *data_ = nullptr;
+    uint64_t map_bytes_ = 0;
+    std::string name_;
+    bool creator_ = false;
+    bool linked_ = false;
+};
+
 struct Conn {
     int fd = -1;
     int remote_rank = -1;
@@ -289,6 +360,10 @@ struct Conn {
     // block, so dead conns can be pruned opportunistically (alive=false
     // alone only means the conn was closed, not that the thread is gone)
     std::atomic<bool> reader_done{false};
+    // shared-memory bulk path (colocated peers; see ShmRing above):
+    // shm_tx on the dialing side, shm_rx on the accepting side
+    std::unique_ptr<ShmRing> shm_tx;
+    std::unique_ptr<ShmRing> shm_rx;
 };
 
 struct PeerAddr {

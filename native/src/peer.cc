@@ -71,13 +71,15 @@ class Peer {
           monitor_(int(peers_.size())),
           recv_timeout_(env_double("KFT_RECV_TIMEOUT_S", 120.0)),
           conn_retries_(env_int("KFT_CONN_RETRIES", 150)),
-          conn_retry_ms_(env_int("KFT_CONN_RETRY_MS", 200)) {}
+          conn_retry_ms_(env_int("KFT_CONN_RETRY_MS", 200)),
+          shm_mb_(env_int("KFT_SHM_MB", 32)) {}
 
     ~Peer() { stop(); }
 
     int rank() const { return rank_; }
     int size() const { return int(peers_.size()); }
     uint32_t token() const { return token_.load(); }
+    int64_t shm_bytes() const { return shm_bytes_.load(); }
 
     bool start() {
         listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -652,6 +654,28 @@ class Peer {
     void reader_loop(std::shared_ptr<Conn> conn) {
         Msg m;
         while (conn->alive && recv_msg(conn->fd, &m)) {
+            if (m.flags & FLAG_SHM) {
+                // bulk payload sits in the sender's ring; the socket
+                // frame carried only the {off, len, advance} descriptor
+                if (!conn->shm_rx || m.body.size() != 24) break;
+                uint64_t off, len, adv;
+                std::memcpy(&off, m.body.data(), 8);
+                std::memcpy(&len, m.body.data() + 8, 8);
+                std::memcpy(&adv, m.body.data() + 16, 8);
+                ShmRing *ring = conn->shm_rx.get();
+                uint64_t sz = ring->size();
+                // overflow-safe bounds: len/off each within the mapping
+                if (len > sz || off > sz - len || adv > sz) break;
+                // acquire-load of head pairs with the producer's release
+                // publish: the payload this descriptor covers must be
+                // published data, and the load makes it visible here
+                uint64_t avail =
+                    ring->produced_acquire() - ring->consumed();
+                if (adv > avail) break;  // descriptor ahead of publish
+                m.body.assign(ring->data(off), ring->data(off) + len);
+                ring->consume(adv);
+                m.flags &= uint8_t(~FLAG_SHM);
+            }
             if (m.flags & FLAG_RESPONSE) {
                 conn->responses.push(std::move(m));
                 m = Msg();
@@ -707,6 +731,26 @@ class Peer {
                         uint32_t t;
                         std::memcpy(&t, m.body.data(), 4);
                         token_.store(t);
+                    } else if (m.name == "shm") {
+                        // colocated dialer offers its ring; map it and
+                        // confirm (it unlinks the name on our ack)
+                        Msg r;
+                        r.cls = CLS_CONTROL;
+                        r.flags = FLAG_RESPONSE;
+                        r.token = token_.load();
+                        r.name = "shm";
+                        std::string nm(m.body.begin(), m.body.end());
+                        auto ring = ShmRing::attach(nm);
+                        if (ring)
+                            conn->shm_rx = std::move(ring);
+                        else
+                            r.flags |= FLAG_FAILED;
+                        std::lock_guard<std::mutex> wg(conn->write_mu);
+                        send_msg(conn->fd, r);
+                    } else if (m.name == "shm-off") {
+                        // dialer gave up on the lane (ack timeout): drop
+                        // the mapping so the segment's memory is freed
+                        conn->shm_rx.reset();
                     }
                     break;
                 default:
@@ -798,6 +842,7 @@ class Peer {
             rejected = false;
             int fd = -1;
             bool connected = false;
+            bool is_unix = false;
             // colocated peer: abstract unix socket first (reference:
             // connection.go:60-64), TCP as the fallback
             if (unix_listen_fd_ >= 0 && pa.host == peers_[rank_].host) {
@@ -808,6 +853,7 @@ class Peer {
                     if (::connect(fd, reinterpret_cast<sockaddr *>(&ua),
                                   ulen) == 0) {
                         connected = true;
+                        is_unix = true;
                     } else {
                         ::close(fd);
                         fd = -1;
@@ -854,6 +900,10 @@ class Peer {
                     conn->remote_rank = dest;
                     conn->reader =
                         std::thread([this, conn] { outbound_reader(conn); });
+                    // colocated collective conns get a shared-memory
+                    // bulk lane (unix socket implies same host)
+                    if (is_unix && cls == CLS_COLLECTIVE && shm_mb_ > 0)
+                        negotiate_shm(conn, dest);
                     return conn;
                 }
                 ::close(fd);
@@ -878,6 +928,44 @@ class Peer {
         return nullptr;
     }
 
+    // Offer this conn's shm ring to the accepting side (colocated only).
+    // Runs at dial time, before the conn is shared, so the response
+    // queue has no other traffic to race with.  Failure at ANY step just
+    // leaves the conn on the socket body path — shm is an optimization,
+    // never a requirement.
+    void negotiate_shm(const std::shared_ptr<Conn> &conn, int dest) {
+        std::string nm = "/kft-" + std::to_string(uint32_t(::getpid())) +
+                         "-" + std::to_string(rank_) + "-" +
+                         std::to_string(dest) + "-" +
+                         std::to_string(shm_seq_.fetch_add(1));
+        auto ring = ShmRing::create(nm, uint64_t(shm_mb_) << 20);
+        if (!ring) return;
+        Msg req;
+        req.cls = CLS_CONTROL;
+        req.token = token_.load();
+        req.name = "shm";
+        req.body.assign(nm.begin(), nm.end());
+        {
+            std::lock_guard<std::mutex> wg(conn->write_mu);
+            if (!send_msg(conn->fd, req)) return;  // ring dtor unlinks
+        }
+        Msg resp;
+        if (!conn->responses.pop(&resp, 5.0) || (resp.flags & FLAG_FAILED)) {
+            // tell the acceptor to unmap whatever it attached, so a late
+            // ack doesn't strand an unused ring mapped for the conn's
+            // lifetime; our ring dtor unlinks the name either way
+            Msg off;
+            off.cls = CLS_CONTROL;
+            off.token = token_.load();
+            off.name = "shm-off";
+            std::lock_guard<std::mutex> wg(conn->write_mu);
+            send_msg(conn->fd, off);
+            return;
+        }
+        ring->unlink_name();  // consumer mapped it; name no longer needed
+        conn->shm_tx = std::move(ring);
+    }
+
     bool send_named(int dest, const std::string &name, const void *data,
                     size_t nbytes) {
         auto conn = get_conn(dest, CLS_COLLECTIVE);
@@ -887,7 +975,31 @@ class Peer {
         m.token = token_.load();
         m.name = name;
         std::lock_guard<std::mutex> wg(conn->write_mu);
-        if (!send_msg_ref(conn->fd, m, data, nbytes)) {
+        bool ok;
+        uint64_t adv = 0;
+        uint64_t off = ShmRing::NO_SPACE;
+        // the shm lane pays off once the payload outweighs the descriptor
+        // bookkeeping; tiny control-ish frames stay on the socket
+        if (conn->shm_tx && nbytes >= 2048)
+            off = conn->shm_tx->alloc(nbytes, &adv);
+        if (off != ShmRing::NO_SPACE) {
+            std::memcpy(conn->shm_tx->data(off), data, nbytes);
+            conn->shm_tx->publish(adv);  // release: payload before head
+            uint8_t desc[24];
+            uint64_t len = nbytes;
+            std::memcpy(desc, &off, 8);
+            std::memcpy(desc + 8, &len, 8);
+            std::memcpy(desc + 16, &adv, 8);
+            m.flags |= FLAG_SHM;
+            ok = send_msg_ref(conn->fd, m, desc, sizeof(desc));
+            if (ok) shm_bytes_.fetch_add(int64_t(nbytes));
+        } else {
+            // ring absent, full (receiver lagging), or frame too small:
+            // the socket body path — consumption order stays consistent
+            // because only FLAG_SHM frames advance the ring
+            ok = send_msg_ref(conn->fd, m, data, nbytes);
+        }
+        if (!ok) {
             set_error("send to peer " + std::to_string(dest) + " failed");
             drop_conn(dest, CLS_COLLECTIVE);
             return false;
@@ -946,6 +1058,9 @@ class Peer {
     double recv_timeout_;
     int conn_retries_;
     int conn_retry_ms_;
+    int shm_mb_;                          // KFT_SHM_MB; 0 disables
+    std::atomic<uint64_t> shm_seq_{0};    // unique segment names
+    std::atomic<int64_t> shm_bytes_{0};   // payload bytes via the shm lane
 };
 
 }  // namespace kft
@@ -1075,6 +1190,8 @@ int kft_request(kft_peer *p, int target, const char *name, void *buf,
 int64_t kft_egress_bytes(const kft_peer *p, int peer) {
     return p->impl.monitor().bytes(peer);
 }
+
+int64_t kft_shm_bytes(const kft_peer *p) { return p->impl.shm_bytes(); }
 
 double kft_egress_rate(const kft_peer *p, int peer) {
     return p->impl.monitor().rate(peer);

@@ -1,8 +1,11 @@
 // Socket IO, message framing, and reduce kernels for the kft runtime.
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <cmath>
@@ -281,6 +284,83 @@ void reduce_inplace(void *acc, const void *in, int64_t count, kft_dtype dt,
                         static_cast<const double *>(in), count, op);
             break;
     }
+}
+
+// --------------------------------------------------------------- shm ring
+std::unique_ptr<ShmRing> ShmRing::create(const std::string &name,
+                                         uint64_t data_bytes) {
+    int fd = ::shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    uint64_t total = sizeof(ShmHdr) + data_bytes;
+    if (::ftruncate(fd, off_t(total)) != 0) {
+        ::close(fd);
+        ::shm_unlink(name.c_str());
+        return nullptr;
+    }
+    void *m = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+    ::close(fd);
+    if (m == MAP_FAILED) {
+        ::shm_unlink(name.c_str());
+        return nullptr;
+    }
+    std::unique_ptr<ShmRing> r(new ShmRing());
+    r->hdr_ = new (m) ShmHdr();
+    r->hdr_->head.store(0, std::memory_order_relaxed);
+    r->hdr_->tail.store(0, std::memory_order_relaxed);
+    r->hdr_->size = data_bytes;
+    r->data_ = static_cast<uint8_t *>(m) + sizeof(ShmHdr);
+    r->map_bytes_ = total;
+    r->name_ = name;
+    r->creator_ = true;
+    r->linked_ = true;
+    return r;
+}
+
+std::unique_ptr<ShmRing> ShmRing::attach(const std::string &name) {
+    int fd = ::shm_open(name.c_str(), O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (::fstat(fd, &st) != 0 ||
+        size_t(st.st_size) < sizeof(ShmHdr)) {
+        ::close(fd);
+        return nullptr;
+    }
+    void *m = ::mmap(nullptr, size_t(st.st_size), PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (m == MAP_FAILED) return nullptr;
+    std::unique_ptr<ShmRing> r(new ShmRing());
+    r->hdr_ = static_cast<ShmHdr *>(m);
+    r->data_ = static_cast<uint8_t *>(m) + sizeof(ShmHdr);
+    r->map_bytes_ = size_t(st.st_size);
+    r->name_ = name;
+    if (r->hdr_->size + sizeof(ShmHdr) > r->map_bytes_) return nullptr;
+    return r;
+}
+
+ShmRing::~ShmRing() {
+    if (hdr_) ::munmap(hdr_, map_bytes_);
+    if (creator_ && linked_) ::shm_unlink(name_.c_str());
+}
+
+void ShmRing::unlink_name() {
+    if (creator_ && linked_) {
+        ::shm_unlink(name_.c_str());
+        linked_ = false;
+    }
+}
+
+uint64_t ShmRing::alloc(uint64_t len, uint64_t *advance) {
+    uint64_t sz = hdr_->size;
+    if (len == 0 || len > sz / 2) return NO_SPACE;
+    uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+    uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
+    uint64_t off = head % sz;
+    uint64_t need = (off + len <= sz) ? len : (sz - off) + len;
+    if (need > sz - (head - tail)) return NO_SPACE;
+    *advance = need;
+    return (off + len <= sz) ? off : 0;
 }
 
 void StallTracker::check(int self_rank) {

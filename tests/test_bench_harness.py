@@ -90,14 +90,26 @@ def test_tpu_plugin_presence_is_detected_without_a_tunnel_client(
     """The orchestrator must decide TPU-vs-CPU WITHOUT creating a tunnel
     client (a successful probe leaves the chip granted for minutes and
     the first real attempt would queue behind it)."""
-    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
-    monkeypatch.setenv("PYTHONPATH", "/other/path")
-    assert not bench.tpu_plugin_present()
     monkeypatch.setenv("PYTHONPATH", "/root/.axon_site:/other/path")
     assert bench.tpu_plugin_present()
     monkeypatch.setenv("PYTHONPATH", "")
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
     assert bench.tpu_plugin_present()
+    # negative direction: no env markers AND no importable plugin module
+    # (strip them from sys.path so find_spec comes up empty too)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("PYTHONPATH", "/other/path")
+    import importlib
+    monkeypatch.setattr("sys.path", [p for p in sys.path
+                                     if "axon" not in p
+                                     and "site-packages" not in p])
+    # this image's sitecustomize imports axon at interpreter start;
+    # find_spec short-circuits through sys.modules, so clear those too
+    for mod in list(sys.modules):
+        if mod == "axon" or mod.startswith("axon.") or mod == "libtpu":
+            monkeypatch.delitem(sys.modules, mod)
+    importlib.invalidate_caches()
+    assert not bench.tpu_plugin_present()
 
 
 def test_cpu_env_strips_axon_plugin(monkeypatch):
