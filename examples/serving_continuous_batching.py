@@ -41,7 +41,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", choices=["cpu", "tpu"], default="cpu")
     ap.add_argument("--requests", type=int, default=24)
-    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode slots (default: 8 cpu / 24 tpu)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -50,14 +51,18 @@ def main():
                           n_kv_heads=4, n_layers=12, d_ff=4096,
                           max_seq=2048, rope=True, mlp="swiglu",
                           dtype=jnp.bfloat16)
-        block, blocks, buckets = 64, 512, (128, 512)
+        block, blocks, buckets, chunk = 64, 768, (128, 512), 16
         pmin, pmax, omin, omax = 16, 500, 8, 512
+        if args.slots is None:       # preset default: saturate the pool
+            args.slots = 24
     else:
         cfg = G.GPTConfig(vocab_size=256, d_model=64, n_heads=4,
                           n_kv_heads=2, n_layers=2, d_ff=128, max_seq=256,
                           rope=True, dtype=jnp.float32)
-        block, blocks, buckets = 16, 128, (16, 64)
+        block, blocks, buckets, chunk = 16, 128, (16, 64), 4
         pmin, pmax, omin, omax = 4, 60, 4, 64
+        if args.slots is None:
+            args.slots = 8
 
     params = G.init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.RandomState(args.seed)
@@ -69,7 +74,8 @@ def main():
 
     # ---- continuous batching
     eng = DecodeEngine(params, cfg, num_slots=args.slots, block_size=block,
-                       num_blocks=blocks, prompt_buckets=buckets)
+                       num_blocks=blocks, prompt_buckets=buckets,
+                       decode_chunk=chunk)
     res = eng.run(reqs)          # first run includes compiles
     eng.stats.reset()
     res = eng.run(reqs)          # timed run, warm

@@ -335,7 +335,8 @@ std::unique_ptr<ShmRing> ShmRing::attach(const std::string &name) {
     r->data_ = static_cast<uint8_t *>(m) + sizeof(ShmHdr);
     r->map_bytes_ = size_t(st.st_size);
     r->name_ = name;
-    if (r->hdr_->size + sizeof(ShmHdr) > r->map_bytes_) return nullptr;
+    // overflow-safe: st_size >= sizeof(ShmHdr) was checked above
+    if (r->hdr_->size > r->map_bytes_ - sizeof(ShmHdr)) return nullptr;
     return r;
 }
 

@@ -80,9 +80,13 @@ def test_single_request_matches_generate(cfg):
     assert res[7] == _oracle(params, cfg, prompt, 6)
 
 
-def test_many_requests_varying_lengths_match_oracle():
+@pytest.mark.parametrize("chunk", [1, 5], ids=["chunk1", "chunk5"])
+def test_many_requests_varying_lengths_match_oracle(chunk):
     """More requests than slots, mixed prompt/output lengths: every
-    result equals its solo-run oracle and the engine reuses slots."""
+    result equals its solo-run oracle and the engine reuses slots —
+    whether the device program decodes one token or five per sync (the
+    in-chunk steps past a sequence's budget are discarded garbage that
+    must never leak into another slot's cache)."""
     cfg = CFG
     params = _params(cfg)
     rng = np.random.RandomState(3)
@@ -90,7 +94,8 @@ def test_many_requests_varying_lengths_match_oracle():
                     max_new=int(rng.randint(1, 9)))
             for i in range(7)]
     eng = DecodeEngine(params, cfg, num_slots=3, block_size=4,
-                       num_blocks=32, prompt_buckets=(8, 16))
+                       num_blocks=32, prompt_buckets=(8, 16),
+                       decode_chunk=chunk)
     res = eng.run(reqs)
     assert set(res) == {r.uid for r in reqs}
     for r in reqs:

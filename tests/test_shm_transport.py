@@ -116,7 +116,7 @@ def test_small_ring_pressure_stays_correct():
     _spawn(_w_shm_ring_pressure, 2)
 
 
-def test_no_segment_leak(tmp_path):
+def test_no_segment_leak():
     """Ring names are unlinked after the attach handshake: /dev/shm has
     no kft segments once the job exits."""
     _spawn(_w_shm_allreduce, 2, 8)
