@@ -79,6 +79,22 @@ def paged_write_prompt(pool, table_row, kv, t_real, block_size: int):
     return pool.at[blk, off].set(kv)
 
 
+def paged_write_prompt_batch(pool, table_rows, kv, t_real, block_size: int):
+    """Batched :func:`paged_write_prompt`: ``kv`` [G, T, kv_heads, Dh]
+    for G prompts lands in one scatter (one device program admits a whole
+    group of requests — a dispatch-latency saver on remote TPUs).
+    ``table_rows`` [G, max_blocks]; ``t_real`` [G] (0 for padding rows —
+    their every position routes to scratch)."""
+    Gn, T = kv.shape[0], kv.shape[1]
+    p = jnp.broadcast_to(jnp.arange(T)[None, :], (Gn, T))
+    real = p < t_real[:, None]
+    blk = jnp.where(real, jnp.take_along_axis(table_rows, p // block_size,
+                                              axis=1), 0)
+    off = p % block_size
+    return pool.at[blk.reshape(-1), off.reshape(-1)].set(
+        kv.reshape((-1,) + kv.shape[2:]))
+
+
 def paged_gather(pool, tables):
     """[S, max_blocks * block_size, kv_heads, Dh] logical view of every
     slot's cache (a whole-block HBM gather; unallocated table entries

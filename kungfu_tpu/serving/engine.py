@@ -38,7 +38,8 @@ from jax import lax
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
 from .cache import (init_paged_pools, lookup_blocks, paged_decode_attend,
-                    paged_gather, paged_write_prompt, paged_write_token)
+                    paged_gather, paged_write_prompt_batch,
+                    paged_write_token)
 
 
 @dataclasses.dataclass
@@ -140,32 +141,39 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int):
     return jax.jit(run, donate_argnums=(1,))
 
 
-def _make_prefill(cfg: GPTConfig, block_size: int):
-    """Bucketed dense prefill for ONE request: causal forward over the
-    padded prompt (one matmul-heavy pass — the MXU path, not T scan
-    steps), K/V scatter into the slot's blocks, greedy first token from
-    the hidden state at the true last position.  ``t_real`` is a traced
-    scalar: every prompt length in a bucket shares the compile."""
+def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
+    """Bucketed dense prefill for a GROUP of requests in one device
+    program: causal forward over the padded prompts (one matmul-heavy
+    pass — the MXU path, not T scan steps), K/V scattered into every
+    group member's blocks at once, greedy first token from each row's
+    hidden state at its true last position.
 
-    def prefill(params, pools, table_row, tokens, t_real):
-        T = tokens.shape[0]
+    ``group`` is static (the admission batch is padded up to it with
+    ``t_real = 0`` rows whose writes all route to scratch); ``t_real``
+    [group] is traced, so every prompt-length mix in a bucket shares the
+    compile.  Batching admissions matters for the same reason chunked
+    decode does: on a tunnelled TPU each dispatch costs ~100 ms+, and
+    admitting N requests must not cost N dispatches."""
+
+    def prefill(params, pools, table_rows, tokens, t_real):
+        T = tokens.shape[1]                              # [G, T]
         pos = jnp.arange(T)
-        x = G.embed(params, tokens[None], pos, cfg)      # [1, T, D]
+        x = G.embed(params, tokens, pos, cfg)            # [G, T, D]
         new_pools = []
         for layer, pool in zip(params["layers"], pools):
             q, kk, v = G._layer_qkv(layer, x, cfg, pos=pos)
-            kp = paged_write_prompt(pool["k"], table_row, kk[0], t_real,
-                                    block_size)
-            vp = paged_write_prompt(pool["v"], table_row, v[0], t_real,
-                                    block_size)
+            kp = paged_write_prompt_batch(pool["k"], table_rows, kk,
+                                          t_real, block_size)
+            vp = paged_write_prompt_batch(pool["v"], table_rows, v,
+                                          t_real, block_size)
             new_pools.append({"k": kp, "v": vp})
             o = G._attend(q, kk, v, "dense", None, kv_groups=cfg.kv_groups)
             x = G._layer_finish(layer, x, o, cfg)
         x = G.rms_norm(x, params["lnf"])
         h_last = jnp.take_along_axis(
-            x, (t_real - 1)[None, None, None], axis=1)   # [1, 1, D]
-        logits = G._head(params, h_last)                 # [1, V]
-        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), new_pools
+            x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
+        logits = G._head(params, h_last)                 # [G, V]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
 
     return jax.jit(prefill, donate_argnums=(1,))
 
@@ -185,7 +193,8 @@ class DecodeEngine:
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
                  block_size: int = 32, num_blocks: int = 64,
                  max_len: Optional[int] = None,
-                 prompt_buckets=(32, 128, 512), decode_chunk: int = 8):
+                 prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
+                 prefill_group: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.S = num_slots
@@ -209,8 +218,9 @@ class DecodeEngine:
         self._admit_order: List[int] = []    # slots, oldest first
         self._results: Dict[int, List[int]] = {}
         self.K = max(1, decode_chunk)
+        self.G = max(1, min(prefill_group or min(num_slots, 8), num_slots))
         self._decode = _make_decode_chunk(cfg, block_size, self.K)
-        self._prefill = _make_prefill(cfg, block_size)
+        self._prefill = _make_prefill(cfg, block_size, self.G)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin
@@ -251,44 +261,89 @@ class DecodeEngine:
         self._admit_order.remove(slot)
 
     def _admit(self) -> None:
+        """Admit the longest FCFS prefix of the queue that shares one
+        prompt bucket and fits (free slot + blocks + growth headroom),
+        up to ``prefill_group`` requests — then prefill them all in ONE
+        device program.
+
+        Admission hysteresis: while anything is running, wait until
+        ``min(prefill_group, queue)`` slots are free before dispatching,
+        so freed slots accumulate into one full-group prefill instead of
+        one dispatch each (slots free a few per chunk boundary; on a
+        high-dispatch-latency backend per-slot admission dominated the
+        whole run — measured 51 prefill dispatches for 96 requests)."""
+        free_slots = sum(r is None for r in self._running)
+        # cap the threshold at S-1: a threshold of S would wait for EVERY
+        # running sequence to finish (gang scheduling — exactly the
+        # static-batching behavior the engine exists to beat)
+        if self._admit_order and free_slots < min(self.G,
+                                                  len(self._queue),
+                                                  self.S - 1):
+            return
         while self._queue:
-            slot = next((i for i in range(self.S)
-                         if self._running[i] is None), None)
-            if slot is None:
+            # the head's bucket sets the batch shape; later queue entries
+            # of the SAME bucket may join it (bounded skip-ahead — the
+            # head is always admitted first, so nothing starves).  With
+            # strict same-bucket prefixes, mixed workloads averaged ~2.4
+            # requests per prefill dispatch; skipping ahead fills groups
+            bucket = self._bucket(len(self._queue[0].prompt))
+            batch = []                      # (req, slot, blocks)
+            picked = []                     # queue indices admitted
+            for qi, req in enumerate(self._queue):
+                if len(batch) >= self.G:
+                    break
+                t_real = len(req.prompt)
+                if self._bucket(t_real) != bucket:
+                    continue
+                taken = {s for _, s, _ in batch}
+                slot = next((i for i in range(self.S)
+                             if self._running[i] is None
+                             and i not in taken), None)
+                if slot is None:
+                    break
+                need = -(-t_real // self.bs)
+                # +1 growth headroom: admitting with only exactly the
+                # prompt's blocks free would preempt (and waste the
+                # prefill) within block_size decode steps under pressure
+                if len(self._free) < need + 1 and (self._admit_order
+                                                   or batch):
+                    break
+                blocks = self._alloc(need)
+                if blocks is None:
+                    break
+                batch.append((req, slot, blocks))
+                picked.append(qi)
+            if not batch:
                 return
-            req = self._queue[0]
-            t_real = len(req.prompt)
-            need = -(-t_real // self.bs)
-            # +1 growth headroom: admitting with only exactly the prompt's
-            # blocks free would preempt (and waste the prefill) within at
-            # most block_size decode steps under steady pressure
-            if len(self._free) < need + 1 and self._admit_order:
-                return                      # FCFS: wait for memory
-            blocks = self._alloc(need)
-            if blocks is None:
-                return                      # FCFS: wait for memory
-            self._queue.popleft()
-            run = _Running(req=req, slot=slot, blocks=blocks, out=[])
-            self._tables[slot] = 0
-            self._tables[slot, :len(blocks)] = blocks
-            Tb = self._bucket(t_real)
-            toks = np.zeros(Tb, np.int32)
-            toks[:t_real] = req.prompt
-            tok0, self.pools = self._prefill(
-                self.params, self.pools,
-                jnp.asarray(self._tables[slot]), jnp.asarray(toks),
-                jnp.int32(t_real))
+            for qi in reversed(picked):
+                del self._queue[qi]
+            Tb = bucket
+            toks = np.zeros((self.G, Tb), np.int32)
+            rows = np.zeros((self.G, self.max_blocks), np.int32)
+            t_reals = np.zeros(self.G, np.int32)
+            for g, (req, slot, blocks) in enumerate(batch):
+                toks[g, :len(req.prompt)] = req.prompt
+                rows[g, :len(blocks)] = blocks
+                t_reals[g] = len(req.prompt)
+            tok0s, self.pools = self._prefill(
+                self.params, self.pools, jnp.asarray(rows),
+                jnp.asarray(toks), jnp.asarray(t_reals))
+            tok0s = np.asarray(tok0s)
             self.stats.prefills += 1
-            tok0 = int(tok0)
-            run.out.append(tok0)
-            self.stats.tokens_out += 1
-            self._running[slot] = run
-            self._admit_order.append(slot)
-            if self._finished(run):
-                self._harvest(slot)
-                continue
-            self._pos[slot] = t_real        # next write position
-            self._tok[slot] = tok0
+            for g, (req, slot, blocks) in enumerate(batch):
+                run = _Running(req=req, slot=slot, blocks=blocks, out=[])
+                self._tables[slot] = 0
+                self._tables[slot, :len(blocks)] = blocks
+                tok0 = int(tok0s[g])
+                run.out.append(tok0)
+                self.stats.tokens_out += 1
+                self._running[slot] = run
+                self._admit_order.append(slot)
+                if self._finished(run):
+                    self._harvest(slot)
+                    continue
+                self._pos[slot] = len(req.prompt)   # next write position
+                self._tok[slot] = tok0
 
     def _finished(self, run: _Running) -> bool:
         return (len(run.out) >= run.req.max_new

@@ -101,8 +101,10 @@ def test_many_requests_varying_lengths_match_oracle(chunk):
     for r in reqs:
         assert res[r.uid] == _oracle(params, cfg, r.prompt, r.max_new), \
             f"uid {r.uid}"
-    # slot reuse happened: 7 requests through 3 slots
-    assert eng.stats.prefills == 7
+    # slot reuse happened: 7 requests through 3 slots — and admission
+    # BATCHED them (a regression to one prefill dispatch per request
+    # would read 7; the scheduler is deterministic, so this is stable)
+    assert 1 <= eng.stats.prefills <= 4
     # all blocks returned to the pool
     assert len(eng._free) == eng._total_blocks
 
