@@ -118,9 +118,14 @@ def run_static(args, data):
         gy = jax.make_array_from_process_local_data(
             sharding, ytr[mine])
         sp, st, sm, loss = step(sp, st, sm, (gx, gy))
-        if rank == 0 and i % 50 == 0:
-            print(f"step {i:4d}: loss "
-                  f"{float(np.asarray(loss.addressable_data(0))[0]):.4f}")
+        if i % 25 == 0:
+            # EVERY rank fetches (a local-shard read): it synchronizes
+            # the ranks' async dispatch queues.  Fetching on rank 0 only
+            # let rank 1 run unboundedly ahead and the cross-process
+            # collective stream deadlocked within ~100 steps
+            lv = float(np.asarray(loss.addressable_data(0))[0])
+            if rank == 0:
+                print(f"step {i:4d}: loss {lv:.4f}")
 
     # every lane is identical under sync SGD: eval this process's replica
     one = lambda tree: jax.tree_util.tree_map(

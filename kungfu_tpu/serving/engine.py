@@ -48,6 +48,12 @@ class Request:
     prompt: List[int]
     max_new: int
     eos: Optional[int] = None
+    # 0.0 = greedy; > 0 samples with a PER-REQUEST key discipline
+    # (fold_in(base, uid) then fold_in per token index), so a sampled
+    # request's tokens are identical whatever slot it lands in, whatever
+    # else is in flight, and across preemption replays — unlike a
+    # batch-level rng, where scheduling would change the output
+    temperature: float = 0.0
 
 
 @dataclasses.dataclass
@@ -92,7 +98,7 @@ class EngineStats:
 def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
                  pos, tokens):
     """One decode step for every slot: feed each its last token at its
-    own position, scatter K/V through the block tables, sample greedily.
+    own position, scatter K/V through the block tables, return logits.
     Inactive slots have zeroed table rows, so their writes land in the
     scratch block — no conditionals anywhere."""
     x = G.embed(params, tokens[:, None], pos[:, None], cfg)
@@ -108,8 +114,25 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
         o = paged_decode_attend(q, kc, vc, pos)
         x = G._layer_finish(layer, x, o, cfg)
     x = G.rms_norm(x, params["lnf"])
-    logits = G._head(params, x)                     # [S, V] f32
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+    return G._head(params, x), new_pools            # [S, V] f32
+
+
+def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
+    """Greedy or per-slot sampled next token.  The sampling key depends
+    ONLY on (request uid — both 32-bit halves — and token index):
+    scheduling-invariant.  The discarded sampling work on greedy slots
+    is [S, V] Gumbel draws — noise next to the [S, V] lm_head matmul
+    that produced the logits, so one executable serves both modes."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample_one(lg, lo, hi, t, tau):
+        key = jax.random.fold_in(jax.random.fold_in(jax.random.fold_in(
+            jax.random.PRNGKey(0), lo), hi), t)
+        return jax.random.categorical(key, lg / jnp.maximum(tau, 1e-6))
+
+    sampled = jax.vmap(sample_one)(logits, uid_lo, uid_hi, tcount,
+                                   temp).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
 
 
 def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int):
@@ -127,15 +150,17 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int):
     bounded by chunk-1 slot-steps per finish, all safely routed to the
     slot's own blocks or scratch)."""
 
-    def run(params, pools, tables, pos, tokens):
+    def run(params, pools, tables, pos, tokens, uid_lo, uid_hi, tcount,
+            temp):
         def body(carry, _):
-            pools, pos, tok = carry
-            nxt, pools = _decode_core(params, cfg, block_size, pools,
-                                      tables, pos, tok)
-            return (pools, pos + 1, nxt), nxt
+            pools, pos, tok, tc = carry
+            logits, pools = _decode_core(params, cfg, block_size, pools,
+                                         tables, pos, tok)
+            nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp)
+            return (pools, pos + 1, nxt, tc + 1), nxt
 
-        (pools, _, _), toks = lax.scan(body, (pools, pos, tokens), None,
-                                       length=chunk)
+        (pools, _, _, _), toks = lax.scan(
+            body, (pools, pos, tokens, tcount), None, length=chunk)
         return toks, pools                          # toks [chunk, S]
 
     return jax.jit(run, donate_argnums=(1,))
@@ -155,7 +180,8 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
     decode does: on a tunnelled TPU each dispatch costs ~100 ms+, and
     admitting N requests must not cost N dispatches."""
 
-    def prefill(params, pools, table_rows, tokens, t_real):
+    def prefill(params, pools, table_rows, tokens, t_real, uid_lo,
+                uid_hi, temp):
         T = tokens.shape[1]                              # [G, T]
         pos = jnp.arange(T)
         x = G.embed(params, tokens, pos, cfg)            # [G, T, D]
@@ -173,7 +199,9 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
         h_last = jnp.take_along_axis(
             x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
         logits = G._head(params, h_last)                 # [G, V]
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_pools
+        tok0 = _pick_tokens(logits, uid_lo, uid_hi,
+                            jnp.zeros_like(uid_lo), temp)
+        return tok0, new_pools
 
     return jax.jit(prefill, donate_argnums=(1,))
 
@@ -213,6 +241,10 @@ class DecodeEngine:
         self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
         self._pos = np.zeros(num_slots, np.int32)
         self._tok = np.zeros(num_slots, np.int32)
+        self._uid_lo = np.zeros(num_slots, np.uint32)
+        self._uid_hi = np.zeros(num_slots, np.uint32)
+        self._tcount = np.zeros(num_slots, np.int32)
+        self._temp = np.zeros(num_slots, np.float32)
         self._running: List[Optional[_Running]] = [None] * num_slots
         self._queue: "collections.deque[Request]" = collections.deque()
         self._admit_order: List[int] = []    # slots, oldest first
@@ -228,6 +260,12 @@ class DecodeEngine:
         if not req.prompt or req.max_new < 1:
             raise ValueError(f"request {req.uid}: needs a non-empty "
                              f"prompt and max_new >= 1")
+        in_flight = ({r.uid for r in self._queue}
+                     | {r.req.uid for r in self._running if r is not None}
+                     | set(self._results))
+        if req.uid in in_flight:
+            raise ValueError(f"request uid {req.uid} already in flight "
+                             f"(uids key both results and sampling)")
         need = len(req.prompt) + req.max_new
         if need > self.max_len:
             raise ValueError(f"request {req.uid}: prompt+max_new {need} "
@@ -258,6 +296,10 @@ class DecodeEngine:
         self._tables[slot] = 0
         self._pos[slot] = 0
         self._tok[slot] = 0
+        self._uid_lo[slot] = 0
+        self._uid_hi[slot] = 0
+        self._tcount[slot] = 0
+        self._temp[slot] = 0.0      # freed slots sample nothing (greedy)
         self._admit_order.remove(slot)
 
     def _admit(self) -> None:
@@ -321,13 +363,21 @@ class DecodeEngine:
             toks = np.zeros((self.G, Tb), np.int32)
             rows = np.zeros((self.G, self.max_blocks), np.int32)
             t_reals = np.zeros(self.G, np.int32)
+            uid_lo = np.zeros(self.G, np.uint32)
+            uid_hi = np.zeros(self.G, np.uint32)
+            temps = np.zeros(self.G, np.float32)
             for g, (req, slot, blocks) in enumerate(batch):
                 toks[g, :len(req.prompt)] = req.prompt
                 rows[g, :len(blocks)] = blocks
                 t_reals[g] = len(req.prompt)
+                uid_lo[g] = req.uid & 0xFFFFFFFF
+                uid_hi[g] = (req.uid >> 32) & 0xFFFFFFFF
+                temps[g] = req.temperature
             tok0s, self.pools = self._prefill(
                 self.params, self.pools, jnp.asarray(rows),
-                jnp.asarray(toks), jnp.asarray(t_reals))
+                jnp.asarray(toks), jnp.asarray(t_reals),
+                jnp.asarray(uid_lo), jnp.asarray(uid_hi),
+                jnp.asarray(temps))
             tok0s = np.asarray(tok0s)
             self.stats.prefills += 1
             for g, (req, slot, blocks) in enumerate(batch):
@@ -344,6 +394,10 @@ class DecodeEngine:
                     continue
                 self._pos[slot] = len(req.prompt)   # next write position
                 self._tok[slot] = tok0
+                self._uid_lo[slot] = req.uid & 0xFFFFFFFF
+                self._uid_hi[slot] = (req.uid >> 32) & 0xFFFFFFFF
+                self._tcount[slot] = 1              # tok0 was index 0
+                self._temp[slot] = req.temperature
 
     def _finished(self, run: _Running) -> bool:
         return (len(run.out) >= run.req.max_new
@@ -413,7 +467,9 @@ class DecodeEngine:
             return bool(self._queue)
         toks, self.pools = self._decode(
             self.params, self.pools, jnp.asarray(self._tables),
-            jnp.asarray(self._pos), jnp.asarray(self._tok))
+            jnp.asarray(self._pos), jnp.asarray(self._tok),
+            jnp.asarray(self._uid_lo), jnp.asarray(self._uid_hi),
+            jnp.asarray(self._tcount), jnp.asarray(self._temp))
         toks = np.asarray(toks)                      # [K, S] — ONE sync
         self.stats.decode_steps += self.K
         for slot in active:
@@ -428,6 +484,7 @@ class DecodeEngine:
             else:
                 self._pos[slot] += self.K
                 self._tok[slot] = int(toks[self.K - 1, slot])
+                self._tcount[slot] += self.K
         return True
 
     def run(self, requests) -> Dict[int, List[int]]:

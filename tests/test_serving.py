@@ -145,6 +145,46 @@ def test_preemption_replays_deterministically():
     assert eng.stats.tokens_out == sum(len(t) for t in res.values())
 
 
+def test_sampled_request_is_scheduling_invariant():
+    """A sampled request's tokens depend only on (uid, token index) —
+    NOT on which slot it lands in, what else is in flight, or replay
+    after preemption.  (This is stronger than generate()'s batch-level
+    rng, where scheduling would change the output.)"""
+    cfg = CFG
+    params = _params(cfg)
+    rng = np.random.RandomState(7)
+    target = Request(uid=42, prompt=_prompt(rng, 6, cfg), max_new=8,
+                     temperature=1.3)
+
+    def run_with(extra_reqs, **kw):
+        eng = DecodeEngine(params, cfg, block_size=4,
+                           prompt_buckets=(8,), **kw)
+        req = Request(uid=target.uid, prompt=list(target.prompt),
+                      max_new=target.max_new,
+                      temperature=target.temperature)
+        return eng.run([req] + extra_reqs)[target.uid]
+
+    solo = run_with([], num_slots=2, num_blocks=16)
+    noise = [Request(uid=100 + i, prompt=_prompt(rng, 7, cfg), max_new=6,
+                     temperature=0.7) for i in range(4)]
+    busy = run_with(noise, num_slots=3, num_blocks=32)
+    assert busy == solo
+    # under memory pressure (preemption/replay) it still holds
+    squeezed = run_with(noise[:2], num_slots=3, num_blocks=10)
+    assert squeezed == solo
+    # a fresh engine reproduces the identical stream...
+    other = run_with([], num_slots=2, num_blocks=16)
+    assert other == solo
+    # ...and a different uid genuinely samples a different one — even a
+    # uid differing only ABOVE bit 32 (both halves key the sampler)
+    for uid2 in (43, target.uid + (1 << 32)):
+        eng2 = DecodeEngine(params, cfg, num_slots=2, block_size=4,
+                            num_blocks=16, prompt_buckets=(8,))
+        diff = eng2.run([Request(uid=uid2, prompt=list(target.prompt),
+                                 max_new=8, temperature=1.3)])[uid2]
+        assert diff != solo, uid2
+
+
 def test_submit_validation():
     cfg = CFG
     eng = DecodeEngine(_params(cfg), cfg, num_slots=2, block_size=4,
