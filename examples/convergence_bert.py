@@ -63,7 +63,7 @@ def main():
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--batch-per-lane", type=int, default=8)
     ap.add_argument("--lr", type=float, default=1e-3)
-    ap.add_argument("--target", type=float, default=1.0,
+    ap.add_argument("--target", type=float, default=5.0,
                     help="required final MLM loss (upper bound)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
