@@ -12,7 +12,8 @@ the decode-serving gap called out as explicit future work in round 2.
 from .cache import (init_paged_pools, paged_decode_attend, paged_gather,
                     paged_write_prompt, paged_write_token)
 from .engine import DecodeEngine, EngineStats, Request
+from .server import ServingServer
 
-__all__ = ["DecodeEngine", "EngineStats", "Request", "init_paged_pools",
-           "paged_decode_attend", "paged_gather", "paged_write_prompt",
-           "paged_write_token"]
+__all__ = ["DecodeEngine", "EngineStats", "Request", "ServingServer",
+           "init_paged_pools", "paged_decode_attend", "paged_gather",
+           "paged_write_prompt", "paged_write_token"]

@@ -256,16 +256,13 @@ class DecodeEngine:
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin
-    def submit(self, req: Request) -> None:
+    def validate_shape(self, req: Request) -> None:
+        """Static admissibility checks (no engine state touched — safe
+        to call from any thread, e.g. an HTTP handler pre-validating
+        before handing the request to the scheduler thread)."""
         if not req.prompt or req.max_new < 1:
             raise ValueError(f"request {req.uid}: needs a non-empty "
                              f"prompt and max_new >= 1")
-        in_flight = ({r.uid for r in self._queue}
-                     | {r.req.uid for r in self._running if r is not None}
-                     | set(self._results))
-        if req.uid in in_flight:
-            raise ValueError(f"request uid {req.uid} already in flight "
-                             f"(uids key both results and sampling)")
         need = len(req.prompt) + req.max_new
         if need > self.max_len:
             raise ValueError(f"request {req.uid}: prompt+max_new {need} "
@@ -276,6 +273,15 @@ class DecodeEngine:
         if len(req.prompt) > self.buckets[-1]:
             raise ValueError(f"request {req.uid}: prompt longer than the "
                              f"largest prefill bucket {self.buckets[-1]}")
+
+    def submit(self, req: Request) -> None:
+        self.validate_shape(req)
+        in_flight = ({r.uid for r in self._queue}
+                     | {r.req.uid for r in self._running if r is not None}
+                     | set(self._results))
+        if req.uid in in_flight:
+            raise ValueError(f"request uid {req.uid} already in flight "
+                             f"(uids key both results and sampling)")
         self._queue.append(req)
 
     def _bucket(self, n: int) -> int:
@@ -487,6 +493,19 @@ class DecodeEngine:
                 self._tcount[slot] += self.K
         return True
 
+    @property
+    def busy(self) -> bool:
+        """Anything queued or decoding."""
+        return bool(self._queue) or any(r is not None
+                                        for r in self._running)
+
+    def take_results(self) -> Dict[int, List[int]]:
+        """Pop and return every finished request so far (uid -> tokens).
+        The incremental-harvest API the serving front-end drives between
+        step() calls; run() is the batch-mode convenience on top."""
+        out, self._results = self._results, {}
+        return out
+
     def run(self, requests) -> Dict[int, List[int]]:
         """Drain ``requests`` through the engine; returns uid -> tokens."""
         t0 = time.perf_counter()
@@ -495,5 +514,4 @@ class DecodeEngine:
         while self.step():
             pass
         self.stats.wall_s += time.perf_counter() - t0
-        out, self._results = self._results, {}
-        return out
+        return self.take_results()
