@@ -222,7 +222,7 @@ class DecodeEngine:
                  block_size: int = 32, num_blocks: int = 64,
                  max_len: Optional[int] = None,
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
-                 prefill_group: Optional[int] = None):
+                 prefill_group: Optional[int] = None, on_tokens=None):
         self.params = params
         self.cfg = cfg
         self.S = num_slots
@@ -247,6 +247,13 @@ class DecodeEngine:
         self._temp = np.zeros(num_slots, np.float32)
         self._running: List[Optional[_Running]] = [None] * num_slots
         self._queue: "collections.deque[Request]" = collections.deque()
+        # streaming: emit each request's tokens as they are produced.
+        # Replay after preemption regenerates BIT-IDENTICAL tokens (both
+        # greedy and sampled streams are scheduling-invariant), so
+        # _emitted[uid] suppresses re-emission and a consumer never sees
+        # a duplicate or a rollback.
+        self.on_tokens = on_tokens          # fn(uid, new_tokens) or None
+        self._emitted: Dict[int, int] = {}
         self._admit_order: List[int] = []    # slots, oldest first
         self._results: Dict[int, List[int]] = {}
         self.K = max(1, decode_chunk)
@@ -398,6 +405,7 @@ class DecodeEngine:
                 if self._finished(run):
                     self._harvest(slot)
                     continue
+                self._emit(run)
                 self._pos[slot] = len(req.prompt)   # next write position
                 self._tok[slot] = tok0
                 self._uid_lo[slot] = req.uid & 0xFFFFFFFF
@@ -410,8 +418,18 @@ class DecodeEngine:
                 or (run.req.eos is not None and run.out
                     and run.out[-1] == run.req.eos))
 
+    def _emit(self, run: _Running) -> None:
+        if self.on_tokens is None:
+            return
+        seen = self._emitted.get(run.req.uid, 0)
+        if len(run.out) > seen:
+            self.on_tokens(run.req.uid, run.out[seen:])
+            self._emitted[run.req.uid] = len(run.out)
+
     def _harvest(self, slot: int) -> None:
         run = self._running[slot]
+        self._emit(run)
+        self._emitted.pop(run.req.uid, None)
         self._results[run.req.uid] = run.out
         self._free_slot(slot)
 
@@ -488,6 +506,7 @@ class DecodeEngine:
                     self._harvest(slot)
                     break
             else:
+                self._emit(run)
                 self._pos[slot] += self.K
                 self._tok[slot] = int(toks[self.K - 1, slot])
                 self._tcount[slot] += self.K

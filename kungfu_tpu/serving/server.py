@@ -28,6 +28,7 @@ config server and /metrics; the reference runs its config server the
 same way).
 """
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
@@ -35,6 +36,8 @@ from typing import Dict, List, Optional
 
 from ..utils.http import BackgroundHTTPServer
 from .engine import DecodeEngine, Request
+
+_STREAM_END = object()
 
 
 class ServingServer:
@@ -53,7 +56,13 @@ class ServingServer:
         self._pending: List[Request] = []
         self._done: Dict[int, List[int]] = {}
         self._events: Dict[int, threading.Event] = {}
+        self._streams: Dict[int, "queue.Queue"] = {}
         self._next_uid = 1
+        # scheduler-thread-only callback: fan tokens out to stream
+        # queues, CHAINING any callback the caller already installed on
+        # the engine (overwriting it silently would eat their events)
+        self._chained_on_tokens = engine.on_tokens
+        engine.on_tokens = self._on_tokens
         self._fatal: Optional[str] = None
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -66,6 +75,12 @@ class ServingServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer is an HTTP/1.1 construct: a 1.0 status
+            # line makes compliant clients skip chunk decoding and read
+            # raw chunk framing as body.  Non-stream replies all send
+            # Content-Length, so keep-alive stays correct.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):            # quiet
                 pass
 
@@ -103,13 +118,18 @@ class ServingServer:
                 except (KeyError, TypeError, ValueError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
+                stream = bool(req.get("stream", False))
                 try:
-                    uid, ev = server._submit(prompt, max_new, eos, temp)
+                    uid, ev = server._submit(prompt, max_new, eos, temp,
+                                             stream=stream)
                 except ValueError as e:
                     self._reply(422, {"error": str(e)})
                     return
                 except RuntimeError as e:         # already closed/dead
                     self._reply(503, {"error": str(e)})
+                    return
+                if stream:
+                    self._stream_reply(uid)
                     return
                 ev.wait()
                 with server._lock:
@@ -122,10 +142,60 @@ class ServingServer:
                 else:
                     self._reply(200, {"uid": uid, "tokens": tokens})
 
+            def _chunk(self, payload: bytes):
+                self.wfile.write(f"{len(payload):x}\r\n".encode()
+                                 + payload + b"\r\n")
+
+            def _stream_reply(self, uid):
+                """Chunked transfer: one JSON line per token batch as
+                the engine produces it, then a final done line.  Thanks
+                to deterministic replay + the engine's emitted-count
+                suppression, the stream never duplicates or rolls back
+                tokens across preemptions."""
+                q = server._streams[uid]
+                total = 0
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while True:
+                        item = q.get()
+                        if item is _STREAM_END:
+                            break
+                        total += len(item)
+                        self._chunk(json.dumps(
+                            {"uid": uid,
+                             "tokens": item}).encode() + b"\n")
+                finally:
+                    # a client disconnect raises out of the writes above;
+                    # the uid's queue/event/result must not leak (the
+                    # scheduler would keep feeding an orphaned queue)
+                    with server._lock:
+                        done = uid in server._done
+                        server._done.pop(uid, None)
+                        server._streams.pop(uid, None)
+                        server._events.pop(uid, None)
+                        fatal = server._fatal
+                tail = ({"uid": uid, "done": True, "tokens_total": total}
+                        if done else
+                        {"uid": uid, "error": fatal or "server closed"})
+                self._chunk(json.dumps(tail).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+
         return Handler
 
+    def _on_tokens(self, uid, new_tokens):
+        """Runs on the scheduler thread (engine callback)."""
+        if self._chained_on_tokens is not None:
+            self._chained_on_tokens(uid, new_tokens)
+        q = self._streams.get(uid)
+        if q is not None:
+            q.put(list(new_tokens))
+
     # ------------------------------------------------------------ plumbing
-    def _submit(self, prompt, max_new, eos, temperature):
+    def _submit(self, prompt, max_new, eos, temperature, stream=False):
         with self._lock:
             if self._stop.is_set() or self._fatal:
                 raise RuntimeError(self._fatal or "server is closed")
@@ -140,14 +210,19 @@ class ServingServer:
             self._pending.append(req)
             ev = threading.Event()
             self._events[uid] = ev
+            if stream:
+                self._streams[uid] = queue.Queue()
         self._wake.set()
         return uid, ev
 
     def _release_all_waiters(self) -> None:
         with self._lock:
             evs = list(self._events.values())
+            qs = list(self._streams.values())
         for ev in evs:
             ev.set()
+        for q in qs:
+            q.put(_STREAM_END)
 
     def _scheduler(self):
         """Sole owner of the engine after start().  Any engine exception
@@ -167,8 +242,12 @@ class ServingServer:
                         self._done.update(finished)
                         evs = [self._events[u] for u in finished
                                if u in self._events]
+                        qs = [self._streams[u] for u in finished
+                              if u in self._streams]
                     for ev in evs:
                         ev.set()
+                    for q in qs:
+                        q.put(_STREAM_END)
                 if not progressed and not self.engine.busy:
                     self._wake.wait(timeout=0.25)  # idle: park
                     self._wake.clear()

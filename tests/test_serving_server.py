@@ -144,6 +144,28 @@ def test_close_releases_inflight_clients():
     assert "r" in out or out.get("code") == 503
 
 
+def test_streaming_response_delivers_incremental_ndjson(served):
+    """stream:true returns chunked ndjson: token batches as produced,
+    then a done line; the concatenation equals the oracle."""
+    params, srv = served
+    prompt, n_new = [7, 8, 9, 10], 7
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}/generate",
+        data=json.dumps({"prompt": prompt, "max_new": n_new,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    lines = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers.get("Content-Type") == "application/x-ndjson"
+        for raw in r:
+            lines.append(json.loads(raw))
+    assert lines[-1].get("done") is True
+    toks = [t for ln in lines[:-1] for t in ln["tokens"]]
+    assert lines[-1]["tokens_total"] == len(toks) == n_new
+    assert len(lines) > 2          # genuinely incremental (chunk=2)
+    assert toks == _oracle(params, prompt, n_new)
+
+
 def test_sampled_via_http_is_deterministic_per_uid(served):
     """Same note as the engine test: sampling keys on (uid, index).
     Server uids increase monotonically, so two posts of the same prompt
