@@ -32,6 +32,7 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -124,3 +125,27 @@ def save_npz(path: str, tree) -> None:
 def load_npz(path: str) -> Dict[str, np.ndarray]:
     with np.load(path) as z:
         return {k: z[k] for k in z.files}
+
+
+def restore_npz_like(template, flat) -> object:
+    """Rebuild a pytree from :func:`save_npz`'s flat dump: flatten the
+    ``template`` with the same key-path encoding and look each leaf up.
+    ``flat`` is the dict from :func:`load_npz` (or a path).  The
+    load-side counterpart of save_npz — the one place its key scheme is
+    decoded (serving CLI and any eval script restore through here)."""
+    if isinstance(flat, str):
+        flat = load_npz(flat)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = []
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(template):
+        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                       for k in kp)
+        if key not in flat:
+            raise KeyError(f"checkpoint is missing {key!r}")
+        arr = jnp.asarray(flat[key])
+        if arr.shape != leaf.shape:
+            raise ValueError(f"{key!r}: checkpoint shape {arr.shape} != "
+                             f"model shape {leaf.shape}")
+        out.append(arr.astype(leaf.dtype))
+    assert len(out) == len(leaves)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,84 @@
+"""Serve a GPT model over HTTP from the command line.
+
+    python -m kungfu_tpu.serving --d-model 512 --n-heads 8 --n-layers 6 \
+        --vocab 32768 --rope --swiglu --npz weights.npz --port 8100
+
+Prints ``SERVING ready on <host>:<port>`` once live, then blocks until
+SIGINT/SIGTERM.  Without ``--npz`` the model is seed-initialized (demo /
+smoke mode — same layout the training side produces).  The CLI mirrors
+the launcher-binary pattern (kft-run, kft-config-server…; the reference
+ships its runners the same way).
+"""
+import argparse
+import signal
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..checkpoint import restore_npz_like
+from ..models import gpt as G
+from .engine import DecodeEngine
+from .server import ServingServer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m kungfu_tpu.serving")
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-kv-heads", type=int, default=None)
+    ap.add_argument("--n-layers", type=int, default=6)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--max-seq", type=int, default=1024)
+    ap.add_argument("--rope", action="store_true")
+    ap.add_argument("--swiglu", action="store_true")
+    ap.add_argument("--npz", default=None,
+                    help="weights from checkpoint.save_npz (else: "
+                         "seed-initialized demo weights)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8100)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--block", type=int, default=32)
+    ap.add_argument("--blocks", type=int, default=256)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--buckets", default="32,128,512",
+                    help="comma-separated prefill bucket lengths")
+    ap.add_argument("--max-len", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    dtype = (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
+             else jnp.float32)
+    cfg = G.GPTConfig(vocab_size=args.vocab, d_model=args.d_model,
+                      n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+                      n_layers=args.n_layers, d_ff=args.d_ff,
+                      max_seq=args.max_seq, rope=args.rope,
+                      mlp="swiglu" if args.swiglu else "gelu",
+                      dtype=dtype)
+    params = G.init_params(jax.random.PRNGKey(args.seed), cfg)
+    if args.npz:
+        params = restore_npz_like(params, args.npz)
+        print(f"serving: restored weights from {args.npz}",
+              file=sys.stderr)
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    eng = DecodeEngine(params, cfg, num_slots=args.slots,
+                       block_size=args.block, num_blocks=args.blocks,
+                       prompt_buckets=buckets, decode_chunk=args.chunk,
+                       max_len=args.max_len)
+    srv = ServingServer(eng, host=args.host, port=args.port).start()
+    # handlers BEFORE the readiness line: a supervisor reacting to it
+    # may signal immediately, and that must reach graceful shutdown
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    print(f"SERVING ready on {srv.host}:{srv.port}", flush=True)
+    done.wait()
+    print("serving: shutting down", file=sys.stderr)
+    srv.close()
+
+
+if __name__ == "__main__":
+    main()
