@@ -114,3 +114,29 @@ def paged_decode_attend(q, kc, vc, pos):
     note lives on), passing per-row positions instead of its scalar."""
     from ..models.gpt import _decode_attend
     return _decode_attend(q, kc, vc, pos)
+
+
+def paged_attend(q, k_pool, v_pool, tables, pos, *, mode: str = "auto"):
+    """The engine's per-layer attend: ``q`` [S, 1, H, Dh] against the
+    pool through the block tables.
+
+    ``mode``: ``"fused"`` runs the Pallas paged-attention kernel
+    (ops/paged_attention.py — pool bytes DMA'd once, no gathered copy,
+    no GQA expansion); ``"gather"`` the portable materialise-then-attend
+    path; ``"auto"`` picks fused on TPU only — CPU would pay
+    interpret-mode Pallas across the engine's many steps, and other
+    backends can't lower the TPU grid spec (the kernel itself is
+    oracle-checked in tests/test_paged_attention.py).
+    """
+    if mode == "auto":
+        mode = "fused" if jax.default_backend() == "tpu" else "gather"
+    if mode == "fused":
+        from ..ops.paged_attention import paged_attention
+        return paged_attention(q[:, 0], k_pool, v_pool, tables, pos)[:, None]
+    if mode != "gather":
+        raise ValueError(f"unknown paged attend mode {mode!r}")
+    from ..ops.flash_attention import _expand_kv_heads
+    groups = q.shape[2] // k_pool.shape[2]
+    kc = _expand_kv_heads(paged_gather(k_pool, tables), groups)
+    vc = _expand_kv_heads(paged_gather(v_pool, tables), groups)
+    return paged_decode_attend(q, kc, vc, pos)

@@ -37,8 +37,8 @@ from jax import lax
 
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
-from .cache import (init_paged_pools, lookup_blocks, paged_decode_attend,
-                    paged_gather, paged_write_prompt_batch,
+from .cache import (init_paged_pools, lookup_blocks, paged_attend,
+                    paged_write_prompt_batch,
                     paged_write_token)
 
 
@@ -96,11 +96,13 @@ class EngineStats:
 
 
 def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
-                 pos, tokens):
+                 pos, tokens, attend_mode: str = "auto"):
     """One decode step for every slot: feed each its last token at its
     own position, scatter K/V through the block tables, return logits.
     Inactive slots have zeroed table rows, so their writes land in the
-    scratch block — no conditionals anywhere."""
+    scratch block — no conditionals anywhere.  The attend reads straight
+    off the pool: the Pallas paged-attention kernel on TPU, the portable
+    gather path elsewhere (cache.paged_attend)."""
     x = G.embed(params, tokens[:, None], pos[:, None], cfg)
     blk, off = lookup_blocks(tables, pos, block_size)
     new_pools = []
@@ -109,9 +111,7 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
         kp = paged_write_token(pool["k"], blk, off, kk[:, 0])
         vp = paged_write_token(pool["v"], blk, off, v[:, 0])
         new_pools.append({"k": kp, "v": vp})
-        kc = G._expand_kv(paged_gather(kp, tables), cfg)
-        vc = G._expand_kv(paged_gather(vp, tables), cfg)
-        o = paged_decode_attend(q, kc, vc, pos)
+        o = paged_attend(q, kp, vp, tables, pos, mode=attend_mode)
         x = G._layer_finish(layer, x, o, cfg)
     x = G.rms_norm(x, params["lnf"])
     return G._head(params, x), new_pools            # [S, V] f32
@@ -135,7 +135,8 @@ def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
     return jnp.where(temp > 0, sampled, greedy)
 
 
-def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int):
+def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
+                       attend_mode: str = "auto"):
     """``chunk`` decode steps in ONE device program (a lax.scan feeding
     each sampled token to the next step on-device), returning all sampled
     tokens [chunk, S] at once.
@@ -155,7 +156,7 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int):
         def body(carry, _):
             pools, pos, tok, tc = carry
             logits, pools = _decode_core(params, cfg, block_size, pools,
-                                         tables, pos, tok)
+                                         tables, pos, tok, attend_mode)
             nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp)
             return (pools, pos + 1, nxt, tc + 1), nxt
 
@@ -216,13 +217,20 @@ class DecodeEngine:
     _make_decode_chunk — essential on remote/tunnelled TPUs where a
     per-token sync costs more than the decode step itself; the trade is
     slot-churn granularity, so shrink it for latency-sensitive serving).
+    ``attend`` picks the per-layer cache read: "fused" = the Pallas
+    paged-attention kernel (pool bytes DMA'd once, no gathered copy),
+    "gather" = portable materialise-then-attend, "auto" = fused on TPU.
     """
 
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
                  block_size: int = 32, num_blocks: int = 64,
                  max_len: Optional[int] = None,
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
-                 prefill_group: Optional[int] = None, on_tokens=None):
+                 prefill_group: Optional[int] = None, on_tokens=None,
+                 attend: str = "auto"):
+        if attend not in ("auto", "fused", "gather"):
+            raise ValueError(f"attend must be auto|fused|gather, "
+                             f"got {attend!r}")
         self.params = params
         self.cfg = cfg
         self.S = num_slots
@@ -258,7 +266,7 @@ class DecodeEngine:
         self._results: Dict[int, List[int]] = {}
         self.K = max(1, decode_chunk)
         self.G = max(1, min(prefill_group or min(num_slots, 8), num_slots))
-        self._decode = _make_decode_chunk(cfg, block_size, self.K)
+        self._decode = _make_decode_chunk(cfg, block_size, self.K, attend)
         self._prefill = _make_prefill(cfg, block_size, self.G)
         self.stats = EngineStats(num_slots)
 
