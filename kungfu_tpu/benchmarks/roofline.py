@@ -174,18 +174,30 @@ def main(argv=None):
         mm = bench_matmul(256, reps=4)
         fa_f = bench_flash(1, 256, 2, 64, reps=2, with_bwd=False)
         fa_b = bench_flash(1, 256, 2, 64, reps=2, with_bwd=True)
+        fa_f128 = fa_b128 = it128 = None
         it = bench_intree_flash(1, 256, 2, 64, reps=2)
         hbm = bench_hbm(16, reps=4)
     else:
         # the GPT benchmark's attention shape: seq 2048, head_dim 64
-        # (164M/470M presets), batch*heads sized to fill the chip
-        mm = bench_matmul(4096, reps=8)
-        fa_f = bench_flash(4, 2048, 12, 64, reps=4, with_bwd=False)
-        fa_b = bench_flash(4, 2048, 12, 64, reps=2, with_bwd=True)
-        it = bench_intree_flash(4, 2048, 12, 64, reps=4)
-        hbm = bench_hbm(512, reps=8)
+        # (164M/470M presets), batch*heads sized to fill the chip — plus
+        # head_dim 128 at the same total width (8x128 vs 16x64): the MXU
+        # is a 128x128 array, so D=64 contractions half-fill it and the
+        # D gap quantifies how much MFU a hd128 model config buys back
+        # reps sized so on-chip work is ~1 s per call: the tunnel's
+        # ~60-100 ms dispatch+fetch floor otherwise swamps the number
+        # (reps=8 measured 15 "TFLOP/s" for a ~150 TFLOP/s matmul, and
+        # reps=64 still read flash at half its real rate)
+        mm = bench_matmul(4096, reps=1024)
+        fa_f = bench_flash(4, 2048, 12, 64, reps=512, with_bwd=False)
+        fa_b = bench_flash(4, 2048, 12, 64, reps=128, with_bwd=True)
+        fa_f128 = bench_flash(4, 2048, 8, 128, reps=512, with_bwd=False)
+        fa_b128 = bench_flash(4, 2048, 8, 128, reps=128, with_bwd=True)
+        it = bench_intree_flash(4, 2048, 12, 64, reps=256)
+        it128 = bench_intree_flash(4, 2048, 8, 128, reps=256)
+        hbm = bench_hbm(512, reps=512)
 
-    results = [r for r in (mm, fa_f, fa_b, it, hbm) if r is not None]
+    results = [r for r in (mm, fa_f, fa_b, fa_f128, fa_b128, it, it128,
+                           hbm) if r is not None]
     doc = {
         "platform": plat,
         "device": str(jax.devices()[0]),
