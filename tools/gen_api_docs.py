@@ -1,0 +1,189 @@
+"""Generate docs/api/ — the committed markdown API reference.
+
+The reference framework ships a documentation build (docs/ with an
+index + API extraction scripts); this is the TPU framework's
+equivalent, kept dependency-free: plain introspection over the public
+modules, markdown out, committed to the repo, and held in sync by
+tests/test_docs.py (regenerate with ``python tools/gen_api_docs.py``).
+
+Public = names in ``__all__`` when defined, else top-level
+functions/classes defined in the module itself (not re-exports), names
+not starting with "_".
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import re
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# every module a user can reach through documented surfaces
+MODULES = [
+    "kungfu_tpu",
+    "kungfu_tpu.comm.session",
+    "kungfu_tpu.comm.mesh",
+    "kungfu_tpu.comm.collectives",
+    "kungfu_tpu.plan",
+    "kungfu_tpu.plan.topology",
+    "kungfu_tpu.plan.graph",
+    "kungfu_tpu.plan.mst",
+    "kungfu_tpu.training",
+    "kungfu_tpu.optimizers",
+    "kungfu_tpu.optimizers.sync_sgd",
+    "kungfu_tpu.optimizers.sma",
+    "kungfu_tpu.optimizers.pair_avg",
+    "kungfu_tpu.optimizers.ada_sgd",
+    "kungfu_tpu.optimizers.monitors",
+    "kungfu_tpu.elastic",
+    "kungfu_tpu.elastic.trainer",
+    "kungfu_tpu.elastic.policy",
+    "kungfu_tpu.elastic.schedule",
+    "kungfu_tpu.elastic.dataset",
+    "kungfu_tpu.elastic.config_server",
+    "kungfu_tpu.elastic.state",
+    "kungfu_tpu.launcher.env",
+    "kungfu_tpu.launcher.discovery",
+    "kungfu_tpu.launcher.control",
+    "kungfu_tpu.models.gpt",
+    "kungfu_tpu.models.resnet",
+    "kungfu_tpu.models.bert",
+    "kungfu_tpu.models.simple",
+    "kungfu_tpu.models.fake_model",
+    "kungfu_tpu.ops",
+    "kungfu_tpu.ops.flash_attention",
+    "kungfu_tpu.ops.chunked_ce",
+    "kungfu_tpu.ops.paged_attention",
+    "kungfu_tpu.ops.state",
+    "kungfu_tpu.parallel.tensor",
+    "kungfu_tpu.parallel.pipeline",
+    "kungfu_tpu.parallel.ring_attention",
+    "kungfu_tpu.parallel.moe",
+    "kungfu_tpu.parallel.moe_gpt",
+    "kungfu_tpu.parallel.fsdp",
+    "kungfu_tpu.parallel.threed",
+    "kungfu_tpu.serving.engine",
+    "kungfu_tpu.serving.cache",
+    "kungfu_tpu.serving.server",
+    "kungfu_tpu.native",
+    "kungfu_tpu.store",
+    "kungfu_tpu.monitor",
+    "kungfu_tpu.checkpoint",
+    "kungfu_tpu.data",
+    "kungfu_tpu.torch",
+    "kungfu_tpu.torch.optimizers",
+    "kungfu_tpu.torch.ops",
+    "kungfu_tpu.utils.trace",
+    "kungfu_tpu.utils.memstats",
+    "kungfu_tpu.utils.compile_cache",
+]
+
+
+def _public_names(mod):
+    if hasattr(mod, "__all__"):
+        return [n for n in mod.__all__ if not n.startswith("_")]
+    out = []
+    for n, obj in vars(mod).items():
+        if n.startswith("_"):
+            continue
+        if inspect.isfunction(obj) or inspect.isclass(obj):
+            if getattr(obj, "__module__", None) == mod.__name__:
+                out.append(n)
+    return sorted(out)
+
+
+def _sig(obj) -> str:
+    try:
+        s = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+    # default-value reprs can embed memory addresses (flax sentinels);
+    # strip them so the committed output is deterministic
+    return re.sub(r" at 0x[0-9a-f]+", "", s)
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj)
+    if not d:
+        return ""
+    # flax dataclass docstrings repeat the signature, addresses included
+    return re.sub(r" at 0x[0-9a-f]+", "", d.strip())
+
+
+def _render_function(name, fn, level="###") -> str:
+    parts = [f"{level} `{name}{_sig(fn)}`", ""]
+    d = _doc(fn)
+    if d:
+        parts += [d, ""]
+    return "\n".join(parts)
+
+
+def _render_class(name, cls) -> str:
+    parts = [f"### class `{name}{_sig(cls)}`", ""]
+    d = _doc(cls)
+    if d:
+        parts += [d, ""]
+    for mname, m in sorted(vars(cls).items()):
+        if mname.startswith("_"):
+            continue  # __init__'s signature is already on the class line
+        if isinstance(m, (staticmethod, classmethod)):
+            m = m.__func__
+        if inspect.isfunction(m):
+            parts.append(_render_function(f"{name}.{mname}", m, level="####"))
+        elif isinstance(m, property):
+            pd = _doc(m.fget) if m.fget else ""
+            parts.append(f"#### property `{name}.{mname}`\n")
+            if pd:
+                parts.append(pd + "\n")
+    return "\n".join(parts)
+
+
+def render_module(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    parts = [f"# `{modname}`", ""]
+    d = _doc(mod)
+    if d:
+        parts += [d, ""]
+    for name in _public_names(mod):
+        obj = getattr(mod, name, None)
+        if obj is None:
+            continue
+        if inspect.isclass(obj):
+            parts.append(_render_class(name, obj))
+        elif callable(obj):
+            parts.append(_render_function(name, obj))
+    return "\n".join(parts).rstrip() + "\n"
+
+
+def first_line(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    d = _doc(mod)
+    return d.splitlines()[0] if d else ""
+
+
+def generate(outdir: Path) -> None:
+    outdir.mkdir(parents=True, exist_ok=True)
+    index = ["# API reference", "",
+             "Generated by `python tools/gen_api_docs.py` — do not edit "
+             "by hand (tests/test_docs.py keeps it in sync).", ""]
+    for modname in MODULES:
+        fname = modname.replace(".", "_") + ".md"
+        (outdir / fname).write_text(render_module(modname))
+        index.append(f"- [`{modname}`]({fname}) — {first_line(modname)}")
+    (outdir / "index.md").write_text("\n".join(index) + "\n")
+
+
+if __name__ == "__main__":
+    out = Path(sys.argv[1]) if len(sys.argv) > 1 else REPO / "docs" / "api"
+    generate(out)
+    print(f"wrote {out} ({len(MODULES)} modules)")
