@@ -38,11 +38,19 @@ PRESETS = {
     "164m-long": ["--seq", "8192", "--batch", "16", "--n-kv-heads", "4",
                   "--rope", "--swiglu", "--accum", "16",
                   "--chunked-ce", "8192"],
-    # same d_model/d_ff/params as 470m but 8 heads of 128 instead of
-    # 16 of 64: head_dim 128 fills the MXU contraction (ROOFLINE.json:
-    # flash fwd 52.5 vs 29.6 TFLOP/s) — the high-MFU configuration.
-    # kv 2x128 = 4x64 bytes, so the KV cache and param count are
-    # unchanged
+    # -hd128 variants: same d_model/d_ff/params but head_dim 128 —
+    # 128-wide heads fill the MXU contraction (ROOFLINE.json: flash fwd
+    # 52.5 vs 29.6 TFLOP/s at hd64), the high-MFU configurations.  KV
+    # width is unchanged (2x128 = 4x64 bytes), so cache size and param
+    # count match the hd64 presets exactly.  Measured (v5e): 164m 51%
+    # -> 70% MFU, 164m-long 38% -> 62%, 470m 52% -> 68%
+    "164m-hd128": ["--seq", "2048", "--batch", "64", "--n-heads", "6",
+                   "--n-kv-heads", "2", "--rope", "--swiglu",
+                   "--accum", "16", "--chunked-ce", "16384"],
+    "164m-long-hd128": ["--seq", "8192", "--batch", "16",
+                        "--n-heads", "6", "--n-kv-heads", "2",
+                        "--rope", "--swiglu", "--accum", "16",
+                        "--chunked-ce", "8192"],
     "470m-hd128": ["--d-model", "1024", "--n-layers", "24",
                    "--n-heads", "8", "--n-kv-heads", "2",
                    "--d-ff", "4096", "--seq", "2048", "--batch", "64",

@@ -133,10 +133,13 @@ def test_gpt_preset_expansion_and_override():
 
     with pytest.raises(SystemExit):
         parse_args(["--preset", "bogus"])
-    assert set(PRESETS) == {"164m", "470m", "164m-long", "470m-hd128"}
-    # the high-MFU row: same d_model/params as 470m, MXU-filling heads
+    assert set(PRESETS) == {"164m", "470m", "164m-long", "164m-hd128",
+                            "164m-long-hd128", "470m-hd128"}
+    # the high-MFU rows: same d_model/params, MXU-filling 128-wide heads
     d = parse_args(["--preset", "470m-hd128"])
     assert (d.d_model, d.n_heads, d.n_kv_heads) == (1024, 8, 2)
+    e = parse_args(["--preset", "164m-long-hd128"])
+    assert (e.d_model, e.n_heads, e.seq) == (768, 6, 8192)
 
 
 def test_roofline_harness_produces_artifact(tmp_path):

@@ -13,12 +13,17 @@ DOCS = REPO / "docs"
 
 def test_api_reference_in_sync(tmp_path):
     """Committed docs/api == a fresh generation (regenerate with
-    `python tools/gen_api_docs.py` after changing public APIs)."""
-    import sys
-    sys.path.insert(0, str(REPO / "tools"))
-    import gen_api_docs as gen
+    `python tools/gen_api_docs.py` after changing public APIs).
 
-    gen.generate(tmp_path)
+    The generator runs in a subprocess: it pins jax to CPU at import,
+    which must not leak into this pytest process (collection-order
+    independence)."""
+    import subprocess
+    import sys
+    subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_api_docs.py"),
+         str(tmp_path)],
+        check=True, cwd=REPO, capture_output=True, timeout=600)
     fresh = {p.name: p.read_text() for p in tmp_path.glob("*.md")}
     committed = {p.name: p.read_text() for p in (DOCS / "api").glob("*.md")}
     assert set(fresh) == set(committed), (
