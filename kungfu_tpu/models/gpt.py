@@ -166,6 +166,17 @@ def param_specs(cfg: GPTConfig, tp: Optional[str] = "tp") -> Dict:
     return out
 
 
+def validate_tp(cfg: GPTConfig, ntp: int) -> None:
+    """Every dimension :func:`param_specs` shards over tp must divide by
+    the rank count — the one validator shared by every tensor-parallel
+    entry point (training, generation, the serving engine)."""
+    for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
+                      ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
+        if val % ntp != 0:
+            raise ValueError(f"{what}={val} not divisible by {ntp} "
+                             f"tensor-parallel ranks")
+
+
 def embed(params, tokens, pos, cfg: GPTConfig):
     """Token (+ learned position, unless RoPE) embedding.
     ``tokens`` [...,]; ``pos`` broadcastable positions."""

@@ -148,10 +148,13 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, interpret=None):
             pltpu.VMEM((H, _LANES), jnp.float32),
         ],
     )
+    # carry q's varying-axis type so the kernel composes with shard_map's
+    # check_vma (tensor-parallel serving: pools/q hold tp-head shards)
+    from .flash_attention import _sds
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, KVH, G, Dh), q.dtype),
+        out_shape=_sds((S, KVH, G, Dh), q.dtype, q),
         interpret=interpret,
     )(tables.astype(jnp.int32), pos.astype(jnp.int32), qg, k_pool, v_pool)
     return out.reshape(S, H, Dh)

@@ -73,11 +73,7 @@ def make_gpt_train_step(cfg: G.GPTConfig,
     specs = G.param_specs(cfg, TP_AXIS)
     data_spec = P(DP_AXIS, SP_AXIS)
     ntp = mesh.devices.shape[mesh.axis_names.index(TP_AXIS)]
-    for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
-                      ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
-        if val % ntp != 0:
-            raise ValueError(f"{what}={val} not divisible by {ntp} "
-                             f"tensor-parallel ranks")
+    G.validate_tp(cfg, ntp)
 
     def grad_body(params, tokens, targets):
         # static global token count: local tokens x dp x sp
@@ -134,11 +130,7 @@ def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
     specs = G.param_specs(cfg, TP_AXIS)
     L = max_len or cfg.max_seq
     ntp = mesh.devices.shape[mesh.axis_names.index(TP_AXIS)]
-    for what, val in (("n_heads", cfg.n_heads), ("kv_heads", cfg.kv_heads),
-                      ("d_ff", cfg.d_ff), ("vocab_size", cfg.vocab_size)):
-        if val % ntp != 0:
-            raise ValueError(f"{what}={val} not divisible by {ntp} "
-                             f"tensor-parallel ranks")
+    G.validate_tp(cfg, ntp)
 
     def body(params, prompt, rng):
         B = prompt.shape[0]

@@ -27,6 +27,7 @@ produce exactly the tokens the plain whole-batch decoder produces
 """
 import collections
 import dataclasses
+import functools
 import time
 from typing import Dict, List, Optional
 
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
@@ -95,14 +97,28 @@ class EngineStats:
                 if self.wall_s else 0.0}
 
 
+def _head_logits(params, x, tp_axis):
+    """lm_head on [S, 1, D] -> [S, V] f32.  Under tensor parallelism the
+    vocab-sharded local product is all-gathered over tp (a tiny [S, V]
+    f32 row next to the cache traffic) so every rank holds identical
+    logits and picks the SAME token (parallel.threed.make_tp_generate's
+    gathered_head, for the paged engine)."""
+    local = G._head(params, x)          # full [S, V] or the tp vocab shard
+    if tp_axis is None:
+        return local
+    return lax.all_gather(local, tp_axis, axis=1, tiled=True)
+
+
 def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
-                 pos, tokens, attend_mode: str = "auto"):
+                 pos, tokens, attend_mode: str = "auto", tp_axis=None):
     """One decode step for every slot: feed each its last token at its
     own position, scatter K/V through the block tables, return logits.
     Inactive slots have zeroed table rows, so their writes land in the
     scratch block — no conditionals anywhere.  The attend reads straight
     off the pool: the Pallas paged-attention kernel on TPU, the portable
-    gather path elsewhere (cache.paged_attend)."""
+    gather path elsewhere (cache.paged_attend).  Under ``tp_axis`` the
+    pools hold each rank's KV-head shard and per-layer psums restore
+    replicated activations — the same Megatron sharding as training."""
     x = G.embed(params, tokens[:, None], pos[:, None], cfg)
     blk, off = lookup_blocks(tables, pos, block_size)
     new_pools = []
@@ -112,9 +128,9 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
         vp = paged_write_token(pool["v"], blk, off, v[:, 0])
         new_pools.append({"k": kp, "v": vp})
         o = paged_attend(q, kp, vp, tables, pos, mode=attend_mode)
-        x = G._layer_finish(layer, x, o, cfg)
+        x = G._layer_finish(layer, x, o, cfg, tp_axis)
     x = G.rms_norm(x, params["lnf"])
-    return G._head(params, x), new_pools            # [S, V] f32
+    return _head_logits(params, x, tp_axis), new_pools   # [S, V] f32
 
 
 def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
@@ -135,8 +151,15 @@ def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
     return jnp.where(temp > 0, sampled, greedy)
 
 
+def _pool_spec(tp_axis):
+    """PartitionSpec for a pool leaf [N, bs, kv_heads, Dh]: KV heads
+    sharded over tp (each rank holds its head shard's blocks)."""
+    return P(None, None, tp_axis, None)
+
+
 def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
-                       attend_mode: str = "auto"):
+                       attend_mode: str = "auto", mesh=None,
+                       tp_axis: str = "tp"):
     """``chunk`` decode steps in ONE device program (a lax.scan feeding
     each sampled token to the next step on-device), returning all sampled
     tokens [chunk, S] at once.
@@ -149,25 +172,52 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
     granularity (a finished sequence's slot refills at the next chunk
     boundary, and its trailing in-chunk steps sample discarded garbage —
     bounded by chunk-1 slot-steps per finish, all safely routed to the
-    slot's own blocks or scratch)."""
+    slot's own blocks or scratch).
+
+    With ``mesh``, the whole chunk runs shard_mapped over its tp axis:
+    params Megatron-sharded (G.param_specs), pools KV-head-sharded,
+    tables/positions replicated.  Every rank all-gathers identical
+    logits and samples the same token, so the host scheduler is
+    unchanged."""
 
     def run(params, pools, tables, pos, tokens, uid_lo, uid_hi, tcount,
-            temp):
+            temp, tp_axis_=None):
+        if tp_axis_ is not None:
+            # the token carry becomes tp-varying after the first gathered
+            # sample; align the initial carry's varying-state with that
+            tokens = lax.pcast(tokens, (tp_axis_,), to="varying")
+
         def body(carry, _):
             pools, pos, tok, tc = carry
             logits, pools = _decode_core(params, cfg, block_size, pools,
-                                         tables, pos, tok, attend_mode)
+                                         tables, pos, tok, attend_mode,
+                                         tp_axis_)
             nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp)
             return (pools, pos + 1, nxt, tc + 1), nxt
 
         (pools, _, _, _), toks = lax.scan(
             body, (pools, pos, tokens, tcount), None, length=chunk)
+        if tp_axis_ is not None:
+            # ranks computed identical tokens; pmax is an identity that
+            # PROVES replication so the P() out_spec type-checks
+            toks = lax.pmax(toks, tp_axis_)
         return toks, pools                          # toks [chunk, S]
 
-    return jax.jit(run, donate_argnums=(1,))
+    if mesh is None:
+        return jax.jit(run, donate_argnums=(1,))
+    specs = G.param_specs(cfg, tp_axis)
+    rep = P()
+    body = functools.partial(run, tp_axis_=tp_axis)
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, _pool_spec(tp_axis), rep, rep, rep, rep, rep,
+                  rep, rep),
+        out_specs=(rep, _pool_spec(tp_axis)))
+    return jax.jit(sm, donate_argnums=(1,))
 
 
-def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
+def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
+                  mesh=None, tp_axis: str = "tp"):
     """Bucketed dense prefill for a GROUP of requests in one device
     program: causal forward over the padded prompts (one matmul-heavy
     pass — the MXU path, not T scan steps), K/V scattered into every
@@ -182,7 +232,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
     admitting N requests must not cost N dispatches."""
 
     def prefill(params, pools, table_rows, tokens, t_real, uid_lo,
-                uid_hi, temp):
+                uid_hi, temp, tp_axis_=None):
         T = tokens.shape[1]                              # [G, T]
         pos = jnp.arange(T)
         x = G.embed(params, tokens, pos, cfg)            # [G, T, D]
@@ -194,17 +244,31 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int):
             vp = paged_write_prompt_batch(pool["v"], table_rows, v,
                                           t_real, block_size)
             new_pools.append({"k": kp, "v": vp})
+            # local head shard attends (GQA group ratio is tp-invariant);
+            # the psum in _layer_finish restores replicated activations
             o = G._attend(q, kk, v, "dense", None, kv_groups=cfg.kv_groups)
-            x = G._layer_finish(layer, x, o, cfg)
+            x = G._layer_finish(layer, x, o, cfg, tp_axis_)
         x = G.rms_norm(x, params["lnf"])
         h_last = jnp.take_along_axis(
             x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
-        logits = G._head(params, h_last)                 # [G, V]
+        logits = _head_logits(params, h_last, tp_axis_)  # [G, V]
         tok0 = _pick_tokens(logits, uid_lo, uid_hi,
                             jnp.zeros_like(uid_lo), temp)
+        if tp_axis_ is not None:
+            tok0 = lax.pmax(tok0, tp_axis_)   # identity; proves replication
         return tok0, new_pools
 
-    return jax.jit(prefill, donate_argnums=(1,))
+    if mesh is None:
+        return jax.jit(prefill, donate_argnums=(1,))
+    specs = G.param_specs(cfg, tp_axis)
+    rep = P()
+    body = functools.partial(prefill, tp_axis_=tp_axis)
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, _pool_spec(tp_axis), rep, rep, rep, rep, rep,
+                  rep),
+        out_specs=(rep, _pool_spec(tp_axis)))
+    return jax.jit(sm, donate_argnums=(1,))
 
 
 class DecodeEngine:
@@ -220,6 +284,12 @@ class DecodeEngine:
     ``attend`` picks the per-layer cache read: "fused" = the Pallas
     paged-attention kernel (pool bytes DMA'd once, no gathered copy),
     "gather" = portable materialise-then-attend, "auto" = fused on TPU.
+    ``mesh`` switches on tensor-parallel serving: decode and prefill run
+    shard_mapped over the mesh's ``tp_axis`` with params Megatron-sharded
+    and the KV pools sharded by KV head; a host params tree is sharded
+    automatically.  The host scheduler is identical — every rank
+    all-gathers the same logits and picks the same token, so block
+    tables, admission, preemption, and replay don't know tp exists.
     """
 
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
@@ -227,10 +297,19 @@ class DecodeEngine:
                  max_len: Optional[int] = None,
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
                  prefill_group: Optional[int] = None, on_tokens=None,
-                 attend: str = "auto"):
+                 attend: str = "auto", mesh=None, tp_axis: str = "tp"):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
+        if mesh is not None:
+            G.validate_tp(cfg,
+                          mesh.devices.shape[mesh.axis_names.index(tp_axis)])
+            # accept a host tree (shard it) or already-sharded params
+            params = jax.tree_util.tree_map(
+                lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+                params, G.param_specs(cfg, tp_axis))
+        self.mesh = mesh
+        self.tp_axis = tp_axis
         self.params = params
         self.cfg = cfg
         self.S = num_slots
@@ -244,6 +323,9 @@ class DecodeEngine:
         if not self.buckets:
             raise ValueError("no prompt bucket fits max_len")
         self.pools = init_paged_pools(cfg, num_blocks, block_size)
+        if mesh is not None:
+            self.pools = jax.device_put(
+                self.pools, NamedSharding(mesh, _pool_spec(tp_axis)))
         self._total_blocks = num_blocks - 1      # block 0 is scratch
         self._free = collections.deque(range(1, num_blocks))
         self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
@@ -266,8 +348,10 @@ class DecodeEngine:
         self._results: Dict[int, List[int]] = {}
         self.K = max(1, decode_chunk)
         self.G = max(1, min(prefill_group or min(num_slots, 8), num_slots))
-        self._decode = _make_decode_chunk(cfg, block_size, self.K, attend)
-        self._prefill = _make_prefill(cfg, block_size, self.G)
+        self._decode = _make_decode_chunk(cfg, block_size, self.K, attend,
+                                          mesh, tp_axis)
+        self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
+                                      tp_axis)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin
