@@ -539,6 +539,20 @@ def _head(params, x):
                       params["lm_head"])[:, 0]
 
 
+def tp_head(params, x, tp_axis: Optional[str] = None):
+    """lm_head logits [B, V] f32 under optional tensor parallelism: the
+    vocab-sharded local product (lm_head is ``P(None, tp)`` in
+    :func:`param_specs`) is all-gathered over ``tp_axis`` — a tiny
+    [B, V] f32 row — so every rank holds identical logits and any
+    downstream argmax/sample picks the SAME token.  The one shared
+    implementation for every tp decode path (parallel.threed generation,
+    the serving engine)."""
+    local = _head(params, x)
+    if tp_axis is None:
+        return local
+    return lax.all_gather(local, tp_axis, axis=1, tiled=True)
+
+
 def decode_step(params, cfg: GPTConfig, cache, pos, token):
     """One incremental decode step.
 

@@ -144,12 +144,9 @@ def make_tp_generate(cfg: G.GPTConfig, mesh: Mesh, n_tokens: int,
         cache = [{"k": zero, "v": zero} for _ in range(cfg.n_layers)]
 
         def gathered_head(x):
-            # [B, V/tp] local -> [B, V] via tp all-gather (tiny); every
-            # rank then holds identical logits and the same rng stream,
-            # so all tp ranks sample the SAME token
-            local = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                               params["lm_head"])[:, 0]
-            return lax.all_gather(local, TP_AXIS, axis=1, tiled=True)
+            # every rank gathers identical logits and shares the rng
+            # stream, so all tp ranks sample the SAME token
+            return G.tp_head(params, x, TP_AXIS)
 
         toks = G.generate(params, cfg, prompt, n_tokens,
                           temperature=temperature, rng=rng, cache=cache,

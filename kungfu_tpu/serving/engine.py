@@ -97,18 +97,6 @@ class EngineStats:
                 if self.wall_s else 0.0}
 
 
-def _head_logits(params, x, tp_axis):
-    """lm_head on [S, 1, D] -> [S, V] f32.  Under tensor parallelism the
-    vocab-sharded local product is all-gathered over tp (a tiny [S, V]
-    f32 row next to the cache traffic) so every rank holds identical
-    logits and picks the SAME token (parallel.threed.make_tp_generate's
-    gathered_head, for the paged engine)."""
-    local = G._head(params, x)          # full [S, V] or the tp vocab shard
-    if tp_axis is None:
-        return local
-    return lax.all_gather(local, tp_axis, axis=1, tiled=True)
-
-
 def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
                  pos, tokens, attend_mode: str = "auto", tp_axis=None):
     """One decode step for every slot: feed each its last token at its
@@ -130,7 +118,7 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
         o = paged_attend(q, kp, vp, tables, pos, mode=attend_mode)
         x = G._layer_finish(layer, x, o, cfg, tp_axis)
     x = G.rms_norm(x, params["lnf"])
-    return _head_logits(params, x, tp_axis), new_pools   # [S, V] f32
+    return G.tp_head(params, x, tp_axis), new_pools    # [S, V] f32
 
 
 def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
@@ -251,7 +239,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
         x = G.rms_norm(x, params["lnf"])
         h_last = jnp.take_along_axis(
             x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
-        logits = _head_logits(params, h_last, tp_axis_)  # [G, V]
+        logits = G.tp_head(params, h_last, tp_axis_)     # [G, V]
         tok0 = _pick_tokens(logits, uid_lo, uid_hi,
                             jnp.zeros_like(uid_lo), temp)
         if tp_axis_ is not None:
