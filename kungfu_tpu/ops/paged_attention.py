@@ -48,8 +48,13 @@ NEG_INF = -1e30
 _LANES = 128
 
 
-def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
-               block_size, n_blocks, kv_heads, groups, scale, precision):
+def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+               block_size, n_blocks, kv_heads, groups, scale, precision,
+               quant):
+    if quant:
+        ks_ref, vs_ref, o_ref, acc, m, l = rest
+    else:
+        o_ref, acc, m, l = rest
     s_i = pl.program_id(0)
     b = pl.program_id(1)
 
@@ -71,6 +76,13 @@ def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
             q = q_ref[0, h, :, :]                   # [G, Dh] model dtype
             k = k_ref[0, :, h, :]                   # [bs, Dh]
             v = v_ref[0, :, h, :]
+            if quant:
+                # int8 pool: dequantize in VMEM (per-token scales); the
+                # HBM sweep stays half the bf16 pool's bytes
+                k = (k.astype(jnp.float32)
+                     * ks_ref[0, :, h][:, None]).astype(q.dtype)
+                v = (v.astype(jnp.float32)
+                     * vs_ref[0, :, h][:, None]).astype(q.dtype)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -97,7 +109,8 @@ def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
             kv_heads, groups, out.shape[-1]).astype(o_ref.dtype)
 
 
-def paged_attention(q, k_pool, v_pool, tables, pos, *, interpret=None):
+def paged_attention(q, k_pool, v_pool, tables, pos, *, k_scale=None,
+                    v_scale=None, interpret=None):
     """Decode attention straight off the paged pool.
 
     q        [S, H, Dh]  one decode token per slot (model dtype)
@@ -105,12 +118,18 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, interpret=None):
     v_pool   [N, bs, KVH, Dh]
     tables   int32 [S, MB]  per-slot block tables (0 = scratch block)
     pos      int32 [S]  each slot attends to positions <= pos[s]
+    k_scale / v_scale  f32 [N, bs, KVH]  per-(token, head) scales for
+             the int8 pool layout (both or neither); dequantization is
+             fused into the VMEM block processing
 
     Returns [S, H, Dh] in q's dtype.  Query head ``h`` reads KV head
     ``h // (H // KVH)`` — the same grouping as
     ops.flash_attention._expand_kv_heads, so this is a drop-in for
     gather+expand+dense-attend.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    quant = k_scale is not None
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     S, H, Dh = q.shape
@@ -128,18 +147,25 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, interpret=None):
                  else None)
     kernel = functools.partial(_pa_kernel, block_size=bs, n_blocks=MB,
                                kv_heads=KVH, groups=G,
-                               scale=1.0 / np.sqrt(Dh), precision=precision)
+                               scale=1.0 / np.sqrt(Dh), precision=precision,
+                               quant=quant)
+    pool_spec = pl.BlockSpec((1, bs, KVH, Dh),
+                             lambda s, b, tbl, ps: (tbl[s, b], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, KVH, G, Dh), lambda s, b, tbl, ps: (s, 0, 0, 0)),
+        pool_spec,
+        pool_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        scale_spec = pl.BlockSpec((1, bs, KVH),
+                                  lambda s, b, tbl, ps: (tbl[s, b], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, MB),
-        in_specs=[
-            pl.BlockSpec((1, KVH, G, Dh),
-                         lambda s, b, tbl, ps: (s, 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, Dh),
-                         lambda s, b, tbl, ps: (tbl[s, b], 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, Dh),
-                         lambda s, b, tbl, ps: (tbl[s, b], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, KVH, G, Dh),
                                lambda s, b, tbl, ps: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -156,5 +182,5 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, interpret=None):
         grid_spec=grid_spec,
         out_shape=_sds((S, KVH, G, Dh), q.dtype, q),
         interpret=interpret,
-    )(tables.astype(jnp.int32), pos.astype(jnp.int32), qg, k_pool, v_pool)
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *operands)
     return out.reshape(S, H, Dh)

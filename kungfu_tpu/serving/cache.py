@@ -40,16 +40,48 @@ from ..models.gpt import GPTConfig
 
 
 def init_paged_pools(cfg: GPTConfig, num_blocks: int,
-                     block_size: int) -> List[dict]:
+                     block_size: int, kv_dtype=None) -> List[dict]:
     """Per-layer K/V pools ``[num_blocks, block_size, kv_heads, Dh]`` in
     the model dtype (GQA keeps the pool compact, kv_groups-times smaller
-    than MHA).  Block 0 is reserved as the scratch block."""
+    than MHA).  Block 0 is reserved as the scratch block.
+
+    ``kv_dtype=jnp.int8`` switches on the quantized cache: tokens are
+    stored as int8 with one f32 scale per (token, kv_head) —
+    ``{"k", "ks", "v", "vs"}`` per layer.  Halves (vs bf16) the pool
+    bytes the bandwidth-bound decode attend must sweep and doubles how
+    many tokens a given HBM budget caches; the f32 scale planes add
+    4/head_dim of the int8 pool bytes (~3% at head_dim 128)."""
     if num_blocks < 2:
         raise ValueError("need >= 2 blocks (block 0 is scratch)")
+    if kv_dtype is not None and kv_dtype != jnp.int8:
+        raise ValueError("kv_dtype must be None (model dtype) or jnp.int8")
     shape = (num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype == jnp.int8:
+        sshape = shape[:-1]
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros(sshape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "vs": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
     return [{"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
+
+
+def quantize_kv(kv):
+    """Symmetric per-(token, head) int8: ``kv`` [..., Dh] ->
+    (int8 [..., Dh], f32 scale [...]).  amax/127 scaling; zero rows get
+    scale 0 (and dequantize back to exact zeros)."""
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    scale = amax / 127.0
+    q = jnp.round(kv.astype(jnp.float32)
+                  / jnp.maximum(scale, 1e-30)[..., None])
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """Adjoint of :func:`quantize_kv`."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def lookup_blocks(tables, pos, block_size: int):
@@ -116,27 +148,83 @@ def paged_decode_attend(q, kc, vc, pos):
     return _decode_attend(q, kc, vc, pos)
 
 
-def paged_attend(q, k_pool, v_pool, tables, pos, *, mode: str = "auto"):
-    """The engine's per-layer attend: ``q`` [S, 1, H, Dh] against the
-    pool through the block tables.
+def pool_write_token(pool, blk, off, kkv, vkv):
+    """Write one token per slot into a pool dict — model-dtype or int8
+    (quantizing at write time; scales ride the same scatter routing, so
+    padding/inactive scales land in scratch too)."""
+    if "ks" in pool:
+        kq, ks = quantize_kv(kkv)
+        vq, vs = quantize_kv(vkv)
+        return {"k": paged_write_token(pool["k"], blk, off, kq),
+                "ks": pool["ks"].at[blk, off].set(ks),
+                "v": paged_write_token(pool["v"], blk, off, vq),
+                "vs": pool["vs"].at[blk, off].set(vs)}
+    return {"k": paged_write_token(pool["k"], blk, off, kkv),
+            "v": paged_write_token(pool["v"], blk, off, vkv)}
+
+
+def pool_write_prompt_batch(pool, table_rows, kkv, vkv, t_real,
+                            block_size: int):
+    """Batched prompt write into a pool dict (both cache dtypes).
+    ``paged_write_prompt_batch`` is shape-generic in the trailing dims,
+    so the [G, T, H] scale planes reuse the same scatter."""
+    w = lambda p, t: paged_write_prompt_batch(p, table_rows, t, t_real,
+                                              block_size)
+    if "ks" in pool:
+        kq, ks = quantize_kv(kkv)
+        vq, vs = quantize_kv(vkv)
+        return {"k": w(pool["k"], kq), "ks": w(pool["ks"], ks),
+                "v": w(pool["v"], vq), "vs": w(pool["vs"], vs)}
+    return {"k": w(pool["k"], kkv), "v": w(pool["v"], vkv)}
+
+
+def pool_attend(q, pool, tables, pos, *, mode: str = "auto"):
+    """THE attend dispatcher: one place picks fused-vs-gather and
+    handles both cache layouts (model-dtype ``{"k","v"}`` and int8
+    ``{"k","ks","v","vs"}``).
 
     ``mode``: ``"fused"`` runs the Pallas paged-attention kernel
     (ops/paged_attention.py — pool bytes DMA'd once, no gathered copy,
-    no GQA expansion); ``"gather"`` the portable materialise-then-attend
-    path; ``"auto"`` picks fused on TPU only — CPU would pay
-    interpret-mode Pallas across the engine's many steps, and other
-    backends can't lower the TPU grid spec (the kernel itself is
-    oracle-checked in tests/test_paged_attention.py).
+    no GQA expansion, int8 dequantized in VMEM); ``"gather"`` the
+    portable materialise-then-attend path; ``"auto"`` picks fused on
+    TPU only — CPU would pay interpret-mode Pallas across the engine's
+    many steps, and other backends can't lower the TPU grid spec (the
+    kernel itself is oracle-checked in tests/test_paged_attention.py).
     """
+    quant = "ks" in pool
     if mode == "auto":
         mode = "fused" if jax.default_backend() == "tpu" else "gather"
     if mode == "fused":
         from ..ops.paged_attention import paged_attention
-        return paged_attention(q[:, 0], k_pool, v_pool, tables, pos)[:, None]
+        return paged_attention(q[:, 0], pool["k"], pool["v"], tables,
+                               pos, k_scale=pool.get("ks"),
+                               v_scale=pool.get("vs"))[:, None]
     if mode != "gather":
         raise ValueError(f"unknown paged attend mode {mode!r}")
     from ..ops.flash_attention import _expand_kv_heads
-    groups = q.shape[2] // k_pool.shape[2]
-    kc = _expand_kv_heads(paged_gather(k_pool, tables), groups)
-    vc = _expand_kv_heads(paged_gather(v_pool, tables), groups)
-    return paged_decode_attend(q, kc, vc, pos)
+    groups = q.shape[2] // pool["k"].shape[2]
+    kc = paged_gather(pool["k"], tables)
+    vc = paged_gather(pool["v"], tables)
+    if quant:
+        kc = dequantize_kv(kc, paged_gather_scales(pool["ks"], tables),
+                           q.dtype)
+        vc = dequantize_kv(vc, paged_gather_scales(pool["vs"], tables),
+                           q.dtype)
+    return paged_decode_attend(q, _expand_kv_heads(kc, groups),
+                               _expand_kv_heads(vc, groups), pos)
+
+
+def paged_gather_scales(spool, tables):
+    """[S, max_blocks * block_size, kv_heads] logical view of the scale
+    planes (the 3-D sibling of :func:`paged_gather`)."""
+    S = tables.shape[0]
+    g = spool[tables]                      # [S, MB, bs, H]
+    return g.reshape(S, -1, g.shape[-1])
+
+
+def paged_attend(q, k_pool, v_pool, tables, pos, *, mode: str = "auto"):
+    """Array-operand convenience over :func:`pool_attend` (the one
+    dispatcher) for the model-dtype layout: ``q`` [S, 1, H, Dh] against
+    bare K/V pools through the block tables."""
+    return pool_attend(q, {"k": k_pool, "v": v_pool}, tables, pos,
+                       mode=mode)

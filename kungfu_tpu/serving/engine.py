@@ -39,9 +39,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
-from .cache import (init_paged_pools, lookup_blocks, paged_attend,
-                    paged_write_prompt_batch,
-                    paged_write_token)
+from .cache import (init_paged_pools, lookup_blocks, pool_attend,
+                    pool_write_prompt_batch, pool_write_token)
 
 
 @dataclasses.dataclass
@@ -112,10 +111,9 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
     new_pools = []
     for layer, pool in zip(params["layers"], pools):
         q, kk, v = G._layer_qkv(layer, x, cfg, pos=pos[:, None])
-        kp = paged_write_token(pool["k"], blk, off, kk[:, 0])
-        vp = paged_write_token(pool["v"], blk, off, v[:, 0])
-        new_pools.append({"k": kp, "v": vp})
-        o = paged_attend(q, kp, vp, tables, pos, mode=attend_mode)
+        pool = pool_write_token(pool, blk, off, kk[:, 0], v[:, 0])
+        new_pools.append(pool)
+        o = pool_attend(q, pool, tables, pos, mode=attend_mode)
         x = G._layer_finish(layer, x, o, cfg, tp_axis)
     x = G.rms_norm(x, params["lnf"])
     return G.tp_head(params, x, tp_axis), new_pools    # [S, V] f32
@@ -139,15 +137,20 @@ def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
     return jnp.where(temp > 0, sampled, greedy)
 
 
-def _pool_spec(tp_axis):
-    """PartitionSpec for a pool leaf [N, bs, kv_heads, Dh]: KV heads
-    sharded over tp (each rank holds its head shard's blocks)."""
-    return P(None, None, tp_axis, None)
+def _pool_specs(tp_axis, quant: bool, n_layers: int):
+    """PartitionSpec tree for the pools: KV heads sharded over tp (each
+    rank holds its head shard's blocks); int8 pools add 3-D scale planes
+    sharded the same way."""
+    p4 = P(None, None, tp_axis, None)
+    if not quant:
+        return [{"k": p4, "v": p4}] * n_layers
+    p3 = P(None, None, tp_axis)
+    return [{"k": p4, "ks": p3, "v": p4, "vs": p3}] * n_layers
 
 
 def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
                        attend_mode: str = "auto", mesh=None,
-                       tp_axis: str = "tp"):
+                       tp_axis: str = "tp", quant: bool = False):
     """``chunk`` decode steps in ONE device program (a lax.scan feeding
     each sampled token to the next step on-device), returning all sampled
     tokens [chunk, S] at once.
@@ -198,14 +201,14 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
     body = functools.partial(run, tp_axis_=tp_axis)
     sm = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(specs, _pool_spec(tp_axis), rep, rep, rep, rep, rep,
-                  rep, rep),
-        out_specs=(rep, _pool_spec(tp_axis)))
+        in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
+                  rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
     return jax.jit(sm, donate_argnums=(1,))
 
 
 def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
-                  mesh=None, tp_axis: str = "tp"):
+                  mesh=None, tp_axis: str = "tp", quant: bool = False):
     """Bucketed dense prefill for a GROUP of requests in one device
     program: causal forward over the padded prompts (one matmul-heavy
     pass — the MXU path, not T scan steps), K/V scattered into every
@@ -227,11 +230,9 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
         new_pools = []
         for layer, pool in zip(params["layers"], pools):
             q, kk, v = G._layer_qkv(layer, x, cfg, pos=pos)
-            kp = paged_write_prompt_batch(pool["k"], table_rows, kk,
-                                          t_real, block_size)
-            vp = paged_write_prompt_batch(pool["v"], table_rows, v,
-                                          t_real, block_size)
-            new_pools.append({"k": kp, "v": vp})
+            pool = pool_write_prompt_batch(pool, table_rows, kk, v,
+                                           t_real, block_size)
+            new_pools.append(pool)
             # local head shard attends (GQA group ratio is tp-invariant);
             # the psum in _layer_finish restores replicated activations
             o = G._attend(q, kk, v, "dense", None, kv_groups=cfg.kv_groups)
@@ -253,9 +254,9 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
     body = functools.partial(prefill, tp_axis_=tp_axis)
     sm = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(specs, _pool_spec(tp_axis), rep, rep, rep, rep, rep,
-                  rep),
-        out_specs=(rep, _pool_spec(tp_axis)))
+        in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
+                  rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
     return jax.jit(sm, donate_argnums=(1,))
 
 
@@ -278,6 +279,11 @@ class DecodeEngine:
     automatically.  The host scheduler is identical — every rank
     all-gathers the same logits and picks the same token, so block
     tables, admission, preemption, and replay don't know tp exists.
+    ``kv_dtype=jnp.int8`` stores the cache quantized (one f32 scale per
+    token per KV head, dequantized inside the attend): half the pool
+    bytes of bf16 — so ~2x the cached tokens per HBM byte and half the
+    bandwidth the decode attend sweeps — at a small accuracy cost.
+    Quantization is deterministic, so preemption replay stays exact.
     """
 
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
@@ -285,10 +291,15 @@ class DecodeEngine:
                  max_len: Optional[int] = None,
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
                  prefill_group: Optional[int] = None, on_tokens=None,
-                 attend: str = "auto", mesh=None, tp_axis: str = "tp"):
+                 attend: str = "auto", mesh=None, tp_axis: str = "tp",
+                 kv_dtype=None):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
+        quant = kv_dtype == jnp.int8
+        if kv_dtype is not None and not quant:
+            raise ValueError("kv_dtype must be None (model dtype) or "
+                             "jnp.int8")
         if mesh is not None:
             G.validate_tp(cfg,
                           mesh.devices.shape[mesh.axis_names.index(tp_axis)])
@@ -310,10 +321,12 @@ class DecodeEngine:
                                     if b <= self.max_len))
         if not self.buckets:
             raise ValueError("no prompt bucket fits max_len")
-        self.pools = init_paged_pools(cfg, num_blocks, block_size)
+        self.pools = init_paged_pools(cfg, num_blocks, block_size,
+                                      kv_dtype=kv_dtype)
         if mesh is not None:
-            self.pools = jax.device_put(
-                self.pools, NamedSharding(mesh, _pool_spec(tp_axis)))
+            self.pools = jax.tree_util.tree_map(
+                lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+                self.pools, _pool_specs(tp_axis, quant, cfg.n_layers))
         self._total_blocks = num_blocks - 1      # block 0 is scratch
         self._free = collections.deque(range(1, num_blocks))
         self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
@@ -337,9 +350,9 @@ class DecodeEngine:
         self.K = max(1, decode_chunk)
         self.G = max(1, min(prefill_group or min(num_slots, 8), num_slots))
         self._decode = _make_decode_chunk(cfg, block_size, self.K, attend,
-                                          mesh, tp_axis)
+                                          mesh, tp_axis, quant)
         self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
-                                      tp_axis)
+                                      tp_axis, quant)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin

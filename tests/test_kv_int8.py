@@ -1,0 +1,133 @@
+"""int8-quantized paged KV cache: quant math, attend accuracy, engine
+determinism (replay-exactness survives quantization), and TP composition.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.serving import DecodeEngine, Request
+from kungfu_tpu.serving.cache import (dequantize_kv, init_paged_pools,
+                                      pool_attend, quantize_kv)
+
+CFG = G.GPTConfig(vocab_size=128, d_model=32, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=64, max_seq=64, rope=True,
+                  dtype=jnp.float32)
+
+
+def test_quant_roundtrip_error_bound():
+    """Symmetric per-row int8: relative error <= 1/254 of the row amax
+    (half a quantization step); zero rows come back exactly zero."""
+    rng = np.random.RandomState(0)
+    kv = jnp.asarray(rng.randn(5, 3, 16) * 7.0, jnp.float32)
+    q, s = quantize_kv(kv)
+    back = dequantize_kv(q, s, jnp.float32)
+    amax = np.abs(np.asarray(kv)).max(axis=-1, keepdims=True)
+    assert np.all(np.abs(np.asarray(back) - np.asarray(kv))
+                  <= amax / 254.0 + 1e-7)
+    zq, zs = quantize_kv(jnp.zeros((2, 4)))
+    assert np.all(np.asarray(dequantize_kv(zq, zs, jnp.float32)) == 0)
+
+
+def test_int8_pool_attend_close_to_fp():
+    """Gather-path attend on the int8 pool tracks the fp pool within
+    quantization noise (same tables/positions/values)."""
+    rng = np.random.RandomState(1)
+    S, H, KVH, Dh, bs, MB = 4, 4, 2, 16, 4, 4
+    N = S * MB + 1
+    cfg = G.GPTConfig(vocab_size=128, d_model=H * Dh, n_heads=H,
+                      n_kv_heads=KVH, n_layers=1, d_ff=32,
+                      max_seq=MB * bs, rope=True, dtype=jnp.float32)
+    kv_k = jnp.asarray(rng.randn(N, bs, KVH, Dh), jnp.float32)
+    kv_v = jnp.asarray(rng.randn(N, bs, KVH, Dh), jnp.float32)
+    fp = {"k": kv_k, "v": kv_v}
+    kq, ks = quantize_kv(kv_k)
+    vq, vs = quantize_kv(kv_v)
+    q8 = {"k": kq, "ks": ks, "v": vq, "vs": vs}
+    # the hand-built dict must be exactly the init_paged_pools layout
+    # (structure + shapes + dtypes), or this test drifts from the engine
+    ref = init_paged_pools(cfg, N, bs, kv_dtype=jnp.int8)[0]
+    assert jax.tree_util.tree_structure(q8) == \
+        jax.tree_util.tree_structure(ref)
+    for a, b in zip(jax.tree_util.tree_leaves(q8),
+                    jax.tree_util.tree_leaves(ref)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    q = jnp.asarray(rng.randn(S, 1, H, Dh), jnp.float32)
+    tables = np.zeros((S, MB), np.int32)
+    pos = rng.randint(0, MB * bs, S).astype(np.int32)
+    free = list(range(1, N))
+    rng.shuffle(free)
+    for s_ in range(S):
+        for b in range(pos[s_] // bs + 1):
+            tables[s_, b] = free.pop()
+    tables = jnp.asarray(tables)
+    posj = jnp.asarray(pos)
+    of = np.asarray(pool_attend(q, fp, tables, posj, mode="gather"))
+    o8 = np.asarray(pool_attend(q, q8, tables, posj, mode="gather"))
+    assert np.max(np.abs(of - o8)) < 0.05      # quantization noise only
+
+
+def test_int8_engine_runs_and_is_deterministic():
+    """The engine with kv_dtype=int8: same requests twice -> identical
+    tokens (quantization is deterministic), through slot churn and a
+    preemption-tight pool."""
+    params = G.init_params(jax.random.PRNGKey(0), CFG)
+    rng = np.random.RandomState(2)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 128,
+                                       int(rng.randint(2, 12))).tolist(),
+                    max_new=int(rng.randint(1, 7)))
+            for i in range(6)]
+
+    def run():
+        eng = DecodeEngine(params, CFG, num_slots=3, block_size=4,
+                           num_blocks=12,   # tight: forces preemption
+                           prompt_buckets=(8, 16), decode_chunk=2,
+                           kv_dtype=jnp.int8)
+        return eng.run(list(reqs))
+
+    a, b = run(), run()
+    assert a == b
+    assert set(a) == {r.uid for r in reqs}
+    assert all(len(v) for v in a.values())
+
+
+def test_int8_engine_tokens_track_fp_engine():
+    """int8 vs fp cache engines mostly agree on greedy tokens (the
+    quantization perturbs logits only slightly); exact equality is not
+    promised, but gross divergence means a routing/scale bug."""
+    params = G.init_params(jax.random.PRNGKey(3), CFG)
+    rng = np.random.RandomState(4)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 128,
+                                       int(rng.randint(2, 10))).tolist(),
+                    max_new=4)
+            for i in range(6)]
+    kw = dict(num_slots=3, block_size=4, num_blocks=32,
+              prompt_buckets=(8, 16), decode_chunk=2)
+    rf = DecodeEngine(params, CFG, **kw).run(list(reqs))
+    r8 = DecodeEngine(params, CFG, kv_dtype=jnp.int8,
+                      **kw).run(list(reqs))
+    agree = sum(a == b for u in rf for a, b in zip(rf[u], r8[u]))
+    total = sum(len(v) for v in rf.values())
+    assert agree / total >= 0.75, (agree, total, rf, r8)
+
+
+def test_int8_with_tensor_parallel(devices):
+    """int8 pools compose with tp serving: deterministic, and the scale
+    planes shard with their pools."""
+    params = G.init_params(jax.random.PRNGKey(5), CFG)
+    rng = np.random.RandomState(6)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 128,
+                                       int(rng.randint(2, 10))).tolist(),
+                    max_new=3)
+            for i in range(4)]
+    mesh = Mesh(np.asarray(devices[:2]), ("tp",))
+    kw = dict(num_slots=2, block_size=4, num_blocks=24,
+              prompt_buckets=(8, 16), decode_chunk=2, kv_dtype=jnp.int8)
+    res_tp = DecodeEngine(params, CFG, mesh=mesh, **kw).run(list(reqs))
+    res_1d = DecodeEngine(params, CFG, **kw).run(list(reqs))
+    assert res_tp == res_1d

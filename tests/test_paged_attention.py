@@ -107,6 +107,25 @@ def test_engine_with_fused_attend_matches_oracle():
         assert res[r.uid] == solo
 
 
+def test_kernel_int8_matches_gather_dequant():
+    """Fused kernel with int8 pool + scales == gather-path dequantized
+    attend (identical quantized inputs, so the only difference allowed
+    is accumulation order)."""
+    from kungfu_tpu.serving.cache import pool_attend, quantize_kv
+    rng = np.random.RandomState(7)
+    S, H, KVH, Dh, bs, MB = 4, 4, 2, 16, 8, 3
+    N = S * MB + 1
+    q, kp, vp, tables, pos = _rand_case(rng, S, H, KVH, Dh, N, bs, MB)
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    pool = {"k": kq, "ks": ks, "v": vq, "vs": vs}
+    got = np.asarray(pool_attend(q[:, None], pool, tables, pos,
+                                 mode="fused")[:, 0])
+    want = np.asarray(pool_attend(q[:, None], pool, tables, pos,
+                                  mode="gather")[:, 0])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
 def test_kernel_bf16_runs():
     rng = np.random.RandomState(3)
     S, H, KVH, Dh, bs, MB = 2, 4, 2, 16, 4, 2
