@@ -14,6 +14,10 @@ import signal
 import sys
 import threading
 
+from ..utils.platform import pin_cpu_if_requested
+
+pin_cpu_if_requested()   # honor JAX_PLATFORMS=cpu over the TPU plugin
+
 import jax
 import jax.numpy as jnp
 
@@ -47,6 +51,13 @@ def main(argv=None):
     ap.add_argument("--buckets", default="32,128,512",
                     help="comma-separated prefill bucket lengths")
     ap.add_argument("--max-len", type=int, default=None)
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="int8-quantized KV cache: ~2x cached tokens "
+                         "per HBM byte, dequant fused into the attend")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel ranks (0 = single device); "
+                         "shards params + KV pools over the first N "
+                         "local devices")
     args = ap.parse_args(argv)
 
     dtype = (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
@@ -64,10 +75,23 @@ def main(argv=None):
               file=sys.stderr)
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    mesh = None
+    if args.tp:
+        import numpy as np
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        if len(devs) < args.tp:
+            raise SystemExit(f"--tp {args.tp} but only {len(devs)} "
+                             f"devices visible")
+        mesh = Mesh(np.asarray(devs[:args.tp]), ("tp",))
+        print(f"serving: tensor-parallel over {args.tp} devices",
+              file=sys.stderr)
     eng = DecodeEngine(params, cfg, num_slots=args.slots,
                        block_size=args.block, num_blocks=args.blocks,
                        prompt_buckets=buckets, decode_chunk=args.chunk,
-                       max_len=args.max_len)
+                       max_len=args.max_len,
+                       kv_dtype=jnp.int8 if args.kv_int8 else None,
+                       mesh=mesh)
     srv = ServingServer(eng, host=args.host, port=args.port).start()
     # handlers BEFORE the readiness line: a supervisor reacting to it
     # may signal immediately, and that must reach graceful shutdown

@@ -156,9 +156,9 @@ def pool_write_token(pool, blk, off, kkv, vkv):
         kq, ks = quantize_kv(kkv)
         vq, vs = quantize_kv(vkv)
         return {"k": paged_write_token(pool["k"], blk, off, kq),
-                "ks": pool["ks"].at[blk, off].set(ks),
+                "ks": paged_write_token(pool["ks"], blk, off, ks),
                 "v": paged_write_token(pool["v"], blk, off, vq),
-                "vs": pool["vs"].at[blk, off].set(vs)}
+                "vs": paged_write_token(pool["vs"], blk, off, vs)}
     return {"k": paged_write_token(pool["k"], blk, off, kkv),
             "v": paged_write_token(pool["v"], blk, off, vkv)}
 

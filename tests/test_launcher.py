@@ -132,7 +132,9 @@ class TestWatcher:
         hl = HostList.parse("127.0.0.1:2")
         w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000))
         w.update(0, Cluster.from_hostlist(hl, 2))
-        deadline = time.time() + 10
+        # generous deadline: under a loaded machine (parallel suites +
+        # TPU jobs) just spawning python can take >10 s
+        deadline = time.time() + 60
         while w.failed is None and time.time() < deadline:
             time.sleep(0.1)
             w.reap()
@@ -208,7 +210,7 @@ class TestWatcherRegressions:
         w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 31000))
         w.update(0, Cluster.from_hostlist(hl, 2))
         import time as _t
-        deadline = _t.time() + 10
+        deadline = _t.time() + 60     # loaded-machine headroom
         while not w.all_local_done() and _t.time() < deadline:
             _t.sleep(0.1)
             w.reap()

@@ -108,6 +108,26 @@ def test_cli_serves_npz_weights(tmp_path):
         _stop(proc)
 
 
+def test_cli_kv_int8_and_tp(tmp_path):
+    """--kv-int8 --tp 2 serve the same model (int8 cache + tensor
+    parallelism through the binary); greedy tokens must still come from
+    the served weights (int8 noise can flip near-ties on random weights,
+    so assert the shape/validity and determinism across two calls)."""
+    # vocab overridden to a tp-divisible size (last --vocab flag wins)
+    proc, host, port = _start(["--seed", "3", "--kv-int8", "--tp", "2",
+                               "--vocab", "64"],
+                              str(tmp_path / "err.log"))
+    try:
+        prompt = [4, 9, 2, 7]
+        a = _post(host, port, {"prompt": prompt, "max_new": 5})
+        b = _post(host, port, {"prompt": prompt, "max_new": 5})
+        assert len(a["tokens"]) == 5 and a["tokens"] == b["tokens"]
+        assert all(0 <= t < 64 for t in a["tokens"])
+    finally:
+        _stop(proc)
+    assert proc.returncode == 0
+
+
 def test_cli_rejects_bad_npz(tmp_path):
     bad = G.GPTConfig(vocab_size=61, d_model=8, n_heads=2, n_layers=1,
                       d_ff=16, max_seq=64, dtype=jnp.float32)
