@@ -100,3 +100,120 @@ class ScheduledResizePolicy(BasePolicy):
             ctx.request_stop()
         elif size != ctx.cluster_size:
             ctx.resize(size)
+
+
+def find_noise_scale(opt_state):
+    """The live gradient-noise-scale reading from an optimizer-state tree
+    (optimizers.gradient_noise_scale carries it as ``state.noise_scale``,
+    however deeply the transform is chained).  Returns a numpy array
+    ([lanes] — replicated-equal) or None when no GNS monitor is in the
+    chain."""
+    import numpy as np
+    if hasattr(opt_state, "noise_scale"):
+        return np.asarray(opt_state.noise_scale)
+    if isinstance(opt_state, dict):
+        opt_state = tuple(opt_state.values())   # e.g. multi_transform
+    if isinstance(opt_state, (tuple, list)):
+        for s in opt_state:
+            r = find_noise_scale(s)
+            if r is not None:
+                return r
+    return None
+
+
+class GNSScalingPolicy(BasePolicy):
+    """Autoscaling from the gradient noise scale.
+
+    The GNS estimates the *critical batch size* — the global batch
+    beyond which extra data parallelism stops buying optimization
+    progress (An Empirical Model of Large-Batch Training; the same
+    estimator the reference monitors with
+    MonitorGradientNoiseScaleOptimizer and feeds to its adaptation
+    policies).  This policy closes the loop the reference leaves to the
+    user: it reads the live GNS off the optimizer state and proposes a
+    cluster size such that ``size * per_lane_batch`` tracks it.
+
+    Guard rails (a resize costs seconds of recompile/re-sync —
+    benchmarks/resize_cost):
+
+    - ``warmup_steps`` before the EMA estimator is trusted at all;
+    - a proposal only every ``check_every`` steps;
+    - a deadband: resize only when the wanted size differs from the
+      current one by at least ``deadband`` (ratio, default 1.5x either
+      way), so noise can't thrash the cluster;
+    - ``cooldown_steps`` after each resize;
+    - hard [min_size, max_size] clamp.
+
+    Use with an optimizer chain containing
+    ``optimizers.gradient_noise_scale`` (any nesting), e.g.::
+
+        factory = lambda n: kfopt.gradient_noise_scale(
+            kfopt.synchronous_sgd(optax.sgd(0.1)),
+            batch_size=PER_LANE * n)
+        trainer = ElasticTrainer(loss, factory, params)
+        PolicyRunner([GNSScalingPolicy(PER_LANE, max_size=8)],
+                     trainer, ...).run(...)
+    """
+
+    def __init__(self, per_lane_batch: int, min_size: int = 1,
+                 max_size: Optional[int] = None, check_every: int = 10,
+                 warmup_steps: int = 20, cooldown_steps: int = 50,
+                 deadband: float = 1.5):
+        if per_lane_batch <= 0:
+            raise ValueError("per_lane_batch must be positive")
+        if deadband < 1.0:
+            raise ValueError("deadband is a ratio >= 1.0")
+        if max_size is not None and min_size > max_size:
+            raise ValueError(f"min_size {min_size} > max_size {max_size}")
+        self.per_lane_batch = per_lane_batch
+        self.min_size = min_size
+        self.max_size = max_size
+        self.check_every = max(1, check_every)
+        self.warmup_steps = warmup_steps
+        self.cooldown_steps = cooldown_steps
+        self.deadband = deadband
+        self._last_resize_step: Optional[int] = None
+        self.history: List[tuple] = []   # (step, gns, proposed or None)
+
+    def _wanted(self, gns: float, ctx) -> Optional[int]:
+        import numpy as np
+        caps = [self.max_size]
+        # never propose beyond what the trainer itself can install
+        caps.append(getattr(ctx.trainer, "max_size", None))
+        real = [c for c in caps if c is not None]
+        if not real:
+            import jax
+            real = [len(jax.devices())]
+        cap = min(real)
+        if cap < self.min_size:      # floor unsatisfiable on this host
+            return None
+        want = int(np.clip(round(gns / self.per_lane_batch),
+                           self.min_size, cap))
+        return max(1, want)
+
+    def after_step(self, ctx):
+        if ctx.step < self.warmup_steps or ctx.step % self.check_every:
+            return
+        if (self._last_resize_step is not None
+                and ctx.step - self._last_resize_step < self.cooldown_steps):
+            return
+        trainer = ctx.trainer
+        ns = find_noise_scale(getattr(trainer, "opt_state", None))
+        if ns is None:
+            return
+        gns = float(ns.reshape(-1)[0])
+        if not (gns > 0):            # estimator not settled (or NaN)
+            self.history.append((ctx.step, gns, None))
+            return
+        want = self._wanted(gns, ctx)
+        if want is None:
+            self.history.append((ctx.step, gns, None))
+            return
+        cur = ctx.cluster_size
+        if want != cur and (want >= cur * self.deadband
+                            or want <= cur / self.deadband):
+            self.history.append((ctx.step, gns, want))
+            self._last_resize_step = ctx.step
+            ctx.resize(want)
+        else:
+            self.history.append((ctx.step, gns, None))
