@@ -28,7 +28,8 @@ import kungfu_tpu.optimizers as kfopt
 from kungfu_tpu.elastic.policy import GNSScalingPolicy, PolicyRunner
 from kungfu_tpu.elastic.trainer import ElasticTrainer
 
-PER_LANE = 16
+PER_LANE = 8   # small per-lane batch: the critical batch (GNS) exceeds
+               # it by several x on this noisy task, so scaling out pays
 
 
 def main():
@@ -40,9 +41,11 @@ def main():
         return jnp.mean((bx @ p["w"] - by) ** 2)
 
     def factory(n):
+        # batch_size is the monitor's B_small = the PER-LANE batch; it
+        # derives B_big = n * B_small from the mesh itself
         return kfopt.gradient_noise_scale(
             kfopt.synchronous_sgd(optax.sgd(0.05)),
-            batch_size=PER_LANE * n)
+            batch_size=PER_LANE)
 
     n0 = min(2, len(jax.devices()))
     tr = ElasticTrainer(loss, factory,
@@ -52,7 +55,7 @@ def main():
     def batch_fn(trainer):
         n = trainer.n * PER_LANE
         bx = jnp.asarray(rng.randn(n, 32), jnp.float32)
-        noise = 2.0 * jnp.asarray(rng.randn(n, 8), jnp.float32)
+        noise = 4.0 * jnp.asarray(rng.randn(n, 8), jnp.float32)
         return bx, bx @ W + noise
 
     pol = GNSScalingPolicy(PER_LANE, min_size=1,

@@ -145,11 +145,14 @@ class GNSScalingPolicy(BasePolicy):
     - hard [min_size, max_size] clamp.
 
     Use with an optimizer chain containing
-    ``optimizers.gradient_noise_scale`` (any nesting), e.g.::
+    ``optimizers.gradient_noise_scale`` (any nesting).  NOTE the
+    monitor's ``batch_size`` is the PER-LANE batch (its B_small; it
+    derives B_big = n * B_small itself) — the same number this policy
+    takes::
 
         factory = lambda n: kfopt.gradient_noise_scale(
             kfopt.synchronous_sgd(optax.sgd(0.1)),
-            batch_size=PER_LANE * n)
+            batch_size=PER_LANE)
         trainer = ElasticTrainer(loss, factory, params)
         PolicyRunner([GNSScalingPolicy(PER_LANE, max_size=8)],
                      trainer, ...).run(...)
@@ -175,23 +178,19 @@ class GNSScalingPolicy(BasePolicy):
         self._last_resize_step: Optional[int] = None
         self.history: List[tuple] = []   # (step, gns, proposed or None)
 
-    def _wanted(self, gns: float, ctx) -> Optional[int]:
-        import numpy as np
-        caps = [self.max_size]
-        # never propose beyond what the trainer itself can install
-        caps.append(getattr(ctx.trainer, "max_size", None))
+    def _cap(self, ctx) -> Optional[int]:
+        caps = [self.max_size,
+                # never propose beyond what the trainer can install
+                getattr(ctx.trainer, "max_size", None)]
         real = [c for c in caps if c is not None]
         if not real:
             import jax
             real = [len(jax.devices())]
         cap = min(real)
-        if cap < self.min_size:      # floor unsatisfiable on this host
-            return None
-        want = int(np.clip(round(gns / self.per_lane_batch),
-                           self.min_size, cap))
-        return max(1, want)
+        return None if cap < self.min_size else cap
 
     def after_step(self, ctx):
+        import numpy as np
         if ctx.step < self.warmup_steps or ctx.step % self.check_every:
             return
         if (self._last_resize_step is not None
@@ -202,16 +201,18 @@ class GNSScalingPolicy(BasePolicy):
         if ns is None:
             return
         gns = float(ns.reshape(-1)[0])
-        if not (gns > 0):            # estimator not settled (or NaN)
-            self.history.append((ctx.step, gns, None))
-            return
-        want = self._wanted(gns, ctx)
-        if want is None:
-            self.history.append((ctx.step, gns, None))
+        cap = self._cap(ctx)
+        if not (gns > 0) or cap is None:   # estimator unsettled/NaN, or
+            self.history.append((ctx.step, gns, None))  # floor > capacity
             return
         cur = ctx.cluster_size
-        if want != cur and (want >= cur * self.deadband
-                            or want <= cur / self.deadband):
+        # deadband on the RAW demand, clamp after: a huge GNS must still
+        # reach max_size from a nearby size (clamping first would make
+        # the band test want-vs-cur and saturate below the cap forever)
+        raw = max(1, round(gns / self.per_lane_batch))
+        want = int(np.clip(raw, self.min_size, cap))
+        if want != cur and (raw >= cur * self.deadband
+                            or raw <= cur / self.deadband):
             self.history.append((ctx.step, gns, want))
             self._last_resize_step = ctx.step
             ctx.resize(want)

@@ -13,8 +13,11 @@ mitigations stack:
    grown worker pays deserialisation, not XLA compilation.
 
 Call :func:`enable_compile_cache` once per process before the first jit
-(idempotent).  ``KFT_COMPILE_CACHE`` overrides the location; setting it
-to ``0``/``off`` disables the wiring entirely.
+(idempotent).  Default-on for accelerator backends; on CPU it requires
+an explicit opt-in (the ``path`` argument or ``KFT_COMPILE_CACHE``)
+because XLA:CPU AOT blobs log a harmless-but-alarming loader error on
+every cached load.  ``KFT_COMPILE_CACHE`` overrides the location;
+``0``/``off`` disables the wiring entirely.
 """
 from __future__ import annotations
 
@@ -60,7 +63,9 @@ def enable_compile_cache(path: Optional[str] = None,
     ``~/.cache/kungfu_tpu/xla``) — blobs are partitioned per host type
     because XLA:CPU AOT code baked for one machine's ISA can SIGILL on
     another.  Returns the directory in use (the subdirectory, not the
-    base), or None when disabled via the env toggle.
+    base), or None when disabled — via the env toggle, or because the
+    backend is CPU and neither ``path`` nor ``KFT_COMPILE_CACHE`` asked
+    for it explicitly (see the module docstring).
 
     The default threshold (0: cache every program) is right for elastic
     training, where even sub-second step compiles add up across a fleet
@@ -71,6 +76,17 @@ def enable_compile_cache(path: Optional[str] = None,
     if env in ("0", "off", "none", "disable"):
         return None
     import jax
+    # Default the cache to accelerator backends only.  XLA:CPU AOT blobs
+    # record pseudo machine features (+prefer-no-scatter/gather) that the
+    # loader's host-feature probe never reports, so EVERY cached-program
+    # load on CPU logs a scary (harmless) cpu_aot_loader "SIGILL" error —
+    # even on the very host that wrote the blob.  On TPU (where a resize
+    # recompile costs seconds and the loader is quiet) the cache stays
+    # on by default; on CPU it needs an explicit opt-in via the argument
+    # or KFT_COMPILE_CACHE.
+    explicit = path is not None or CACHE_ENV in os.environ
+    if not explicit and jax.default_backend() == "cpu":
+        return None
     # respect a cache the user already configured (jax env var or
     # jax.config) — this helper provides a default, never an override
     existing = (jax.config.jax_compilation_cache_dir

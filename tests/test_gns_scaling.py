@@ -83,6 +83,18 @@ def test_policy_deadband_blocks_thrash():
     assert ctx2._requested_size == 8
 
 
+def test_deadband_on_raw_demand_still_reaches_cap():
+    """A huge GNS must reach max_size from a nearby size: the deadband
+    tests the raw demand, not the clamped proposal (clamp-then-band
+    would saturate at 6/8 forever)."""
+    tr = _FakeTrainer(6, 50 * 64.0)          # raw demand: 50 lanes
+    pol = GNSScalingPolicy(per_lane_batch=64, max_size=8, check_every=1,
+                           warmup_steps=0, cooldown_steps=0)
+    ctx = _ctx(tr, 10)
+    pol.after_step(ctx)
+    assert ctx._requested_size == 8          # clamped, but not blocked
+
+
 def test_find_noise_scale_through_dict_states():
     """multi_transform-style dict-valued states are traversed too."""
     state = {"outer": ({"inner": kfopt.NoiseScaleState(
@@ -131,9 +143,10 @@ def test_policy_closes_loop_on_live_trainer(devices):
         return jnp.mean((bx @ p["w"] - by) ** 2)
 
     def factory(n):
+        # batch_size = the per-lane batch (the monitor's B_small)
         return kfopt.gradient_noise_scale(
             kfopt.synchronous_sgd(optax.sgd(0.05)),
-            batch_size=per_lane * n)
+            batch_size=per_lane)
 
     tr = ElasticTrainer(loss, factory,
                         init_params={"w": jnp.zeros((16, 4))},
