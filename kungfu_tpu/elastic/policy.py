@@ -208,10 +208,14 @@ class GNSScalingPolicy(BasePolicy):
         cur = ctx.cluster_size
         # deadband on the RAW demand, clamp after: a huge GNS must still
         # reach max_size from a nearby size (clamping first would make
-        # the band test want-vs-cur and saturate below the cap forever)
+        # the band test want-vs-cur and saturate below the cap forever).
+        # A cluster OUTSIDE the [min_size, cap] bounds is always pulled
+        # back in — bounds are hard, the deadband only damps noise.
         raw = max(1, round(gns / self.per_lane_batch))
         want = int(np.clip(raw, self.min_size, cap))
-        if want != cur and (raw >= cur * self.deadband
+        out_of_bounds = cur < self.min_size or cur > cap
+        if want != cur and (out_of_bounds
+                            or raw >= cur * self.deadband
                             or raw <= cur / self.deadband):
             self.history.append((ctx.step, gns, want))
             self._last_resize_step = ctx.step

@@ -76,6 +76,12 @@ def enable_compile_cache(path: Optional[str] = None,
     if env in ("0", "off", "none", "disable"):
         return None
     import jax
+    # respect a cache the user already configured (jax env var or
+    # jax.config) — this helper provides a default, never an override
+    existing = (jax.config.jax_compilation_cache_dir
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    if path is None and CACHE_ENV not in os.environ and existing:
+        return existing
     # Default the cache to accelerator backends only.  XLA:CPU AOT blobs
     # record pseudo machine features (+prefer-no-scatter/gather) that the
     # loader's host-feature probe never reports, so EVERY cached-program
@@ -87,12 +93,6 @@ def enable_compile_cache(path: Optional[str] = None,
     explicit = path is not None or CACHE_ENV in os.environ
     if not explicit and jax.default_backend() == "cpu":
         return None
-    # respect a cache the user already configured (jax env var or
-    # jax.config) — this helper provides a default, never an override
-    existing = (jax.config.jax_compilation_cache_dir
-                or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
-    if path is None and CACHE_ENV not in os.environ and existing:
-        return existing
     base_dir = path or os.environ.get(CACHE_ENV) or _DEFAULT_DIR
     cache_dir = os.path.join(base_dir, "host-" + _host_fingerprint())
     os.makedirs(cache_dir, exist_ok=True)

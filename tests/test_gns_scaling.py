@@ -95,6 +95,19 @@ def test_deadband_on_raw_demand_still_reaches_cap():
     assert ctx._requested_size == 8          # clamped, but not blocked
 
 
+def test_bounds_override_deadband():
+    """A cluster outside [min_size, cap] is pulled back in even when the
+    raw demand sits inside the deadband (bounds are hard; the band only
+    damps noise)."""
+    tr = _FakeTrainer(4, 5 * 64.0)           # raw demand 5: within band
+    pol = GNSScalingPolicy(per_lane_batch=64, min_size=6, max_size=8,
+                           check_every=1, warmup_steps=0,
+                           cooldown_steps=0)
+    ctx = _ctx(tr, 10)
+    pol.after_step(ctx)
+    assert ctx._requested_size == 6          # raised to the floor
+
+
 def test_find_noise_scale_through_dict_states():
     """multi_transform-style dict-valued states are traversed too."""
     state = {"outer": ({"inner": kfopt.NoiseScaleState(
