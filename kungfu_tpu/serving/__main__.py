@@ -58,6 +58,10 @@ def main(argv=None):
                     help="tensor-parallel ranks (0 = single device); "
                          "shards params + KV pools over the first N "
                          "local devices")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="speculative decoding with up to K prompt-"
+                         "lookup drafts per dispatch (lossless for "
+                         "greedy; see docs/serving.md for when it pays)")
     args = ap.parse_args(argv)
 
     dtype = (jnp.bfloat16 if jax.devices()[0].platform == "tpu"
@@ -91,7 +95,7 @@ def main(argv=None):
                        prompt_buckets=buckets, decode_chunk=args.chunk,
                        max_len=args.max_len,
                        kv_dtype=jnp.int8 if args.kv_int8 else None,
-                       mesh=mesh)
+                       mesh=mesh, speculative=args.speculative)
     srv = ServingServer(eng, host=args.host, port=args.port).start()
     # handlers BEFORE the readiness line: a supervisor reacting to it
     # may signal immediately, and that must reach graceful shutdown

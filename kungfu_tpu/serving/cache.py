@@ -178,6 +178,69 @@ def pool_write_prompt_batch(pool, table_rows, kkv, vkv, t_real,
     return {"k": w(pool["k"], kkv), "v": w(pool["v"], vkv)}
 
 
+def pool_write_at(pool, tables, qpos, kkv, vkv, block_size: int):
+    """Scatter Q tokens per slot at ABSOLUTE positions ``qpos`` [S, Q]
+    (the speculative-verify write: current token + K drafts land in one
+    scatter).  ``kkv``/``vkv`` [S, Q, kv_heads, Dh].  Positions whose
+    table entry is 0 (unallocated / inactive slot) route to scratch via
+    the zeroed tables — and positions past the table's width (padding
+    queries of a near-max_len slot) are routed to scratch explicitly:
+    clamping them into the last column would overwrite LIVE cache."""
+    limit = tables.shape[1] * block_size
+    safe = jnp.minimum(qpos, limit - 1)
+    blk = jnp.where(qpos < limit,
+                    jnp.take_along_axis(tables, safe // block_size,
+                                        axis=1), 0)
+    off = safe % block_size
+    flat = lambda t: t.reshape((-1,) + t.shape[2:])
+    return pool_write_token(pool, blk.reshape(-1), off.reshape(-1),
+                            flat(kkv), flat(vkv))
+
+
+def pool_attend_queries(q, pool, tables, qpos, *, mode: str = "auto"):
+    """Multi-query attend for the speculative verify: ``q``
+    [S, Q, H, Dh], query ``(s, j)`` attends keys at positions
+    ``<= qpos[s, j]``.
+
+    Gather path sweeps/materialises the cache ONCE for all Q queries
+    (the point of speculative decoding: Q queries cost barely more than
+    one on the bandwidth side) and applies a per-query causal mask.
+    The fused Pallas kernel is single-query, so that path loops Q
+    kernel calls — correct, but it re-DMAs the pool per query; a
+    multi-query kernel is the known follow-up."""
+    S, Q = q.shape[0], q.shape[1]
+    if mode == "auto":
+        mode = "fused" if jax.default_backend() == "tpu" else "gather"
+    if mode == "fused":
+        from ..ops.paged_attention import paged_attention
+        outs = [paged_attention(q[:, j], pool["k"], pool["v"], tables,
+                                qpos[:, j], k_scale=pool.get("ks"),
+                                v_scale=pool.get("vs"))[:, None]
+                for j in range(Q)]
+        return jnp.concatenate(outs, axis=1)
+    if mode != "gather":
+        raise ValueError(f"unknown paged attend mode {mode!r}")
+    from ..ops.flash_attention import _expand_kv_heads
+    groups = q.shape[2] // pool["k"].shape[2]
+    kc = paged_gather(pool["k"], tables)
+    vc = paged_gather(pool["v"], tables)
+    if "ks" in pool:
+        kc = dequantize_kv(kc, paged_gather_scales(pool["ks"], tables),
+                           q.dtype)
+        vc = dequantize_kv(vc, paged_gather_scales(pool["vs"], tables),
+                           q.dtype)
+    kc = _expand_kv_heads(kc, groups)
+    vc = _expand_kv_heads(vc, groups)
+    L = kc.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    mask = (jnp.arange(L)[None, None, :] <= qpos[:, :, None])[:, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)
+
+
 def pool_attend(q, pool, tables, pos, *, mode: str = "auto"):
     """THE attend dispatcher: one place picks fused-vs-gather and
     handles both cache layouts (model-dtype ``{"k","v"}`` and int8

@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
 from .cache import (init_paged_pools, lookup_blocks, pool_attend,
+                    pool_attend_queries, pool_write_at,
                     pool_write_prompt_batch, pool_write_token)
 
 
@@ -73,11 +74,14 @@ class EngineStats:
     def reset(self):
         """Zero the counters (e.g. after a warm-up run); keeps the slot
         count the occupancy metric divides by."""
-        self.decode_steps = 0
+        self.decode_steps = 0        # position budget (K or Q per go)
+        self.dispatches = 0          # device programs launched (decode)
         self.slot_steps = 0          # sum over steps of active slots
         self.tokens_out = 0          # tokens DELIVERED (preempted work
         self.prefills = 0            # is subtracted when discarded)
         self.preemptions = 0
+        self.spec_proposed = 0       # speculative: drafted tokens sent
+        self.spec_accepted = 0       # ...and verified == model argmax
         self.wall_s = 0.0
 
     @property
@@ -86,14 +90,20 @@ class EngineStats:
         return self.slot_steps / tot if tot else 0.0
 
     def summary(self):
-        return {"tokens_out": self.tokens_out,
-                "decode_steps": self.decode_steps,
-                "prefills": self.prefills,
-                "preemptions": self.preemptions,
-                "occupancy": round(self.occupancy, 3),
-                "wall_s": round(self.wall_s, 3),
-                "tok_per_s": round(self.tokens_out / self.wall_s, 1)
-                if self.wall_s else 0.0}
+        out = {"tokens_out": self.tokens_out,
+               "decode_steps": self.decode_steps,
+               "prefills": self.prefills,
+               "preemptions": self.preemptions,
+               "occupancy": round(self.occupancy, 3),
+               "wall_s": round(self.wall_s, 3),
+               "tok_per_s": round(self.tokens_out / self.wall_s, 1)
+               if self.wall_s else 0.0}
+        if self.spec_proposed:
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
+            out["spec_accept_rate"] = round(
+                self.spec_accepted / self.spec_proposed, 3)
+        return out
 
 
 def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
@@ -207,6 +217,86 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
     return jax.jit(sm, donate_argnums=(1,))
 
 
+def _make_verify(cfg: GPTConfig, block_size: int, K: int,
+                 attend_mode: str = "auto", mesh=None,
+                 tp_axis: str = "tp", quant: bool = False):
+    """Speculative-decoding verify step: feed every slot its current
+    token PLUS ``K`` drafted continuations (Q = K+1 query positions) in
+    ONE forward, return the model's prediction at each position.
+
+    Decode attention is HBM-bandwidth-bound: sweeping the cache once for
+    Q queries costs barely more than for one, so drafted tokens that
+    match the model's own argmax are verified almost for free — greedy
+    speculative decoding is LOSSLESS (the emitted stream is exactly the
+    sequential argmax stream, whatever the drafts were; only throughput
+    changes with draft quality).
+
+    Rejected positions leave stale K/V in the pool; that is safe by
+    construction: a query at position p only attends keys <= p, and
+    every position <= the next step's highest used query is re-written
+    by that step before its attends run."""
+    Q = K + 1
+
+    def verify(params, pools, tables, pos, draft, uid_lo, uid_hi,
+               tcount, temp, tp_axis_=None):
+        qpos = pos[:, None] + jnp.arange(Q)[None, :]      # [S, Q]
+        x = G.embed(params, draft, qpos, cfg)             # [S, Q, D]
+        new_pools = []
+        for layer, pool in zip(params["layers"], pools):
+            q, kk, v = G._layer_qkv(layer, x, cfg, pos=qpos)
+            pool = pool_write_at(pool, tables, qpos, kk, v, block_size)
+            new_pools.append(pool)
+            # one cache sweep for all Q queries (per-query causal mask)
+            o = pool_attend_queries(q, pool, tables, qpos,
+                                    mode=attend_mode)     # [S, Q, H, Dh]
+            x = G._layer_finish(layer, x, o, cfg, tp_axis_)
+        x = G.rms_norm(x, params["lnf"])
+        logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
+                            params["lm_head"])            # [S, Q, V]
+        if tp_axis_ is not None:
+            logits = lax.all_gather(logits, tp_axis_, axis=2, tiled=True)
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # position 0 honors the per-request sampling discipline (spec
+        # drafts are greedy-only; sampled slots run with dlen = 0, so
+        # only their column 0 is ever consumed)
+        preds = preds.at[:, 0].set(
+            _pick_tokens(logits[:, 0], uid_lo, uid_hi, tcount, temp))
+        if tp_axis_ is not None:
+            preds = lax.pmax(preds, tp_axis_)  # identity: proves replication
+        return preds, new_pools                           # preds [S, Q]
+
+    if mesh is None:
+        return jax.jit(verify, donate_argnums=(1,))
+    specs = G.param_specs(cfg, tp_axis)
+    rep = P()
+    body = functools.partial(verify, tp_axis_=tp_axis)
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
+                  rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
+    return jax.jit(sm, donate_argnums=(1,))
+
+
+def _propose_draft(history, K: int, ngram: int = 2):
+    """Prompt-lookup drafting: find the most recent earlier occurrence
+    of the trailing ``ngram`` tokens in ``history`` and propose the K
+    tokens that followed it.  Returns [] when no match — the verify
+    step then just decodes one token (never worse than plain decode).
+    Pure host-side; the model never sees a draft it didn't verify."""
+    n = len(history)
+    if n < ngram + 1:
+        return []
+    tail = history[-ngram:]
+    # search backward, excluding the trailing occurrence itself
+    for start in range(n - ngram - 1, -1, -1):
+        if history[start:start + ngram] == tail:
+            nxt = history[start + ngram:start + ngram + K]
+            if nxt:
+                return list(nxt)
+    return []
+
+
 def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
                   mesh=None, tp_axis: str = "tp", quant: bool = False):
     """Bucketed dense prefill for a GROUP of requests in one device
@@ -284,6 +374,14 @@ class DecodeEngine:
     bytes of bf16 — so ~2x the cached tokens per HBM byte and half the
     bandwidth the decode attend sweeps — at a small accuracy cost.
     Quantization is deterministic, so preemption replay stays exact.
+    ``speculative=K`` switches the decode loop to speculative decoding
+    with prompt-lookup drafting: each dispatch verifies the current
+    token + up to K drafted continuations in one bandwidth-bound pass
+    and emits the matching prefix + the model's own next token — up to
+    K+1 tokens per dispatch, **lossless for greedy** (the stream equals
+    sequential argmax whatever the drafts), and sampled requests fall
+    back to 1-token steps with the usual key discipline.  Replaces
+    ``decode_chunk`` (drafts come from the host between dispatches).
     """
 
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
@@ -292,7 +390,7 @@ class DecodeEngine:
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
                  prefill_group: Optional[int] = None, on_tokens=None,
                  attend: str = "auto", mesh=None, tp_axis: str = "tp",
-                 kv_dtype=None):
+                 kv_dtype=None, speculative: int = 0):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
@@ -349,8 +447,14 @@ class DecodeEngine:
         self._results: Dict[int, List[int]] = {}
         self.K = max(1, decode_chunk)
         self.G = max(1, min(prefill_group or min(num_slots, 8), num_slots))
-        self._decode = _make_decode_chunk(cfg, block_size, self.K, attend,
-                                          mesh, tp_axis, quant)
+        self.spec = max(0, int(speculative))
+        if self.spec:
+            self._verify = _make_verify(cfg, block_size, self.spec,
+                                        attend, mesh, tp_axis, quant)
+        else:
+            self._decode = _make_decode_chunk(cfg, block_size, self.K,
+                                              attend, mesh, tp_axis,
+                                              quant)
         self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
                                       tp_axis, quant)
         self.stats = EngineStats(num_slots)
@@ -549,18 +653,23 @@ class DecodeEngine:
         self.stats.preemptions += 1
         return True
 
-    def _ensure_blocks(self) -> None:
+    def _ensure_blocks(self, horizons=None) -> None:
         """Every active slot is about to write its next
         ``min(K, remaining)`` positions; make sure the blocks holding
         them exist, preempting if the pool is dry.  In-chunk steps past
         ``remaining`` deliberately get no blocks: their writes fall
         through the zeroed table entries to scratch and their tokens are
-        discarded at harvest."""
+        discarded at harvest.  ``horizons`` (speculative mode) overrides
+        the per-slot position count: the current token + accepted-prefix
+        keys every USED verify query reads must be in real blocks."""
         for slot in list(self._admit_order):
             run = self._running[slot]
             if run is None:
                 continue
-            horizon = min(self.K, run.req.max_new - len(run.out))
+            if horizons is not None:
+                horizon = horizons.get(slot, 1)
+            else:
+                horizon = min(self.K, run.req.max_new - len(run.out))
             bi = (int(self._pos[slot]) + horizon - 1) // self.bs
             while self._running[slot] is run and bi >= len(run.blocks):
                 got = self._alloc(1)
@@ -573,10 +682,83 @@ class DecodeEngine:
                         "— increase num_blocks")
 
     # -------------------------------------------------------------- run
+    def _step_speculative(self) -> bool:
+        """Speculative tick: draft via prompt-lookup, one verify
+        dispatch checks every slot's current token + drafts, accept the
+        matching prefix + the model's own next token.  Greedy streams
+        are EXACTLY the sequential argmax streams (lossless); sampled
+        slots draft nothing and behave as 1-token steps with the usual
+        key discipline."""
+        self._admit()
+        # draft BEFORE ensuring blocks: each slot's block horizon is its
+        # accepted-prefix-reachable positions (dlen + 1)
+        drafts: Dict[int, List[int]] = {}
+        horizons: Dict[int, int] = {}
+        for slot in range(self.S):
+            run = self._running[slot]
+            if run is None:
+                continue
+            rem = run.req.max_new - len(run.out)
+            if run.req.temperature > 0 or rem <= 1:
+                drafts[slot] = []
+            else:
+                hist = list(run.req.prompt) + run.out
+                drafts[slot] = _propose_draft(hist, min(self.spec,
+                                                        rem - 1))
+            horizons[slot] = len(drafts[slot]) + 1
+        self._ensure_blocks(horizons)
+        active = [s for s in range(self.S) if self._running[s] is not None]
+        if not active:
+            return bool(self._queue)
+        Q = self.spec + 1
+        draft = np.zeros((self.S, Q), np.int32)
+        dlen = np.zeros(self.S, np.int32)
+        for slot in active:
+            d = drafts.get(slot, [])
+            draft[slot, 0] = self._tok[slot]
+            draft[slot, 1:1 + len(d)] = d
+            dlen[slot] = len(d)
+        preds, self.pools = self._verify(
+            self.params, self.pools, jnp.asarray(self._tables),
+            jnp.asarray(self._pos), jnp.asarray(draft),
+            jnp.asarray(self._uid_lo), jnp.asarray(self._uid_hi),
+            jnp.asarray(self._tcount), jnp.asarray(self._temp))
+        preds = np.asarray(preds)                    # [S, Q] — ONE sync
+        # a verify dispatch budgets Q positions per slot (occupancy then
+        # reads emitted/(Q*slots), comparable with chunk mode's K)
+        self.stats.decode_steps += Q
+        self.stats.dispatches += 1
+        for slot in active:
+            run = self._running[slot]
+            # longest drafted prefix matching the model's own predictions
+            a = 0
+            while a < dlen[slot] and draft[slot, a + 1] == preds[slot, a]:
+                a += 1
+            self.stats.spec_proposed += int(dlen[slot])
+            self.stats.spec_accepted += a
+            emitted = [int(t) for t in draft[slot, 1:1 + a]] \
+                + [int(preds[slot, a])]
+            for j, tok in enumerate(emitted):
+                run.out.append(tok)
+                self.stats.tokens_out += 1
+                self.stats.slot_steps += 1
+                if self._finished(run):
+                    self._harvest(slot)
+                    break
+            else:
+                self._emit(run)
+                n_new = len(emitted)
+                self._pos[slot] += n_new
+                self._tok[slot] = emitted[-1]
+                self._tcount[slot] += n_new
+        return True
+
     def step(self) -> bool:
         """One scheduler tick: admit, guarantee memory, ONE device
         program decoding ``K`` tokens for every active slot, harvest.
         Returns False when idle."""
+        if self.spec:
+            return self._step_speculative()
         self._admit()
         self._ensure_blocks()
         active = [s for s in range(self.S) if self._running[s] is not None]
@@ -589,6 +771,7 @@ class DecodeEngine:
             jnp.asarray(self._tcount), jnp.asarray(self._temp))
         toks = np.asarray(toks)                      # [K, S] — ONE sync
         self.stats.decode_steps += self.K
+        self.stats.dispatches += 1
         for slot in active:
             run = self._running[slot]
             for j in range(self.K):

@@ -1,0 +1,181 @@
+"""Speculative decoding (prompt-lookup drafts + one-dispatch verify).
+
+The load-bearing property: greedy speculative decoding is LOSSLESS —
+whatever the drafts, the emitted stream equals the sequential argmax
+stream — so every test is an exact-equality oracle check, plus
+acceptance accounting on draft-friendly inputs."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.serving import DecodeEngine, Request
+from kungfu_tpu.serving.engine import _propose_draft
+
+CFG = G.GPTConfig(vocab_size=97, d_model=16, n_heads=4, n_kv_heads=2,
+                  n_layers=2, d_ff=32, max_seq=96, rope=True,
+                  dtype=jnp.float32)
+
+
+def _params(seed=0, cfg=CFG):
+    return G.init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _solo(params, prompt, n_new, cfg=CFG):
+    out = G.generate(params, cfg, jnp.asarray([prompt], jnp.int32), n_new)
+    return np.asarray(out)[0].tolist()
+
+
+# ------------------------------------------------------------- drafting
+def test_propose_draft_finds_repeats():
+    hist = [5, 6, 7, 8, 9, 5, 6]
+    assert _propose_draft(hist, 3) == [7, 8, 9]     # bigram (5,6) recurs
+    assert _propose_draft([1, 2, 3], 3) == []       # no repeat
+    assert _propose_draft([4], 3) == []             # too short
+
+
+def test_propose_draft_most_recent_match_wins():
+    hist = [1, 2, 9, 1, 2, 8, 1, 2]
+    assert _propose_draft(hist, 2) == [8, 1]        # the later (1,2)
+
+
+# ------------------------------------------------------------- losslessness
+@pytest.mark.parametrize("K", [1, 3])
+def test_spec_engine_matches_oracle_random_prompts(K):
+    """Random prompts (drafts rarely hit): exact oracle equality and no
+    corruption from rejected-draft stale KV."""
+    params = _params(1)
+    rng = np.random.RandomState(2)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 97,
+                                       int(rng.randint(2, 14))).tolist(),
+                    max_new=int(rng.randint(1, 9)))
+            for i in range(7)]
+    eng = DecodeEngine(params, CFG, num_slots=3, block_size=4,
+                       num_blocks=64, prompt_buckets=(8, 16),
+                       speculative=K)
+    res = eng.run(list(reqs))
+    for r in reqs:
+        assert res[r.uid] == _solo(params, r.prompt, r.max_new), r.uid
+
+
+def test_spec_engine_accepts_on_repetitive_prompt():
+    """A looping prompt makes prompt-lookup drafts land: exact oracle
+    equality AND a positive acceptance rate in fewer dispatches than
+    tokens emitted."""
+    params = _params(3)
+    base = [11, 22, 33, 44]
+    prompt = base * 6                      # strongly periodic history
+    n_new = 16
+    eng = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                       num_blocks=64, prompt_buckets=(32,),
+                       speculative=3)
+    res = eng.run([Request(uid=1, prompt=prompt, max_new=n_new)])
+    assert res[1] == _solo(params, prompt, n_new)
+    s = eng.stats
+    assert s.spec_proposed > 0
+    # dispatches strictly fewer than tokens would need at 1/dispatch
+    # iff anything was accepted; with a periodic model-free draft the
+    # model may or may not continue the pattern — so only require the
+    # accounting to be consistent
+    assert 0 <= s.spec_accepted <= s.spec_proposed
+
+
+def test_spec_engine_forced_acceptance():
+    """Make acceptance certain: draft from the model's OWN continuation
+    (prompt = its previous greedy output), so prompt-lookup proposes
+    exactly what the model will emit whenever the generated stream
+    repeats the prompt's tail pattern.  Uses a near-deterministic
+    scenario: generation continues a sequence the model has already
+    produced once inside the prompt."""
+    params = _params(4)
+    seed_prompt = [7, 8, 9]
+    cont = _solo(params, seed_prompt, 10)
+    # prompt = seed + model's continuation + seed again: the model's
+    # next tokens tend to re-walk its continuation, which prompt-lookup
+    # proposes verbatim
+    prompt = seed_prompt + cont + seed_prompt
+    n_new = 8
+    eng = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                       num_blocks=96, prompt_buckets=(32,),
+                       speculative=3)
+    res = eng.run([Request(uid=1, prompt=prompt, max_new=n_new)])
+    assert res[1] == _solo(params, prompt, n_new)
+    assert eng.stats.spec_accepted > 0, eng.stats.summary()
+    assert eng.stats.dispatches < n_new   # spec actually saved dispatches
+
+
+def test_spec_with_sampled_request_and_churn():
+    """A sampled request inside a speculative engine behaves exactly as
+    in the plain engine (drafts greedy-only; key discipline intact),
+    and slot churn with more requests than slots stays oracle-exact."""
+    params = _params(5)
+    rng = np.random.RandomState(6)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 97,
+                                       int(rng.randint(2, 10))).tolist(),
+                    max_new=int(rng.randint(2, 7)))
+            for i in range(6)]
+    reqs[2] = Request(uid=reqs[2].uid, prompt=reqs[2].prompt,
+                      max_new=reqs[2].max_new, temperature=0.8)
+    kw = dict(num_slots=2, block_size=4, num_blocks=64,
+              prompt_buckets=(8, 16))
+    spec = DecodeEngine(params, CFG, speculative=3, **kw).run(list(reqs))
+    plain = DecodeEngine(params, CFG, **kw).run(list(reqs))
+    assert spec == plain
+
+
+def test_spec_with_int8_cache_deterministic():
+    """Speculative + int8 cache: runs, deterministic across repeats,
+    and equal to the int8 non-speculative engine (same quantized-cache
+    argmax stream — spec must not change WHAT is computed)."""
+    params = _params(7)
+    rng = np.random.RandomState(8)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 97,
+                                       int(rng.randint(2, 10))).tolist(),
+                    max_new=5)
+            for i in range(4)]
+    kw = dict(num_slots=2, block_size=4, num_blocks=64,
+              prompt_buckets=(8, 16), kv_dtype=jnp.int8)
+    a = DecodeEngine(params, CFG, speculative=2, **kw).run(list(reqs))
+    b = DecodeEngine(params, CFG, speculative=2, **kw).run(list(reqs))
+    c = DecodeEngine(params, CFG, **kw).run(list(reqs))
+    assert a == b == c
+
+
+def test_spec_padding_queries_never_clobber_live_cache():
+    """A request whose prompt+max_new fills its table exactly: the
+    verify step's padding query positions spill past the table width
+    and must route to scratch, not clamp into the last real block
+    (clamping overwrote live KV and broke losslessness)."""
+    cfg = G.GPTConfig(vocab_size=97, d_model=16, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=32, max_seq=24, rope=True,
+                      dtype=jnp.float32)
+    params = G.init_params(jax.random.PRNGKey(11), cfg)
+    prompt = list(range(1, 13))              # 12 tokens
+    n_new = 12                               # 12+12 = max_len exactly
+    eng = DecodeEngine(params, cfg, num_slots=2, block_size=4,
+                       num_blocks=32, prompt_buckets=(16,),
+                       max_len=24, speculative=4)
+    res = eng.run([Request(uid=1, prompt=prompt, max_new=n_new)])
+    assert res[1] == _solo(params, prompt, n_new, cfg)
+
+
+def test_spec_with_preemption_replay():
+    """Tight pool forces preemption mid-speculation; replay must stay
+    exact (drafting is deterministic, so replays are too)."""
+    params = _params(9)
+    rng = np.random.RandomState(10)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 97,
+                                       int(rng.randint(4, 12))).tolist(),
+                    max_new=8)
+            for i in range(4)]
+    eng = DecodeEngine(params, CFG, num_slots=3, block_size=4,
+                       num_blocks=14,           # tight: forces preemption
+                       prompt_buckets=(8, 16), speculative=3)
+    res = eng.run(list(reqs))
+    for r in reqs:
+        assert res[r.uid] == _solo(params, r.prompt, r.max_new), r.uid
