@@ -19,16 +19,30 @@ nothing is materialised, and the GQA expansion never happens: the G
 query heads of group ``h`` attend to the *compact* KV head ``h``
 directly ([G, Dh] x [Dh, bs] on the MXU per head per block).
 
+**Multi-query (speculative verify)**: the same sweep serves Q query
+positions per slot — query ``j`` attends keys ``<= pos[s] + j`` via a
+per-row offset in the causal mask — so verifying K drafted tokens
+costs ONE pool sweep, the property speculative decoding banks on.
+Correctness of the online softmax for rows whose first blocks are
+fully masked: block 0 always has position 0 visible to every query
+(``pos >= 0``), so every row's running max is finite after the first
+processed block and later fully-masked rows contribute exp(-inf)=0.
+
+**int8 pools** (``k_scale``/``v_scale``): per-(token, head) scales ride
+as two more scalar-prefetch-indexed operands and dequantization happens
+in VMEM — the HBM sweep is half the bf16 pool's bytes.
+
 Grid ``(slots, max_blocks)``, block index innermost so the accumulators
 live across the sweep (same convention as ops/flash_attention.py).  All
-operand blocks keep their trailing two dims full — q/out ``(G, Dh)``,
-pool ``(kv_heads, Dh)`` — satisfying the TPU (8, 128) tiling rule by
-the full-dim escape hatch; the per-head ``[bs, Dh]`` slice happens on
-the VMEM ref inside the kernel.  Blocks past a slot's length are
-skipped compute-wise (``pl.when``); their table entries are 0, so the
-prefetch pipeline re-reads the scratch block — bounded waste of one
-block's bandwidth per slot tail step, vs. the gather path's full
-``max_blocks`` materialisation for every slot regardless of length.
+operand blocks keep their trailing two dims full — q/out ``(Q*G, Dh)``,
+pool ``(kv_heads, Dh)``, scales ``(bs, kv_heads)`` — satisfying the TPU
+(8, 128) tiling rule by the full-dim escape hatch; the per-head
+``[bs, Dh]`` slice happens on the VMEM ref inside the kernel.  Blocks
+past a slot's reach are skipped compute-wise (``pl.when``); their table
+entries are 0, so the prefetch pipeline re-reads the scratch block —
+bounded waste of one block's bandwidth per slot tail step, vs. the
+gather path's full ``max_blocks`` materialisation for every slot
+regardless of length.
 
 Reference parity note: the reference framework (Young768/KungFu) has no
 inference path at all — this extends the flagship family's serving
@@ -49,14 +63,15 @@ _LANES = 128
 
 
 def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
-               block_size, n_blocks, kv_heads, groups, scale, precision,
-               quant):
+               block_size, n_blocks, kv_heads, groups, n_queries, scale,
+               precision, quant):
     if quant:
         ks_ref, vs_ref, o_ref, acc, m, l = rest
     else:
         o_ref, acc, m, l = rest
     s_i = pl.program_id(0)
     b = pl.program_id(1)
+    R = n_queries * groups          # rows per KV head
 
     @pl.when(b == 0)
     def _init():
@@ -66,14 +81,18 @@ def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
 
     p_slot = pos_ref[s_i]
 
-    # a block contributes iff its first position is <= the slot's depth
-    @pl.when(b * block_size <= p_slot)
+    # a block contributes iff its first position is <= the DEEPEST
+    # query's reach (query j attends <= p_slot + j)
+    @pl.when(b * block_size <= p_slot + n_queries - 1)
     def _attend():
         kpos = b * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (groups, block_size), 1)
+            jnp.int32, (R, block_size), 1)
+        qoff = jax.lax.broadcasted_iota(
+            jnp.int32, (R, block_size), 0) // groups
+        visible = kpos <= p_slot + qoff
         for h in range(kv_heads):
-            rows = slice(h * groups, (h + 1) * groups)
-            q = q_ref[0, h, :, :]                   # [G, Dh] model dtype
+            rows = slice(h * R, (h + 1) * R)
+            q = q_ref[0, h, :, :]                   # [R, Dh] model dtype
             k = k_ref[0, :, h, :]                   # [bs, Dh]
             v = v_ref[0, :, h, :]
             if quant:
@@ -87,15 +106,15 @@ def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=precision) * scale
-            s = jnp.where(kpos <= p_slot, s, NEG_INF)
+            s = jnp.where(visible, s, NEG_INF)
             m_prev = m[rows, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_new)
             corr = jnp.exp(m_prev - m_new)
             l[rows, :] = jnp.broadcast_to(
                 corr * l[rows, :1] + jnp.sum(p, axis=1, keepdims=True),
-                (groups, l.shape[1]))
-            m[rows, :] = jnp.broadcast_to(m_new, (groups, m.shape[1]))
+                (R, l.shape[1]))
+            m[rows, :] = jnp.broadcast_to(m_new, (R, m.shape[1]))
             acc[rows, :] = acc[rows, :] * corr + jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -104,9 +123,63 @@ def _pa_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
     @pl.when(b == n_blocks - 1)
     def _finish():
         lsafe = jnp.maximum(l[:, :1], 1e-30)
-        out = acc[...] / lsafe                      # [H, Dh]
+        out = acc[...] / lsafe                      # [KVH*R, Dh]
         o_ref[0, :, :, :] = out.reshape(
-            kv_heads, groups, out.shape[-1]).astype(o_ref.dtype)
+            kv_heads, R, out.shape[-1]).astype(o_ref.dtype)
+
+
+def _run_kernel(qg, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                n_queries, interpret):
+    """Shared pallas_call: ``qg`` [S, KVH, Q*G, Dh] pre-grouped."""
+    S, KVH, R, Dh = qg.shape
+    N, bs, _, _ = k_pool.shape
+    MB = tables.shape[1]
+    quant = k_scale is not None
+    groups = R // n_queries
+    # bf16 feeds the MXU natively; f32 models ask for the full-precision
+    # multi-pass so the kernel matches the portable path to ~1e-6 (the
+    # default TPU f32 matmul truncates to bf16 passes: measured 4e-3 off
+    # a f64 oracle vs 1e-6 for the XLA gather path)
+    precision = (jax.lax.Precision.HIGHEST if qg.dtype == jnp.float32
+                 else None)
+    kernel = functools.partial(
+        _pa_kernel, block_size=bs, n_blocks=MB, kv_heads=KVH,
+        groups=groups, n_queries=n_queries, scale=1.0 / np.sqrt(Dh),
+        precision=precision, quant=quant)
+    pool_spec = pl.BlockSpec((1, bs, KVH, Dh),
+                             lambda s, b, tbl, ps: (tbl[s, b], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, KVH, R, Dh), lambda s, b, tbl, ps: (s, 0, 0, 0)),
+        pool_spec,
+        pool_spec,
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        scale_spec = pl.BlockSpec((1, bs, KVH),
+                                  lambda s, b, tbl, ps: (tbl[s, b], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, KVH, R, Dh),
+                               lambda s, b, tbl, ps: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH * R, Dh), jnp.float32),
+            pltpu.VMEM((KVH * R, _LANES), jnp.float32),
+            pltpu.VMEM((KVH * R, _LANES), jnp.float32),
+        ],
+    )
+    # carry q's varying-axis type so the kernel composes with shard_map's
+    # check_vma (tensor-parallel serving: pools/q hold tp-head shards)
+    from .flash_attention import _sds
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_sds((S, KVH, R, Dh), qg.dtype, qg),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *operands)
 
 
 def paged_attention(q, k_pool, v_pool, tables, pos, *, k_scale=None,
@@ -129,58 +202,41 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, k_scale=None,
     """
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale or neither")
-    quant = k_scale is not None
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     S, H, Dh = q.shape
-    N, bs, KVH, _ = k_pool.shape
-    MB = tables.shape[1]
+    KVH = k_pool.shape[2]
+    if H % KVH:
+        raise ValueError(f"n_heads {H} not a multiple of kv_heads {KVH}")
+    qg = q.reshape(S, KVH, H // KVH, Dh)
+    out = _run_kernel(qg, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                      n_queries=1, interpret=interpret)
+    return out.reshape(S, H, Dh)
+
+
+def paged_attention_queries(q, k_pool, v_pool, tables, pos, *,
+                            k_scale=None, v_scale=None, interpret=None):
+    """Multi-query decode attention: ``q`` [S, Q, H, Dh]; query ``j``
+    of slot ``s`` attends keys at positions ``<= pos[s] + j`` (the
+    speculative-verify layout: current token + K drafts at consecutive
+    positions).  ONE pool sweep serves all Q queries.
+
+    Returns [S, Q, H, Dh] in q's dtype.
+    """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    S, Q, H, Dh = q.shape
+    KVH = k_pool.shape[2]
     if H % KVH:
         raise ValueError(f"n_heads {H} not a multiple of kv_heads {KVH}")
     G = H // KVH
-    qg = q.reshape(S, KVH, G, Dh)
-    # bf16 feeds the MXU natively; f32 models ask for the full-precision
-    # multi-pass so the kernel matches the portable path to ~1e-6 (the
-    # default TPU f32 matmul truncates to bf16 passes: measured 4e-3 off
-    # a f64 oracle vs 1e-6 for the XLA gather path)
-    precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
-                 else None)
-    kernel = functools.partial(_pa_kernel, block_size=bs, n_blocks=MB,
-                               kv_heads=KVH, groups=G,
-                               scale=1.0 / np.sqrt(Dh), precision=precision,
-                               quant=quant)
-    pool_spec = pl.BlockSpec((1, bs, KVH, Dh),
-                             lambda s, b, tbl, ps: (tbl[s, b], 0, 0, 0))
-    in_specs = [
-        pl.BlockSpec((1, KVH, G, Dh), lambda s, b, tbl, ps: (s, 0, 0, 0)),
-        pool_spec,
-        pool_spec,
-    ]
-    operands = [qg, k_pool, v_pool]
-    if quant:
-        scale_spec = pl.BlockSpec((1, bs, KVH),
-                                  lambda s, b, tbl, ps: (tbl[s, b], 0, 0))
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scale, v_scale]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(S, MB),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, KVH, G, Dh),
-                               lambda s, b, tbl, ps: (s, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, Dh), jnp.float32),
-            pltpu.VMEM((H, _LANES), jnp.float32),
-            pltpu.VMEM((H, _LANES), jnp.float32),
-        ],
-    )
-    # carry q's varying-axis type so the kernel composes with shard_map's
-    # check_vma (tensor-parallel serving: pools/q hold tp-head shards)
-    from .flash_attention import _sds
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=_sds((S, KVH, G, Dh), q.dtype, q),
-        interpret=interpret,
-    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *operands)
-    return out.reshape(S, H, Dh)
+    # rows per KV head ordered (query j, group g) — row r = j*G + g,
+    # matching the kernel's qoff = r // G
+    qg = jnp.transpose(q.reshape(S, Q, KVH, G, Dh),
+                       (0, 2, 1, 3, 4)).reshape(S, KVH, Q * G, Dh)
+    out = _run_kernel(qg, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                      n_queries=Q, interpret=interpret)
+    return jnp.transpose(out.reshape(S, KVH, Q, G, Dh),
+                         (0, 2, 1, 3, 4)).reshape(S, Q, H, Dh)

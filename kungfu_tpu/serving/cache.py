@@ -202,24 +202,40 @@ def pool_attend_queries(q, pool, tables, qpos, *, mode: str = "auto"):
     [S, Q, H, Dh], query ``(s, j)`` attends keys at positions
     ``<= qpos[s, j]``.
 
-    Gather path sweeps/materialises the cache ONCE for all Q queries
-    (the point of speculative decoding: Q queries cost barely more than
-    one on the bandwidth side) and applies a per-query causal mask.
-    The fused Pallas kernel is single-query, so that path loops Q
-    kernel calls — correct, but it re-DMAs the pool per query; a
-    multi-query kernel is the known follow-up."""
+    Both paths sweep the cache ONCE for all Q queries — the point of
+    speculative decoding: Q queries cost barely more than one on the
+    bandwidth side.  The fused path is the multi-query Pallas kernel
+    (per-row position offsets in the causal mask); the gather path
+    materialises once and applies a per-query mask.
+
+    ``qpos`` must be ``pos[:, None] + arange(Q)`` — consecutive
+    positions per slot (the kernel takes the base and derives offsets).
+    """
     S, Q = q.shape[0], q.shape[1]
     if mode == "auto":
         mode = "fused" if jax.default_backend() == "tpu" else "gather"
     if mode == "fused":
-        from ..ops.paged_attention import paged_attention
-        outs = [paged_attention(q[:, j], pool["k"], pool["v"], tables,
-                                qpos[:, j], k_scale=pool.get("ks"),
-                                v_scale=pool.get("vs"))[:, None]
-                for j in range(Q)]
-        return jnp.concatenate(outs, axis=1)
+        from ..ops.paged_attention import paged_attention_queries
+        return paged_attention_queries(
+            q, pool["k"], pool["v"], tables, qpos[:, 0],
+            k_scale=pool.get("ks"), v_scale=pool.get("vs"))
     if mode != "gather":
         raise ValueError(f"unknown paged attend mode {mode!r}")
+    kc, vc = _materialize(pool, tables, q)
+    L = kc.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    mask = (jnp.arange(L)[None, None, :] <= qpos[:, :, None])[:, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      vc.astype(jnp.float32)).astype(q.dtype)
+
+
+def _materialize(pool, tables, q):
+    """The gather path's shared front half: the logical (gathered,
+    dequantized, GQA-expanded) K/V views for both cache layouts — ONE
+    implementation for the single- and multi-query oracles."""
     from ..ops.flash_attention import _expand_kv_heads
     groups = q.shape[2] // pool["k"].shape[2]
     kc = paged_gather(pool["k"], tables)
@@ -229,16 +245,7 @@ def pool_attend_queries(q, pool, tables, qpos, *, mode: str = "auto"):
                            q.dtype)
         vc = dequantize_kv(vc, paged_gather_scales(pool["vs"], tables),
                            q.dtype)
-    kc = _expand_kv_heads(kc, groups)
-    vc = _expand_kv_heads(vc, groups)
-    L = kc.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
-    mask = (jnp.arange(L)[None, None, :] <= qpos[:, :, None])[:, None]
-    s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p,
-                      vc.astype(jnp.float32)).astype(q.dtype)
+    return _expand_kv_heads(kc, groups), _expand_kv_heads(vc, groups)
 
 
 def pool_attend(q, pool, tables, pos, *, mode: str = "auto"):
@@ -254,7 +261,6 @@ def pool_attend(q, pool, tables, pos, *, mode: str = "auto"):
     many steps, and other backends can't lower the TPU grid spec (the
     kernel itself is oracle-checked in tests/test_paged_attention.py).
     """
-    quant = "ks" in pool
     if mode == "auto":
         mode = "fused" if jax.default_backend() == "tpu" else "gather"
     if mode == "fused":
@@ -264,17 +270,8 @@ def pool_attend(q, pool, tables, pos, *, mode: str = "auto"):
                                v_scale=pool.get("vs"))[:, None]
     if mode != "gather":
         raise ValueError(f"unknown paged attend mode {mode!r}")
-    from ..ops.flash_attention import _expand_kv_heads
-    groups = q.shape[2] // pool["k"].shape[2]
-    kc = paged_gather(pool["k"], tables)
-    vc = paged_gather(pool["v"], tables)
-    if quant:
-        kc = dequantize_kv(kc, paged_gather_scales(pool["ks"], tables),
-                           q.dtype)
-        vc = dequantize_kv(vc, paged_gather_scales(pool["vs"], tables),
-                           q.dtype)
-    return paged_decode_attend(q, _expand_kv_heads(kc, groups),
-                               _expand_kv_heads(vc, groups), pos)
+    kc, vc = _materialize(pool, tables, q)
+    return paged_decode_attend(q, kc, vc, pos)
 
 
 def paged_gather_scales(spool, tables):

@@ -64,6 +64,38 @@ class _Running:
     slot: int
     blocks: List[int]            # pool blocks owned, in logical order
     out: List[int]               # generated tokens so far
+    # speculative drafting: incremental bigram -> most recent STRICTLY
+    # EARLIER position of its second token.  A bigram ending at position
+    # i is only indexed once token i+1 exists, so looking up the
+    # history's tail always returns a previous occurrence — O(1) per
+    # emitted token instead of _propose_draft's O(history) rescan
+    ngrams: Dict[tuple, int] = dataclasses.field(default_factory=dict)
+    indexed_to: int = 0          # history prefix length already indexed
+
+    def history(self) -> List[int]:
+        return list(self.req.prompt) + self.out
+
+    def index_history(self) -> None:
+        """Advance the bigram index to cover history[:-1] (the tail
+        bigram stays unindexed until the next token arrives)."""
+        h = self.history()
+        start = max(self.indexed_to, 2)
+        for i in range(start, len(h)):
+            # token i exists, so bigram ending at i-1 is now "earlier"
+            self.ngrams[(h[i - 2], h[i - 1])] = i - 1
+        self.indexed_to = max(self.indexed_to, len(h))
+
+    def draft(self, K: int) -> List[int]:
+        """Prompt-lookup draft via the incremental index; equivalent to
+        _propose_draft(history, K) (asserted in tests)."""
+        h = self.history()
+        if len(h) < 3 or K <= 0:
+            return []
+        self.index_history()
+        p = self.ngrams.get((h[-2], h[-1]))
+        if p is None:
+            return []
+        return h[p + 1:p + 1 + K]
 
 
 class EngineStats:
@@ -92,6 +124,7 @@ class EngineStats:
     def summary(self):
         out = {"tokens_out": self.tokens_out,
                "decode_steps": self.decode_steps,
+               "dispatches": self.dispatches,
                "prefills": self.prefills,
                "preemptions": self.preemptions,
                "occupancy": round(self.occupancy, 3),
@@ -251,10 +284,11 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
                                     mode=attend_mode)     # [S, Q, H, Dh]
             x = G._layer_finish(layer, x, o, cfg, tp_axis_)
         x = G.rms_norm(x, params["lnf"])
-        logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32),
-                            params["lm_head"])            # [S, Q, V]
-        if tp_axis_ is not None:
-            logits = lax.all_gather(logits, tp_axis_, axis=2, tiled=True)
+        S = x.shape[0]
+        # G.tp_head is the ONE tp-logits implementation (vocab-gather
+        # convention lives there); fold Q into the batch to reuse it
+        logits = G.tp_head(params, x.reshape(S * Q, 1, x.shape[-1]),
+                           tp_axis_).reshape(S, Q, -1)    # [S, Q, V]
         preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # position 0 honors the per-request sampling discipline (spec
         # drafts are greedy-only; sampled slots run with dlen = 0, so
@@ -702,9 +736,7 @@ class DecodeEngine:
             if run.req.temperature > 0 or rem <= 1:
                 drafts[slot] = []
             else:
-                hist = list(run.req.prompt) + run.out
-                drafts[slot] = _propose_draft(hist, min(self.spec,
-                                                        rem - 1))
+                drafts[slot] = run.draft(min(self.spec, rem - 1))
             horizons[slot] = len(drafts[slot]) + 1
         self._ensure_blocks(horizons)
         active = [s for s in range(self.S) if self._running[s] is not None]

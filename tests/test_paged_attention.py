@@ -126,6 +126,47 @@ def test_kernel_int8_matches_gather_dequant():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("Q,H,KVH", [(2, 4, 2), (4, 4, 4), (3, 8, 2)])
+def test_multi_query_kernel_matches_gather(Q, H, KVH):
+    """paged_attention_queries == the multi-query gather oracle for
+    consecutive per-slot positions (the speculative-verify layout)."""
+    from kungfu_tpu.ops.paged_attention import paged_attention_queries
+    from kungfu_tpu.serving.cache import pool_attend_queries
+    rng = np.random.RandomState(11)
+    S, Dh, bs, MB = 4, 16, 8, 4
+    N = S * MB + 1
+    _, kp, vp, tables, pos = _rand_case(rng, S, H, KVH, Dh, N, bs, MB)
+    # keep pos + Q - 1 inside the table reach
+    pos = jnp.minimum(pos, MB * bs - Q)
+    q = jnp.asarray(rng.randn(S, Q, H, Dh), jnp.float32)
+    qpos = pos[:, None] + jnp.arange(Q)[None, :]
+    got = paged_attention_queries(q, kp, vp, tables, pos)
+    want = pool_attend_queries(q, {"k": kp, "v": vp}, tables, qpos,
+                               mode="gather")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_query_kernel_int8():
+    from kungfu_tpu.ops.paged_attention import paged_attention_queries
+    from kungfu_tpu.serving.cache import pool_attend_queries, quantize_kv
+    rng = np.random.RandomState(12)
+    S, Q, H, KVH, Dh, bs, MB = 3, 3, 4, 2, 16, 8, 3
+    N = S * MB + 1
+    _, kp, vp, tables, pos = _rand_case(rng, S, H, KVH, Dh, N, bs, MB)
+    pos = jnp.minimum(pos, MB * bs - Q)
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    q = jnp.asarray(rng.randn(S, Q, H, Dh), jnp.float32)
+    qpos = pos[:, None] + jnp.arange(Q)[None, :]
+    got = paged_attention_queries(q, kq, vq, tables, pos,
+                                  k_scale=ks, v_scale=vs)
+    want = pool_attend_queries(q, {"k": kq, "ks": ks, "v": vq, "vs": vs},
+                               tables, qpos, mode="gather")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_kernel_bf16_runs():
     rng = np.random.RandomState(3)
     S, H, KVH, Dh, bs, MB = 2, 4, 2, 16, 4, 2

@@ -40,6 +40,26 @@ def test_propose_draft_most_recent_match_wins():
     assert _propose_draft(hist, 2) == [8, 1]        # the later (1,2)
 
 
+def test_incremental_index_matches_reference_drafter():
+    """The engine's O(1)-per-token bigram index (_Running.draft) must
+    equal the O(history) reference implementation for every prefix of a
+    random repetitive stream."""
+    from kungfu_tpu.serving.engine import _Running
+    rng = np.random.RandomState(13)
+    stream = rng.randint(0, 5, 60).tolist()          # small vocab: repeats
+    for cut in range(3, 30):
+        prompt, rest = stream[:cut], stream[cut:cut + 20]
+        run = _Running(req=Request(uid=1, prompt=list(prompt),
+                                   max_new=99), slot=0, blocks=[], out=[])
+        for k, tok in enumerate([None] + rest):
+            if tok is not None:
+                run.out.append(tok)
+            hist = run.history()
+            for K in (1, 3):
+                assert run.draft(K) == _propose_draft(hist, K), \
+                    (cut, k, hist)
+
+
 # ------------------------------------------------------------- losslessness
 @pytest.mark.parametrize("K", [1, 3])
 def test_spec_engine_matches_oracle_random_prompts(K):
@@ -161,6 +181,29 @@ def test_spec_padding_queries_never_clobber_live_cache():
                        max_len=24, speculative=4)
     res = eng.run([Request(uid=1, prompt=prompt, max_new=n_new)])
     assert res[1] == _solo(params, prompt, n_new, cfg)
+
+
+def test_spec_with_tensor_parallel(devices):
+    """Speculative verify under shard_map (tp=2): oracle-exact (f32),
+    the gathered-logits head reuse included."""
+    from jax.sharding import Mesh
+    cfg = G.GPTConfig(vocab_size=96, d_model=16, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=32, max_seq=96, rope=True,
+                      dtype=jnp.float32)        # tp-divisible vocab
+    params = _params(12, cfg)
+    rng = np.random.RandomState(13)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, 96,
+                                       int(rng.randint(3, 10))).tolist(),
+                    max_new=5)
+            for i in range(4)]
+    mesh = Mesh(np.asarray(devices[:2]), ("tp",))
+    eng = DecodeEngine(params, cfg, num_slots=2, block_size=4,
+                       num_blocks=64, prompt_buckets=(8, 16),
+                       speculative=3, mesh=mesh)
+    res = eng.run(list(reqs))
+    for r in reqs:
+        assert res[r.uid] == _solo(params, r.prompt, r.max_new, cfg), r.uid
 
 
 def test_spec_with_preemption_replay():
