@@ -23,6 +23,8 @@ setup(
             "kft-config-server = kungfu_tpu.elastic.config_server:main",
             "kft-distribute = kungfu_tpu.launcher.distribute:main",
             "kft-rrun = kungfu_tpu.launcher.rrun:main",
+            # beyond the reference: the serving binary
+            "kft-serve = kungfu_tpu.serving.__main__:main",
         ],
     },
 )
