@@ -40,7 +40,7 @@ PRESETS = {
                   "--chunked-ce", "8192"],
     # -hd128 variants: same d_model/d_ff/params but head_dim 128 —
     # 128-wide heads fill the MXU contraction (ROOFLINE.json: flash fwd
-    # 52.5 vs 29.6 TFLOP/s at hd64), the high-MFU configurations.  KV
+    # 56.1 vs 29.5 TFLOP/s at hd64), the high-MFU configurations.  KV
     # width is unchanged (2x128 = 4x64 bytes), so cache size and param
     # count match the hd64 presets exactly.  Measured (v5e): 164m 51%
     # -> 70% MFU, 164m-long 38% -> 62%, 470m 52% -> 68%
