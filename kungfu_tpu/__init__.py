@@ -54,44 +54,37 @@ def _live_peer():
     return _native.installed_peer()
 
 
-_JAX_COORD_PORT_OFFSET = 1000
-_jax_distributed = False
-
-
 def init_distributed(local_device_ids=None) -> bool:
     """Initialize jax's distributed runtime from the KFT_* env ABI.
 
     Call at the top of a launcher-spawned worker, BEFORE any jax device
     use, to make ``jax.devices()`` span the whole cluster (multi-host TPU).
-    The coordinator defaults to peer 0's host at its worker port +
-    1000 — derived identically by every worker from the shared peer list —
-    or ``KFT_COORDINATOR`` when set.  Singleton mode is a no-op (returns
-    False): on a plain TPU pod VM set, use jax.distributed directly or
-    launch via kft-run.
+    The coordinator is the versioned rendezvous endpoint of
+    :mod:`kungfu_tpu.distributed` (peer 0's worker port + 1000 + cluster
+    version, identical on every worker; ``KFT_COORDINATOR`` overrides at
+    version 0).  Singleton mode is a no-op (returns False): on a plain
+    TPU pod VM set, use jax.distributed directly or launch via kft-run.
+
+    Elastic jobs that must RESIZE this data plane at runtime should use
+    :class:`kungfu_tpu.elastic.DistributedElasticTrainer` (or the
+    :mod:`kungfu_tpu.distributed` primitives directly): a resize is a
+    coordinated ``distributed.reinit`` at the new cluster version.
 
     Reference analogue: the worker-side half of the bootstrap that the Go
     runtime does over its TCP plane (peer.go:87-104 Start + first
     Barrier); here the rendezvous is jax's coordinator service and the
     collectives are XLA's.
     """
-    global _jax_distributed
     we = _worker_env()
     if we.singleton or len(we.peers) <= 1:
         return False
-    if _jax_distributed:
+    from . import distributed as D
+    if D.is_initialized():
         return True
-    import jax
-    coord = we.coordinator
-    if coord is None:
-        p0 = we.peers[0]
-        coord = f"{p0.host}:{p0.port + _JAX_COORD_PORT_OFFSET}"
     if local_device_ids is None and we.chip_ids is not None:
         local_device_ids = we.chip_ids
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=len(we.peers),
-                               process_id=we.rank(),
-                               local_device_ids=local_device_ids)
-    _jax_distributed = True
+    D.initialize(list(we.peers), we.rank(), we.cluster_version,
+                 local_device_ids=local_device_ids)
     return True
 
 

@@ -6,9 +6,11 @@ from .policy import (BasePolicy, PolicyContext, PolicyRunner,
                      ScheduledResizePolicy)
 from .schedule import Stage, StepSchedule
 from .trainer import ElasticTrainer
+from .multiproc import DistributedElasticTrainer
 
 __all__ = [
     "state", "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
+    "DistributedElasticTrainer",
     "BasePolicy", "PolicyContext", "PolicyRunner", "ScheduledResizePolicy",
     "Stage", "StepSchedule", "ElasticDataShard",
 ]

@@ -1,0 +1,299 @@
+"""Elastic training over a LIVE multi-process jax.distributed data plane.
+
+This is the TPU answer to the reference's hardest capability: a resize
+re-forms the data plane across OS processes — every peer rebuilds its
+session at the new cluster version and collectives span the new
+membership (srcs/go/kungfu/peer/peer.go:227-263, runner diff/spawn at
+srcs/go/kungfu/runner/watch.go:64-104).  Here the data plane is XLA
+(one jax process per host, devices spanning the cluster), so a resize is
+
+    drain step -> snapshot state to host -> native host-plane rebuild
+    (resize_from_url: digest consensus, token fencing, detach) ->
+    jax.distributed shutdown + re-init at version v+1 (fresh versioned
+    coordinator, kungfu_tpu.distributed) -> host-plane state broadcast
+    from rank 0 -> mesh + step rebuild -> keep training.
+
+Removed workers see ``detached`` and exit; preempted (killed) workers
+surface as a failed collective on the survivors, who recover through the
+same path (native.recover_from_failure) and REDO the interrupted step
+from the last committed host snapshot.
+
+Single-process-per-job elastic (one controller, lanes = devices) is
+:class:`kungfu_tpu.elastic.ElasticTrainer`; this class is its
+multi-process sibling for real pods.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import distributed as D
+from .. import native
+from ..launcher import env as E
+from . import state as _flags
+from .config_server import fetch_config
+
+
+class DistributedElasticTrainer:
+    """Synchronous data-parallel training whose process membership can
+    change at runtime.
+
+    Per step: (1) a version FENCE over the native host plane — an
+    allreduce-MAX of each process's latest config-server version — so
+    every member agrees whether to step or resize first (the reference
+    fences every cluster change with a consensus round, peer.go:186);
+    (2) the jitted DP step over the global device mesh (params replicated,
+    batch sharded over devices, gradient pmean compiled by XLA); (3) a
+    host snapshot of the new state — the committed point a preemption
+    recovery restarts from.
+
+    ``step()`` expects the GLOBAL batch (identical numpy on every
+    process; jax places each process's addressable shard).  Returns the
+    loss, or None once this worker is detached.
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, init_params,
+                 poll_every: int = 1, recover_timeout: float = 60.0,
+                 snapshot_every: int = 1):
+        import jax
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.poll_every = max(1, int(poll_every))
+        self.recover_timeout = recover_timeout
+        # commit (device->host snapshot) cadence: recovery redoes at most
+        # snapshot_every steps from the last committed state; 1 = commit
+        # every step (full D2H per step — fine for small models, raise it
+        # for large ones)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.we = E.from_env()
+        if self.we.singleton:
+            raise RuntimeError(
+                "DistributedElasticTrainer needs the launcher env ABI "
+                "(KFT_*); for single-process elastic use ElasticTrainer")
+        self.peer = native.default_peer()
+        self.version = self.peer.token
+        self._last_seen_version = self.version
+        D.reinit(self.peer.peers, self.peer.rank, self.version,
+                 local_device_ids=self.we.chip_ids)
+        self.trained_samples = 0
+        self.step_count = 0
+        self._round = 0  # per-version fence round
+        self._host_params = jax.tree_util.tree_map(np.asarray, init_params)
+        # host-side optimizer init so a snapshot exists before any device
+        # state does; new joiners overwrite it via the rank-0 broadcast
+        self._host_opt = jax.tree_util.tree_map(
+            np.asarray, self.optimizer.init(self._host_params))
+        self._committed_progress = (0, 0)
+        self._sync_state()
+        self._build()
+
+    # ------------------------------------------------------------ internals
+    def _sync_state(self) -> None:
+        """Adopt rank 0's committed state AND the progress counters that
+        describe it (reference: state broadcast on every membership
+        change, experimental/hook/elastic.py:62-84).  Counters ride the
+        same broadcast as the state — a MAX of counters could count a
+        step whose update came from a rank that never committed it,
+        silently skipping data; rank 0's (state, counters) pair is
+        always consistent."""
+        self._host_params = D.broadcast_host_tree(
+            self._host_params, self.peer, root=0,
+            name=f"params@{self.version}")
+        self._host_opt = D.broadcast_host_tree(
+            self._host_opt, self.peer, root=0,
+            name=f"opt@{self.version}")
+        if self.peer.size > 1:
+            got = self.peer.broadcast(
+                np.asarray(list(self._committed_progress), np.int64),
+                root=0, name=f"progress@{self.version}")
+            self._committed_progress = (int(got[0]), int(got[1]))
+        self.trained_samples, self.step_count = self._committed_progress
+
+    def _build(self) -> None:
+        """(Re)build mesh + jitted step over the CURRENT global device
+        set and restore device state from the host snapshot."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import (Mesh, NamedSharding,
+                                  PartitionSpec as P)
+        devs = jax.devices()
+        self.mesh = Mesh(np.array(devs), ("dp",))
+        rep = NamedSharding(self.mesh, P())
+        self._params = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, self._host_params), rep)
+        self._opt = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, self._host_opt), rep)
+        loss_fn, opt = self.loss_fn, self.optimizer
+
+        def body(p, s, b):
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            grads = jax.lax.pmean(grads, "dp")
+            loss = jax.lax.pmean(loss, "dp")
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        self._step = jax.jit(jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), P(), P("dp")), out_specs=(P(), P(), P())))
+        self._batch_sharding = NamedSharding(self.mesh, P("dp"))
+
+    def _fetch_version(self) -> int:
+        if not self.we.config_server:
+            return self.version
+        try:
+            v, _ = fetch_config(self.we.config_server, timeout=5.0)
+            return v
+        except Exception:
+            return self._last_seen_version
+
+    def _rebuild_at(self, peer) -> None:
+        self.peer = peer
+        self.version = peer.token
+        self._last_seen_version = max(self._last_seen_version, self.version)
+        # fence rounds restart at every membership version: a freshly
+        # joined worker counts from 0, so survivors must too (collective
+        # names must match across the new membership)
+        self._round = 0
+        D.reinit(peer.peers, peer.rank, peer.token,
+                 local_device_ids=self.we.chip_ids)
+        self._sync_state()
+        self._build()
+
+    def _teardown_plane_ordered(self) -> None:
+        """Take the LIVE data plane down while the old membership is
+        still intact: non-coordinators disconnect first, the coordinator
+        stops its service last — a client whose coordination service
+        vanished mid-disconnect terminates the process (client.h
+        fatal), which would turn a voluntary resize into a crash.  The
+        sequencing rides the native host plane."""
+        if not D.is_initialized():
+            return
+        p = self.peer
+        try:
+            if p is not None and p.size > 1:
+                p.barrier(name=f"plane-down@{self.version}")
+                if p.rank == 0:
+                    # wait until every client has disconnected, then
+                    # stop the coordination service
+                    p.barrier(name=f"plane-drained@{self.version}")
+                    D.shutdown()
+                else:
+                    D.shutdown()
+                    p.barrier(name=f"plane-drained@{self.version}")
+                return
+        except native.NativeError:
+            pass  # a peer died mid-teardown: fall through to force
+        D.shutdown()
+
+    def _commit(self) -> None:
+        """Snapshot device state + the counters describing it to host —
+        the point a recovery or resize restarts from."""
+        import jax
+        self._host_params = jax.tree_util.tree_map(np.asarray, self._params)
+        self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
+        self._committed_progress = (self.trained_samples, self.step_count)
+
+    def _resize(self) -> bool:
+        """Apply a pending config change; False when detached."""
+        # everyone is at the same fence: commit the live device state so
+        # a voluntary resize never discards steps since the last snapshot
+        self._commit()
+        # the old plane comes down FIRST, with everyone still alive —
+        # after resize_from_url the old host membership no longer exists
+        # to sequence the teardown
+        self._teardown_plane_ordered()
+        changed, detach = native.resize_from_url()
+        if detach:
+            return False
+        self._rebuild_at(native.installed_peer())
+        return True
+
+    def _recover(self, batch, cause=None) -> Optional[float]:
+        """A peer died mid-protocol: tear down the data plane, absorb the
+        shrink over the host plane, rebuild, and REDO the interrupted
+        step(s) from the last committed snapshot."""
+        D.shutdown()
+        try:
+            peer = native.recover_from_failure(timeout=self.recover_timeout)
+        except native.NativeError as e:
+            # not a membership event after all: surface the original
+            # failure instead of a bare recovery timeout
+            raise e from cause
+        if peer is None:
+            return None  # this worker was shrunk away
+        self._rebuild_at(peer)
+        return self.step(batch)
+
+    # ---------------------------------------------------------------- public
+    def step(self, global_batch) -> Optional[float]:
+        """One fenced, elastic training step; None once detached."""
+        import jax
+        if _flags.is_detached():
+            return None
+        while True:
+            local = (self._fetch_version()
+                     if self.step_count % self.poll_every == 0
+                     else self._last_seen_version)
+            self._last_seen_version = max(self._last_seen_version, local)
+            try:
+                agreed = int(self.peer.all_reduce(
+                    np.asarray([self._last_seen_version], np.int64),
+                    op="MAX",
+                    name=f"fence@{self.version}:{self._round}")[0])
+            except native.NativeError as e:
+                return self._recover(global_batch, cause=e)
+            self._round += 1
+            self._last_seen_version = max(self._last_seen_version, agreed)
+            if agreed <= self.version:
+                break
+            if not self._resize():
+                return None
+            # re-fence on the NEW membership before stepping: a freshly
+            # joined worker's first fence must pair with everyone's
+        try:
+            batch = jax.device_put(global_batch, self._batch_sharding)
+            params, opt, loss = self._step(self._params, self._opt, batch)
+            lossv = float(np.asarray(loss))  # blocks until the step ran
+        except (native.NativeError, RuntimeError, OSError) as e:
+            # RuntimeError covers XlaRuntimeError (a dead peer inside a
+            # compiled collective); deterministic user errors (shape /
+            # dtype / tracing TypeError|ValueError) propagate instead of
+            # being misread as membership failures
+            if _flags.is_detached():
+                raise
+            return self._recover(global_batch, cause=e)
+        self._params, self._opt = params, opt
+        self.step_count += 1
+        leaf = jax.tree_util.tree_leaves(global_batch)[0]
+        self.trained_samples += int(leaf.shape[0])
+        if self.step_count % self.snapshot_every == 0:
+            self._commit()
+        return lossv
+
+    @property
+    def size(self) -> int:
+        return self.peer.size
+
+    @property
+    def rank(self) -> int:
+        return self.peer.rank
+
+    def num_devices(self) -> int:
+        import jax
+        return len(jax.devices())
+
+    def current_params(self):
+        return self._host_params
+
+    def shutdown(self) -> None:
+        """Ordered end-of-job teardown (all members should call it)."""
+        self._teardown_plane_ordered()
+
+    def propose_new_size(self, n: int) -> bool:
+        """Rank-0 convenience: PUT a resized cluster to the config server
+        (reference ProposeNewSize, peer/legacy.go:18-38); every member
+        picks it up at its next step fence."""
+        import kungfu_tpu as kft
+        return kft.propose_new_size(n)

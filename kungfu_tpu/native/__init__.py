@@ -221,6 +221,13 @@ class NativePeer:
     def token(self) -> int:
         return self._lib.kft_token(self._h)
 
+    @property
+    def peers(self) -> List[str]:
+        """Current membership as ``host:port`` specs, rank-ordered — the
+        live peer list a resize installed (the static KFT_INIT_PEERS env
+        only describes version 0)."""
+        return list(self._peers)
+
     def reset_connections(self, token: int) -> None:
         """Adopt a new cluster version; stale connections are fenced
         (reference: peer.go updateTo / server.SetToken)."""
