@@ -190,7 +190,15 @@ def paged_attention(q, k_pool, v_pool, tables, pos, *, k_scale=None,
     k_pool   [N, bs, KVH, Dh]  block pool (layer's K)
     v_pool   [N, bs, KVH, Dh]
     tables   int32 [S, MB]  per-slot block tables (0 = scratch block)
-    pos      int32 [S]  each slot attends to positions <= pos[s]
+    pos      int32 [S]  each slot attends to positions <= pos[s].
+             PRECONDITION: pos[s] >= 0 for every slot.  The online
+             softmax seeds its running max from the first processed
+             block, which is correct only because position 0 is always
+             visible (pos >= 0); a negative pos would make the first
+             block fully masked and the NEG_INF sentinel rows would
+             average garbage scratch V instead of zeros.  Idle slots
+             must carry pos = 0 and a scratch block table, as
+             serving.cache.PagedKVCache does — not pos = -1.
     k_scale / v_scale  f32 [N, bs, KVH]  per-(token, head) scales for
              the int8 pool layout (both or neither); dequantization is
              fused into the VMEM block processing
@@ -220,6 +228,10 @@ def paged_attention_queries(q, k_pool, v_pool, tables, pos, *,
     of slot ``s`` attends keys at positions ``<= pos[s] + j`` (the
     speculative-verify layout: current token + K drafts at consecutive
     positions).  ONE pool sweep serves all Q queries.
+
+    PRECONDITION: ``pos >= 0`` elementwise (see :func:`paged_attention`
+    — the online softmax relies on the first block never being fully
+    masked, which pos >= 0 guarantees for every query row).
 
     Returns [S, Q, H, Dh] in q's dtype.
     """

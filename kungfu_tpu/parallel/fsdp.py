@@ -121,6 +121,131 @@ def make_fsdp_step(loss_fn: Callable, optimizer, mesh: Mesh,
     return init, make_step
 
 
+def make_fsdp_scan_step(embed_fn: Callable, layer_fn: Callable,
+                        head_loss_fn: Callable, optimizer, mesh: Mesh,
+                        axis: str = FSDP_AXIS, remat: bool = True
+                        ) -> Tuple[Callable, Callable]:
+    """ZeRO-3 with the REAL ZeRO-3 memory profile: per-layer
+    (scan-carried) parameter gather/free.
+
+    ``make_fsdp_step`` all-gathers the whole flat parameter vector
+    before compute, so peak step memory is full params + activations —
+    the memory class ZeRO-3 exists for still does not fit.  This builder
+    takes the model in stacked-layer form and gathers ONE layer inside
+    each ``lax.scan`` iteration; the gathered copy is freed when the
+    iteration ends, and with ``remat`` (default) the backward re-gathers
+    it instead of keeping per-layer residuals.  Peak ≈ parameter shard +
+    one layer's params + activations (asserted against XLA's compiled
+    memory analysis in tests/test_fsdp_scan.py).  The all_gather's
+    adjoint is a reduce-scatter, so gradients arrive sharded without any
+    extra sync — gather/compute overlap and collective placement belong
+    to XLA, which pipelines the next layer's gather under the current
+    layer's matmuls.
+
+    Model contract (embed -> L x layer -> head)::
+
+        embed_fn(embed_params, batch_inputs)          -> activations
+        layer_fn(layer_params, activations)           -> activations
+        head_loss_fn(head_params, activations, batch) -> scalar loss
+
+    ``init(params)`` takes ``{"embed": tree, "layers": stacked tree
+    (leading axis L on every leaf), "head": tree}`` and returns sharded
+    state; the step's batch is data-parallel over the same mesh axis
+    (leading dim), and the trajectory matches the replicated oracle for
+    elementwise optimizers (same caveat for cross-gradient transforms as
+    ``make_zero1_step``).
+    """
+    n = int(mesh.shape[axis])
+
+    def init(params):
+        embed, layers, head = (params["embed"], params["layers"],
+                               params["head"])
+        L = jax.tree_util.tree_leaves(layers)[0].shape[0]
+        one_layer = jax.tree_util.tree_map(lambda t: t[0], layers)
+        lflat0, unravel_layer = ravel_pytree(one_layer)
+        lsize = lflat0.shape[0]
+        lflat = jax.vmap(lambda i: _pad_to(
+            ravel_pytree(jax.tree_util.tree_map(
+                lambda t: t[i], layers))[0], n))(jnp.arange(L))
+        eflat, unravel_embed = ravel_pytree(embed)
+        hflat, unravel_head = ravel_pytree(head)
+        esize, hsize = eflat.shape[0], hflat.shape[0]
+        shards = {
+            "embed": jax.device_put(_pad_to(eflat, n),
+                                    shard_pytree_spec(mesh, axis)),
+            "layers": jax.device_put(
+                lflat, NamedSharding(mesh, P(None, axis))),
+            "head": jax.device_put(_pad_to(hflat, n),
+                                   shard_pytree_spec(mesh, axis)),
+        }
+        pspecs = {"embed": P(axis), "layers": P(None, axis),
+                  "head": P(axis)}
+        # optimizer state over the shard pytree: elementwise transforms
+        # see each leaf's local shard (adam m/v cost 1/n per device)
+        sshapes = jax.eval_shape(
+            optimizer.init,
+            jax.tree_util.tree_map(
+                lambda t: jax.ShapeDtypeStruct(
+                    (t.shape[0], t.shape[1] // n) if t.ndim == 2
+                    else (t.shape[0] // n,), t.dtype), shards))
+        local_shapes = {
+            (lflat.shape[0], lflat.shape[1] // n),
+            (shards["embed"].shape[0] // n,),
+            (shards["head"].shape[0] // n,)}
+        sspecs = jax.tree_util.tree_map(
+            lambda s: (P(None, axis) if getattr(s, "ndim", 0) == 2
+                       and s.shape in local_shapes
+                       else P(axis) if getattr(s, "ndim", 0) == 1
+                       and s.shape in local_shapes
+                       else P()), sshapes)
+        opt_state = jax.jit(jax.shard_map(
+            optimizer.init, mesh=mesh, in_specs=(pspecs,),
+            out_specs=sspecs))(shards)
+        meta = (unravel_embed, unravel_layer, unravel_head,
+                esize, lsize, hsize, pspecs, sspecs)
+        return shards, opt_state, meta
+
+    def make_step(meta):
+        (unravel_embed, unravel_layer, unravel_head,
+         esize, lsize, hsize, pspecs, sspecs) = meta
+
+        def body(shards, opt_state, batch):
+            def layer_step(act, layer_shard):
+                full = lax.all_gather(layer_shard, axis, axis=0,
+                                      tiled=True)
+                return layer_fn(unravel_layer(full[:lsize]), act), None
+
+            if remat:
+                layer_step = jax.checkpoint(layer_step)
+
+            def loss_of(sh):
+                efull = lax.all_gather(sh["embed"], axis, axis=0,
+                                       tiled=True)
+                hfull = lax.all_gather(sh["head"], axis, axis=0,
+                                       tiled=True)
+                act = embed_fn(unravel_embed(efull[:esize]), batch)
+                act, _ = lax.scan(layer_step, act, sh["layers"])
+                return head_loss_fn(unravel_head(hfull[:hsize]), act,
+                                    batch)
+
+            loss, grads = jax.value_and_grad(loss_of)(shards)
+            # the all_gather adjoint already reduce-scattered (summed)
+            # each gradient across devices; divide for the mean
+            grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+            updates, new_opt = optimizer.update(grads, opt_state, shards)
+            new_shards = jax.tree_util.tree_map(
+                lambda p, u: p + u, shards, updates)
+            return new_shards, new_opt, lax.pmean(loss, axis)
+
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, sspecs, P(axis)),
+            out_specs=(pspecs, sspecs, P()))
+        return jax.jit(fn)
+
+    return init, make_step
+
+
 def make_zero1_step(loss_fn: Callable, optimizer, mesh: Mesh,
                     axis: str = FSDP_AXIS
                     ) -> Tuple[Callable, Callable]:

@@ -7,7 +7,7 @@ over ranks with optional self-loops.  A collective strategy is a pair
 graph.
 
 On TPU these graphs are *lowered to schedules of XLA collectives* (see
-kungfu_tpu.comm.graph_collectives) instead of driving a socket transport:
+kungfu_tpu.comm.collectives) instead of driving a socket transport:
 each graph level becomes one `lax.ppermute` round plus an add/select, so any
 reference topology (star, rings, trees) compiles into a single XLA program.
 """

@@ -6,7 +6,7 @@ srcs/go/kungfu/base/strategy.go:10-23.  Each strategy yields one or more
 across the pairs (multi-root strategies spread root load).
 
 On TPU the graphs are compiled to ppermute schedules
-(kungfu_tpu.comm.graph_collectives) or — for the AUTO strategy — replaced
+(kungfu_tpu.comm.collectives) or — for the AUTO strategy — replaced
 entirely by XLA's native AllReduce, which already picks the optimal ICI
 topology.  The generators are retained for parity, for CPU-mesh testing,
 and for DCN-aware hierarchical composition.

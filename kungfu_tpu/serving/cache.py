@@ -209,7 +209,10 @@ def pool_attend_queries(q, pool, tables, qpos, *, mode: str = "auto"):
     materialises once and applies a per-query mask.
 
     ``qpos`` must be ``pos[:, None] + arange(Q)`` — consecutive
-    positions per slot (the kernel takes the base and derives offsets).
+    positions per slot.  BOTH paths honor only the base column
+    ``qpos[:, 0]`` and re-derive the per-query offsets, so a caller
+    violating the contract gets identical (base-derived) results from
+    either backend instead of silently mode-dependent ones.
     """
     S, Q = q.shape[0], q.shape[1]
     if mode == "auto":
@@ -225,6 +228,8 @@ def pool_attend_queries(q, pool, tables, qpos, *, mode: str = "auto"):
     L = kc.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    kc.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    # consecutive-position contract enforced structurally (see above)
+    qpos = qpos[:, :1] + jnp.arange(Q, dtype=qpos.dtype)[None, :]
     mask = (jnp.arange(L)[None, None, :] <= qpos[:, :, None])[:, None]
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)

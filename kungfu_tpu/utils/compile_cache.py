@@ -92,6 +92,12 @@ def enable_compile_cache(path: Optional[str] = None,
     # or KFT_COMPILE_CACHE.
     explicit = path is not None or CACHE_ENV in os.environ
     if not explicit and jax.default_backend() == "cpu":
+        # one-line notice so CPU deployments that previously benefited
+        # from cached recompiles know caching is now opt-in here
+        import logging
+        logging.getLogger(__name__).info(
+            "compile cache: off by default on CPU (set KFT_COMPILE_CACHE "
+            "or pass path= to opt in)")
         return None
     base_dir = path or os.environ.get(CACHE_ENV) or _DEFAULT_DIR
     cache_dir = os.path.join(base_dir, "host-" + _host_fingerprint())

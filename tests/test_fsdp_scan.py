@@ -1,0 +1,207 @@
+"""Layerwise (scan-carried) ZeRO-3: memory profile + trajectory oracle.
+
+Round-3 verdict #3: make_fsdp_step all-gathers the ENTIRE flat parameter
+vector before compute, so peak memory is full params + activations — the
+memory class ZeRO-3 exists for still doesn't fit.  make_fsdp_scan_step
+gathers one layer per scan iteration (freed on exit; remat re-gathers in
+the backward).  These tests assert BOTH halves of the claim:
+
+- trajectory: bit-comparable to the replicated oracle (same model, same
+  data, everything dense) over multiple steps;
+- memory: XLA's compiled memory analysis shows the scan step's temp
+  allocations stay near one layer + activations, far under the
+  monolithic step's full-parameter gather, with the gap growing in L.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.parallel import make_fsdp_scan_step, make_fsdp_step
+from kungfu_tpu.utils.memstats import memory_analysis
+
+D_MODEL = 64
+
+
+def _model_fns():
+    def embed(ep, batch):
+        x, _ = batch
+        return jnp.tanh(x @ ep["w_in"])
+
+    def layer(lp, act):
+        return act + jnp.tanh(act @ lp["w"] + lp["b"])
+
+    def head_loss(hp, act, batch):
+        _, y = batch
+        pred = act @ hp["w_out"]
+        return jnp.mean((pred - y) ** 2)
+
+    return embed, layer, head_loss
+
+
+def _init_params(L, d=D_MODEL, seed=0):
+    rng = np.random.RandomState(seed)
+    f = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32) * 0.1)
+    return {
+        "embed": {"w_in": f(16, d)},
+        "layers": {"w": f(L, d, d), "b": jnp.zeros((L, d))},
+        "head": {"w_out": f(d, 4)},
+    }
+
+
+def _batch(n_rows, seed=1):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(n_rows, 16).astype(np.float32)),
+            jnp.asarray(rng.randn(n_rows, 4).astype(np.float32)))
+
+
+def _replicated_steps(params, batch, n_steps, lr=0.05):
+    """Dense oracle: same model, no sharding anywhere."""
+    embed, layer, head_loss = _model_fns()
+
+    def loss_fn(p):
+        act = embed(p["embed"], batch)
+        act, _ = jax.lax.scan(lambda a, lp: (layer(lp, a), None),
+                              act, p["layers"])
+        return head_loss(p["head"], act, batch)
+
+    opt = optax.adam(lr)
+    state = opt.init(params)
+    losses = []
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    for _ in range(n_steps):
+        params, state, l = step(params, state)
+        losses.append(float(l))
+    return params, losses
+
+
+def test_trajectory_matches_replicated_oracle(devices):
+    mesh = Mesh(np.array(devices), ("fsdp",))
+    L, steps = 4, 5
+    params = _init_params(L)
+    batch = _batch(len(devices) * 2)
+    embed, layer, head_loss = _model_fns()
+    init, make_step = make_fsdp_scan_step(embed, layer, head_loss,
+                                          optax.adam(0.05), mesh)
+    shards, opt_state, meta = init(params)
+    step = make_step(meta)
+    losses = []
+    for _ in range(steps):
+        shards, opt_state, loss = step(shards, opt_state, batch)
+        losses.append(float(np.asarray(loss)))
+
+    want_params, want_losses = _replicated_steps(params, batch, steps)
+    np.testing.assert_allclose(losses, want_losses, rtol=2e-5)
+    # reassemble the final sharded layers and compare to the oracle
+    lflat = np.asarray(shards["layers"])  # [L, padded]
+    one = jax.tree_util.tree_map(lambda t: t[0], params["layers"])
+    from jax.flatten_util import ravel_pytree
+    flat0, unravel = ravel_pytree(one)
+    for i in range(L):
+        got = unravel(jnp.asarray(lflat[i][:flat0.shape[0]]))
+        want = jax.tree_util.tree_map(lambda t: np.asarray(t)[i],
+                                      want_params["layers"])
+        for ga, wa in zip(jax.tree_util.tree_leaves(got),
+                          jax.tree_util.tree_leaves(want)):
+            np.testing.assert_allclose(np.asarray(ga), wa, atol=2e-5)
+
+
+def test_peak_memory_is_one_layer_not_full_params(devices):
+    """The headline claim: temp memory ~ one layer + activations.
+
+    With L layers of d x d weights, the monolithic step's temps include
+    the full gathered parameter vector (~L layer-bytes); the scan step's
+    gathered copy is one layer.  Compare compiled temp bytes at L=16:
+    the scan step must come in far below the monolithic one, and below
+    full-params size."""
+    mesh = Mesh(np.array(devices), ("fsdp",))
+    L = 16
+    params = _init_params(L)
+    batch = _batch(len(devices) * 2)
+    embed, layer, head_loss = _model_fns()
+    layer_bytes = 4 * (D_MODEL * D_MODEL + D_MODEL)
+    full_bytes = L * layer_bytes
+
+    init, make_step = make_fsdp_scan_step(embed, layer, head_loss,
+                                          optax.adam(0.05), mesh)
+    shards, opt_state, meta = init(params)
+    scan_ms = memory_analysis(make_step(meta), shards, opt_state, batch)
+
+    def flat_loss(p, b):
+        act = embed(p["embed"], b)
+        act, _ = jax.lax.scan(lambda a, lp: (layer(lp, a), None),
+                              act, p["layers"])
+        return head_loss(p["head"], act, b)
+
+    finit, fmake = make_fsdp_step(flat_loss, optax.adam(0.05), mesh)
+    fshards, fopt, fmeta = finit(params)
+    flat_ms = memory_analysis(fmake(fmeta), fshards, fopt, batch)
+
+    # monolithic: temps hold the full gathered params (plus grads of
+    # same size); scan: one layer per iteration
+    assert flat_ms.temp_bytes > full_bytes, (
+        f"monolithic temps {flat_ms.temp_bytes} should exceed full "
+        f"params {full_bytes}")
+    assert scan_ms.temp_bytes < flat_ms.temp_bytes / 2, (
+        f"scan temps {scan_ms.temp_bytes} not clearly below monolithic "
+        f"{flat_ms.temp_bytes}")
+    assert scan_ms.temp_bytes < full_bytes, (
+        f"scan temps {scan_ms.temp_bytes} still hold ~full params "
+        f"{full_bytes}")
+
+
+def test_memory_gap_scales_with_depth(devices):
+    """Adding layers must cost the scan step only the per-layer
+    ACTIVATION residuals (inherent to backprop), never the layers'
+    PARAMETER bytes — the gathered parameter copy stays one layer deep.
+    The monolithic step's temps grow by the full layer params."""
+    mesh = Mesh(np.array(devices), ("fsdp",))
+    embed, layer, head_loss = _model_fns()
+    batch = _batch(len(devices) * 2)
+    layer_bytes = 4 * (D_MODEL * D_MODEL + D_MODEL)
+
+    def scan_temps(L):
+        init, make_step = make_fsdp_scan_step(embed, layer, head_loss,
+                                              optax.adam(0.05), mesh)
+        shards, opt_state, meta = init(_init_params(L))
+        return memory_analysis(make_step(meta), shards, opt_state,
+                               batch).temp_bytes
+
+    t8, t32 = scan_temps(8), scan_temps(32)
+    # 24 extra layers: the growth must stay far below 24 full layers of
+    # parameters (activation residuals + scan bookkeeping only) — the
+    # parameter gather itself must not deepen with L
+    growth = t32 - t8
+    assert growth < 2 * layer_bytes, (
+        f"temps grew {growth} bytes over 24 layers — ~{growth / 24:.0f}"
+        f"/layer, vs layer params {layer_bytes}: the per-layer gather "
+        f"is being retained instead of freed")
+
+
+def test_works_without_remat(devices):
+    """remat=False keeps per-layer residuals (more memory) but must stay
+    numerically identical."""
+    mesh = Mesh(np.array(devices), ("fsdp",))
+    params = _init_params(3)
+    batch = _batch(len(devices))
+    embed, layer, head_loss = _model_fns()
+    outs = []
+    for remat in (True, False):
+        init, make_step = make_fsdp_scan_step(embed, layer, head_loss,
+                                              optax.sgd(0.1), mesh,
+                                              remat=remat)
+        shards, opt_state, meta = init(params)
+        step = make_step(meta)
+        shards, opt_state, loss = step(shards, opt_state, batch)
+        outs.append((float(np.asarray(loss)),
+                     np.asarray(shards["layers"])))
+    assert outs[0][0] == pytest.approx(outs[1][0], rel=1e-6)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], atol=1e-6)
