@@ -454,6 +454,23 @@ def _fa_bwd(causal, block_q, block_k, kv_groups, bwd_blocks, res, g):
 _flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
 
 
+def _big_tile_ok() -> bool:
+    """Whether the 16 MiB f32 2048x2048 probability tile is known to fit
+    this target's VMEM.  Measured-good on v5e ("TPU v5 lite") ONLY;
+    every other generation falls back to 1024 until measured (a too-big
+    default would turn a working config into a compile failure —
+    ADVICE r3).  ``KFT_FLASH_BIG_TILE=1/0`` overrides either way."""
+    import os
+    env = os.environ.get("KFT_FLASH_BIG_TILE")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "off", "no")
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return False
+    return "v5 lite" in kind or "v5e" in kind
+
+
 def default_blocks(head_dim: int, seq_len: int):
     """Forward block sizes by (head_dim, seq), measured on v5e:
 
@@ -467,9 +484,11 @@ def default_blocks(head_dim: int, seq_len: int):
       accumulators — overflows VMEM at 2048).  At longer sequences the
       multi-k-block 2048-tile lse-saving forward overflows VMEM
       (measured 24.0M vs the 16M budget at seq 8192), so 1024 stands.
+      Gated on targets where the 16 MiB tile is measured to fit
+      (:func:`_big_tile_ok`; ``KFT_FLASH_BIG_TILE`` overrides).
 
     Shorter sequences fall back via fit_block either way."""
-    if head_dim >= 128 and seq_len <= 2048:
+    if head_dim >= 128 and seq_len <= 2048 and _big_tile_ok():
         return (2048, 2048)
     return (1024, 1024)
 
