@@ -58,6 +58,9 @@ def main(argv=None):
                     help="tensor-parallel ranks (0 = single device); "
                          "shards params + KV pools over the first N "
                          "local devices")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="share prompt-prefix KV blocks across requests "
+                         "(refcounted; suffix-only prefill on a hit)")
     ap.add_argument("--speculative", type=int, default=0, metavar="K",
                     help="speculative decoding with up to K prompt-"
                          "lookup drafts per dispatch (lossless for "
@@ -95,7 +98,8 @@ def main(argv=None):
                        prompt_buckets=buckets, decode_chunk=args.chunk,
                        max_len=args.max_len,
                        kv_dtype=jnp.int8 if args.kv_int8 else None,
-                       mesh=mesh, speculative=args.speculative)
+                       mesh=mesh, speculative=args.speculative,
+                       prefix_cache=args.prefix_cache)
     srv = ServingServer(eng, host=args.host, port=args.port).start()
     # handlers BEFORE the readiness line: a supervisor reacting to it
     # may signal immediately, and that must reach graceful shutdown

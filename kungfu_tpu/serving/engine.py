@@ -56,6 +56,14 @@ class Request:
     # else is in flight, and across preemption replays — unlike a
     # batch-level rng, where scheduling would change the output
     temperature: float = 0.0
+    # top_k > 0: sample only among the k highest logits (ties at the
+    # k-th logit are all kept); top_p < 1: nucleus sampling — the
+    # smallest set of tokens whose cumulative probability reaches p.
+    # Both filters are deterministic functions of the logits, so the
+    # scheduling-invariance of the key discipline carries over intact.
+    # Ignored when temperature == 0 (greedy).
+    top_k: int = 0
+    top_p: float = 1.0
 
 
 @dataclasses.dataclass
@@ -114,6 +122,8 @@ class EngineStats:
         self.preemptions = 0
         self.spec_proposed = 0       # speculative: drafted tokens sent
         self.spec_accepted = 0       # ...and verified == model argmax
+        self.prefix_hits = 0         # admissions served from the cache
+        self.prefix_tokens_reused = 0  # prompt tokens NOT recomputed
         self.wall_s = 0.0
 
     @property
@@ -131,6 +141,9 @@ class EngineStats:
                "wall_s": round(self.wall_s, 3),
                "tok_per_s": round(self.tokens_out / self.wall_s, 1)
                if self.wall_s else 0.0}
+        if self.prefix_hits:
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_tokens_reused"] = self.prefix_tokens_reused
         if self.spec_proposed:
             out["spec_proposed"] = self.spec_proposed
             out["spec_accepted"] = self.spec_accepted
@@ -162,21 +175,42 @@ def _decode_core(params, cfg: GPTConfig, block_size: int, pools, tables,
     return G.tp_head(params, x, tp_axis), new_pools    # [S, V] f32
 
 
-def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp):
+def _filter_logits(lg, k, p):
+    """Top-k / top-p (nucleus) filter for one logits row [V] (f32):
+    tokens outside the filter go to -inf.  ``k <= 0`` and ``p >= 1``
+    disable their halves.  Ties at the k-th logit are all kept; top-p
+    keeps the smallest descending-probability prefix whose cumulative
+    mass reaches p (always at least the argmax).  Pure function of
+    (logits, k, p) — scheduling-invariance is preserved."""
+    V = lg.shape[-1]
+    srt = jnp.sort(lg)[::-1]                        # descending
+    kk = jnp.clip(jnp.where(k <= 0, V, k), 1, V)
+    kth = srt[kk - 1]
+    probs = jax.nn.softmax(srt)
+    cum = jnp.cumsum(probs) - probs                 # exclusive prefix mass
+    n_keep = jnp.sum(cum < p)                       # >= 1 for p > 0
+    pth = srt[jnp.maximum(n_keep - 1, 0)]
+    return jnp.where(lg >= jnp.maximum(kth, pth), lg, -jnp.inf)
+
+
+def _pick_tokens(logits, uid_lo, uid_hi, tcount, temp, top_k, top_p):
     """Greedy or per-slot sampled next token.  The sampling key depends
     ONLY on (request uid — both 32-bit halves — and token index):
-    scheduling-invariant.  The discarded sampling work on greedy slots
-    is [S, V] Gumbel draws — noise next to the [S, V] lm_head matmul
-    that produced the logits, so one executable serves both modes."""
+    scheduling-invariant.  top_k/top_p filter the logits per slot
+    before the draw (deterministically, so the invariance holds).  The
+    discarded sampling work on greedy slots is a [V] sort + Gumbel
+    draws per slot — small next to the [S, V] lm_head matmul that
+    produced the logits, so one executable serves both modes."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    def sample_one(lg, lo, hi, t, tau):
+    def sample_one(lg, lo, hi, t, tau, k, p):
         key = jax.random.fold_in(jax.random.fold_in(jax.random.fold_in(
             jax.random.PRNGKey(0), lo), hi), t)
+        lg = _filter_logits(lg.astype(jnp.float32), k, p)
         return jax.random.categorical(key, lg / jnp.maximum(tau, 1e-6))
 
     sampled = jax.vmap(sample_one)(logits, uid_lo, uid_hi, tcount,
-                                   temp).astype(jnp.int32)
+                                   temp, top_k, top_p).astype(jnp.int32)
     return jnp.where(temp > 0, sampled, greedy)
 
 
@@ -215,7 +249,7 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
     unchanged."""
 
     def run(params, pools, tables, pos, tokens, uid_lo, uid_hi, tcount,
-            temp, tp_axis_=None):
+            temp, top_k, top_p, tp_axis_=None):
         if tp_axis_ is not None:
             # the token carry becomes tp-varying after the first gathered
             # sample; align the initial carry's varying-state with that
@@ -226,7 +260,8 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
             logits, pools = _decode_core(params, cfg, block_size, pools,
                                          tables, pos, tok, attend_mode,
                                          tp_axis_)
-            nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp)
+            nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp,
+                               top_k, top_p)
             return (pools, pos + 1, nxt, tc + 1), nxt
 
         (pools, _, _, _), toks = lax.scan(
@@ -245,7 +280,7 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
     sm = jax.shard_map(
         body, mesh=mesh,
         in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
-                  rep, rep, rep, rep, rep, rep, rep),
+                  rep, rep, rep, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
     return jax.jit(sm, donate_argnums=(1,))
 
@@ -271,7 +306,7 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
     Q = K + 1
 
     def verify(params, pools, tables, pos, draft, uid_lo, uid_hi,
-               tcount, temp, tp_axis_=None):
+               tcount, temp, top_k, top_p, tp_axis_=None):
         qpos = pos[:, None] + jnp.arange(Q)[None, :]      # [S, Q]
         x = G.embed(params, draft, qpos, cfg)             # [S, Q, D]
         new_pools = []
@@ -294,7 +329,8 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
         # drafts are greedy-only; sampled slots run with dlen = 0, so
         # only their column 0 is ever consumed)
         preds = preds.at[:, 0].set(
-            _pick_tokens(logits[:, 0], uid_lo, uid_hi, tcount, temp))
+            _pick_tokens(logits[:, 0], uid_lo, uid_hi, tcount, temp,
+                         top_k, top_p))
         if tp_axis_ is not None:
             preds = lax.pmax(preds, tp_axis_)  # identity: proves replication
         return preds, new_pools                           # preds [S, Q]
@@ -307,7 +343,7 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
     sm = jax.shard_map(
         body, mesh=mesh,
         in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
-                  rep, rep, rep, rep, rep, rep, rep),
+                  rep, rep, rep, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
     return jax.jit(sm, donate_argnums=(1,))
 
@@ -347,7 +383,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
     admitting N requests must not cost N dispatches."""
 
     def prefill(params, pools, table_rows, tokens, t_real, uid_lo,
-                uid_hi, temp, tp_axis_=None):
+                uid_hi, temp, top_k, top_p, tp_axis_=None):
         T = tokens.shape[1]                              # [G, T]
         pos = jnp.arange(T)
         x = G.embed(params, tokens, pos, cfg)            # [G, T, D]
@@ -366,7 +402,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
             x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
         logits = G.tp_head(params, h_last, tp_axis_)     # [G, V]
         tok0 = _pick_tokens(logits, uid_lo, uid_hi,
-                            jnp.zeros_like(uid_lo), temp)
+                            jnp.zeros_like(uid_lo), temp, top_k, top_p)
         if tp_axis_ is not None:
             tok0 = lax.pmax(tok0, tp_axis_)   # identity; proves replication
         return tok0, new_pools
@@ -379,8 +415,68 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
     sm = jax.shard_map(
         body, mesh=mesh,
         in_specs=(specs, _pool_specs(tp_axis, quant, cfg.n_layers),
-                  rep, rep, rep, rep, rep, rep),
+                  rep, rep, rep, rep, rep, rep, rep, rep),
         out_specs=(rep, _pool_specs(tp_axis, quant, cfg.n_layers)))
+    return jax.jit(sm, donate_argnums=(1,))
+
+
+def _make_prefill_cached(cfg: GPTConfig, block_size: int, group: int,
+                         mesh=None, tp_axis: str = "tp"):
+    """Suffix prefill for prefix-cache hits: each row's prompt SUFFIX
+    (positions ``t_cached .. t_cached + t_real - 1``) runs the dense
+    forward; its K/V scatter to the row's own blocks at those absolute
+    positions, and the attend reads the whole cache through the block
+    tables — the shared prefix blocks (written by an earlier request)
+    plus the just-written suffix, one gathered pass per layer.  The
+    compute saved is the whole prefix's QKV/FFN/attention — the point
+    of prefix caching.  Non-quantized pools only: the pool stores K/V
+    in the model dtype, so a cached prefix is bit-identical to a
+    recomputed one (int8 would substitute dequantized values where the
+    uncached prefill attends fresh ones)."""
+
+    def prefill(params, pools, table_rows, tokens, t_real, t_cached,
+                uid_lo, uid_hi, temp, top_k, top_p, tp_axis_=None):
+        T = tokens.shape[1]                              # [G, T] suffixes
+        rel = jnp.arange(T)
+        qpos = t_cached[:, None] + rel[None, :]          # absolute [G, T]
+        x = G.embed(params, tokens, qpos, cfg)
+        limit = table_rows.shape[1] * block_size
+        # pad positions (rel >= t_real) route to scratch — their qpos
+        # points INTO allocated blocks, so an unmasked write would
+        # corrupt live cache with pad garbage
+        wpos = jnp.where(rel[None, :] < t_real[:, None], qpos, limit)
+        new_pools = []
+        for layer, pool in zip(params["layers"], pools):
+            q, kk, v = G._layer_qkv(layer, x, cfg, pos=qpos)
+            pool = pool_write_at(pool, table_rows, wpos, kk, v,
+                                 block_size)
+            new_pools.append(pool)
+            # one gathered sweep serves prefix + fresh suffix (the
+            # suffix was just written); per-query causal mask comes
+            # from the absolute positions
+            o = pool_attend_queries(q, pool, table_rows, qpos,
+                                    mode="gather")
+            x = G._layer_finish(layer, x, o, cfg, tp_axis_)
+        x = G.rms_norm(x, params["lnf"])
+        h_last = jnp.take_along_axis(
+            x, jnp.maximum(t_real - 1, 0)[:, None, None], axis=1)
+        logits = G.tp_head(params, h_last, tp_axis_)     # [G, V]
+        tok0 = _pick_tokens(logits, uid_lo, uid_hi,
+                            jnp.zeros_like(uid_lo), temp, top_k, top_p)
+        if tp_axis_ is not None:
+            tok0 = lax.pmax(tok0, tp_axis_)   # identity; proves replication
+        return tok0, new_pools
+
+    if mesh is None:
+        return jax.jit(prefill, donate_argnums=(1,))
+    specs = G.param_specs(cfg, tp_axis)
+    rep = P()
+    body = functools.partial(prefill, tp_axis_=tp_axis)
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, _pool_specs(tp_axis, False, cfg.n_layers),
+                  rep, rep, rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(rep, _pool_specs(tp_axis, False, cfg.n_layers)))
     return jax.jit(sm, donate_argnums=(1,))
 
 
@@ -416,6 +512,17 @@ class DecodeEngine:
     sequential argmax whatever the drafts), and sampled requests fall
     back to 1-token steps with the usual key discipline.  Replaces
     ``decode_chunk`` (drafts come from the host between dispatches).
+    ``prefix_cache=True`` shares prompt-prefix KV across requests:
+    full blocks are keyed by their token prefix with refcounts; an
+    admission whose prefix is cached prefills only its SUFFIX (the
+    dense compute for the shared prefix is skipped entirely — the win
+    for system-prompt / few-shot workloads), reading the shared blocks
+    through its table.  Unreferenced cached blocks form an LRU the
+    allocator evicts under pressure.  A preempted request pins its
+    prefix split so the replay is numerically identical (streamed
+    tokens never roll back).  Requests admitted in one batched prefill
+    cannot share with each other (entries land after the prefill);
+    model-dtype pools only.
     """
 
     def __init__(self, params, cfg: GPTConfig, *, num_slots: int = 8,
@@ -424,7 +531,8 @@ class DecodeEngine:
                  prompt_buckets=(32, 128, 512), decode_chunk: int = 8,
                  prefill_group: Optional[int] = None, on_tokens=None,
                  attend: str = "auto", mesh=None, tp_axis: str = "tp",
-                 kv_dtype=None, speculative: int = 0):
+                 kv_dtype=None, speculative: int = 0,
+                 prefix_cache: bool = False):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
@@ -461,6 +569,36 @@ class DecodeEngine:
                 self.pools, _pool_specs(tp_axis, quant, cfg.n_layers))
         self._total_blocks = num_blocks - 1      # block 0 is scratch
         self._free = collections.deque(range(1, num_blocks))
+        # ---- prefix cache: refcounted shared prompt blocks ----
+        # a block is in exactly one place: _free (uncached, ref 0),
+        # _reclaim (cached, ref 0 — evictable LRU), or referenced by
+        # >= 1 running slots (ref > 0, possibly cached).  Cache entries
+        # key on the FULL token prefix through that block, so identical
+        # prompt prefixes land on the same physical blocks.
+        if prefix_cache and quant:
+            raise ValueError(
+                "prefix_cache requires the model-dtype pool: the int8 "
+                "cache would substitute dequantized prefix values where "
+                "an uncached prefill attends fresh ones")
+        self.prefix_cache = bool(prefix_cache)
+        self._block_ref = np.zeros(num_blocks, np.int32)
+        self._block_key: Dict[int, tuple] = {}
+        self._prefix_index: Dict[tuple, int] = {}
+        self._reclaim: "collections.OrderedDict[tuple, int]" = \
+            collections.OrderedDict()
+        # per-uid admission split (prompt tokens served from cache) and
+        # the pinned prefix blocks a preempted uid keeps referenced so
+        # its replay re-admits with the SAME split and values —
+        # deterministic replay (streamed tokens never roll back)
+        self._admit_split: Dict[int, int] = {}
+        self._pinned: Dict[int, List[int]] = {}
+        # uids whose pins had to be dropped (all-prefix victim under
+        # extreme pressure): their replay is forced to t_cached=0 so the
+        # split is at least DETERMINISTIC; in bf16 the re-prefilled
+        # stream can still diverge from the cached-split original on
+        # near-tie argmaxes (documented corner: requires prefix_cache +
+        # streaming + a pin-drop preemption)
+        self._force_fresh: set = set()
         self._tables = np.zeros((num_slots, self.max_blocks), np.int32)
         self._pos = np.zeros(num_slots, np.int32)
         self._tok = np.zeros(num_slots, np.int32)
@@ -468,6 +606,8 @@ class DecodeEngine:
         self._uid_hi = np.zeros(num_slots, np.uint32)
         self._tcount = np.zeros(num_slots, np.int32)
         self._temp = np.zeros(num_slots, np.float32)
+        self._topk = np.zeros(num_slots, np.int32)
+        self._topp = np.ones(num_slots, np.float32)
         self._running: List[Optional[_Running]] = [None] * num_slots
         self._queue: "collections.deque[Request]" = collections.deque()
         # streaming: emit each request's tokens as they are produced.
@@ -491,6 +631,9 @@ class DecodeEngine:
                                               quant)
         self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
                                       tp_axis, quant)
+        if self.prefix_cache:
+            self._prefill_cached = _make_prefill_cached(
+                cfg, block_size, self.G, mesh, tp_axis)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin
@@ -511,6 +654,12 @@ class DecodeEngine:
         if len(req.prompt) > self.buckets[-1]:
             raise ValueError(f"request {req.uid}: prompt longer than the "
                              f"largest prefill bucket {self.buckets[-1]}")
+        if not (0.0 < req.top_p <= 1.0):
+            raise ValueError(f"request {req.uid}: top_p must be in "
+                             f"(0, 1], got {req.top_p}")
+        if req.top_k < 0:
+            raise ValueError(f"request {req.uid}: top_k must be >= 0, "
+                             f"got {req.top_k}")
 
     def submit(self, req: Request) -> None:
         self.validate_shape(req)
@@ -528,14 +677,109 @@ class DecodeEngine:
                 return b
         raise AssertionError  # submit() validated
 
-    def _alloc(self, n: int) -> Optional[List[int]]:
-        if len(self._free) < n:
-            return None
-        return [self._free.popleft() for _ in range(n)]
+    def _available(self) -> int:
+        return len(self._free) + len(self._reclaim)
 
-    def _free_slot(self, slot: int) -> None:
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        if self._available() < n:
+            return None
+        while len(self._free) < n:
+            # evict the least-recently-freed cached block (its cache
+            # entry dies; the block itself is reused)
+            key, blk = self._reclaim.popitem(last=False)
+            self._prefix_index.pop(key, None)
+            self._block_key.pop(blk, None)
+            self._free.append(blk)
+        out = [self._free.popleft() for _ in range(n)]
+        for b in out:
+            self._block_ref[b] = 1
+        return out
+
+    def _acquire_shared(self, blk: int) -> None:
+        """Take a reference on a cached block (reviving it from the
+        reclaim list if no running slot holds it)."""
+        if self._block_ref[blk] == 0:
+            key = self._block_key.get(blk)
+            if key is not None:
+                self._reclaim.pop(key, None)
+        self._block_ref[blk] += 1
+
+    def _release_block(self, blk: int) -> None:
+        self._block_ref[blk] -= 1
+        assert self._block_ref[blk] >= 0
+        if self._block_ref[blk] == 0:
+            key = self._block_key.get(blk)
+            if key is not None:
+                self._reclaim[key] = blk     # cached: evictable, LRU
+                self._reclaim.move_to_end(key)
+            else:
+                self._free.append(blk)
+
+    @staticmethod
+    def _chain_keys(prompt, bs, n_blocks):
+        """Chained blake2b digests of the prompt's full blocks: key_j
+        commits to ALL tokens through block j at O(bs) per block (a
+        tuple(prompt[:j*bs]) key would cost O(prefix^2) per probe and
+        hash 100k+ ints per admission at benchmark shapes).  16-byte
+        digests make collisions negligible; a collision would be a
+        correctness bug (wrong KV served), hence a real hash, not
+        Python's."""
+        import hashlib
+        key = b"kft-prefix"
+        for j in range(n_blocks):
+            h = hashlib.blake2b(key, digest_size=16)
+            h.update(np.asarray(prompt[j * bs:(j + 1) * bs],
+                                np.int64).tobytes())
+            key = h.digest()
+            yield key
+
+    def _probe_prefix(self, req: Request):
+        """(shared_blocks, t_cached) for this request under the cache.
+
+        A replayed (previously preempted) uid reuses its pinned split
+        verbatim — same physical prefix blocks, same t_cached — so the
+        re-prefill is numerically identical to the original and the
+        already-streamed tokens stay valid.  Fresh requests probe the
+        longest contiguous run of cached full blocks, capped one token
+        short of the prompt (the prefill needs >= 1 query position to
+        produce the first token)."""
+        if not self.prefix_cache or req.uid in self._force_fresh:
+            return [], 0
+        uid = req.uid
+        if uid in self._pinned:
+            shared = self._pinned[uid]
+            return shared, self._admit_split.get(uid, 0)
+        p = req.prompt
+        shared = []
+        n_full = (len(p) - 1) // self.bs  # cap: >= 1 suffix token
+        for key in self._chain_keys(p, self.bs, n_full):
+            blk = self._prefix_index.get(key)
+            if blk is None:
+                break
+            shared.append(blk)
+        return shared, len(shared) * self.bs
+
+    def _cache_insert(self, req: Request, blocks: List[int]) -> None:
+        """Register this prompt's full blocks in the prefix index (the
+        first sharer's physical blocks win; later identical prompts just
+        keep their own copies uncached)."""
+        if not self.prefix_cache:
+            return
+        p = req.prompt
+        for j, key in enumerate(self._chain_keys(p, self.bs,
+                                                 len(p) // self.bs)):
+            if key in self._prefix_index:
+                continue
+            blk = blocks[j]
+            if blk in self._block_key:   # already caches another key
+                continue
+            self._prefix_index[key] = blk
+            self._block_key[blk] = key
+
+    def _free_slot(self, slot: int, keep: int = 0) -> None:
         run = self._running[slot]
-        self._free.extend(run.blocks)
+        for b in run.blocks[keep:]:
+            self._release_block(b)
         self._running[slot] = None
         self._tables[slot] = 0
         self._pos[slot] = 0
@@ -544,6 +788,8 @@ class DecodeEngine:
         self._uid_hi[slot] = 0
         self._tcount[slot] = 0
         self._temp[slot] = 0.0      # freed slots sample nothing (greedy)
+        self._topk[slot] = 0
+        self._topp[slot] = 1.0
         self._admit_order.remove(slot)
 
     def _admit(self) -> None:
@@ -572,32 +818,48 @@ class DecodeEngine:
             # head is always admitted first, so nothing starves).  With
             # strict same-bucket prefixes, mixed workloads averaged ~2.4
             # requests per prefill dispatch; skipping ahead fills groups
-            bucket = self._bucket(len(self._queue[0].prompt))
-            batch = []                      # (req, slot, blocks)
+            # bucket by the SUFFIX still to compute (the cached prefix
+            # skips the prefill entirely — the point of prefix caching)
+            head_probe = self._probe_prefix(self._queue[0])
+            bucket = self._bucket(len(self._queue[0].prompt)
+                                  - head_probe[1])
+            batch = []          # (req, slot, blocks, t_cached)
             picked = []                     # queue indices admitted
             for qi, req in enumerate(self._queue):
                 if len(batch) >= self.G:
                     break
-                t_real = len(req.prompt)
-                if self._bucket(t_real) != bucket:
+                shared, t_cached = (head_probe if qi == 0
+                                    else self._probe_prefix(req))
+                t_suffix = len(req.prompt) - t_cached
+                if self._bucket(t_suffix) != bucket:
                     continue
-                taken = {s for _, s, _ in batch}
+                taken = {s for _, s, *_ in batch}
                 slot = next((i for i in range(self.S)
                              if self._running[i] is None
                              and i not in taken), None)
                 if slot is None:
                     break
-                need = -(-t_real // self.bs)
+                need = -(-len(req.prompt) // self.bs) - len(shared)
                 # +1 growth headroom: admitting with only exactly the
                 # prompt's blocks free would preempt (and waste the
                 # prefill) within block_size decode steps under pressure
-                if len(self._free) < need + 1 and (self._admit_order
-                                                   or batch):
+                if self._available() < need + 1 and (self._admit_order
+                                                     or batch):
                     break
-                blocks = self._alloc(need)
-                if blocks is None:
+                pinned = req.uid in self._pinned
+                if not pinned:
+                    # take refs BEFORE _alloc: an eviction inside the
+                    # alloc must not reclaim a block we are about to use
+                    for b in shared:
+                        self._acquire_shared(b)
+                own = self._alloc(need)
+                if own is None:
+                    if not pinned:
+                        for b in shared:
+                            self._release_block(b)
                     break
-                batch.append((req, slot, blocks))
+                self._pinned.pop(req.uid, None)
+                batch.append((req, slot, shared + own, t_cached))
                 picked.append(qi)
             if not batch:
                 return
@@ -607,24 +869,49 @@ class DecodeEngine:
             toks = np.zeros((self.G, Tb), np.int32)
             rows = np.zeros((self.G, self.max_blocks), np.int32)
             t_reals = np.zeros(self.G, np.int32)
+            t_cacheds = np.zeros(self.G, np.int32)
             uid_lo = np.zeros(self.G, np.uint32)
             uid_hi = np.zeros(self.G, np.uint32)
             temps = np.zeros(self.G, np.float32)
-            for g, (req, slot, blocks) in enumerate(batch):
-                toks[g, :len(req.prompt)] = req.prompt
+            topks = np.zeros(self.G, np.int32)
+            topps = np.ones(self.G, np.float32)
+            for g, (req, slot, blocks, t_cached) in enumerate(batch):
+                suffix = req.prompt[t_cached:]
+                toks[g, :len(suffix)] = suffix
                 rows[g, :len(blocks)] = blocks
-                t_reals[g] = len(req.prompt)
+                t_reals[g] = len(suffix)
+                t_cacheds[g] = t_cached
                 uid_lo[g] = req.uid & 0xFFFFFFFF
                 uid_hi[g] = (req.uid >> 32) & 0xFFFFFFFF
                 temps[g] = req.temperature
-            tok0s, self.pools = self._prefill(
-                self.params, self.pools, jnp.asarray(rows),
-                jnp.asarray(toks), jnp.asarray(t_reals),
-                jnp.asarray(uid_lo), jnp.asarray(uid_hi),
-                jnp.asarray(temps))
+                topks[g] = req.top_k
+                topps[g] = req.top_p
+            if t_cacheds.any():
+                # at least one cached prefix: the suffix program (reads
+                # the shared blocks through the tables)
+                tok0s, self.pools = self._prefill_cached(
+                    self.params, self.pools, jnp.asarray(rows),
+                    jnp.asarray(toks), jnp.asarray(t_reals),
+                    jnp.asarray(t_cacheds),
+                    jnp.asarray(uid_lo), jnp.asarray(uid_hi),
+                    jnp.asarray(temps), jnp.asarray(topks),
+                    jnp.asarray(topps))
+                self.stats.prefix_hits += int((t_cacheds > 0).sum())
+                self.stats.prefix_tokens_reused += int(t_cacheds.sum())
+            else:
+                # all-fresh batch: the original full-prompt program
+                # (bit-identical to the cache-off engine)
+                tok0s, self.pools = self._prefill(
+                    self.params, self.pools, jnp.asarray(rows),
+                    jnp.asarray(toks), jnp.asarray(t_reals),
+                    jnp.asarray(uid_lo), jnp.asarray(uid_hi),
+                    jnp.asarray(temps), jnp.asarray(topks),
+                    jnp.asarray(topps))
             tok0s = np.asarray(tok0s)
             self.stats.prefills += 1
-            for g, (req, slot, blocks) in enumerate(batch):
+            for g, (req, slot, blocks, t_cached) in enumerate(batch):
+                self._admit_split[req.uid] = t_cached
+                self._cache_insert(req, blocks)
                 run = _Running(req=req, slot=slot, blocks=blocks, out=[])
                 self._tables[slot] = 0
                 self._tables[slot, :len(blocks)] = blocks
@@ -643,6 +930,8 @@ class DecodeEngine:
                 self._uid_hi[slot] = (req.uid >> 32) & 0xFFFFFFFF
                 self._tcount[slot] = 1              # tok0 was index 0
                 self._temp[slot] = req.temperature
+                self._topk[slot] = req.top_k
+                self._topp[slot] = req.top_p
 
     def _finished(self, run: _Running) -> bool:
         return (len(run.out) >= run.req.max_new
@@ -662,6 +951,8 @@ class DecodeEngine:
         self._emit(run)
         self._emitted.pop(run.req.uid, None)
         self._results[run.req.uid] = run.out
+        self._admit_split.pop(run.req.uid, None)
+        self._force_fresh.discard(run.req.uid)
         self._free_slot(slot)
 
     def _preempt_for(self, needy_slot: int) -> bool:
@@ -683,7 +974,26 @@ class DecodeEngine:
         # its generated-so-far tokens are discarded and will be
         # regenerated on replay: don't count them twice
         self.stats.tokens_out -= len(run.out)
-        self._free_slot(victim)
+        uid = run.req.uid
+        pin = 0
+        if self.prefix_cache:
+            # keep references on the prefix blocks the replay's split
+            # needs — a replay MUST re-admit at the same t_cached with
+            # the same physical blocks to regenerate identical tokens
+            pin = self._admit_split.get(uid, 0) // self.bs
+        kept = run.blocks[:pin]
+        before = self._available()
+        self._free_slot(victim, keep=pin)
+        if kept and self._available() == before:
+            # pinning freed nothing (the victim was all prefix):
+            # progress beats the pin — drop it, and the uid's split
+            # record with it (its replay re-prefills from scratch)
+            for b in kept:
+                self._release_block(b)
+            self._admit_split.pop(uid, None)
+            self._force_fresh.add(uid)
+        elif kept:
+            self._pinned[uid] = kept
         self.stats.preemptions += 1
         return True
 
@@ -754,7 +1064,8 @@ class DecodeEngine:
             self.params, self.pools, jnp.asarray(self._tables),
             jnp.asarray(self._pos), jnp.asarray(draft),
             jnp.asarray(self._uid_lo), jnp.asarray(self._uid_hi),
-            jnp.asarray(self._tcount), jnp.asarray(self._temp))
+            jnp.asarray(self._tcount), jnp.asarray(self._temp),
+            jnp.asarray(self._topk), jnp.asarray(self._topp))
         preds = np.asarray(preds)                    # [S, Q] — ONE sync
         # a verify dispatch budgets Q positions per slot (occupancy then
         # reads emitted/(Q*slots), comparable with chunk mode's K)
@@ -800,7 +1111,8 @@ class DecodeEngine:
             self.params, self.pools, jnp.asarray(self._tables),
             jnp.asarray(self._pos), jnp.asarray(self._tok),
             jnp.asarray(self._uid_lo), jnp.asarray(self._uid_hi),
-            jnp.asarray(self._tcount), jnp.asarray(self._temp))
+            jnp.asarray(self._tcount), jnp.asarray(self._temp),
+            jnp.asarray(self._topk), jnp.asarray(self._topp))
         toks = np.asarray(toks)                      # [K, S] — ONE sync
         self.stats.decode_steps += self.K
         self.stats.dispatches += 1

@@ -1,0 +1,131 @@
+"""Prefix caching: refcounted shared prompt blocks + suffix prefill.
+
+Round-3 verdict #5: shared-prefix workloads (system prompts, few-shot
+templates) re-prefilled the common prefix per request.  With
+``prefix_cache=True`` the engine serves cached full blocks by reference
+(refcounts) and prefills only each prompt's suffix.  Invariants:
+
+- tokens match the cache-off engine / solo oracle;
+- the cache actually skips work (prefix_tokens_reused accounting);
+- eviction under pool pressure stays correct (LRU of unreferenced
+  cached blocks);
+- preemption pins the victim's split so replays are deterministic.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.serving import DecodeEngine, Request
+
+
+def _cfg():
+    return G.GPTConfig(vocab_size=64, d_model=32, n_heads=4,
+                       n_kv_heads=2, n_layers=2, d_ff=64, max_seq=128,
+                       rope=True, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return G.init_params(jax.random.PRNGKey(0), _cfg())
+
+
+PREFIX = [7, 3, 9, 1, 8, 2, 6, 4, 5, 9, 2, 7, 1, 3, 8, 6]  # 16 tokens
+
+
+def _shared_reqs(n=4, max_new=6, **kw):
+    # same 16-token prefix (= 4 full blocks at bs=4), distinct suffixes
+    return [Request(uid=i, prompt=PREFIX + [10 + i, 20 + i],
+                    max_new=max_new, **kw) for i in range(n)]
+
+
+def _engine(params, **kw):
+    # prefill_group=1: admissions are sequential, so every request after
+    # the first probes a cache the earlier ones populated (requests
+    # admitted in ONE batched prefill cannot share — the cache entry is
+    # inserted after the prefill runs; a documented limitation)
+    kw.setdefault("prefill_group", 1)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("prompt_buckets", (8, 32))
+    return DecodeEngine(params, _cfg(), **kw)
+
+
+def test_tokens_match_cache_off_engine(params):
+    want = _engine(params).run(_shared_reqs())
+    eng = _engine(params, prefix_cache=True)
+    got = eng.run(_shared_reqs())
+    assert got == want
+    # later admissions hit the prefix written by the first
+    assert eng.stats.prefix_hits >= 1
+    assert eng.stats.prefix_tokens_reused >= 16
+
+
+def test_tokens_match_solo_oracle(params):
+    cfg = _cfg()
+    eng = _engine(params, prefix_cache=True, num_slots=2)
+    reqs = _shared_reqs(6)
+    got = eng.run(reqs)
+    for r in _shared_reqs(6):
+        solo = np.asarray(G.generate(
+            params, cfg, jnp.asarray([r.prompt], jnp.int32),
+            r.max_new))[0].tolist()
+        assert got[r.uid] == solo, r.uid
+
+
+def test_repeated_identical_prompt_reuses_blocks(params):
+    eng = _engine(params, prefix_cache=True, num_slots=1)
+    r1 = Request(uid=1, prompt=PREFIX + [11], max_new=4)
+    r2 = Request(uid=2, prompt=PREFIX + [11], max_new=4)
+    out = eng.run([r1])
+    out2 = eng.run([r2])
+    assert out[1] == out2[2]          # same prompt, greedy: same tokens
+    # the second admission reused all 4 full prefix blocks
+    assert eng.stats.prefix_tokens_reused >= 16
+
+
+def test_sampled_requests_with_prefix_cache(params):
+    """Sampling's scheduling invariance must survive the cache: cached
+    and uncached admissions of the same request produce the same
+    stream (key discipline is position-based, not prefill-based)."""
+    reqs = lambda: [Request(uid=i, prompt=PREFIX + [30 + i], max_new=5,
+                            temperature=0.9, top_k=12) for i in range(3)]
+    want = _engine(params).run(reqs())
+    got = _engine(params, prefix_cache=True).run(reqs())
+    assert got == want
+
+
+def test_eviction_under_pressure_stays_correct(params):
+    """A pool barely larger than one request forces cached blocks to be
+    evicted and re-made; outputs must not change."""
+    want = _engine(params).run(_shared_reqs(6, max_new=4))
+    eng = _engine(params, prefix_cache=True, num_slots=2,
+                  num_blocks=14)
+    got = eng.run(_shared_reqs(6, max_new=4))
+    assert got == want
+
+
+def test_preemption_with_prefix_cache_deterministic(params):
+    """Preemption + replay with the cache on: the pinned split keeps
+    replays identical; the stream equals the cache-off run."""
+    reqs = lambda: _shared_reqs(5, max_new=8)
+    want = _engine(params, num_slots=4, num_blocks=64).run(reqs())
+    eng = _engine(params, prefix_cache=True, num_slots=4,
+                  num_blocks=16)
+    got = eng.run(reqs())
+    assert got == want
+
+
+def test_int8_pool_rejects_prefix_cache(params):
+    with pytest.raises(ValueError, match="prefix_cache"):
+        _engine(params, prefix_cache=True, kv_dtype=jnp.int8)
+
+
+def test_refcounts_return_to_zero(params):
+    eng = _engine(params, prefix_cache=True)
+    eng.run(_shared_reqs(4))
+    # all running slots drained: every block either free or reclaimable
+    assert int((eng._block_ref > 0).sum()) == 0
+    assert eng._available() == eng._total_blocks
