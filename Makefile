@@ -1,0 +1,23 @@
+# Top-level targets (reference ran its pyramid from .travis.yml:23-40;
+# here `make check` is the single entry point CI or a contributor runs).
+.PHONY: check check-fast native selftest clean
+
+native:
+	$(MAKE) -C native
+
+selftest: native
+	./native/selftest
+
+# Full pyramid: native build + C++ selftest + sharded pytest + the
+# multi-chip dryrun.  ~25 min wall at the default 2 shards (tools/ci.sh
+# documents the budget; pass JOBS=4 for more shards).
+JOBS ?= 2
+check:
+	tools/ci.sh -j$(JOBS)
+
+# Smoke tier: native + one fast pytest slice + dryrun (~8 min).
+check-fast:
+	tools/ci.sh --fast
+
+clean:
+	rm -f native/libkft_comm.so native/selftest

@@ -359,6 +359,32 @@ def orchestrate() -> None:
                       file=sys.stderr)
                 _cpu_fallback_line(attempts_log, probes, "no_tpu_backend")
                 return
+            if cfg["donate"] == 0:
+                # bonus rung (VERDICT r3): donation measurably helps the
+                # GPT benchmarks, and with the chip proven healthy the
+                # r02-hang caution no longer applies — try donate=1 and
+                # keep the better number.  A hang here costs one budget
+                # window, never the headline (base result is in hand).
+                dcfg = dict(cfg, donate=1)
+                dcmd = [sys.executable, os.path.abspath(__file__),
+                        "--worker", "--batch", str(dcfg["batch"]),
+                        "--iters", str(dcfg["iters"]), "--warmup",
+                        str(dcfg["warmup"]), "--donate", "1"]
+                print(f"bench: donate rung {dcfg}", file=sys.stderr)
+                doutcome, dresult, delapsed, derr = run_staged(
+                    dcmd, RETRY_BUDGETS)
+                drec = {"platform": "tpu", "config": dcfg,
+                        "outcome": doutcome,
+                        "elapsed_s": round(delapsed, 1)}
+                if derr:
+                    drec["stderr_tail"] = derr[-500:]
+                attempts_log.append(drec)
+                print(f"bench: donate rung -> {doutcome} in "
+                      f"{delapsed:.0f}s", file=sys.stderr)
+                if (doutcome == "ok"
+                        and dresult.get("metric") == result["metric"]
+                        and dresult.get("value", 0) > result["value"]):
+                    result = dresult
             result["attempts"] = attempts_log
             result["probes"] = probes
             print(json.dumps(result))

@@ -1,0 +1,135 @@
+"""P2P model-store benchmark (reference: tests/go/cmd/kungfu-bench-p2p).
+
+Measures the versioned-store save/request path over the native host
+plane — the rate that is LOAD-BEARING for the PairAveraging scaling
+claim (benchmarks/scaling.py models the async pull as hidden behind
+compute; that only holds at the measured pull rate, which this harness
+finally produces instead of assuming).
+
+Two numbers per worker:
+
+- ``pull``: synchronous ``request`` of the whole model from a random
+  other peer, tight loop — the raw store+transport throughput
+  (framing, rendezvous, memcpy, shm lane when colocated);
+- ``hidden``: ``request_async`` issued before a simulated compute step
+  (``--compute-ms``), awaited after — the PairAveraging shape
+  (AsyncRequestModel's prefetch double-buffer, peer_to_peer.cpp:8-524).
+  Reported as the fraction of pulls that completed within the step,
+  i.e. how much of the exchange the compute actually hides.
+
+Run (spawns workers through the launcher):
+
+    python -m kungfu_tpu.benchmarks.p2p -np 4 --size-mb 100 --secs 3
+
+Writes one JSON line per run; ``--out`` also writes P2P_BENCH.json-style
+artifacts that benchmarks/scaling.py picks up for the pairavg curve.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _worker(args) -> None:
+    from .. import native
+
+    p = native.default_peer()
+    rank, size = p.rank, p.size
+    n_f32 = args.size_mb * (1 << 20) // 4
+    model = np.full(n_f32, float(rank + 1), np.float32)
+    p.save("model", model, version=0)
+    p.barrier(name="p2p-bench-start")
+    rng = np.random.RandomState(rank)
+    others = [j for j in range(size) if j != rank] or [rank]
+
+    # --- synchronous pull loop ------------------------------------
+    pulled = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        got = p.request(peer, "model", model, version=0)
+        assert got[0] == peer + 1.0
+        pulled += got.nbytes
+    sync_secs = time.perf_counter() - t0
+    sync_gib = pulled / sync_secs / (1 << 30)
+
+    # --- hidden (prefetch) loop -----------------------------------
+    hidden_done = 0
+    hidden_total = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        fut = p.request_async(peer, "model", model, version=0)
+        time.sleep(args.compute_ms / 1e3)     # the "local step"
+        hidden_total += 1
+        if fut.done():
+            hidden_done += 1
+        fut.result()                          # always consume
+    hid_secs = time.perf_counter() - t0
+    hid_rate = hidden_total * model.nbytes / hid_secs / (1 << 30)
+
+    p.barrier(name="p2p-bench-end")
+    row = np.asarray([sync_gib, hid_rate,
+                      hidden_done / max(1, hidden_total)], np.float64)
+    allrows = p.gather(row, root=0, name="p2p-bench-rows")
+    if rank == 0:
+        shm = p.shm_bytes()
+        doc = {
+            "bench": "p2p-store",
+            "workers": size,
+            "model_mb": args.size_mb,
+            "compute_ms": args.compute_ms,
+            "sync_pull_gib_s_per_worker": round(
+                float(allrows[:, 0].mean()), 3),
+            "sync_pull_gib_s_aggregate": round(
+                float(allrows[:, 0].sum()), 3),
+            "hidden_pull_gib_s_per_worker": round(
+                float(allrows[:, 1].mean()), 3),
+            "hidden_fraction": round(float(allrows[:, 2].mean()), 3),
+            "shm_lane_bytes": int(shm),
+        }
+        print("RESULT " + json.dumps(doc), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+    p.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m kungfu_tpu.benchmarks.p2p")
+    ap.add_argument("-np", type=int, default=4, dest="nproc")
+    ap.add_argument("--size-mb", type=int, default=100,
+                    help="model size (100 ~ ResNet-50 f32)")
+    ap.add_argument("--secs", type=float, default=3.0)
+    ap.add_argument("--compute-ms", type=float, default=50.0,
+                    help="simulated local step for the hidden loop")
+    ap.add_argument("--out", default=None,
+                    help="write the rank-0 JSON doc here "
+                         "(e.g. P2P_BENCH.json)")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("KFT_SELF_SPEC"):
+        _worker(args)
+        return 0
+
+    # parent: spawn through the launcher so workers get the env ABI
+    cmd = [sys.executable, "-m", "kungfu_tpu.launcher", "-np",
+           str(args.nproc), "--", sys.executable, "-m",
+           "kungfu_tpu.benchmarks.p2p", "-np", str(args.nproc),
+           "--size-mb", str(args.size_mb), "--secs", str(args.secs),
+           "--compute-ms", str(args.compute_ms)]
+    if args.out:
+        cmd += ["--out", args.out]
+    r = subprocess.run(cmd)
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

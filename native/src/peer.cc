@@ -512,9 +512,15 @@ class Peer {
         req.body.resize(8);
         std::memcpy(req.body.data(), &version, 8);
         std::lock_guard<std::mutex> rg(conn->request_mu);
+        // register the destination BEFORE the request goes out: the
+        // reader thread deposits a size-matching response body straight
+        // into it (saves a body-sized alloc + copy per pull)
+        conn->pending_len.store(uint64_t(nbytes));
+        conn->pending_dst.store(buf, std::memory_order_release);
         {
             std::lock_guard<std::mutex> wg(conn->write_mu);
             if (!send_msg(conn->fd, req)) {
+                conn->pending_dst.store(nullptr);
                 set_error("p2p send failed");
                 drop_conn(target, CLS_P2P);
                 return false;
@@ -523,13 +529,29 @@ class Peer {
         monitor_.add(target, int64_t(req.body.size() + req.name.size()));
         Msg resp;
         if (!conn->responses.pop(&resp, recv_timeout_)) {
+            // the conn must DIE with the abandoned request: a late
+            // response would otherwise poison the next round trip (or,
+            // worse, land in its registered buffer)
+            bool unclaimed = conn->pending_dst.exchange(nullptr) != nullptr;
+            drop_conn(target, CLS_P2P);
+            if (!unclaimed) {
+                // the reader claimed the registration and may be
+                // mid-read INTO buf: drop_conn's shutdown wakes it;
+                // wait for the read to finish or fail before buf can
+                // be freed by the caller
+                while (conn->direct_busy.load(std::memory_order_acquire))
+                    std::this_thread::sleep_for(
+                        std::chrono::milliseconds(1));
+            }
             set_error("p2p response timeout for " + name);
             return false;
         }
+        conn->pending_dst.store(nullptr);
         if (resp.flags & FLAG_FAILED) {
             set_error("peer has no blob " + name);
             return false;
         }
+        if (resp.flags & FLAG_DIRECT) return true;  // already in buf
         if (int64_t(resp.body.size()) != nbytes) {
             set_error("p2p size mismatch for " + name);
             return false;
@@ -653,7 +675,7 @@ class Peer {
 
     void reader_loop(std::shared_ptr<Conn> conn) {
         Msg m;
-        while (conn->alive && recv_msg(conn->fd, &m)) {
+        while (conn->alive && recv_msg_conn(conn->fd, &m, conn.get())) {
             if (m.flags & FLAG_SHM) {
                 // bulk payload sits in the sender's ring; the socket
                 // frame carried only the {off, len, advance} descriptor
@@ -716,11 +738,20 @@ class Peer {
                     } else {
                         int64_t ver;
                         std::memcpy(&ver, m.body.data(), 8);
-                        Bytes out;
-                        if (store_.load(m.name, ver, &out))
-                            r.body = std::move(out);
-                        else
-                            r.flags |= FLAG_FAILED;
+                        // send straight from the shared blob — no
+                        // body-sized alloc/copy per request, and the
+                        // store lock is NOT held across the write (the
+                        // blob reference keeps it alive through
+                        // concurrent saves)
+                        auto blob = store_.get_blob(m.name, ver);
+                        if (blob) {
+                            std::lock_guard<std::mutex> wg(
+                                conn->write_mu);
+                            send_msg_ref(conn->fd, r, blob->data(),
+                                         blob->size());
+                            break;
+                        }
+                        r.flags |= FLAG_FAILED;
                     }
                     std::lock_guard<std::mutex> wg(conn->write_mu);
                     send_msg(conn->fd, r);

@@ -70,6 +70,10 @@ bool send_msg_ref(int fd, const Msg &m, const void *body, size_t nbytes) {
 }
 
 bool recv_msg(int fd, Msg *m) {
+    return recv_msg_conn(fd, m, nullptr);
+}
+
+bool recv_msg_conn(int fd, Msg *m, Conn *conn) {
     WireHeader h;
     if (!read_all(fd, &h, sizeof(h))) return false;
     if (h.magic != MSG_MAGIC || h.name_len > 4096 || h.body_len > MAX_BODY)
@@ -79,6 +83,38 @@ bool recv_msg(int fd, Msg *m) {
     m->token = h.token;
     m->name.resize(h.name_len);
     if (h.name_len && !read_all(fd, &m->name[0], h.name_len)) return false;
+    if (conn && h.cls == CLS_P2P && (h.flags & FLAG_RESPONSE) &&
+        !(h.flags & (FLAG_FAILED | FLAG_SHM))) {
+        // The destination registration is sampled HERE — at the moment
+        // this specific response's header is parsed — never earlier: a
+        // registration is live exactly between its request's send and
+        // pop, requests on a conn are serialized (request_mu), and an
+        // abandoned request drops the conn, so this header can only
+        // belong to the currently registered request.  Sampling at the
+        // reader loop's top instead would pair a STALE registration
+        // (whose buffer the requester may already have freed) with the
+        // next response — a write-after-free.
+        // direct_busy brackets claim + body read: it is raised BEFORE
+        // the claim so a timed-out request() that lost the claim race
+        // always observes it and waits — otherwise this thread could
+        // keep writing into a buffer the caller already freed
+        conn->direct_busy.store(true);
+        void *dst = conn->pending_dst.exchange(
+            nullptr, std::memory_order_acq_rel);
+        if (dst && h.body_len == conn->pending_len.load()) {
+            bool ok = !h.body_len || read_all(fd, dst, h.body_len);
+            conn->direct_busy.store(false, std::memory_order_release);
+            if (!ok) return false;
+            m->body.clear();
+            m->flags |= FLAG_DIRECT;
+            return true;
+        }
+        conn->direct_busy.store(false, std::memory_order_release);
+        // size mismatch: the registration stays CONSUMED (never
+        // resurrected — the requester may already have abandoned it);
+        // the generic path below fills m.body and request() reports
+        // the mismatch
+    }
     m->body.resize(h.body_len);
     if (h.body_len && !read_all(fd, m->body.data(), h.body_len)) return false;
     return true;

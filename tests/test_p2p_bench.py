@@ -1,0 +1,51 @@
+"""P2P store benchmark harness (reference: kungfu-bench-p2p) and the
+measured-rate plumbing into the PairAveraging scaling prediction."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kungfu_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_p2p_bench_end_to_end(tmp_path):
+    out = tmp_path / "p2p.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.benchmarks.p2p", "-np", "2",
+         "--size-mb", "4", "--secs", "0.5", "--compute-ms", "5",
+         "--out", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RESULT" in r.stdout
+    doc = json.loads(out.read_text())
+    assert doc["workers"] == 2
+    assert doc["sync_pull_gib_s_per_worker"] > 0
+    assert doc["hidden_pull_gib_s_per_worker"] > 0
+    assert 0.0 <= doc["hidden_fraction"] <= 1.0
+
+
+def test_measured_rate_caps_pairavg_curve(tmp_path):
+    from kungfu_tpu.benchmarks.scaling import LinkModel, predict_table
+
+    art = tmp_path / "P2P_BENCH.json"
+    art.write_text(json.dumps({"sync_pull_gib_s_per_worker": 0.5}))
+    link = LinkModel.from_p2p_artifact(str(art))
+    assert link.p2p_gbps == pytest.approx(0.5 * (1 << 30) / 1e9)
+
+    rows = predict_table(10**9, 1.0, sizes=(8, 64), link=link)
+    for r in rows:
+        # the wire-model column survives AND the measured-cap column is
+        # a lower bound on it (a slow measured path can only cost)
+        assert "pairavg_eff" in r and "pairavg_eff_measured_cap" in r
+        assert r["pairavg_eff_measured_cap"] <= r["pairavg_eff"] + 1e-9
+
+    # without a measurement the capped column is absent
+    rows = predict_table(10**9, 1.0, sizes=(8,), link=LinkModel())
+    assert "pairavg_eff_measured_cap" not in rows[0]

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# CI driver (reference: .travis.yml:23-40 runs go test -> C++ unit/
+# integration -> strategy sweep -> python op/optimizer/train tests; the
+# cluster workflow adds a two-node elastic test).  This is the one entry
+# point that runs this repo's whole pyramid:
+#
+#   1. native build + C++ selftest            (~20 s)
+#   2. pytest suite, sharded across N workers (~15-20 min at -j2 on the
+#      1-core dev VM; ~35 min serial — the suite is full of sleeps and
+#      subprocess waits, so sharding pays even without cores)
+#   3. the driver's dryrun_multichip on a virtual 8-device CPU mesh
+#      (multi-chip shardings compile + execute, incl. the multi-process
+#      elastic resize)                        (~3-5 min)
+#
+# Wall-clock budget: ~25 min at the default -j2.  Usage:
+#
+#   tools/ci.sh            # everything
+#   tools/ci.sh -j4        # more pytest shards
+#   tools/ci.sh --fast     # native + one smoke shard + dryrun (~8 min)
+set -u
+cd "$(dirname "$0")/.."
+
+JOBS=2
+FAST=0
+for a in "$@"; do
+  case "$a" in
+    -j*) JOBS="${a#-j}" ;;
+    --fast) FAST=1 ;;
+    *) echo "unknown arg $a" >&2; exit 2 ;;
+  esac
+done
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+fail=0
+say() { printf '\n==== %s ====\n' "$*"; }
+
+say "1/3 native build + selftest"
+make -C native || exit 1
+./native/selftest || exit 1
+
+say "2/3 pytest (${JOBS} shards)"
+if [ "$FAST" = 1 ]; then
+  python -m pytest tests/test_end_to_end.py tests/test_session.py \
+      tests/test_plan.py -q || fail=1
+else
+  # shard by file, round-robin after sorting by size (crude balance:
+  # big files spread across shards)
+  mapfile -t FILES < <(ls -S tests/test_*.py)
+  pids=()
+  for ((s = 0; s < JOBS; s++)); do
+    shard=()
+    for ((i = s; i < ${#FILES[@]}; i += JOBS)); do
+      shard+=("${FILES[$i]}")
+    done
+    ( python -m pytest "${shard[@]}" -q \
+        > "/tmp/kft-ci-shard-$s.log" 2>&1 ) &
+    pids+=($!)
+  done
+  for ((s = 0; s < JOBS; s++)); do
+    if ! wait "${pids[$s]}"; then
+      fail=1
+      echo "shard $s FAILED:"
+    fi
+    tail -3 "/tmp/kft-ci-shard-$s.log"
+  done
+fi
+
+say "3/3 dryrun_multichip(8)"
+DRYRUN_DEVICES=8 python __graft_entry__.py || fail=1
+
+if [ "$fail" = 0 ]; then
+  say "CI PASSED"
+else
+  say "CI FAILED"
+fi
+exit $fail
