@@ -6,6 +6,7 @@ native:
 	$(MAKE) -C native
 
 selftest: native
+	$(MAKE) -C native selftest
 	./native/selftest
 
 # Full pyramid: native build + C++ selftest + sharded pytest + the

@@ -37,7 +37,7 @@ fail=0
 say() { printf '\n==== %s ====\n' "$*"; }
 
 say "1/3 native build + selftest"
-make -C native || exit 1
+make -C native all selftest || exit 1
 ./native/selftest || exit 1
 
 say "2/3 pytest (${JOBS} shards)"
