@@ -120,37 +120,49 @@ def bench_flash(B, T, H, D, reps: int, with_bwd: bool, causal=True) -> dict:
             "tflops": round(flops / secs / 1e12, 2)}
 
 
-def _nosoftmax_kernel(q_ref, k_ref, v_ref, o_ref, acc, *, n_k):
+def _nosoftmax_kernel(q_ref, k_ref, v_ref, o_ref, acc, *, n_k, causal,
+                      bq, bk):
     """The flash kernel's two matmuls with softmax deleted — the MXU-only
     ceiling of the kernel structure at a given head_dim.  The gap between
     this and the real kernel is the (exp2) softmax cost; the gap between
     head dims is the MXU contraction fill (a 128x128 systolic array run
-    at a 64-deep contraction)."""
+    at a 64-deep contraction).  ``causal=True`` keeps the real kernel's
+    block skip and counts only T^2/2 useful flops, so the causal ceiling
+    row includes the intrinsic diagonal-tile waste of the blocking —
+    apples-to-apples with the causal flash rows."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    iq = pl.program_id(2)
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
     def _():
         acc[...] = jnp.zeros_like(acc)
 
-    q = q_ref[0, 0, :, :]
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    acc[...] += jax.lax.dot_general(
-        s.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    visible = True
+    if causal:
+        visible = ik * bk <= iq * bq + bq - 1
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        acc[...] += jax.lax.dot_general(
+            s.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_k - 1)
     def _():
         o_ref[0, 0, :, :] = acc[...].astype(o_ref.dtype)
 
 
-def bench_kernel_ceiling(B, T, H, D, reps: int, bq=1024, bk=1024):
-    """Matmul-only flash-shaped kernel (non-causal): the ceiling the real
-    kernel's softmax/masking eats into."""
+def bench_kernel_ceiling(B, T, H, D, reps: int, bq=1024, bk=1024,
+                         causal=False):
+    """Matmul-only flash-shaped kernel: the ceiling the real kernel's
+    softmax/masking eats into."""
     import functools as _ft
 
     import jax.numpy as jnp
@@ -162,7 +174,8 @@ def bench_kernel_ceiling(B, T, H, D, reps: int, bq=1024, bk=1024):
     v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
     n_q, n_k = T // bq, T // bk
     call = pl.pallas_call(
-        _ft.partial(_nosoftmax_kernel, n_k=n_k),
+        _ft.partial(_nosoftmax_kernel, n_k=n_k, causal=causal, bq=bq,
+                    bk=bk),
         grid=(B, H, n_q, n_k),
         in_specs=[pl.BlockSpec((1, 1, bq, D),
                                lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -181,8 +194,9 @@ def bench_kernel_ceiling(B, T, H, D, reps: int, bq=1024, bk=1024):
         return call(q_, k, v).astype(jnp.bfloat16)
 
     secs = _time_chained(op, q, reps)
-    flops = 4.0 * B * H * T * T * D * reps
-    return {"op": f"kernel_ceiling_matmul_only_B{B}_T{T}_H{H}_D{D}",
+    flops = 4.0 * B * H * T * T * D * (0.5 if causal else 1.0) * reps
+    tag = "causal_" if causal else ""
+    return {"op": f"kernel_ceiling_matmul_only_{tag}B{B}_T{T}_H{H}_D{D}",
             "seconds": round(secs, 4),
             "tflops": round(flops / secs / 1e12, 2)}
 
@@ -244,7 +258,7 @@ def main(argv=None):
         fa_f128 = fa_b128 = it128 = None
         ceil64 = bench_kernel_ceiling(1, 256, 2, 64, reps=2, bq=256,
                                       bk=256)
-        ceil128 = None
+        ceil128 = ceil64c = None
         it = bench_intree_flash(1, 256, 2, 64, reps=2)
         hbm = bench_hbm(16, reps=4)
     else:
@@ -264,12 +278,15 @@ def main(argv=None):
         fa_b128 = bench_flash(4, 2048, 8, 128, reps=128, with_bwd=True)
         ceil64 = bench_kernel_ceiling(4, 2048, 12, 64, reps=512)
         ceil128 = bench_kernel_ceiling(4, 2048, 8, 128, reps=512)
+        ceil64c = bench_kernel_ceiling(4, 2048, 12, 64, reps=512,
+                                       causal=True)
         it = bench_intree_flash(4, 2048, 12, 64, reps=256)
         it128 = bench_intree_flash(4, 2048, 8, 128, reps=256)
         hbm = bench_hbm(512, reps=512)
 
     results = [r for r in (mm, fa_f, fa_b, fa_f128, fa_b128, ceil64,
-                           ceil128, it, it128, hbm) if r is not None]
+                           ceil128, ceil64c, it, it128, hbm)
+               if r is not None]
     doc = {
         "platform": plat,
         "device": str(jax.devices()[0]),
