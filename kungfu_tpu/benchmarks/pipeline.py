@@ -41,7 +41,8 @@ import optax
 def run_sweep(dp: int = 2, pp: int = 4, micro=(1, 2, 4, 8),
               d_model: int = 128, n_layers: int = 8, seq: int = 64,
               global_batch: int = 16, vocab: int = 256,
-              n_heads: int = 4, iters: int = 5, remat: bool = False):
+              n_heads: int = 4, iters: int = 5, remat: bool = False,
+              virtual_stages: int = 1):
     from ..models.gpt import GPTConfig
     from ..parallel import pipeline as PPL
 
@@ -57,13 +58,16 @@ def run_sweep(dp: int = 2, pp: int = 4, micro=(1, 2, 4, 8),
     tgts = jnp.asarray(rng.randint(0, vocab, (global_batch, seq)),
                        jnp.int32)
     S = pp
+    v = virtual_stages
     rows = []
     for M in micro:
         if (global_batch // dp) % M:
             continue
-        params, opt_state = PPL.init_gpt_pp(cfg, opt, mesh)
+        params, opt_state = PPL.init_gpt_pp(cfg, opt, mesh,
+                                            virtual_stages=v)
         step = PPL.make_gpt_pp_train_step(cfg, opt, mesh, n_micro=M,
-                                          donate=False, remat=remat)
+                                          donate=False, remat=remat,
+                                          virtual_stages=v)
         params, opt_state, loss = step(params, opt_state, toks, tgts)
         float(np.asarray(loss))  # compile + sync
         best = float("inf")
@@ -72,9 +76,13 @@ def run_sweep(dp: int = 2, pp: int = 4, micro=(1, 2, 4, 8),
             params, opt_state, loss = step(params, opt_state, toks, tgts)
             float(np.asarray(loss))
             best = min(best, time.perf_counter() - t0)
-        rows.append({"n_micro": M, "ticks": S + M - 1,
+        # exact compiled tick count (NOT v*M+S-1, which holds only for
+        # M a multiple of S); each tick is 1/v of a stage; v=1 is GPipe
+        ticks = PPL.pp_schedule_ticks(S, M, v)
+        theory = ticks / (v * M)
+        rows.append({"n_micro": M, "ticks": ticks,
                      "seconds": round(best, 4),
-                     "theory_overhead": round((S + M - 1) / M, 3)})
+                     "theory_overhead": round(theory, 3)})
     # fit t(M) = c * (S + M - 1): one tick costs ~c (stage compute is
     # constant across the sweep because the global batch is fixed ONLY
     # in count, not per-tick size — normalise per-tick work first:
@@ -88,10 +96,12 @@ def run_sweep(dp: int = 2, pp: int = 4, micro=(1, 2, 4, 8),
     base = min(r["fitted_tick_cost"] for r in rows)
     for r in rows:
         r["measured_overhead"] = round(r["seconds"] / base, 3)
-    return {"dp": dp, "pp": pp, "rows": rows,
+    return {"dp": dp, "pp": pp, "virtual_stages": v, "rows": rows,
             "note": ("measured_overhead = seconds / best ideal-rate "
-                     "estimate; theory_overhead = (S+M-1)/M — matching "
-                     "columns mean the schedule is compute-bound GPipe")}
+                     "estimate; theory_overhead = exact_ticks/(v*M) "
+                     "(pp_schedule_ticks) — GPipe at v=1, Megatron-"
+                     "interleaved at v>1; matching columns mean the "
+                     "schedule is compute-bound")}
 
 
 def main(argv=None):
@@ -99,11 +109,14 @@ def main(argv=None):
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--pp", type=int, default=4)
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--virtual-stages", type=int, default=1)
     ap.add_argument("--json", default=None, help="also write JSON here")
     args = ap.parse_args(argv)
-    doc = run_sweep(dp=args.dp, pp=args.pp, remat=args.remat)
+    doc = run_sweep(dp=args.dp, pp=args.pp, remat=args.remat,
+                    virtual_stages=args.virtual_stages)
     for r in doc["rows"]:
-        print(f"RESULT pp={doc['pp']} M={r['n_micro']}: "
+        print(f"RESULT pp={doc['pp']} v={doc['virtual_stages']} "
+              f"M={r['n_micro']}: "
               f"{r['seconds']*1e3:.1f} ms/step, overhead "
               f"{r['measured_overhead']:.3f} (theory "
               f"{r['theory_overhead']:.3f})")

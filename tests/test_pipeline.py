@@ -62,6 +62,63 @@ def test_pp_parity_with_oracle(devices, dp, pp, n_layers, n_micro):
     _tree_allclose(got, ref_params)
 
 
+@pytest.mark.parametrize("dp,pp,v,n_layers,n_micro", [
+    (2, 2, 2, 4, 2),   # 4 chunks of 1 layer, M = S
+    (1, 4, 2, 8, 4),   # 8 chunks, M = S
+    (2, 2, 2, 4, 3),   # M not a multiple of S (partial last group)
+    (1, 2, 3, 6, 4),   # v = 3, M = 2S
+])
+def test_pp_interleaved_parity_with_oracle(devices, dp, pp, v, n_layers,
+                                           n_micro):
+    """Interleaved virtual stages must match the single-device oracle
+    bit-for-bit in loss and (de-interleaved) updated params — same
+    contract as GPipe."""
+    cfg = _cfg(n_layers)
+    opt = optax.sgd(0.1)
+    tokens, targets = _data(cfg, batch=6 if n_micro == 3 else 4)
+    ref_params, ref_loss = _oracle(cfg, tokens, targets, opt)
+
+    mesh = PP.mesh_dp_pp(dp, pp, devices)
+    params, state = PP.init_gpt_pp(cfg, opt, mesh, seed=0,
+                                   virtual_stages=v)
+    step = PP.make_gpt_pp_train_step(cfg, opt, mesh, n_micro=n_micro,
+                                     donate=False, virtual_stages=v)
+    params, state, loss = step(params, state, tokens, targets)
+
+    assert np.isclose(float(loss), ref_loss, rtol=1e-4), \
+        f"loss {float(loss)} != oracle {ref_loss}"
+    nat = PP.deinterleave_params(jax.device_get(params), cfg.n_layers,
+                                 pp, v)
+    got = PP.unstack_layers(nat, cfg.n_layers)
+    _tree_allclose(got, ref_params)
+
+
+def test_pp_interleaved_remat_matches(devices):
+    cfg = _cfg(4)
+    opt = optax.sgd(0.1)
+    tokens, targets = _data(cfg, batch=8, seq=16, seed=2)
+    mesh = PP.mesh_dp_pp(2, 2, devices)
+    outs = []
+    for remat in (False, True):
+        params, state = PP.init_gpt_pp(cfg, opt, mesh, seed=3,
+                                       virtual_stages=2)
+        step = PP.make_gpt_pp_train_step(cfg, opt, mesh, n_micro=4,
+                                         donate=False, remat=remat,
+                                         virtual_stages=2)
+        params, state, loss = step(params, state, tokens, targets)
+        outs.append((float(loss), np.asarray(params["layers"]["wq"])))
+    assert outs[0][0] == pytest.approx(outs[1][0], rel=1e-6)
+    np.testing.assert_allclose(outs[0][1], outs[1][1], atol=1e-6)
+
+
+def test_pp_interleaved_validation(devices):
+    cfg = _cfg(4)
+    mesh = PP.mesh_dp_pp(1, 2, devices)
+    with pytest.raises(ValueError, match="virtual"):
+        PP.make_gpt_pp_train_step(cfg, optax.sgd(0.1), mesh, n_micro=2,
+                                  virtual_stages=3)  # 4 % (2*3) != 0
+
+
 @pytest.mark.parametrize("dp,pp,tp,n_layers,n_micro", [
     (2, 2, 2, 2, 2),
     (1, 2, 4, 2, 2),
