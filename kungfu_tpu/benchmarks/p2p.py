@@ -10,7 +10,12 @@ Two numbers per worker:
 
 - ``pull``: synchronous ``request`` of the whole model from a random
   other peer, tight loop — the raw store+transport throughput
-  (framing, rendezvous, memcpy, shm lane when colocated);
+  (framing, rendezvous, memcpy over the abstract-unix socket when
+  colocated).  NOTE: p2p requests ride CLS_P2P connections, which do
+  NOT negotiate the shm bulk lane (that lane is collective-class only —
+  native/src/peer.cc); ``shm_lane_bytes`` is reported to make that
+  explicit — it is structurally 0 here, so the measured rate is the
+  socket path, a LOWER bound on colocated transport;
 - ``hidden``: ``request_async`` issued before a simulated compute step
   (``--compute-ms``), awaited after — the PairAveraging shape
   (AsyncRequestModel's prefetch double-buffer, peer_to_peer.cpp:8-524).

@@ -79,7 +79,11 @@ bool recv_msg_conn(int fd, Msg *m, Conn *conn) {
     if (h.magic != MSG_MAGIC || h.name_len > 4096 || h.body_len > MAX_BODY)
         return false;
     m->cls = h.cls;
-    m->flags = h.flags;
+    // FLAG_DIRECT is a local receive-path annotation (set below when the
+    // body lands in a registered destination buffer) — it must never be
+    // honored from the wire: a peer that set it would make request()
+    // report success without the destination ever being written
+    m->flags = h.flags & ~FLAG_DIRECT;
     m->token = h.token;
     m->name.resize(h.name_len);
     if (h.name_len && !read_all(fd, &m->name[0], h.name_len)) return false;

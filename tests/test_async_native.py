@@ -176,9 +176,12 @@ def test_prefetch_overlaps_request_with_compute():
         results = _spawn(_prefetch_worker, 2, elems, steps, compute_s)
         ok = True
         for rank, (blocking, prefetch, pulls) in results.items():
-            # the pull must be non-trivial for the test to mean
-            # anything; 32 MB over loopback comfortably is
-            assert pulls > 0.02 * steps, (rank, pulls)
+            if pulls <= 0.02 * steps:
+                # transport so fast the pull is trivial (< 20 ms for
+                # 32 MB): overlap is unmeasurable here, not broken —
+                # don't fail a test because the hardware got faster
+                pytest.skip(f"pull too fast to measure overlap "
+                            f"({pulls / steps * 1e3:.1f} ms/pull)")
             # at least 40% of the total pull time must be hidden
             if not blocking - prefetch > 0.4 * pulls:
                 ok = False
