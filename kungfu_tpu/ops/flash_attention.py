@@ -54,10 +54,10 @@ def _mask_skip() -> bool:
     """Causal mask strategy: True = dual-branch kernels where
     fully-visible blocks skip the mask iota/compare/select (only
     diagonal-straddling tiles pay it); False = single branch, mask on
-    every visible block.  Measured on v5e (B4 T2048 D64, 1024 blocks):
-    neutral in the forward (the causal kernel sits at its predicated-
-    grid ceiling either way), +23% in the backward (33.7 vs 27.4
-    TFLOP/s fwd+bwd).  ``KFT_FLASH_MASK_SKIP=0/1`` overrides for
+    every visible block.  Measured on idle v5e (B4 T2048 D64, 1024
+    blocks): neutral in the forward and +1.9% fwd+bwd (36.7 vs 36.1
+    TFLOP/s) — kept as default because it never loses and the margin
+    widens under host load.  ``KFT_FLASH_MASK_SKIP=0/1`` overrides for
     experiments — in a FRESH process: the flag is read at trace time
     and compiled kernels are cached, so flipping it mid-process has no
     effect."""

@@ -111,6 +111,32 @@ def test_pp_interleaved_remat_matches(devices):
     np.testing.assert_allclose(outs[0][1], outs[1][1], atol=1e-6)
 
 
+def test_pp_schedule_ticks_formula():
+    """Brute-force the interleaved schedule for a grid of (S, M, v):
+    unit (chunk c, microbatch m) runs at tick e(m) + c on rank c % S.
+    Assert (a) no rank ever has two units in one tick (the
+    conflict-freedom the docstring claims), (b) the last tick matches
+    pp_schedule_ticks, (c) the Megatron closed form holds when S | M."""
+    for S in (2, 3, 4):
+        for v in (1, 2, 3):
+            for M in (1, 2, 3, 4, 6, 8):
+                e = lambda m: (m // S) * v * S + m % S
+                busy = {}
+                last = -1
+                for m in range(M):
+                    for c in range(S * v):
+                        t = e(m) + c
+                        key = (t, c % S)
+                        assert key not in busy, (S, M, v, key, busy[key],
+                                                (c, m))
+                        busy[key] = (c, m)
+                        last = max(last, t)
+                assert last + 1 == PP.pp_schedule_ticks(S, M, v), \
+                    (S, M, v, last + 1)
+                if M % S == 0:
+                    assert PP.pp_schedule_ticks(S, M, v) == v * M + S - 1
+
+
 def test_pp_interleaved_validation(devices):
     cfg = _cfg(4)
     mesh = PP.mesh_dp_pp(1, 2, devices)
