@@ -22,7 +22,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kungfu_tpu import native  # noqa: E402
 from kungfu_tpu.plan import Cluster, HostList, PeerID  # noqa: E402
 
-WORKER = r"""
+# shared worker scaffolding: both workers train the same sync-DP least-
+# squares model and report "size:ndev:trained:wsum:phases" (parsed by
+# _parse_done) so the protocol lives in ONE writer + ONE parser
+WORKER_PRELUDE = r"""
 import os, signal, sys, time
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -31,14 +34,12 @@ import numpy as np
 from kungfu_tpu.elastic.multiproc import DistributedElasticTrainer
 from kungfu_tpu.launcher import env as E
 
-B, DIE_STEP, TARGET = 8, 4, 60 * 8
 out_dir = os.environ["TEST_OUT"]
 we = E.from_env()
 
 rng = np.random.RandomState(0)
 X = rng.randn(B, 16).astype(np.float32)
-W_true = rng.randn(16, 4).astype(np.float32)
-Y = X @ W_true
+Y = X @ rng.randn(16, 4).astype(np.float32)
 
 def loss_fn(p, batch):
     bx, by = batch
@@ -48,12 +49,31 @@ def loss_fn(p, batch):
 import optax
 tr = DistributedElasticTrainer(loss_fn, optax.sgd(0.05),
                                {"w": np.zeros((16, 4), np.float32)})
+phases = [(tr.size, tr.num_devices())]
+"""
+
+WORKER_EPILOGUE = r"""
+w = tr.current_params()["w"]
+with open(os.path.join(out_dir, f"done.{we.self_spec.port}"), "w") as f:
+    f.write(f"{tr.size}:{tr.num_devices()}:{tr.trained_samples}:"
+            f"{float(np.square(w).sum()):.9e}:"
+            f"{';'.join(f'{a}x{b}' for a, b in phases)}")
+tr.shutdown()
+"""
+
+
+def _parse_done(path):
+    """-> (size, ndev, trained, wsum, phases list) from a done file."""
+    size, ndev, trained, wsum, phases = path.read_text().split(":")
+    return int(size), int(ndev), int(trained), wsum, phases.split(";")
+
+
+WORKER = "B, DIE_STEP, TARGET = 8, 4, 60 * 8" + WORKER_PRELUDE + r"""
 # the last-rank worker of the ORIGINAL membership is the victim; the
 # regrown worker (spawned only after the victim wrote its marker) is not
 victim_marker = os.path.join(out_dir, "victim")
 victim = (tr.size == 2 and tr.rank == tr.size - 1
           and not os.path.exists(victim_marker))
-phases = [(tr.size, tr.num_devices())]
 proposed = False
 while tr.trained_samples < TARGET:
     loss = tr.step((X, Y))
@@ -69,14 +89,7 @@ while tr.trained_samples < TARGET:
     if (not victim and tr.rank == 0 and tr.size == 1 and not proposed):
         tr.propose_new_size(2)   # grow back once the shrink landed
         proposed = True
-
-w = tr.current_params()["w"]
-with open(os.path.join(out_dir, f"done.{we.self_spec.port}"), "w") as f:
-    f.write(f"{tr.size}:{tr.num_devices()}:{tr.trained_samples}:"
-            f"{float(np.square(w).sum()):.9e}:"
-            f"{';'.join(f'{a}x{b}' for a, b in phases)}")
-tr.shutdown()
-"""
+""" + WORKER_EPILOGUE
 
 
 @pytest.mark.skipif(not native.available(), reason="native lib unavailable")
@@ -118,13 +131,12 @@ def test_resize_live_multiprocess_data_plane(tmp_path, monkeypatch):
         finals = []
         survivor_phases = None
         for f in done:
-            size, ndev, trained, wsum, phases = (
-                (out / f).read_text().split(":"))
-            assert int(size) == 2          # finished on the 2-proc cluster
-            assert int(ndev) == 8          # ... whose mesh spans 2x4 devs
-            assert int(trained) >= 60 * 8  # target reached
+            size, ndev, trained, wsum, phases = _parse_done(out / f)
+            assert size == 2          # finished on the 2-proc cluster
+            assert ndev == 8          # ... whose mesh spans 2x4 devs
+            assert trained >= 60 * 8  # target reached
             # progress preserved: counters carried across both rebuilds
-            assert int(trained) > victim_trained
+            assert trained > victim_trained
             finals.append((trained, wsum))
             if "1x4" in phases:
                 survivor_phases = phases
@@ -133,9 +145,83 @@ def test_resize_live_multiprocess_data_plane(tmp_path, monkeypatch):
         # the survivor actually passed through the shrunken 1-proc x
         # 4-device data plane before growing back
         assert survivor_phases is not None, "no worker saw the 1x4 phase"
-        assert survivor_phases.split(";") == ["2x8", "1x4", "2x8"]
+        assert survivor_phases == ["2x8", "1x4", "2x8"]
 
         _, final_cluster = fetch_config(srv.url)
         assert final_cluster.size() == 2
+    finally:
+        srv.stop()
+
+
+GROW_WORKER = (
+    "B, TARGET = 24, 40 * 24  # B divides the 2x4=8 and 3x4=12 meshes"
+    + WORKER_PRELUDE + r"""
+proposed = False
+while tr.trained_samples < TARGET:
+    loss = tr.step((X, Y))
+    if loss is None:
+        sys.exit(0)
+    if (tr.size, tr.num_devices()) != phases[-1]:
+        phases.append((tr.size, tr.num_devices()))
+    if tr.rank == 0 and tr.size == 2 and tr.step_count >= 4 and not proposed:
+        tr.propose_new_size(3)   # grow BEYOND the original membership
+        proposed = True
+""" + WORKER_EPILOGUE
+)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_grow_beyond_initial_membership(tmp_path, monkeypatch):
+    """Growing the live data plane PAST its original size: 2 procs x 4
+    devices propose 3; the watcher spawns a process that never existed
+    before, it joins at v+1 over the versioned coordinator, receives
+    state over the host plane, and all three finish on the 3 x 4 = 12
+    device mesh with identical parameters.  (The preemption test above
+    only regrows to the original size — this is the harder half of
+    watch.go:64-83's diff/spawn contract.)"""
+    from kungfu_tpu.elastic import ConfigServer, fetch_config, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text(GROW_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=4")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KFT_RECV_TIMEOUT_S", "3")
+    monkeypatch.setenv("KFT_CONN_RETRIES", "10")
+
+    # capacity 3 on the host, initial membership 2
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:3"), 2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31966),
+                       cluster, srv.url, poll_interval=0.2,
+                       preempt_recover=True)
+        assert rc == 0
+
+        done = sorted(f for f in os.listdir(out) if f.startswith("done"))
+        assert len(done) == 3, done
+        finals = []
+        grew = None
+        for f in done:
+            size, ndev, trained, wsum, phases = _parse_done(out / f)
+            assert size == 3
+            assert ndev == 12
+            assert trained >= 40 * 24
+            finals.append((trained, wsum))
+            if phases[:2] == ["2x8", "3x12"]:
+                grew = phases
+        assert len(set(finals)) == 1, finals
+        assert grew is not None, "no original worker saw 2x8 -> 3x12"
+
+        _, final_cluster = fetch_config(srv.url)
+        assert final_cluster.size() == 3
     finally:
         srv.stop()
