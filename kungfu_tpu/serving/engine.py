@@ -227,7 +227,8 @@ def _pool_specs(tp_axis, quant: bool, n_layers: int):
 
 def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
                        attend_mode: str = "auto", mesh=None,
-                       tp_axis: str = "tp", quant: bool = False):
+                       tp_axis: str = "tp", quant: bool = False,
+                       prep=None):
     """``chunk`` decode steps in ONE device program (a lax.scan feeding
     each sampled token to the next step on-device), returning all sampled
     tokens [chunk, S] at once.
@@ -257,7 +258,22 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
 
         def body(carry, _):
             pools, pos, tok, tc = carry
-            logits, pools = _decode_core(params, cfg, block_size, pools,
+            p = params
+            if prep is not None:
+                # dequant INSIDE the scan body, pinned to the
+                # loop-varying step counter: XLA's while-loop LICM
+                # would otherwise hoist the convert out of the scan and
+                # materialize a full-dtype weight copy — paying an
+                # extra write+read per chunk and forfeiting the halved
+                # per-step weight stream that is the whole point
+                # (measured 0.94x before pinning).  The barrier ties
+                # the int8 leaves to ``tc`` so the dequant stays
+                # per-step and fuses into each dot's weight read.
+                leaves, tdef = jax.tree_util.tree_flatten(params)
+                pinned = lax.optimization_barrier(tuple(leaves) + (tc,))
+                p = prep(jax.tree_util.tree_unflatten(tdef,
+                                                      pinned[:-1]))
+            logits, pools = _decode_core(p, cfg, block_size, pools,
                                          tables, pos, tok, attend_mode,
                                          tp_axis_)
             nxt = _pick_tokens(logits, uid_lo, uid_hi, tc, temp,
@@ -287,7 +303,7 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
 
 def _make_verify(cfg: GPTConfig, block_size: int, K: int,
                  attend_mode: str = "auto", mesh=None,
-                 tp_axis: str = "tp", quant: bool = False):
+                 tp_axis: str = "tp", quant: bool = False, prep=None):
     """Speculative-decoding verify step: feed every slot its current
     token PLUS ``K`` drafted continuations (Q = K+1 query positions) in
     ONE forward, return the model's prediction at each position.
@@ -307,6 +323,8 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
 
     def verify(params, pools, tables, pos, draft, uid_lo, uid_hi,
                tcount, temp, top_k, top_p, tp_axis_=None):
+        if prep is not None:
+            params = prep(params)
         qpos = pos[:, None] + jnp.arange(Q)[None, :]      # [S, Q]
         x = G.embed(params, draft, qpos, cfg)             # [S, Q, D]
         new_pools = []
@@ -368,7 +386,8 @@ def _propose_draft(history, K: int, ngram: int = 2):
 
 
 def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
-                  mesh=None, tp_axis: str = "tp", quant: bool = False):
+                  mesh=None, tp_axis: str = "tp", quant: bool = False,
+                  prep=None):
     """Bucketed dense prefill for a GROUP of requests in one device
     program: causal forward over the padded prompts (one matmul-heavy
     pass — the MXU path, not T scan steps), K/V scattered into every
@@ -384,6 +403,8 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
 
     def prefill(params, pools, table_rows, tokens, t_real, uid_lo,
                 uid_hi, temp, top_k, top_p, tp_axis_=None):
+        if prep is not None:
+            params = prep(params)
         T = tokens.shape[1]                              # [G, T]
         pos = jnp.arange(T)
         x = G.embed(params, tokens, pos, cfg)            # [G, T, D]
@@ -421,7 +442,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
 
 
 def _make_prefill_cached(cfg: GPTConfig, block_size: int, group: int,
-                         mesh=None, tp_axis: str = "tp"):
+                         mesh=None, tp_axis: str = "tp", prep=None):
     """Suffix prefill for prefix-cache hits: each row's prompt SUFFIX
     (positions ``t_cached .. t_cached + t_real - 1``) runs the dense
     forward; its K/V scatter to the row's own blocks at those absolute
@@ -436,6 +457,8 @@ def _make_prefill_cached(cfg: GPTConfig, block_size: int, group: int,
 
     def prefill(params, pools, table_rows, tokens, t_real, t_cached,
                 uid_lo, uid_hi, temp, top_k, top_p, tp_axis_=None):
+        if prep is not None:
+            params = prep(params)
         T = tokens.shape[1]                              # [G, T] suffixes
         rel = jnp.arange(T)
         qpos = t_cached[:, None] + rel[None, :]          # absolute [G, T]
@@ -532,7 +555,8 @@ class DecodeEngine:
                  prefill_group: Optional[int] = None, on_tokens=None,
                  attend: str = "auto", mesh=None, tp_axis: str = "tp",
                  kv_dtype=None, speculative: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 weights_int8: bool = False):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
@@ -549,6 +573,21 @@ class DecodeEngine:
                 params, G.param_specs(cfg, tp_axis))
         self.mesh = mesh
         self.tp_axis = tp_axis
+        prep = None
+        if weights_int8:
+            # weight-only int8 (W8A16): halves the per-step HBM weight
+            # stream that dominates low-concurrency decode; dequant runs
+            # inside each jitted step (ops/quant.py).  Single-controller
+            # only for now: the tp shard_map path would need sharded
+            # per-channel scale specs alongside G.param_specs.
+            if mesh is not None:
+                raise ValueError("weights_int8 requires mesh=None "
+                                 "(tp-sharded scale layout not "
+                                 "implemented)")
+            from ..ops.quant import dequantize_weights, quantize_weights
+            params = quantize_weights(params)
+            prep = lambda q: dequantize_weights(q, cfg.dtype)
+        self.weights_int8 = bool(weights_int8)
         self.params = params
         self.cfg = cfg
         self.S = num_slots
@@ -624,16 +663,17 @@ class DecodeEngine:
         self.spec = max(0, int(speculative))
         if self.spec:
             self._verify = _make_verify(cfg, block_size, self.spec,
-                                        attend, mesh, tp_axis, quant)
+                                        attend, mesh, tp_axis, quant,
+                                        prep=prep)
         else:
             self._decode = _make_decode_chunk(cfg, block_size, self.K,
                                               attend, mesh, tp_axis,
-                                              quant)
+                                              quant, prep=prep)
         self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
-                                      tp_axis, quant)
+                                      tp_axis, quant, prep=prep)
         if self.prefix_cache:
             self._prefill_cached = _make_prefill_cached(
-                cfg, block_size, self.G, mesh, tp_axis)
+                cfg, block_size, self.G, mesh, tp_axis, prep=prep)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin

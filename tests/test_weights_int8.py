@@ -1,0 +1,176 @@
+"""Weight-only int8 (W8A16) serving: quantization correctness and the
+engine contract under quantized weights.
+
+The quantized model is a DIFFERENT (deterministic) function of the
+prompt than the bf16 one — the oracle for engine tests is therefore
+``models.gpt.generate`` run with the SAME dequantized weights, which
+must match token-exactly; scheduling invariance holds verbatim because
+nothing in the key discipline touches the weight dtype.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.ops.quant import (QuantizedTensor, dequantize_weights,
+                                  quantize_tensor, quantize_weights)
+from kungfu_tpu.serving import DecodeEngine, Request
+
+CFG = G.GPTConfig(vocab_size=97, d_model=16, n_heads=4, n_layers=2,
+                  d_ff=32, max_seq=64, dtype=jnp.float32)
+
+
+def _params(cfg, seed=0):
+    return G.init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _prompt(rng, n, cfg):
+    return rng.randint(0, cfg.vocab_size, n).tolist()
+
+
+# ------------------------------------------------------------ quant math
+def test_roundtrip_error_bounded_by_half_scale():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 128) * 3.0, jnp.float32)
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 128)
+    err = np.abs(np.asarray(qt.dequant(jnp.float32)) - np.asarray(w))
+    bound = np.asarray(qt.scale)[0] / 2 + 1e-6
+    assert (err <= bound[None, :]).all(), (err.max(), bound.max())
+
+
+def test_scale_is_per_output_channel():
+    """A column scaled by 1000x must not poison other columns'
+    precision — the per-channel property."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(64, 8).astype(np.float32)
+    w[:, 3] *= 1000.0
+    qt = quantize_tensor(jnp.asarray(w))
+    deq = np.asarray(qt.dequant(jnp.float32))
+    # untouched columns keep small-scale precision
+    small = [c for c in range(8) if c != 3]
+    assert np.abs(deq[:, small] - w[:, small]).max() < 0.02
+
+
+def test_3d_scale_is_per_head():
+    """wq-shaped [D, H, Dh] weights: one outlier HEAD must not poison
+    the other heads' precision — scale reduces over the fan-in axis
+    only when it is the big leading axis."""
+    rng = np.random.RandomState(5)
+    w = rng.randn(64, 4, 8).astype(np.float32)
+    w[:, 2, :] *= 1000.0
+    qt = quantize_tensor(jnp.asarray(w))
+    assert qt.scale.shape == (1, 4, 8)
+    deq = np.asarray(qt.dequant(jnp.float32))
+    ok_heads = [h for h in range(4) if h != 2]
+    assert np.abs(deq[:, ok_heads] - w[:, ok_heads]).max() < 0.05
+
+
+def test_small_leading_axis_keeps_output_channel_scale():
+    """wo-shaped [H, Dh, D] (small leading H): scale stays per output
+    channel (all leading axes reduced), still a valid reconstruction."""
+    rng = np.random.RandomState(6)
+    w = rng.randn(4, 8, 16).astype(np.float32)
+    qt = quantize_tensor(jnp.asarray(w))
+    assert qt.scale.shape == (1, 1, 16)
+    err = np.abs(np.asarray(qt.dequant(jnp.float32)) - w)
+    assert (err <= np.asarray(qt.scale)[0, 0] / 2 + 1e-6).all()
+
+
+def test_quantize_weights_selects_leaves():
+    params = _params(CFG)
+    qp = quantize_weights(params)
+    # wte excluded by default (gather path), norm gains too small
+    assert not isinstance(qp["wte"], QuantizedTensor)
+    assert not isinstance(qp["lnf"], QuantizedTensor)
+    # the head matmul is the canonical target
+    assert isinstance(qp["lm_head"], QuantizedTensor)
+    # dequant restores a plain tree with the same structure
+    deq = dequantize_weights(qp, CFG.dtype)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: a.shape == b.shape, params, deq))
+
+
+def test_quantized_tree_traces_through_jit():
+    qp = quantize_weights(_params(CFG))
+
+    @jax.jit
+    def head_norm(q):
+        p = dequantize_weights(q, CFG.dtype)
+        return jnp.sum(p["lm_head"] ** 2)
+
+    assert np.isfinite(float(head_norm(qp)))
+
+
+# ------------------------------------------------------- engine contract
+def _dequant_oracle(params, cfg, prompt, n_new):
+    """generate() with the SAME weights the engine actually uses."""
+    ref = dequantize_weights(quantize_weights(params), cfg.dtype)
+    out = G.generate(ref, cfg, jnp.asarray([prompt], jnp.int32), n_new)
+    return np.asarray(out)[0].tolist()
+
+
+def test_engine_matches_dequantized_oracle():
+    params = _params(CFG)
+    rng = np.random.RandomState(2)
+    reqs = [Request(uid=i, prompt=_prompt(rng, int(rng.randint(2, 12)), CFG),
+                    max_new=int(rng.randint(1, 7)))
+            for i in range(5)]
+    eng = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                       num_blocks=32, prompt_buckets=(8, 16),
+                       weights_int8=True)
+    res = eng.run(reqs)
+    for r in reqs:
+        assert res[r.uid] == _dequant_oracle(params, CFG, r.prompt,
+                                             r.max_new), f"uid {r.uid}"
+
+
+def test_weights_int8_scheduling_invariant():
+    """Same request, different co-tenancy/slot pressure: identical
+    stream (the key discipline is untouched by the weight dtype)."""
+    params = _params(CFG)
+    rng = np.random.RandomState(3)
+    probe = Request(uid=99, prompt=_prompt(rng, 6, CFG), max_new=6,
+                    temperature=0.8, top_k=5)
+    others = [Request(uid=i, prompt=_prompt(rng, 4, CFG), max_new=3)
+              for i in range(3)]
+    runs = []
+    for slots in (1, 3):
+        eng = DecodeEngine(params, CFG, num_slots=slots, block_size=4,
+                           num_blocks=32, prompt_buckets=(8, 16),
+                           weights_int8=True)
+        runs.append(eng.run([probe] + (others if slots > 1 else []))[99])
+    assert runs[0] == runs[1]
+
+
+def test_weights_int8_composes_with_kv_int8_and_spec():
+    params = _params(CFG)
+    rng = np.random.RandomState(4)
+    reqs = [Request(uid=i, prompt=_prompt(rng, 5, CFG), max_new=4)
+            for i in range(3)]
+    base = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                        num_blocks=32, prompt_buckets=(8,),
+                        weights_int8=True).run(reqs)
+    spec = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                        num_blocks=32, prompt_buckets=(8,),
+                        weights_int8=True, speculative=2).run(reqs)
+    # greedy speculative is lossless -> identical streams
+    assert base == spec
+
+
+def test_weights_int8_mesh_raises():
+    tp_cfg = G.GPTConfig(vocab_size=96, d_model=16, n_heads=4,
+                         n_layers=2, d_ff=32, max_seq=64,
+                         dtype=jnp.float32)
+    params = _params(tp_cfg)
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    from kungfu_tpu.comm.mesh import make_mesh
+    mesh = make_mesh(("tp",), (2,), devs[:2])
+    with pytest.raises(ValueError, match="weights_int8"):
+        DecodeEngine(params, tp_cfg, num_slots=2, block_size=4,
+                     num_blocks=16, prompt_buckets=(8,), mesh=mesh,
+                     weights_int8=True)

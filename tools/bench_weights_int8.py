@@ -1,0 +1,123 @@
+"""On-chip weight-only int8 (W8A16) decode benchmark, A/B vs bf16.
+
+Measures BOTH things weight quantization buys, honestly:
+
+- weight HBM bytes (halved — the dependable win at every scale: at
+  200M params that is ~0.2 GB freed for KV blocks);
+- decode tok/s.  Isolated-probe context: the 1024x32768 head matmul
+  alone runs 1.87x faster from int8-stored weights at decode batch 8
+  (ops/quant.py docstring).  End to end at 200M params vs a
+  bf16-STORED baseline this chip measures 1.09x (int8 faster in every
+  alternating rep); the gap to 1.87x is the per-op-overhead-bound
+  fraction of the step, which shrinks (and the win grows) with model
+  size.  Arms alternate and report best-of-3 because the tunnelled
+  chip's throughput drifts tens of percent over minutes — a
+  sequential A-then-B run once mismeasured 0.56x from one drift
+  window.
+
+    python tools/bench_weights_int8.py          # writes WEIGHTS_INT8_BENCH.json
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
+        chunk=128, out_path="WEIGHTS_INT8_BENCH.json"):
+    from kungfu_tpu.models import gpt as G
+    from kungfu_tpu.serving import DecodeEngine, Request
+
+    plat = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if plat == "tpu" else jnp.float32
+    # ~200M params so the per-step weight stream (~0.4 GB bf16) dwarfs
+    # activations at 8 decode rows — the regime the int8 read halves
+    cfg = G.GPTConfig(vocab_size=32768, d_model=1024, n_heads=8,
+                      n_kv_heads=4, n_layers=12, d_ff=4096, max_seq=1024,
+                      rope=True, mlp="swiglu", dtype=dtype)
+    params = G.init_params(jax.random.PRNGKey(0), cfg)
+    # store weights in the model dtype: init_params returns f32 leaves,
+    # and benching int8 against an f32-stored baseline would double the
+    # baseline's weight stream and flatter the ratio (caught in review:
+    # the first artifact's "bf16" arm read 1023.5 MB = 4 B/param)
+    params = jax.tree_util.tree_map(
+        lambda t: t.astype(dtype)
+        if jnp.issubdtype(t.dtype, jnp.floating) else t, params)
+    rng = np.random.RandomState(0)
+
+    def reqs(uid0=0):
+        return [Request(uid=uid0 + i,
+                        prompt=rng.randint(1, cfg.vocab_size,
+                                           prompt_len).tolist(),
+                        max_new=max_new) for i in range(n_requests)]
+
+    def tree_bytes(tree):
+        return int(sum(
+            getattr(l, "nbytes",
+                    getattr(l, "size", 0) * l.dtype.itemsize)
+            for l in jax.tree_util.tree_leaves(tree)))
+
+    def make(weights_int8: bool):
+        eng = DecodeEngine(params, cfg, num_slots=slots, block_size=64,
+                           num_blocks=slots * 8 + 1, decode_chunk=chunk,
+                           prompt_buckets=(64,),
+                           weights_int8=weights_int8)
+        warm = eng.run(reqs(90000 + (1000 if weights_int8 else 0))[:2])
+        assert all(len(v) == max_new for v in warm.values())
+        return eng, tree_bytes(eng.params)
+
+    def measure(eng, uid0):
+        t0 = time.perf_counter()
+        res = eng.run(reqs(uid0))
+        wall = time.perf_counter() - t0
+        toks = sum(len(v) for v in res.values())
+        return wall, toks
+
+    # chip throughput drifts tens of percent over minutes on the
+    # tunnelled dev chip; ALTERNATE the arms across 3 reps and take
+    # each arm's best so a drift window cannot masquerade as a result
+    eng_a, bytes_a = make(False)
+    eng_b, bytes_b = make(True)
+    walls_a, walls_b = [], []
+    toks = None
+    for i in range(3):
+        w, toks = measure(eng_a, 10000 + 100 * i)
+        walls_a.append(w)
+        w, toks = measure(eng_b, 60000 + 100 * i)
+        walls_b.append(w)
+
+    def arm(walls, wbytes):
+        wall = min(walls)
+        return {"wall_s_best": round(wall, 3),
+                "wall_s_all": [round(w, 3) for w in walls],
+                "tokens_out": toks,
+                "tok_per_s": round(toks / wall, 1),
+                "weight_hbm_mb": round(wbytes / 1e6, 1)}
+
+    a = arm(walls_a, bytes_a)
+    b = arm(walls_b, bytes_b)
+    doc = {
+        "platform": plat, "device": str(jax.devices()[0]),
+        "workload": {"n_requests": n_requests, "prompt_len": prompt_len,
+                     "max_new": max_new, "slots": slots, "chunk": chunk,
+                     "params_m": 200},
+        "bf16": a, "weights_int8": b,
+        "speedup": round(b["tok_per_s"] / a["tok_per_s"], 3),
+        "weight_hbm_ratio": round(b["weight_hbm_mb"] / a["weight_hbm_mb"],
+                                  3),
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc))
+    return doc
+
+
+if __name__ == "__main__":
+    run()
