@@ -77,13 +77,16 @@ def quantize_tensor(w) -> QuantizedTensor:
     is weight compression, not integer matmul, so scales need not be
     constant per contraction group; finer is strictly lower error).
 
-    Granularity: amax over axis 0 alone when it is the big fan-in axis
-    (>= 64 — e.g. wq [D, H, Dh] gets a per-(head, channel) scale, so
-    one outlier head cannot poison the others' precision), else over
-    all leading axes (e.g. wo [H, Dh, D] with a small leading H keeps
-    a per-output-channel scale and tiny scale storage)."""
+    Granularity: amax over axis 0 alone when axis 0 is the DOMINANT
+    axis of a 3D+ weight (the fan-in layout, e.g. wq [D, H, Dh] — a
+    per-(head, channel) scale, so one outlier head cannot poison the
+    others' precision); otherwise over all leading axes, which for 2D
+    is the same thing and for output-major 3D layouts (wo [H, Dh, D],
+    any H) keeps a small per-output-channel scale instead of a
+    [1, Dh, D] plane whose f32 bytes would erode the int8 saving."""
     w32 = w.astype(jnp.float32)
-    if w.ndim >= 2 and w.shape[0] >= 64:
+    if (w.ndim >= 3 and w.shape[0] >= 64
+            and w.shape[0] >= max(w.shape[1:])):
         amax = jnp.max(jnp.abs(w32), axis=0, keepdims=True)
     else:
         amax = jnp.max(jnp.abs(w32),
@@ -106,6 +109,11 @@ def quantize_weights(params, exclude: Sequence[str] = ("wte", "wpe"),
     full dequantized table instead of fusing.
     """
     def q(leaf):
+        if _is_qt(leaf):
+            # loud rather than nested: double-quantizing would wrap the
+            # scale planes themselves and fail far away at trace time
+            raise ValueError("params are already quantized "
+                             "(QuantizedTensor leaf found)")
         if (hasattr(leaf, "ndim") and leaf.ndim >= 2
                 and (min_size == 0 or leaf.size >= min_size)
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
@@ -114,8 +122,33 @@ def quantize_weights(params, exclude: Sequence[str] = ("wte", "wpe"),
 
     out = {}
     for k, v in params.items():
-        out[k] = v if k in exclude else jax.tree_util.tree_map(q, v)
+        out[k] = v if k in exclude else jax.tree_util.tree_map(
+            q, v, is_leaf=_is_qt)
     return out
+
+
+def quantize_specs(params_q, specs):
+    """PartitionSpec tree for a :func:`quantize_weights` output, derived
+    from the unquantized tree's specs: a QuantizedTensor leaf becomes
+    ``QuantizedTensor(weight_spec, scale_spec)`` — itself a pytree
+    node, so it flattens alongside the (q, scale) arrays for device_put
+    and shard_map in_specs.  Scale dims of size 1 are replicated
+    (``None``); kept dims inherit the weight's sharding, which is
+    consistent because quantization reduces only over leading axes
+    (global amax BEFORE sharding) and elementwise dequant commutes with
+    slicing."""
+    from jax.sharding import PartitionSpec as P
+
+    def f(leaf, spec):
+        if not _is_qt(leaf):
+            return spec
+        sdims = tuple(
+            None if leaf.scale.shape[i] == 1
+            else (spec[i] if i < len(spec) else None)
+            for i in range(leaf.scale.ndim))
+        return QuantizedTensor(spec, P(*sdims))
+
+    return jax.tree_util.tree_map(f, params_q, specs, is_leaf=_is_qt)
 
 
 def dequantize_weights(params, dtype):

@@ -228,7 +228,7 @@ def _pool_specs(tp_axis, quant: bool, n_layers: int):
 def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
                        attend_mode: str = "auto", mesh=None,
                        tp_axis: str = "tp", quant: bool = False,
-                       prep=None):
+                       prep=None, pspecs=None):
     """``chunk`` decode steps in ONE device program (a lax.scan feeding
     each sampled token to the next step on-device), returning all sampled
     tokens [chunk, S] at once.
@@ -290,7 +290,7 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
 
     if mesh is None:
         return jax.jit(run, donate_argnums=(1,))
-    specs = G.param_specs(cfg, tp_axis)
+    specs = pspecs if pspecs is not None else G.param_specs(cfg, tp_axis)
     rep = P()
     body = functools.partial(run, tp_axis_=tp_axis)
     sm = jax.shard_map(
@@ -303,7 +303,8 @@ def _make_decode_chunk(cfg: GPTConfig, block_size: int, chunk: int,
 
 def _make_verify(cfg: GPTConfig, block_size: int, K: int,
                  attend_mode: str = "auto", mesh=None,
-                 tp_axis: str = "tp", quant: bool = False, prep=None):
+                 tp_axis: str = "tp", quant: bool = False, prep=None,
+                 pspecs=None):
     """Speculative-decoding verify step: feed every slot its current
     token PLUS ``K`` drafted continuations (Q = K+1 query positions) in
     ONE forward, return the model's prediction at each position.
@@ -355,7 +356,7 @@ def _make_verify(cfg: GPTConfig, block_size: int, K: int,
 
     if mesh is None:
         return jax.jit(verify, donate_argnums=(1,))
-    specs = G.param_specs(cfg, tp_axis)
+    specs = pspecs if pspecs is not None else G.param_specs(cfg, tp_axis)
     rep = P()
     body = functools.partial(verify, tp_axis_=tp_axis)
     sm = jax.shard_map(
@@ -387,7 +388,7 @@ def _propose_draft(history, K: int, ngram: int = 2):
 
 def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
                   mesh=None, tp_axis: str = "tp", quant: bool = False,
-                  prep=None):
+                  prep=None, pspecs=None):
     """Bucketed dense prefill for a GROUP of requests in one device
     program: causal forward over the padded prompts (one matmul-heavy
     pass — the MXU path, not T scan steps), K/V scattered into every
@@ -430,7 +431,7 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
 
     if mesh is None:
         return jax.jit(prefill, donate_argnums=(1,))
-    specs = G.param_specs(cfg, tp_axis)
+    specs = pspecs if pspecs is not None else G.param_specs(cfg, tp_axis)
     rep = P()
     body = functools.partial(prefill, tp_axis_=tp_axis)
     sm = jax.shard_map(
@@ -442,7 +443,8 @@ def _make_prefill(cfg: GPTConfig, block_size: int, group: int,
 
 
 def _make_prefill_cached(cfg: GPTConfig, block_size: int, group: int,
-                         mesh=None, tp_axis: str = "tp", prep=None):
+                         mesh=None, tp_axis: str = "tp", prep=None,
+                         pspecs=None):
     """Suffix prefill for prefix-cache hits: each row's prompt SUFFIX
     (positions ``t_cached .. t_cached + t_real - 1``) runs the dense
     forward; its K/V scatter to the row's own blocks at those absolute
@@ -492,7 +494,7 @@ def _make_prefill_cached(cfg: GPTConfig, block_size: int, group: int,
 
     if mesh is None:
         return jax.jit(prefill, donate_argnums=(1,))
-    specs = G.param_specs(cfg, tp_axis)
+    specs = pspecs if pspecs is not None else G.param_specs(cfg, tp_axis)
     rep = P()
     body = functools.partial(prefill, tp_axis_=tp_axis)
     sm = jax.shard_map(
@@ -564,29 +566,31 @@ class DecodeEngine:
         if kv_dtype is not None and not quant:
             raise ValueError("kv_dtype must be None (model dtype) or "
                              "jnp.int8")
-        if mesh is not None:
-            G.validate_tp(cfg,
-                          mesh.devices.shape[mesh.axis_names.index(tp_axis)])
-            # accept a host tree (shard it) or already-sharded params
-            params = jax.tree_util.tree_map(
-                lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
-                params, G.param_specs(cfg, tp_axis))
-        self.mesh = mesh
-        self.tp_axis = tp_axis
         prep = None
+        pspecs = None
         if weights_int8:
             # weight-only int8 (W8A16): halves the per-step HBM weight
-            # stream that dominates low-concurrency decode; dequant runs
-            # inside each jitted step (ops/quant.py).  Single-controller
-            # only for now: the tp shard_map path would need sharded
-            # per-channel scale specs alongside G.param_specs.
-            if mesh is not None:
-                raise ValueError("weights_int8 requires mesh=None "
-                                 "(tp-sharded scale layout not "
-                                 "implemented)")
+            # stream of low-concurrency decode; dequant runs inside
+            # each jitted step (ops/quant.py).  Quantization happens on
+            # the HOST tree BEFORE any tp sharding, so scales reduce
+            # over the full (global) leading axes and shard alongside
+            # their weights (quantize_specs).
             from ..ops.quant import dequantize_weights, quantize_weights
             params = quantize_weights(params)
             prep = lambda q: dequantize_weights(q, cfg.dtype)
+        if mesh is not None:
+            G.validate_tp(cfg,
+                          mesh.devices.shape[mesh.axis_names.index(tp_axis)])
+            pspecs = G.param_specs(cfg, tp_axis)
+            if weights_int8:
+                from ..ops.quant import quantize_specs
+                pspecs = quantize_specs(params, pspecs)
+            # accept a host tree (shard it) or already-sharded params
+            params = jax.tree_util.tree_map(
+                lambda t, s: jax.device_put(t, NamedSharding(mesh, s)),
+                params, pspecs)
+        self.mesh = mesh
+        self.tp_axis = tp_axis
         self.weights_int8 = bool(weights_int8)
         self.params = params
         self.cfg = cfg
@@ -664,16 +668,19 @@ class DecodeEngine:
         if self.spec:
             self._verify = _make_verify(cfg, block_size, self.spec,
                                         attend, mesh, tp_axis, quant,
-                                        prep=prep)
+                                        prep=prep, pspecs=pspecs)
         else:
             self._decode = _make_decode_chunk(cfg, block_size, self.K,
                                               attend, mesh, tp_axis,
-                                              quant, prep=prep)
+                                              quant, prep=prep,
+                                              pspecs=pspecs)
         self._prefill = _make_prefill(cfg, block_size, self.G, mesh,
-                                      tp_axis, quant, prep=prep)
+                                      tp_axis, quant, prep=prep,
+                                      pspecs=pspecs)
         if self.prefix_cache:
             self._prefill_cached = _make_prefill_cached(
-                cfg, block_size, self.G, mesh, tp_axis, prep=prep)
+                cfg, block_size, self.G, mesh, tp_axis, prep=prep,
+                pspecs=pspecs)
         self.stats = EngineStats(num_slots)
 
     # ------------------------------------------------------------- admin

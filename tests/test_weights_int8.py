@@ -158,19 +158,47 @@ def test_weights_int8_composes_with_kv_int8_and_spec():
                         weights_int8=True, speculative=2).run(reqs)
     # greedy speculative is lossless -> identical streams
     assert base == spec
+    # kv int8 on top of weight int8: both quantizations active in one
+    # decode step (prep + quantized pool specs); results exist for
+    # every request and match THEIR own deterministic function across
+    # two scheduling shapes
+    kw = dict(block_size=4, num_blocks=32, prompt_buckets=(8,),
+              weights_int8=True, kv_dtype=jnp.int8)
+    both_a = DecodeEngine(params, CFG, num_slots=2, **kw).run(reqs)
+    both_b = DecodeEngine(params, CFG, num_slots=1, **kw).run(reqs)
+    assert set(both_a) == {r.uid for r in reqs}
+    assert both_a == both_b
 
 
-def test_weights_int8_mesh_raises():
+def test_double_quantize_raises():
+    qp = quantize_weights(_params(CFG))
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_weights(qp)
+
+
+def test_weights_int8_tp_matches_single_device():
+    """Quantized weights over a tp mesh: global-scale quantization
+    before sharding + scales sharded alongside their weights
+    (quantize_specs) must emit exactly the single-device quantized
+    engine's tokens — the quantized function is topology-invariant."""
     tp_cfg = G.GPTConfig(vocab_size=96, d_model=16, n_heads=4,
-                         n_layers=2, d_ff=32, max_seq=64,
+                         n_layers=2, d_ff=32, max_seq=64, rope=True,
                          dtype=jnp.float32)
     params = _params(tp_cfg)
     devs = jax.devices()
     if len(devs) < 2:
         pytest.skip("needs >= 2 devices")
-    from kungfu_tpu.comm.mesh import make_mesh
-    mesh = make_mesh(("tp",), (2,), devs[:2])
-    with pytest.raises(ValueError, match="weights_int8"):
-        DecodeEngine(params, tp_cfg, num_slots=2, block_size=4,
-                     num_blocks=16, prompt_buckets=(8,), mesh=mesh,
-                     weights_int8=True)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(devs[:2]), ("tp",))
+    rng = np.random.RandomState(7)
+    reqs = [Request(uid=i, prompt=_prompt(rng, int(rng.randint(2, 10)),
+                                          tp_cfg),
+                    max_new=int(rng.randint(1, 6)))
+            for i in range(4)]
+    reqs[1] = Request(uid=reqs[1].uid, prompt=reqs[1].prompt,
+                      max_new=reqs[1].max_new, temperature=0.7, top_k=9)
+    kw = dict(num_slots=2, block_size=4, num_blocks=32,
+              prompt_buckets=(8, 16), decode_chunk=2, weights_int8=True)
+    res_tp = DecodeEngine(params, tp_cfg, mesh=mesh, **kw).run(list(reqs))
+    res_1d = DecodeEngine(params, tp_cfg, **kw).run(list(reqs))
+    assert res_tp == res_1d
