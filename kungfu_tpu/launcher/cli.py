@@ -48,7 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic config server URL")
     p.add_argument("-builtin-config-port", type=int, default=0,
                    help="embed a config server on this port")
-    p.add_argument("-port-range", default="31100-31199",
+    from ..plan.hostspec import DEFAULT_WORKER_PORT as _BP
+    p.add_argument("-port-range",
+                   default=f"{_BP}-{_BP + 99}",
                    help="worker port range 'lo-hi' (reference: -port-range)")
     p.add_argument("-chips-per-host", type=int, default=0,
                    help="size of the local chip pool (0 = no pinning)")

@@ -56,10 +56,17 @@ class Cluster:
         if not load:
             raise ValueError("cannot grow: no runners")
         host = min(load, key=lambda h: (load[h], list(load).index(h)))
-        port = DEFAULT_WORKER_PORT
+        # derive the port base from the CLUSTER's own workers, not the
+        # process-local default: this cluster may have been built (or
+        # read off the config server) by a process with a different
+        # KFT_BASE_PORT, and mixing bases would hand the grown worker a
+        # duplicate slot (port - base collides with an existing slot 0)
+        bases = [w.port - w.slot for w in workers]
+        base = min(bases) if bases else DEFAULT_WORKER_PORT
+        port = base
         while port in used_ports.get(host, ()):  # next free slot on host
             port += 1
-        return PeerID(host, port, port - DEFAULT_WORKER_PORT)
+        return PeerID(host, port, port - base)
 
     # -- codec (config-server wire schema) ----------------------------------
     def to_json(self) -> str:

@@ -13,8 +13,29 @@ from typing import Iterable, List
 
 from .peer import PeerID, PeerList
 
-DEFAULT_WORKER_PORT = 31100
-DEFAULT_RUNNER_PORT = 31000
+import os as _os
+
+
+def _base_port() -> int:
+    """KFT_BASE_PORT moves the whole default worker-port window:
+    concurrent test/CI processes on one host otherwise race to bind the
+    same 31100+ ports (observed: a pytest shard and a manual launcher
+    run colliding on 31100/31101).  Read ONCE at import — set it before
+    importing kungfu_tpu (children inherit the env); a cluster's OWN
+    base is always derived from its workers (``port - slot``) so
+    clusters built under a different base stay self-consistent."""
+    raw = _os.environ.get("KFT_BASE_PORT", "")
+    try:
+        return int(raw) if raw else 31100
+    except ValueError:
+        import sys
+        print(f"kungfu_tpu: ignoring malformed KFT_BASE_PORT={raw!r}",
+              file=sys.stderr)
+        return 31100
+
+
+DEFAULT_WORKER_PORT = _base_port()
+DEFAULT_RUNNER_PORT = DEFAULT_WORKER_PORT - 100
 
 
 @dataclasses.dataclass(frozen=True)

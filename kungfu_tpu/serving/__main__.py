@@ -54,6 +54,11 @@ def main(argv=None):
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8-quantized KV cache: ~2x cached tokens "
                          "per HBM byte, dequant fused into the attend")
+    ap.add_argument("--weights-int8", action="store_true",
+                    help="weight-only int8 (W8A16): int8 matmul weights "
+                         "+ per-channel scales, dequant fused into each "
+                         "decode step's weight read — ~0.57x weight "
+                         "HBM, measured 1.09x decode tok/s at 200M")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel ranks (0 = single device); "
                          "shards params + KV pools over the first N "
@@ -99,7 +104,8 @@ def main(argv=None):
                        max_len=args.max_len,
                        kv_dtype=jnp.int8 if args.kv_int8 else None,
                        mesh=mesh, speculative=args.speculative,
-                       prefix_cache=args.prefix_cache)
+                       prefix_cache=args.prefix_cache,
+                       weights_int8=args.weights_int8)
     srv = ServingServer(eng, host=args.host, port=args.port).start()
     # handlers BEFORE the readiness line: a supervisor reacting to it
     # may signal immediately, and that must reach graceful shutdown

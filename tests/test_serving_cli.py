@@ -128,6 +128,21 @@ def test_cli_kv_int8_and_tp(tmp_path):
     assert proc.returncode == 0
 
 
+def test_cli_weights_int8(tmp_path):
+    """--weights-int8 through the binary: valid deterministic tokens
+    from the quantized weights (same near-tie caveat as kv-int8)."""
+    proc, host, port = _start(["--seed", "4", "--weights-int8"],
+                              str(tmp_path / "err.log"))
+    try:
+        prompt = [3, 8, 1, 6]
+        a = _post(host, port, {"prompt": prompt, "max_new": 5})
+        b = _post(host, port, {"prompt": prompt, "max_new": 5})
+        assert len(a["tokens"]) == 5 and a["tokens"] == b["tokens"]
+    finally:
+        _stop(proc)
+    assert proc.returncode == 0
+
+
 def test_cli_rejects_bad_npz(tmp_path):
     bad = G.GPTConfig(vocab_size=61, d_model=8, n_heads=2, n_layers=1,
                       d_ff=16, max_seq=64, dtype=jnp.float32)

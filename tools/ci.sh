@@ -54,7 +54,11 @@ else
     for ((i = s; i < ${#FILES[@]}; i += JOBS)); do
       shard+=("${FILES[$i]}")
     done
-    ( python -m pytest "${shard[@]}" -q \
+    # per-shard worker-port window, starting OFF the library default
+    # (31100) so shards collide neither with each other nor with a
+    # concurrent manual run using defaults
+    ( KFT_BASE_PORT=$((31400 + s * 300)) \
+        python -m pytest "${shard[@]}" -q \
         > "/tmp/kft-ci-shard-$s.log" 2>&1 ) &
     pids+=($!)
   done
