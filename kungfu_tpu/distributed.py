@@ -39,6 +39,7 @@ _COORD_PORT_OFFSET = 1000
 # (version, coordinator, num_processes, process_id) of the live runtime,
 # None before the first initialize
 _live: Optional[Tuple[int, str, int, int]] = None
+_atexit_armed = False
 
 
 def _norm_peers(peers: Sequence) -> List[Tuple[str, int]]:
@@ -135,6 +136,65 @@ def initialize(peers: Sequence, rank: int, cluster_version: int = 0,
         shutdown_timeout_seconds=int(
             os.environ.get("KFT_DATA_PLANE_SHUTDOWN_S", "5")))
     _live = (cluster_version, coord, n, rank)
+    global _atexit_armed
+    if not _atexit_armed:
+        # plain init_distributed workers get the ordered teardown on
+        # normal exit (see shutdown_ordered); no-op if something already
+        # shut the plane down, skipped entirely on SIGTERM deaths
+        # (python does not run atexit then — the preemption path)
+        import atexit
+        atexit.register(shutdown_ordered)
+        _atexit_armed = True
+
+
+def shutdown_ordered(grace_s: float = 3.0) -> None:
+    """End-of-job teardown for workers WITHOUT a native host plane
+    (plain :func:`kungfu_tpu.init_distributed` users): a device-plane
+    barrier so every process arrives with the runtime intact, then
+    non-coordinators disconnect immediately while the coordinator gives
+    them ``grace_s`` to get their disconnect in before stopping the
+    coordination service.  Without the ordering, the coordinator's
+    process exit kills the service while peers are still disconnecting
+    and they die with the client.h fatal ("Failed to disconnect from
+    coordination service") — observed as a launcher job whose training
+    succeeded but whose exit code didn't.  (Recoverable mode disables
+    jax's own shutdown barrier for exactly the elastic reasons
+    :func:`initialize` documents, so the ordering is on us.)
+
+    Registered via atexit by :func:`initialize`; elastic trainers that
+    have a native host plane sequence exactly instead
+    (``elastic.multiproc._teardown_plane_ordered``) and leave this a
+    no-op by shutting down first.  The barrier runs under a WATCHDOG
+    (``KFT_DATA_PLANE_SHUTDOWN_S`` + heartbeat, default ~15 s): atexit
+    also fires when THIS rank is dying of an unhandled exception while
+    the others are blocked inside a training collective — they can
+    never reach the barrier, so an unbounded wait would convert a
+    one-rank crash into a cluster-wide hang.  On timeout we fall
+    through to the force disconnect, which surfaces on survivors as the
+    catchable recoverable-mode error the elastic shrink path absorbs —
+    the same signal an un-ordered exit produced."""
+    global _live
+    if _live is None:
+        return
+    import threading
+    import time
+    snap = _live
+
+    def _barrier():
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"kft-shutdown-{snap[0]}")
+        except Exception:
+            pass
+
+    timeout = (int(os.environ.get("KFT_DATA_PLANE_SHUTDOWN_S", "5"))
+               + int(os.environ.get("KFT_DATA_PLANE_HEARTBEAT_S", "10")))
+    t = threading.Thread(target=_barrier, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    if not t.is_alive() and snap[3] == 0 and snap[2] > 1:
+        time.sleep(grace_s)
+    shutdown()
 
 
 def shutdown() -> None:

@@ -238,8 +238,9 @@ def test_pp_bubble_sweep_harness():
         # at toy sizes; under CI-shard load on the 1-core box they
         # measure the scheduler, not the schedule (flaked at 1.1x,
         # 1.6x, and 2.5x margins across three rounds of loosening) —
-        # run them only when the box is quiet
-        return
+        # run them only when the box is quiet, and say so
+        pytest.skip(f"loadavg {os.getloadavg()[0]:.1f} > 2.0: timing "
+                    f"band unmeasurable (structure checks passed)")
     # amortization: more microbatches should not cost MUCH more wall
     # time (margin for background noise)
     assert secs[2] < secs[0] * 1.6, secs
