@@ -169,10 +169,13 @@ def shutdown_ordered(grace_s: float = 3.0) -> None:
     also fires when THIS rank is dying of an unhandled exception while
     the others are blocked inside a training collective — they can
     never reach the barrier, so an unbounded wait would convert a
-    one-rank crash into a cluster-wide hang.  On timeout we fall
-    through to the force disconnect, which surfaces on survivors as the
-    catchable recoverable-mode error the elastic shrink path absorbs —
-    the same signal an un-ordered exit produced."""
+    one-rank crash into a cluster-wide hang.  On timeout we return
+    WITHOUT disconnecting (a native disconnect under the still-blocked
+    barrier thread can abort instead of erroring); the process exit
+    then drops the connection, which surfaces on survivors as the same
+    catchable recoverable-mode error the elastic shrink path absorbs.
+    The timed-out rank's own exit may be unclean — it is the crashing
+    rank."""
     global _live
     if _live is None:
         return
@@ -192,7 +195,18 @@ def shutdown_ordered(grace_s: float = 3.0) -> None:
     t = threading.Thread(target=_barrier, daemon=True)
     t.start()
     t.join(timeout=timeout)
-    if not t.is_alive() and snap[3] == 0 and snap[2] > 1:
+    if t.is_alive():
+        # watchdog fired: the daemon thread is still blocked inside
+        # sync_global_devices, and its `except` cannot catch a
+        # native-level fault — calling jax.distributed.shutdown()
+        # under it can abort at exit instead of surfacing the
+        # catchable recoverable-mode error.  Return WITHOUT
+        # disconnecting: process exit drops the connection, which
+        # surfaces on survivors as the same catchable dead-client
+        # signal.  ``_live`` is left intact so an explicit later
+        # shutdown() (a caller that outlives the wedge) still acts.
+        return
+    if snap[3] == 0 and snap[2] > 1:
         time.sleep(grace_s)
     shutdown()
 

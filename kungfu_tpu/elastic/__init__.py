@@ -7,10 +7,11 @@ from .policy import (BasePolicy, PolicyContext, PolicyRunner,
 from .schedule import Stage, StepSchedule
 from .trainer import ElasticTrainer
 from .multiproc import DistributedElasticTrainer
+from .sharded import ShardedElasticTrainer
 
 __all__ = [
     "state", "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
-    "DistributedElasticTrainer",
+    "DistributedElasticTrainer", "ShardedElasticTrainer",
     "BasePolicy", "PolicyContext", "PolicyRunner", "ScheduledResizePolicy",
     "Stage", "StepSchedule", "ElasticDataShard",
 ]

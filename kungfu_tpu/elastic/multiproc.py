@@ -71,24 +71,36 @@ class DistributedElasticTrainer:
             raise RuntimeError(
                 "DistributedElasticTrainer needs the launcher env ABI "
                 "(KFT_*); for single-process elastic use ElasticTrainer")
+        self.trained_samples = 0
+        self.step_count = 0
+        self._round = 0  # per-version fence round
+        # host-state init BEFORE joining any plane: it triggers this
+        # process's first jax compilations, and a fresh joiner doing
+        # them AFTER the rendezvous stalls warmed-up survivors past
+        # their host-plane recv timeout (the first thing the sharded
+        # sync does is RECEIVE from the joiner)
+        self._init_state(init_params)
+        self._committed_progress = (0, 0)
         self.peer = native.default_peer()
         self.version = self.peer.token
         self._last_seen_version = self.version
         D.reinit(self.peer.peers, self.peer.rank, self.version,
                  local_device_ids=self.we.chip_ids)
-        self.trained_samples = 0
-        self.step_count = 0
-        self._round = 0  # per-version fence round
+        self._sync_state()
+        self._build()
+
+    # ------------------------------------------------------------ internals
+    def _init_state(self, init_params) -> None:
+        """Host-side initial state, before any device state exists; the
+        sharded sibling overrides this (it never materialises full
+        optimizer state on one host)."""
+        import jax
         self._host_params = jax.tree_util.tree_map(np.asarray, init_params)
         # host-side optimizer init so a snapshot exists before any device
         # state does; new joiners overwrite it via the rank-0 broadcast
         self._host_opt = jax.tree_util.tree_map(
             np.asarray, self.optimizer.init(self._host_params))
-        self._committed_progress = (0, 0)
-        self._sync_state()
-        self._build()
 
-    # ------------------------------------------------------------ internals
     def _sync_state(self) -> None:
         """Adopt rank 0's committed state AND the progress counters that
         describe it (reference: state broadcast on every membership
@@ -195,11 +207,18 @@ class DistributedElasticTrainer:
         self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
         self._committed_progress = (self.trained_samples, self.step_count)
 
+    def _pre_teardown(self) -> None:
+        """Hook between the pre-resize commit and the plane teardown,
+        while the OLD membership is still fully alive.  The sharded
+        sibling hands departing workers' state shards to survivors here;
+        replicated DP needs nothing (every process holds everything)."""
+
     def _resize(self) -> bool:
         """Apply a pending config change; False when detached."""
         # everyone is at the same fence: commit the live device state so
         # a voluntary resize never discards steps since the last snapshot
         self._commit()
+        self._pre_teardown()
         # the old plane comes down FIRST, with everyone still alive —
         # after resize_from_url the old host membership no longer exists
         # to sequence the teardown
@@ -248,8 +267,14 @@ class DistributedElasticTrainer:
             self._last_seen_version = max(self._last_seen_version, agreed)
             if agreed <= self.version:
                 break
-            if not self._resize():
-                return None
+            try:
+                if not self._resize():
+                    return None
+            except native.NativeError as e:
+                # a peer died DURING the voluntary resize (handoff
+                # barrier, post-rebuild commit, ...): absorb it through
+                # the same recovery path as a mid-step death
+                return self._recover(global_batch, cause=e)
             # re-fence on the NEW membership before stepping: a freshly
             # joined worker's first fence must pair with everyone's
         try:
@@ -269,7 +294,14 @@ class DistributedElasticTrainer:
         leaf = jax.tree_util.tree_leaves(global_batch)[0]
         self.trained_samples += int(leaf.shape[0])
         if self.step_count % self.snapshot_every == 0:
-            self._commit()
+            try:
+                self._commit()
+            except native.NativeError as e:
+                # sharded commits ride the host plane (shard-replica
+                # exchange); a peer death there is a membership event
+                # like any other — an INCOMPLETE commit is never
+                # recorded, so recovery restarts from the previous one
+                return self._recover(global_batch, cause=e)
         return lossv
 
     @property

@@ -1,0 +1,527 @@
+"""Elastic resize of SHARDED (ZeRO/FSDP) training state over a live
+multi-process data plane.
+
+:class:`kungfu_tpu.elastic.DistributedElasticTrainer` resizes a
+replicated-DP job: every process holds the full state, so a membership
+change is a broadcast.  This module resizes the state layout ZeRO
+exists for — flat parameter/optimizer vectors sharded 1/n per device
+(:func:`kungfu_tpu.parallel.make_fsdp_step`) — where NO process holds
+the full state and a resize must RE-SHARD: each member of the new
+membership pulls exactly the byte ranges its new devices own from
+whichever old member holds them, over the native host plane
+(the p2p versioned store, reference peer_to_peer.cpp Request/Save),
+instead of a full-model broadcast.
+
+Three membership events, three data sources:
+
+- **voluntary resize** (config-server proposal): everyone is alive at
+  the fence.  Departing workers' shard blocks are handed to survivors
+  before the old plane comes down (``_pre_teardown``), so the new
+  membership collectively covers the full vector.
+- **preemption** (a worker dies mid-step): its device shards die with
+  it.  Every commit therefore ring-replicates each process's blocks to
+  its ring successor — any SINGLE simultaneous failure is recoverable
+  from the survivor that holds the replica (the reference tolerates the
+  same failure class: one dead peer per recovery round,
+  peer.go:227-263).  Two adjacent simultaneous deaths lose state and
+  raise.
+- **grow**: a fresh process holds nothing; it pulls its new range from
+  survivors and adopts the committed progress counters.
+
+Commits are consistent by construction: a commit is recorded only after
+its replica exchange completes, every process commits at the same step
+(deterministic cadence), and recovery agrees on the newest commit ALL
+data-holders have (allreduce-MIN), which the 2-deep commit history
+guarantees exists.
+
+The device-side step is exactly ``make_fsdp_step``'s — ZeRO semantics
+as three XLA collectives — rebuilt per membership over the new global
+mesh.  Trajectory caveats (elementwise optimizers) are inherited from
+there.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import distributed as D
+from .. import native
+from ..parallel.fsdp import FSDP_AXIS, make_fsdp_step
+from .config_server import fetch_config
+from .multiproc import DistributedElasticTrainer
+
+# round-1 sync header layout (int64): [has_data, newest_seq, prev_seq,
+# samples@newest, steps@newest, samples@prev, steps@prev, old_ndev,
+# old_nproc, old_rank]
+_HDR = 10
+_NO_SEQ = -1
+
+
+def _layout(size: int, ndev: int, nproc: int) -> Tuple[int, int, int]:
+    """(padded, per-device chunk, per-process block) of the flat vector
+    on an ``ndev``-device, ``nproc``-process mesh.  Blocks are kept
+    PADDED (uniform length) so store requests have deterministic
+    shapes; padding is zeros and stays zeros under elementwise
+    optimizers (the ``make_fsdp_step`` contract)."""
+    chunk = math.ceil(size / ndev)
+    padded = chunk * ndev
+    assert ndev % nproc == 0, (ndev, nproc)
+    return padded, chunk, chunk * (ndev // nproc)
+
+
+class ShardedElasticTrainer(DistributedElasticTrainer):
+    """Elastic ZeRO-3 training whose process membership can change at
+    runtime, with state re-sharded (not re-broadcast) on every change.
+
+    Same contract as :class:`DistributedElasticTrainer` — ``step()``
+    takes the GLOBAL batch, returns the loss or None once detached —
+    but parameters and mirroring optimizer state live sharded 1/n per
+    device as flat vectors, commits snapshot only this process's block
+    (plus one ring replica), and a resize moves blocks point-to-point
+    over the host plane.
+    """
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        # seq-0 snapshot (with its ring replica): a preemption before the
+        # first cadence commit must still find a committed point
+        self._commit()
+
+    # ------------------------------------------------------------ state init
+    def _init_state(self, init_params) -> None:
+        import jax
+        from jax.flatten_util import ravel_pytree
+        host = jax.tree_util.tree_map(np.asarray, init_params)
+        flat, self._unravel = ravel_pytree(host)
+        self._flat = np.asarray(flat)
+        self._vec_size = int(self._flat.shape[0])
+        self._vec_dtype = self._flat.dtype
+        # seq -> {old_rank: {vec_name: padded block}}; 2-deep history
+        self._held: Dict[int, Dict[int, Dict[str, np.ndarray]]] = {}
+        # seq -> (samples, steps, small_leaves, old_ndev, old_nproc)
+        self._held_meta: Dict[int, tuple] = {}
+        self._synced = None  # set by _sync_state for _build to consume
+        self._gather_seq = 0  # collective-name counter for current_params
+        # leaf classification is mesh-size-independent; computed here so
+        # _sync_state can use it before the first _build (fresh joiners)
+        (self._treedef, self._sharded_mask,
+         self._leaf_shapes) = self._opt_templates(1)
+
+    # ----------------------------------------------------------- vector defs
+    def _opt_templates(self, ndev: int):
+        """(treedef, per-leaf is_sharded list, per-leaf ShapeDtype) of the
+        optimizer state over the padded flat vector.  Classification
+        matches ``parallel.fsdp._state_specs``: 1-D leaves mirroring the
+        vector are sharded, everything else is replicated — and it is
+        mesh-size-independent, so old and new membership agree on which
+        leaf is which."""
+        import jax
+        padded, _, _ = _layout(self._vec_size, ndev, 1)
+        shapes = jax.eval_shape(
+            self.optimizer.init,
+            jax.ShapeDtypeStruct((padded,), self._vec_dtype))
+        leaves, treedef = jax.tree_util.tree_flatten(shapes)
+        sharded = [getattr(s, "ndim", 0) == 1 and s.shape[0] == padded
+                   for s in leaves]
+        return treedef, sharded, leaves
+
+    def _vec_names(self) -> List[str]:
+        """Names of the sharded flat vectors: params + each mirroring
+        optimizer-state leaf, in tree order."""
+        return ["p"] + [f"o{i}" for i, s in enumerate(self._sharded_mask)
+                        if s]
+
+    def _vec_dtypes(self) -> Dict[str, np.dtype]:
+        out = {"p": self._vec_dtype}
+        for i, (s, leaf) in enumerate(
+                zip(self._sharded_mask, self._leaf_shapes)):
+            if s:
+                out[f"o{i}"] = np.dtype(leaf.dtype)
+        return out
+
+    # --------------------------------------------------------------- commit
+    def _global_vectors(self):
+        """(name, global sharded jax.Array) pairs for the live state."""
+        import jax
+        out = [("p", self._params)]
+        leaves = jax.tree_util.tree_leaves(self._opt)
+        for i, (leaf, s) in enumerate(zip(leaves, self._sharded_mask)):
+            if s:
+                out.append((f"o{i}", leaf))
+        return out
+
+    def _small_leaves(self):
+        import jax
+        leaves = jax.tree_util.tree_leaves(self._opt)
+        return [np.asarray(leaf) for leaf, s in
+                zip(leaves, self._sharded_mask) if not s]
+
+    def _local_block(self, garr) -> Tuple[int, np.ndarray]:
+        """This process's contiguous padded block of a sharded vector:
+        (padded start offset, data)."""
+        shards = sorted(garr.addressable_shards,
+                        key=lambda s: s.index[0].start)
+        lo = shards[0].index[0].start
+        datas = []
+        at = lo
+        for s in shards:
+            assert s.index[0].start == at, (
+                "non-contiguous addressable shards: device order does not "
+                "group this process's devices; sharded elastic requires "
+                "jax.distributed's per-process-contiguous device ids")
+            datas.append(np.asarray(s.data))
+            at = s.index[0].stop
+        return int(lo), np.concatenate(datas)
+
+    def _commit(self) -> None:
+        seq = self.step_count
+        if seq in self._held_meta:
+            return  # already committed at this step (resize right after)
+        p = self.peer
+        ndev = self.num_devices()
+        nproc = p.size
+        blocks: Dict[str, np.ndarray] = {}
+        for name, garr in self._global_vectors():
+            _, data = self._local_block(garr)
+            blocks[name] = data
+        small = self._small_leaves()
+        # ring replica: pull the PREDECESSOR's blocks so any single
+        # failure leaves each block on a survivor (rank r's block lives
+        # on r and on (r+1) % n)
+        held = {p.rank: blocks}
+        if nproc > 1:
+            for name, b in blocks.items():
+                p.save(f"kftsh:{name}", b, version=seq)
+            p.barrier(name=f"kftsh-commit@{self.version}:{seq}")
+            pred = (p.rank - 1) % nproc
+            _, _, block_len = _layout(self._vec_size, ndev, nproc)
+            dt = self._vec_dtypes()
+            held[pred] = {
+                name: p.request(pred, f"kftsh:{name}",
+                                np.empty(block_len, dt[name]), version=seq)
+                for name in blocks}
+        # record only AFTER the exchange: a commit interrupted by a peer
+        # death must not count (recovery falls back to the previous one)
+        self._held[seq] = held
+        self._held_meta[seq] = (self.trained_samples, self.step_count,
+                                small, ndev, nproc, p.rank)
+        for old in sorted(self._held_meta):
+            if old < seq and len(self._held_meta) > 2:
+                self._held_meta.pop(old)
+                self._held.pop(old, None)
+        self._committed_progress = (self.trained_samples, self.step_count)
+
+    # ------------------------------------------------- voluntary handoff
+    def _pre_teardown(self) -> None:
+        """Departing workers' blocks move to survivors while everyone is
+        still alive: the first surviving ring successor of each departing
+        rank pulls its blocks (already in the departing peer's store from
+        the commit that just ran)."""
+        p = self.peer
+        if p is None or p.size <= 1 or not self.we.config_server:
+            return
+        cluster = None
+        for _ in range(3):  # the handoff is a COLLECTIVE: a member that
+            try:            # silently skipped it would wedge the barrier
+                _, cluster = fetch_config(self.we.config_server,
+                                          timeout=5.0)
+                break
+            except Exception:
+                continue
+        if cluster is None:
+            raise native.NativeError(
+                "sharded elastic: config server unreachable at the "
+                "pre-teardown handoff; cannot resize safely")
+        new_specs = {f"{w.host}:{w.port}" for w in cluster.workers}
+        old = list(p.peers)
+        alive = [i for i, s in enumerate(old) if s in new_specs]
+        departing = [i for i in range(len(old)) if i not in alive]
+        if not departing:
+            return
+        if not alive:
+            raise native.NativeError(
+                "sharded elastic: resize replaces every member; no "
+                "survivor can carry the state")
+        seq = max(self._held_meta)
+        _, _, _, ndev, nproc, _ = self._held_meta[seq]
+        _, _, block_len = _layout(self._vec_size, ndev, nproc)
+        dt = self._vec_dtypes()
+        for r in departing:
+            succ = next(i for k in range(1, len(old) + 1)
+                        for i in [(r + k) % len(old)] if i in alive)
+            if p.rank == succ and r not in self._held[seq]:
+                self._held[seq][r] = {
+                    name: p.request(r, f"kftsh:{name}",
+                                    np.empty(block_len, dt[name]),
+                                    version=seq)
+                    for name in self._vec_names()}
+        p.barrier(name=f"kftsh-handoff@{self.version}")
+
+    # ------------------------------------------------------------- resync
+    def _sync_state(self) -> None:
+        """Re-shard the committed state onto the NEW membership: agree on
+        the commit every data-holder has, then each member pulls exactly
+        the old-layout blocks overlapping its new device range."""
+        p = self.peer
+        nproc = 1 if p is None else p.size
+        newest = max(self._held_meta) if self._held_meta else _NO_SEQ
+        prev = (max((s for s in self._held_meta if s != newest),
+                    default=_NO_SEQ))
+        if nproc == 1:
+            if newest == _NO_SEQ:
+                return  # fresh single-process start: _build uses _flat
+            hdrs = None
+        else:
+            meta_n = self._held_meta.get(newest)
+            meta_p = self._held_meta.get(prev)
+            hdr = np.asarray(
+                [1 if newest != _NO_SEQ else 0, newest, prev,
+                 meta_n[0] if meta_n else 0, meta_n[1] if meta_n else 0,
+                 meta_p[0] if meta_p else 0, meta_p[1] if meta_p else 0,
+                 meta_n[3] if meta_n else 0, meta_n[4] if meta_n else 0,
+                 # rank AT COMMIT TIME (the key into _held) — p.rank
+                 # here is already the NEW membership's rank
+                 meta_n[5] if meta_n else -1], np.int64)
+            assert hdr.shape[0] == _HDR
+            hdrs = p.all_gather(hdr, name=f"kftsh-hdr@{self.version}")
+            if not int(hdrs[:, 0].max()):
+                # nobody holds a commit: fresh start — adopt rank 0's
+                # init vector (base-class semantics)
+                self._flat = p.broadcast(self._flat, root=0,
+                                         name=f"kftsh-init@{self.version}")
+                return
+        # --- choose M: newest commit every data-holder has ---------------
+        if hdrs is None:
+            holders = {0: (newest, prev)}
+            M = newest
+            samples, steps, _, old_ndev, old_nproc, _ = self._held_meta[M]
+        else:
+            holders = {j: (int(hdrs[j, 1]), int(hdrs[j, 2]))
+                       for j in range(nproc) if int(hdrs[j, 0])}
+            M = min(n for n, _ in holders.values())
+            rows = [hdrs[j] for j, (n, pr) in holders.items()
+                    if M in (n, pr)]
+            assert rows, "no holder carries the agreed commit"
+            pick = rows[0]
+            if int(pick[1]) == M:
+                samples, steps = int(pick[3]), int(pick[4])
+            else:
+                samples, steps = int(pick[5]), int(pick[6])
+            old_ndev, old_nproc = int(pick[7]), int(pick[8])
+            for j, (n, pr) in holders.items():
+                assert M in (n, pr), (
+                    f"holder {j} lost commit {M} (has {n}/{pr}): commits "
+                    "drifted more than the 2-deep history covers")
+        # --- availability + source assignment ----------------------------
+        _, old_chunk, old_block = _layout(self._vec_size, old_ndev,
+                                          old_nproc)
+        if hdrs is None:
+            avail = np.zeros((1, old_nproc), np.int64)
+            for r in self._held.get(M, {}):
+                avail[0, r] = 1
+            old_rank_of = {0: 0}
+        else:
+            mine = np.zeros(old_nproc, np.int64)
+            for r in self._held.get(M, {}):
+                mine[r] = 1
+            avail = p.all_gather(mine, name=f"kftsh-avail@{self.version}")
+            old_rank_of = {j: int(hdrs[j, 9]) for j in holders}
+        src: Dict[int, int] = {}
+        for r in range(old_nproc):
+            js = [j for j in range(avail.shape[0]) if avail[j, r]]
+            if not js:
+                raise native.NativeError(
+                    f"sharded elastic: old rank {r}'s state shard is on "
+                    "no survivor (more simultaneous failures than the "
+                    "single-failure ring replica covers)")
+            own = [j for j in js if old_rank_of.get(j) == r]
+            src[r] = own[0] if own else js[0]
+        # --- serve what we hold, then pull what our new range needs ------
+        if p is not None and nproc > 1:
+            for r, blks in self._held.get(M, {}).items():
+                if src.get(r) == p.rank:
+                    for name, b in blks.items():
+                        p.save(f"kftre:{name}:{r}", b, version=M)
+            p.barrier(name=f"kftsh-serve@{self.version}")
+        import jax
+        devs = jax.devices()
+        new_ndev = len(devs)
+        local_pos = sorted(devs.index(d) for d in jax.local_devices())
+        _, new_chunk, _ = _layout(self._vec_size, new_ndev, nproc)
+        # canonical [lo, hi) this process's new devices cover (unpadded;
+        # empty when this process's whole block is padding)
+        lo = min(min(local_pos) * new_chunk, self._vec_size)
+        hi = max(lo, min(self._vec_size, (max(local_pos) + 1) * new_chunk))
+        need = [r for r in range(old_nproc)
+                if r * old_block < hi and (r + 1) * old_block > lo]
+        dt = self._vec_dtypes()
+        pulled: Dict[str, Dict[int, np.ndarray]] = {
+            name: {} for name in self._vec_names()}
+        for r in need:
+            local = self._held.get(M, {}).get(r)
+            for name in self._vec_names():
+                if local is not None:
+                    pulled[name][r] = local[name]
+                else:
+                    pulled[name][r] = p.request(
+                        src[r], f"kftre:{name}:{r}",
+                        np.empty(old_block, dt[name]), version=M)
+        small_root = min(holders) if hdrs is not None else 0
+        _, _, small_tpl, _, _, _ = (
+            self._held_meta[M] if M in self._held_meta else
+            (0, 0, None, 0, 0, -1))
+        if hdrs is not None:
+            if small_tpl is None:
+                # fresh joiner: build the replicated-leaf template from
+                # the optimizer's state shapes
+                _, mask_tpl, leaves = self._opt_templates(new_ndev)
+                small_tpl = [np.zeros(s.shape, s.dtype) for s, m in
+                             zip(leaves, mask_tpl) if not m]
+            small_tpl = [p.broadcast(np.ascontiguousarray(t),
+                                     root=small_root,
+                                     name=f"kftsh-small@{self.version}:{i}")
+                         for i, t in enumerate(small_tpl)]
+        self._synced = dict(M=M, pulled=pulled, small=small_tpl,
+                            old_block=old_block, lo=lo, hi=hi)
+        self._committed_progress = (samples, steps)
+        self.trained_samples, self.step_count = samples, steps
+
+    # -------------------------------------------------------------- build
+    def _assemble(self, name: str, lo: int, hi: int, old_block: int,
+                  pulled: Dict[int, np.ndarray],
+                  dtype) -> np.ndarray:
+        """Canonical [lo, hi) of vector ``name`` from old-layout blocks
+        (zero past the unpadded size — the padding region)."""
+        out = np.zeros(hi - lo, dtype)
+        for r, block in pulled.items():
+            blo = r * old_block
+            s = max(lo, blo)
+            e = min(hi, blo + block.shape[0], self._vec_size)
+            if e > s:
+                out[s - lo:e - lo] = block[s - blo:e - blo]
+        return out
+
+    def _shard_to_devices(self, mesh, local_chunks):
+        """Global sharded vector from per-local-device chunks."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sharding = NamedSharding(mesh, P(FSDP_AXIS))
+        chunk = next(iter(local_chunks.values())).shape[0]
+        arrs = [jax.device_put(c, dev) for dev, c in local_chunks.items()]
+        return jax.make_array_from_single_device_arrays(
+            (chunk * mesh.size,), sharding, arrs)
+
+    def _build(self) -> None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = jax.devices()
+        nproc = 1 if self.peer is None else self.peer.size
+        assert len(devs) % nproc == 0, (
+            "sharded elastic assumes uniform devices per process")
+        mesh = Mesh(np.array(devs), (FSDP_AXIS,))
+        padded, chunk, _ = _layout(self._vec_size, len(devs), nproc)
+        treedef, mask, leaves = self._opt_templates(len(devs))
+        self._sharded_mask = mask
+        self._leaf_shapes = leaves
+        local = sorted(jax.local_devices(), key=lambda d: devs.index(d))
+
+        def from_canonical(vec_of):
+            """Sharded global vector whose canonical [lo, hi) values come
+            from ``vec_of(pos)`` per local device position."""
+            chunks = {}
+            for d in local:
+                pos = devs.index(d)
+                chunks[d] = vec_of(pos)
+            return self._shard_to_devices(mesh, chunks)
+
+        if self._synced is None:
+            # fresh start: every process holds the full init vector
+            full = np.zeros(padded, self._vec_dtype)
+            full[:self._vec_size] = self._flat
+
+            self._params = from_canonical(
+                lambda pos: full[pos * chunk:(pos + 1) * chunk])
+            specs = jax.tree_util.tree_unflatten(
+                treedef, [P(FSDP_AXIS) if m else P() for m in mask])
+            self._opt = jax.jit(jax.shard_map(
+                self.optimizer.init, mesh=mesh, in_specs=P(FSDP_AXIS),
+                out_specs=specs))(self._params)
+        else:
+            sy = self._synced
+            self._synced = None
+            lo, hi, ob = sy["lo"], sy["hi"], sy["old_block"]
+            dt = self._vec_dtypes()
+
+            def vec(name):
+                canon = self._assemble(name, lo, hi, ob,
+                                       sy["pulled"][name], dt[name])
+
+                def of(pos):
+                    s, e = pos * chunk, (pos + 1) * chunk
+                    out = np.zeros(chunk, dt[name])
+                    cs, ce = max(s, lo), min(e, hi)
+                    if ce > cs:
+                        out[cs - s:ce - s] = canon[cs - lo:ce - lo]
+                    return out
+                return of
+
+            self._params = from_canonical(vec("p"))
+            small = list(sy["small"] or [])
+            opt_leaves = []
+            oi = 0
+            for i, m in enumerate(mask):
+                if m:
+                    opt_leaves.append(from_canonical(vec(f"o{i}")))
+                else:
+                    leaf = jax.device_put(
+                        np.asarray(small[oi], leaves[i].dtype),
+                        NamedSharding(mesh, P()))
+                    oi += 1
+                    opt_leaves.append(leaf)
+            self._opt = jax.tree_util.tree_unflatten(treedef, opt_leaves)
+        self.mesh = mesh
+        _, make_step = make_fsdp_step(self.loss_fn, self.optimizer, mesh)
+        specs = jax.tree_util.tree_unflatten(
+            treedef, [P(FSDP_AXIS) if m else P() for m in mask])
+        # make_fsdp_step's meta: (unravel, size, state specs)
+        self._step = make_step((self._unravel, self._vec_size, specs))
+        self._batch_sharding = NamedSharding(mesh, P(FSDP_AXIS))
+
+    # ----------------------------------------------------------- lifecycle
+    def _rebuild_at(self, peer) -> None:
+        super()._rebuild_at(peer)
+        # the pulled state was consumed by _build; blocks keyed by the
+        # OLD membership's ranks are meaningless under the new one —
+        # commit immediately so a snapshot exists before the next step
+        self._held.clear()
+        self._held_meta.clear()
+        self._commit()
+
+    # -------------------------------------------------------------- public
+    def current_params(self):
+        """Full parameter pytree, assembled over the host plane (a
+        collective: every member must call it together)."""
+        _, data = self._local_block(self._params)
+        p = self.peer
+        if p is not None and p.size > 1:
+            self._gather_seq += 1
+            stacked = p.all_gather(
+                data,
+                name=f"kftsh-gather@{self.version}:{self._gather_seq}")
+            full = stacked.reshape(-1)[:self._vec_size]
+        else:
+            full = data[:self._vec_size]
+        return self._unravel(full)
+
+    def local_state_bytes(self) -> int:
+        """Newest committed snapshot's footprint on THIS process (own
+        blocks + ring replica) — the quantity that stays ~2/nproc of
+        total state as the cluster scales.  (The 2-deep history holds
+        up to twice this transiently.)"""
+        if not self._held:
+            return 0
+        held = self._held[max(self._held)]
+        return sum(b.nbytes for blocks in held.values()
+                   for b in blocks.values())

@@ -61,8 +61,13 @@ class Cluster:
         # read off the config server) by a process with a different
         # KFT_BASE_PORT, and mixing bases would hand the grown worker a
         # duplicate slot (port - base collides with an existing slot 0)
-        bases = [w.port - w.slot for w in workers]
-        base = min(bases) if bases else DEFAULT_WORKER_PORT
+        bases = sorted({w.port - w.slot for w in workers})
+        if len(bases) > 1:
+            raise ValueError(
+                f"cluster workers derive different port bases {bases}; "
+                "slot arithmetic would collide — rebuild the cluster "
+                "under one KFT_BASE_PORT")
+        base = bases[0] if bases else DEFAULT_WORKER_PORT
         port = base
         while port in used_ports.get(host, ()):  # next free slot on host
             port += 1

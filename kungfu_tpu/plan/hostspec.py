@@ -26,12 +26,21 @@ def _base_port() -> int:
     clusters built under a different base stay self-consistent."""
     raw = _os.environ.get("KFT_BASE_PORT", "")
     try:
-        return int(raw) if raw else 31100
+        base = int(raw) if raw else 31100
     except ValueError:
         import sys
         print(f"kungfu_tpu: ignoring malformed KFT_BASE_PORT={raw!r}",
               file=sys.stderr)
         return 31100
+    # the runner port sits at base-100 and the monitor window at
+    # base+10000; out-of-range bases would fail much later with an
+    # opaque bind error
+    if raw and not (1124 <= base <= 55000):
+        import sys
+        print(f"kungfu_tpu: KFT_BASE_PORT={base} outside [1124, 55000]; "
+              "using 31100", file=sys.stderr)
+        return 31100
+    return base
 
 
 DEFAULT_WORKER_PORT = _base_port()

@@ -85,14 +85,17 @@ def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
     eng_a, bytes_a = make(False)
     eng_b, bytes_b = make(True)
     walls_a, walls_b = [], []
-    toks = None
+    toks_a = toks_b = None
     for i in range(3):
-        w, toks = measure(eng_a, 10000 + 100 * i)
+        w, toks_a = measure(eng_a, 10000 + 100 * i)
         walls_a.append(w)
-        w, toks = measure(eng_b, 60000 + 100 * i)
+        w, toks_b = measure(eng_b, 60000 + 100 * i)
         walls_b.append(w)
+    # both arms decode the same requests; differing counts would make
+    # the tok/s comparison meaningless
+    assert toks_a == toks_b, (toks_a, toks_b)
 
-    def arm(walls, wbytes):
+    def arm(walls, wbytes, toks):
         wall = min(walls)
         return {"wall_s_best": round(wall, 3),
                 "wall_s_all": [round(w, 3) for w in walls],
@@ -100,8 +103,8 @@ def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
                 "tok_per_s": round(toks / wall, 1),
                 "weight_hbm_mb": round(wbytes / 1e6, 1)}
 
-    a = arm(walls_a, bytes_a)
-    b = arm(walls_b, bytes_b)
+    a = arm(walls_a, bytes_a, toks_a)
+    b = arm(walls_b, bytes_b, toks_b)
     doc = {
         "platform": plat, "device": str(jax.devices()[0]),
         "workload": {"n_requests": n_requests, "prompt_len": prompt_len,
