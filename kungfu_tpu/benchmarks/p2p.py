@@ -53,7 +53,24 @@ def _worker(args) -> None:
     rng = np.random.RandomState(rank)
     others = [j for j in range(size) if j != rank] or [rank]
 
-    # --- synchronous pull loop ------------------------------------
+    # --- synchronous pull loop, REUSED destination ----------------
+    # (how a real exchange loop runs — pair_avg double-buffers; a
+    # fresh GB-scale destination per pull makes the kernel re-fault
+    # + zero-fill the whole mapping each time)
+    dst = np.empty_like(model)
+    pulled = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        got = p.request(peer, "model", model, version=0, out=dst)
+        assert got[0] == peer + 1.0
+        pulled += got.nbytes
+    sync_secs = time.perf_counter() - t0
+    sync_gib = pulled / sync_secs / (1 << 30)
+
+    # --- synchronous pull loop, FRESH allocation per pull ---------
+    # (the naive-caller rate; the gap vs the reused row is kernel
+    # page-fault work, and it explodes past ~1 GB models)
     pulled = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.secs:
@@ -61,16 +78,20 @@ def _worker(args) -> None:
         got = p.request(peer, "model", model, version=0)
         assert got[0] == peer + 1.0
         pulled += got.nbytes
-    sync_secs = time.perf_counter() - t0
-    sync_gib = pulled / sync_secs / (1 << 30)
+    fresh_secs = time.perf_counter() - t0
+    fresh_gib = pulled / fresh_secs / (1 << 30)
 
     # --- hidden (prefetch) loop -----------------------------------
+    # one reused destination suffices: each future is consumed before
+    # the next is issued (pair_avg needs TWO slots because its mix
+    # still reads the previous pull while the next prefetch runs)
+    hdst = np.empty_like(model)
     hidden_done = 0
     hidden_total = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.secs:
         peer = others[rng.randint(len(others))]
-        fut = p.request_async(peer, "model", model, version=0)
+        fut = p.request_async(peer, "model", model, version=0, out=hdst)
         time.sleep(args.compute_ms / 1e3)     # the "local step"
         hidden_total += 1
         if fut.done():
@@ -81,7 +102,8 @@ def _worker(args) -> None:
 
     p.barrier(name="p2p-bench-end")
     row = np.asarray([sync_gib, hid_rate,
-                      hidden_done / max(1, hidden_total)], np.float64)
+                      hidden_done / max(1, hidden_total),
+                      fresh_gib], np.float64)
     allrows = p.gather(row, root=0, name="p2p-bench-rows")
     if rank == 0:
         shm = p.shm_bytes()
@@ -97,6 +119,8 @@ def _worker(args) -> None:
             "hidden_pull_gib_s_per_worker": round(
                 float(allrows[:, 1].mean()), 3),
             "hidden_fraction": round(float(allrows[:, 2].mean()), 3),
+            "sync_pull_fresh_alloc_gib_s": round(
+                float(allrows[:, 3].mean()), 3),
             "shm_lane_bytes": int(shm),
         }
         print("RESULT " + json.dumps(doc), flush=True)

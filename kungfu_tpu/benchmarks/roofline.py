@@ -243,12 +243,115 @@ def bench_hbm(mib: int, reps: int) -> dict:
             "gib_per_s": round(gib / secs, 1)}
 
 
+_HD64_VARIANTS = {
+    # one measured attempt at the D64 fwd softmax gap (30.4 vs its 38.9
+    # no-softmax causal ceiling, round-4 verdict #9): fold the score
+    # scale into the q block (16x fewer multiply elements at D=64), and
+    # D64-specific block shapes (fewer online-softmax rescale rounds /
+    # whole-row tiles)
+    "base": {},
+    "prescale_q": {"env": {"KFT_FLASH_PRESCALE_Q": "1"}},
+    "bq512_bk2048": {"blocks": (512, 2048)},
+    "bq1024_bk2048": {"blocks": (1024, 2048)},
+}
+
+
+def hd64_worker(variant: str, reps: int = 512) -> dict:
+    """One fresh-process measurement of flash fwd D64 causal under a
+    variant (trace-time env flags require process isolation)."""
+    from ..ops.flash_attention import flash_attention
+    spec = _HD64_VARIANTS[variant]
+    B, T, H, D = 4, 2048, 12, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    bq, bk = spec.get("blocks", (1024, 1024))
+
+    def op(q_):
+        return flash_attention(q_, k, v, causal=True, block_q=bq,
+                               block_k=bk).astype(jnp.bfloat16)
+
+    secs = _time_chained(op, q, reps)
+    flops = _attn_flops(B, T, H, D, True, False) * reps
+    return {"op": f"hd64_probe_{variant}", "seconds": round(secs, 4),
+            "tflops": round(flops / secs / 1e12, 2)}
+
+
+def run_hd64_probe(out_path: str, rounds: int = 3) -> dict:
+    """Alternate every variant x ``rounds`` in fresh subprocesses
+    (best-of-rounds per variant — the drift rule), then merge the rows
+    + conclusion into the existing artifact."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    best = {}
+    for _ in range(rounds):
+        for variant, spec in _HD64_VARIANTS.items():
+            # arms must not inherit experiment flags from the caller's
+            # shell: a stray KFT_FLASH_PRESCALE_Q=1 would contaminate
+            # the base arm and the conclusion would compare a variant
+            # against itself
+            env = dict(os.environ)
+            env["KFT_FLASH_PRESCALE_Q"] = "0"
+            env.update(spec.get("env", {}))
+            r = subprocess.run(
+                [sys.executable, "-m", "kungfu_tpu.benchmarks.roofline",
+                 "--hd64-worker", variant],
+                env=env, capture_output=True, text=True, timeout=600)
+            assert r.returncode == 0, r.stderr[-2000:]
+            row = _json.loads(r.stdout.strip().splitlines()[-1])
+            if (variant not in best
+                    or row["tflops"] > best[variant]["tflops"]):
+                best[variant] = row
+    doc = {}
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            doc = _json.load(f)
+    base = best["base"]["tflops"]
+    winner = max(best.values(), key=lambda r: r["tflops"])
+    doc["hd64_probe"] = {
+        "rows": [best[v] for v in _HD64_VARIANTS],
+        "rounds": rounds,
+        "conclusion": (
+            f"best variant {winner['op']} at {winner['tflops']} TFLOP/s "
+            f"vs base {base} "
+            + ("— within the ~2% roofline repro band: NO variant beats "
+               "the base kernel; the D64 gap to the 38.9 ceiling is the "
+               "irreducible row max/sum + exp2 + cast VPU work, not the "
+               "scale multiply or block shape"
+               if winner["tflops"] <= base * 1.02 else
+               "— a real win; before adopting as default, make the "
+               "BACKWARD kernel consistent (prescale_q is fwd-only, "
+               "see _prescale_q docstring)")),
+    }
+    with open(out_path, "w") as f:
+        _json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(_json.dumps(doc["hd64_probe"], indent=2))
+    return doc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="kernel roofline artifact")
     ap.add_argument("--out", default="ROOFLINE.json")
     ap.add_argument("--tiny", action="store_true",
                     help="small shapes (CPU smoke test of the harness)")
+    ap.add_argument("--hd64-probe", action="store_true",
+                    help="measure the D64 softmax-gap variants and merge "
+                    "into --out (fresh subprocess per arm, alternated)")
+    ap.add_argument("--hd64-worker", default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.hd64_worker:
+        import json as _json
+        print(_json.dumps(hd64_worker(args.hd64_worker)))
+        return
+    if args.hd64_probe:
+        run_hd64_probe(args.out)
+        return
 
     plat = jax.devices()[0].platform
     if args.tiny:

@@ -55,7 +55,7 @@ class DistributedElasticTrainer:
 
     def __init__(self, loss_fn: Callable, optimizer, init_params,
                  poll_every: int = 1, recover_timeout: float = 60.0,
-                 snapshot_every: int = 1):
+                 snapshot_every=1):
         import jax
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -63,9 +63,21 @@ class DistributedElasticTrainer:
         self.recover_timeout = recover_timeout
         # commit (device->host snapshot) cadence: recovery redoes at most
         # snapshot_every steps from the last committed state; 1 = commit
-        # every step (full D2H per step — fine for small models, raise it
-        # for large ones)
-        self.snapshot_every = max(1, int(snapshot_every))
+        # every step — fine for small models, ruinous at model scale
+        # (tools/bench_elastic_overhead.py measured the 470M params+adam
+        # snapshot at ~200x the step on the tunnelled dev chip; ~75% of
+        # a step even at a real TPU VM's ~10 GB/s D2H).  "auto" derives
+        # the cadence from the FIRST measured step + commit: the
+        # smallest cadence whose amortized commit cost is under
+        # KFT_SNAPSHOT_BUDGET (default 5%) of the step — trading
+        # recovery redo distance for throughput explicitly.
+        self._auto_snap = snapshot_every == "auto"
+        self.snapshot_every = (1 if self._auto_snap
+                               else max(1, int(snapshot_every)))
+        self._auto_commit_s = 0.0  # measured at step 1 in auto mode; a
+        # joiner restored into an auto run may derive with 0 — the
+        # cadence allreduce-MAX adopts the survivors' real value
+        self._last_step_s: Optional[float] = None
         self.we = E.from_env()
         if self.we.singleton:
             raise RuntimeError(
@@ -117,9 +129,17 @@ class DistributedElasticTrainer:
             name=f"opt@{self.version}")
         if self.peer.size > 1:
             got = self.peer.broadcast(
-                np.asarray(list(self._committed_progress), np.int64),
+                np.asarray([*self._committed_progress,
+                            self.snapshot_every,
+                            1 if self._auto_snap else 0], np.int64),
                 root=0, name=f"progress@{self.version}")
             self._committed_progress = (int(got[0]), int(got[1]))
+            # the commit cadence gates COLLECTIVE commits: a joiner
+            # must adopt the membership's cadence (and whether auto
+            # derivation is still pending), or its commit barriers
+            # would have no partner
+            self.snapshot_every = max(1, int(got[2]))
+            self._auto_snap = bool(got[3])
         self.trained_samples, self.step_count = self._committed_progress
 
     def _build(self) -> None:
@@ -278,9 +298,12 @@ class DistributedElasticTrainer:
             # re-fence on the NEW membership before stepping: a freshly
             # joined worker's first fence must pair with everyone's
         try:
+            import time as _time
+            _t0 = _time.perf_counter()
             batch = jax.device_put(global_batch, self._batch_sharding)
             params, opt, loss = self._step(self._params, self._opt, batch)
             lossv = float(np.asarray(loss))  # blocks until the step ran
+            self._last_step_s = _time.perf_counter() - _t0
         except (native.NativeError, RuntimeError, OSError) as e:
             # RuntimeError covers XlaRuntimeError (a dead peer inside a
             # compiled collective); deterministic user errors (shape /
@@ -293,6 +316,43 @@ class DistributedElasticTrainer:
         self.step_count += 1
         leaf = jax.tree_util.tree_leaves(global_batch)[0]
         self.trained_samples += int(leaf.shape[0])
+        if self._auto_snap and self.step_count == 1:
+            # measure ONE commit now (a snapshot must exist early
+            # anyway); the cadence itself is derived at step 2, whose
+            # step time is compile-free — deriving from the
+            # compile-inflated first step would underestimate the
+            # cadence by the compile/step ratio
+            try:
+                import time as _time
+                t0 = _time.perf_counter()
+                self._commit()
+                self._auto_commit_s = _time.perf_counter() - t0
+            except native.NativeError as e:
+                return self._recover(global_batch, cause=e)
+            return lossv
+        if self._auto_snap and self.step_count == 2:
+            import os as _os
+            budget = float(_os.environ.get("KFT_SNAPSHOT_BUDGET", "0.05"))
+            step_s = max(self._last_step_s or 1e-3, 1e-3)
+            cadence = max(1, int(np.ceil(
+                self._auto_commit_s / (budget * step_s))))
+            # the cadence gates COLLECTIVE commits: every process must
+            # adopt the same one, not its locally-measured one
+            if self.peer.size > 1:
+                try:
+                    cadence = int(self.peer.all_reduce(
+                        np.asarray([cadence], np.int64), op="MAX",
+                        name=f"snapcadence@{self.version}")[0])
+                except native.NativeError as e:
+                    return self._recover(global_batch, cause=e)
+            self.snapshot_every = cadence
+            self._auto_snap = False
+            if self.snapshot_every > 1 and self.peer.rank == 0:
+                import sys as _sys
+                print(f"kft: snapshot_every=auto -> {self.snapshot_every}"
+                      f" (commit {self._auto_commit_s:.2f}s vs step "
+                      f"{step_s:.3f}s, budget {budget:.0%})",
+                      file=_sys.stderr)
         if self.step_count % self.snapshot_every == 0:
             try:
                 self._commit()

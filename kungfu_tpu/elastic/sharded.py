@@ -291,6 +291,7 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                 # init vector (base-class semantics)
                 self._flat = p.broadcast(self._flat, root=0,
                                          name=f"kftsh-init@{self.version}")
+                self._sync_cadence()
                 return
         # --- choose M: newest commit every data-holder has ---------------
         if hdrs is None:
@@ -387,6 +388,21 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                             old_block=old_block, lo=lo, hi=hi)
         self._committed_progress = (samples, steps)
         self.trained_samples, self.step_count = samples, steps
+        self._sync_cadence()
+
+    def _sync_cadence(self) -> None:
+        """Adopt rank 0's commit cadence + pending-auto flag (commits
+        are collective; a joiner on its own cadence would barrier with
+        no partner — same invariant the base class syncs)."""
+        p = self.peer
+        if p is None or p.size <= 1:
+            return
+        got = p.broadcast(
+            np.asarray([self.snapshot_every,
+                        1 if self._auto_snap else 0], np.int64),
+            root=0, name=f"kftsh-cadence@{self.version}")
+        self.snapshot_every = max(1, int(got[0]))
+        self._auto_snap = bool(got[1])
 
     # -------------------------------------------------------------- build
     def _assemble(self, name: str, lo: int, hi: int, old_block: int,

@@ -109,6 +109,31 @@ def _causal_dispatch(body, causal, iq, ik, block_q, block_k):
 
 
 # ------------------------------------------------------------------ forward
+def _prescale_q() -> bool:
+    """hd64 softmax-gap probe (round-4 verdict #9): fold the score
+    scale into the Q BLOCK ([bq, D] multiply) instead of the score tile
+    ([bq, bk] multiply — bk/D times more elements; 16x at D=64).
+    Measured on idle v5e (B4 T2048 D64 causal, fresh process per arm,
+    alternated, best-of-3 — ROOFLINE.json ``hd64_probe``): 30.5 vs
+    base 30.37 TFLOP/s — NEUTRAL (Mosaic already fuses the scalar
+    multiply into the elementwise chain), and whole-row block shapes
+    (bk=2048) LOSE ~18%.  The D64 gap to the 38.9 no-softmax ceiling
+    is the irreducible row max/sum + exp2 + cast VPU work.
+    (Regenerate: ``python -m kungfu_tpu.benchmarks.roofline
+    --hd64-probe``.)
+    FORWARD-ONLY experiment flag: the backward kernel still scales the
+    score tile, so with the flag on, fwd and bwd probabilities differ
+    by the bf16 rounding of the prescaled q — fine for a fwd
+    microbenchmark, NOT a shippable default until the backward is
+    changed to match.  Default off; ``KFT_FLASH_PRESCALE_Q=1``
+    enables — in a FRESH process (trace-time flag, like
+    ``KFT_FLASH_MASK_SKIP``)."""
+    import os
+    env = os.environ.get("KFT_FLASH_PRESCALE_Q")
+    return (env is not None
+            and env.strip().lower() not in ("", "0", "false", "off", "no"))
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal, scale, block_q,
                block_k, n_k, with_lse):
     if with_lse:
@@ -134,9 +159,14 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal, scale, block_q,
         q = q_ref[0, 0, :, :]
         k = k_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32
-                                ) * (scale * _LOG2E)
+        if _prescale_q():
+            q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        else:
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32
+                                    ) * (scale * _LOG2E)
         if masked:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)

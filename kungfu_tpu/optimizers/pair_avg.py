@@ -66,6 +66,14 @@ class AsyncPairAverager:
         self._name = name
         self._prefetch = bool(prefetch)
         self._inflight = None  # Future pulling the NEXT peer's model
+        # persistent pull destinations (double buffer): a FRESH
+        # model-size numpy buffer per exchange makes the kernel
+        # re-fault + zero-fill the whole mapping every pull — measured
+        # 0.6-1.5 vs 3.2 GiB/s at 1 GB on loopback (native.request
+        # docstring); two slots so a prefetch in flight never shares
+        # the buffer the current mix is reading
+        self._bufs = [None, None]
+        self._buf_i = 0
         self._mask = [r != peer.rank for r in range(peer.size)]
         if selection == "roundrobin":
             rr = RoundRobin()
@@ -114,12 +122,21 @@ class AsyncPairAverager:
         """Publish this controller's model to its store."""
         self._peer.save(self._name, self._flat(tree), version=version)
 
+    def _dst(self, like):
+        import numpy as np
+        i = self._buf_i
+        self._buf_i = 1 - i
+        if self._bufs[i] is None or self._bufs[i].nbytes != like.nbytes:
+            self._bufs[i] = np.empty_like(like)
+        return self._bufs[i]
+
     def _mix_flat(self, flat, version):
         target = self._pick()
         if target < 0:
             return flat
         theirs = self._peer.request(target, self._name, flat,
-                                    version=version)
+                                    version=version,
+                                    out=self._dst(flat))
         return (1.0 - self._mix) * flat + self._mix * theirs
 
     def mix(self, tree, version: int = -1):
@@ -169,7 +186,8 @@ class AsyncPairAverager:
     def _start_prefetch(self, like, version: int = -1) -> None:
         target = self._pick()
         self._inflight = (self._peer.request_async(
-            target, self._name, like, version=version)
+            target, self._name, like, version=version,
+            out=self._dst(like))
             if target >= 0 else None)
 
 

@@ -77,31 +77,34 @@ def _parse_done(path):
 def _oracle_wsum(B, n_steps):
     """No-resize replicated trajectory of the same model/optimizer/data
     (ZeRO-3 with an elementwise optimizer is trajectory-equivalent to
-    replicated sync training)."""
-    import jax
-    import jax.numpy as jnp
-    import optax
+    replicated sync training).  Pure numpy (hand-rolled adam matching
+    optax defaults): this test process monkeypatches XLA_FLAGS for its
+    WORKERS, so touching jax here would initialize the test process's
+    backend at the workers' device count and poison every later test
+    file in the session."""
     rng = np.random.RandomState(0)
-    X = jnp.asarray(rng.randn(B, 16).astype(np.float32))
-    Y = X @ jnp.asarray(rng.randn(16, 4).astype(np.float32))
-    params = {"w": jnp.zeros((16, 4), jnp.float32),
-              "b": jnp.zeros((4,), jnp.float32)}
-    opt = optax.adam(0.05)
-    state = opt.init(params)
-
-    def loss_fn(p):
-        return jnp.mean((X @ p["w"] + p["b"] - Y) ** 2)
-
-    @jax.jit
-    def step(p, s):
-        g = jax.grad(loss_fn)(p)
-        u, s = opt.update(g, s, p)
-        return optax.apply_updates(p, u), s
-
-    for _ in range(n_steps):
-        params, state = step(params, state)
-    return float(np.square(np.asarray(params["w"])).sum()
-                 + np.square(np.asarray(params["b"])).sum())
+    X = rng.randn(B, 16).astype(np.float32)
+    Y = X @ rng.randn(16, 4).astype(np.float32)
+    w = np.zeros((16, 4), np.float32)
+    b = np.zeros((4,), np.float32)
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+    m = {"w": np.zeros_like(w), "b": np.zeros_like(b)}
+    v = {"w": np.zeros_like(w), "b": np.zeros_like(b)}
+    for t in range(1, n_steps + 1):
+        r = X @ w + b - Y                       # [B, 4]
+        gw = (2.0 / r.size) * (X.T @ r)
+        gb = (2.0 / r.size) * r.sum(axis=0)
+        for k, g in (("w", gw), ("b", gb)):
+            m[k] = b1 * m[k] + (1 - b1) * g
+            v[k] = b2 * v[k] + (1 - b2) * g * g
+            mh = m[k] / (1 - b1 ** t)
+            vh = v[k] / (1 - b2 ** t)
+            upd = -lr * mh / (np.sqrt(vh) + eps)
+            if k == "w":
+                w = w + upd.astype(np.float32)
+            else:
+                b = b + upd.astype(np.float32)
+    return float(np.square(w).sum() + np.square(b).sum())
 
 
 PREEMPT_WORKER = "B, DIE_STEP, TARGET = 8, 6, 30 * 8" + WORKER_PRELUDE + r"""
@@ -187,6 +190,101 @@ def test_preempt_resharded_recovery(tmp_path, monkeypatch):
 
         _, final_cluster = fetch_config(srv.url)
         assert final_cluster.size() == 2
+    finally:
+        srv.stop()
+
+
+AUTO_SNAP_WORKER = r"""
+import os, signal, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from kungfu_tpu.elastic.multiproc import DistributedElasticTrainer
+from kungfu_tpu.launcher import env as E
+
+out_dir = os.environ["TEST_OUT"]
+we = E.from_env()
+B, TARGET = 8, 20 * 8
+rng = np.random.RandomState(0)
+X = rng.randn(B, 16).astype(np.float32)
+Y = X @ rng.randn(16, 4).astype(np.float32)
+
+def loss_fn(p, batch):
+    bx, by = batch
+    import jax.numpy as jnp
+    return jnp.mean((bx @ p["w"] - by) ** 2)
+
+import optax
+tr = DistributedElasticTrainer(loss_fn, optax.sgd(0.05),
+                               {"w": np.zeros((16, 4), np.float32)},
+                               snapshot_every="auto")
+victim_marker = os.path.join(out_dir, "victim")
+victim = (tr.size == 2 and tr.rank == 1
+          and not os.path.exists(victim_marker))
+redid = 0
+prev_steps = 0
+while tr.trained_samples < TARGET:
+    loss = tr.step((X, Y))
+    if loss is None:
+        sys.exit(0)
+    if tr.step_count <= prev_steps:
+        redid = 1  # progress reverted: recovery redid steps
+    prev_steps = tr.step_count
+    if victim and tr.step_count == 7:
+        open(victim_marker, "w").write("x")
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(30)
+w = tr.current_params()["w"]
+with open(os.path.join(out_dir, f"done.{we.self_spec.port}"), "w") as f:
+    f.write(f"{tr.snapshot_every}:{redid}:{tr.trained_samples}:"
+            f"{float(np.square(w).sum()):.9e}")
+tr.shutdown()
+"""
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_auto_snapshot_cadence(tmp_path, monkeypatch):
+    """snapshot_every="auto" derives the commit cadence from measured
+    commit/step cost under a budget, AGREED across processes (the
+    cadence gates collective commits).  A tiny forced budget makes the
+    cadence large, and a preemption at step 7 must recover from the
+    early auto-measurement commit — a multi-step redo distance."""
+    from kungfu_tpu.elastic import ConfigServer, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text(AUTO_SNAP_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=2")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KFT_RECV_TIMEOUT_S", "3")
+    monkeypatch.setenv("KFT_CONN_RETRIES", "10")
+    # force a huge cadence so commits happen only at the derivation
+    # point; the preemption then has a REAL redo distance
+    monkeypatch.setenv("KFT_SNAPSHOT_BUDGET", "1e-9")
+
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:2"), 2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31970),
+                       cluster, srv.url, poll_interval=0.2,
+                       preempt_recover=True)
+        assert rc == 0
+        # the victim dies and is not regrown; the survivor finishes
+        done = sorted(f for f in os.listdir(out) if f.startswith("done"))
+        assert len(done) == 1, done
+        cadence, redid, trained, _ = (out / done[0]).read_text().split(":")
+        assert int(cadence) > 1  # auto derived a real cadence
+        assert int(redid) == 1   # recovery actually redid steps
+        assert int(trained) >= 20 * 8
     finally:
         srv.stop()
 

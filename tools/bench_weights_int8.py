@@ -30,17 +30,23 @@ import numpy as np
 
 
 def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
-        chunk=128, out_path="WEIGHTS_INT8_BENCH.json"):
+        chunk=128, size="200m", out_path="WEIGHTS_INT8_BENCH.json"):
     from kungfu_tpu.models import gpt as G
     from kungfu_tpu.serving import DecodeEngine, Request
 
     plat = jax.devices()[0].platform
     dtype = jnp.bfloat16 if plat == "tpu" else jnp.float32
     # ~200M params so the per-step weight stream (~0.4 GB bf16) dwarfs
-    # activations at 8 decode rows — the regime the int8 read halves
-    cfg = G.GPTConfig(vocab_size=32768, d_model=1024, n_heads=8,
-                      n_kv_heads=4, n_layers=12, d_ff=4096, max_seq=1024,
-                      rope=True, mlp="swiglu", dtype=dtype)
+    # activations at 8 decode rows — the regime the int8 read halves;
+    # the 470m size anchors the with-model-size trend (verdict r4 #6:
+    # one size point cannot back a trend claim)
+    sizes = {
+        "200m": dict(n_heads=8, n_kv_heads=4, n_layers=12),
+        "470m": dict(n_heads=16, n_kv_heads=8, n_layers=24),
+    }
+    cfg = G.GPTConfig(vocab_size=32768, d_model=1024, d_ff=4096,
+                      max_seq=1024, rope=True, mlp="swiglu", dtype=dtype,
+                      **sizes[size])
     params = G.init_params(jax.random.PRNGKey(0), cfg)
     # store weights in the model dtype: init_params returns f32 leaves,
     # and benching int8 against an f32-stored baseline would double the
@@ -109,7 +115,7 @@ def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
         "platform": plat, "device": str(jax.devices()[0]),
         "workload": {"n_requests": n_requests, "prompt_len": prompt_len,
                      "max_new": max_new, "slots": slots, "chunk": chunk,
-                     "params_m": 200},
+                     "params_m": int(size.rstrip("m"))},
         "bf16": a, "weights_int8": b,
         "speedup": round(b["tok_per_s"] / a["tok_per_s"], 3),
         "weight_hbm_ratio": round(b["weight_hbm_mb"] / a["weight_hbm_mb"],
@@ -123,4 +129,11 @@ def run(n_requests=8, prompt_len=32, max_new=256, slots=8,
 
 
 if __name__ == "__main__":
-    run()
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", choices=("200m", "470m"), default="200m")
+    ap.add_argument("--out", default=None)
+    a = ap.parse_args()
+    run(size=a.size,
+        out_path=a.out or ("WEIGHTS_INT8_BENCH.json" if a.size == "200m"
+                           else f"WEIGHTS_INT8_{a.size.upper()}.json"))
