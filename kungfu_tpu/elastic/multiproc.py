@@ -330,21 +330,39 @@ class DistributedElasticTrainer:
             except native.NativeError as e:
                 return self._recover(global_batch, cause=e)
             return lossv
-        if self._auto_snap and self.step_count == 2:
+        if self._auto_snap and self.step_count >= 2:
             import os as _os
-            budget = float(_os.environ.get("KFT_SNAPSHOT_BUDGET", "0.05"))
+            budget = max(float(_os.environ.get("KFT_SNAPSHOT_BUDGET",
+                                               "0.05")), 1e-6)
             step_s = max(self._last_step_s or 1e-3, 1e-3)
-            cadence = max(1, int(np.ceil(
-                self._auto_commit_s / (budget * step_s))))
+            # 0 = "I never measured a commit" (a joiner restored after
+            # the step-1 measurement); the MAX then adopts whichever
+            # member did measure
+            cadence = (0 if self._auto_commit_s == 0.0 else
+                       max(1, int(np.ceil(
+                           self._auto_commit_s / (budget * step_s)))))
             # the cadence gates COLLECTIVE commits: every process must
             # adopt the same one, not its locally-measured one
             if self.peer.size > 1:
                 try:
                     cadence = int(self.peer.all_reduce(
                         np.asarray([cadence], np.int64), op="MAX",
-                        name=f"snapcadence@{self.version}")[0])
+                        name=f"snapcadence@{self.version}:{self.step_count}"
+                    )[0])
                 except native.NativeError as e:
                     return self._recover(global_batch, cause=e)
+            if cadence == 0:
+                # NO current member measured (every survivor joined
+                # after step 1): measure one collective commit together
+                # now and derive at the next step
+                try:
+                    import time as _time
+                    t0 = _time.perf_counter()
+                    self._commit()
+                    self._auto_commit_s = _time.perf_counter() - t0
+                except native.NativeError as e:
+                    return self._recover(global_batch, cause=e)
+                return lossv
             self.snapshot_every = cadence
             self._auto_snap = False
             if self.snapshot_every > 1 and self.peer.rank == 0:

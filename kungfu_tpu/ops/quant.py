@@ -19,6 +19,19 @@ below); the engine's determinism properties are unaffected — the
 quantized model is just a different (deterministic) function, so
 scheduling invariance and preemption replay hold verbatim.
 
+Where the throughput term actually comes from (measured, round 5): the
+per-LAYER decode matmuls at d_model 1024 shapes are int8-NEUTRAL on
+v5e at decode batch 8 (isolated scan probe: ratio 0.97-1.00 bf16 vs
+inline-dequant — those dots are not weight-read-bound at this
+concurrency), so the end-to-end win is carried by the vocab-sized LM
+head, and it DILUTES with depth: the engine measures 1.16x at 200M/12L
+but 0.91x at 470M/24L (`WEIGHTS_INT8_BENCH.json` /
+`WEIGHTS_INT8_470M.json`).  The RESIDENCY halving (0.54-0.57x weight
+HBM -> more KV blocks) holds at every size and is the load-bearing
+benefit; for throughput-sensitive deployments, quantize selectively
+(``quantize_weights(min_size=10_000_000)`` catches only the
+vocab-sized head at these configs) and measure.
+
 No reference counterpart (the reference has no inference stack); the
 design follows the same measured-fusion discipline as the int8 KV cache
 (`serving/cache.py`).

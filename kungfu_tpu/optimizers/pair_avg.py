@@ -66,14 +66,18 @@ class AsyncPairAverager:
         self._name = name
         self._prefetch = bool(prefetch)
         self._inflight = None  # Future pulling the NEXT peer's model
-        # persistent pull destinations (double buffer): a FRESH
-        # model-size numpy buffer per exchange makes the kernel
-        # re-fault + zero-fill the whole mapping every pull — measured
-        # 0.6-1.5 vs 3.2 GiB/s at 1 GB on loopback (native.request
-        # docstring); two slots so a prefetch in flight never shares
-        # the buffer the current mix is reading
+        # persistent pull destinations: a FRESH model-size numpy buffer
+        # per exchange makes the kernel re-fault + zero-fill the whole
+        # mapping every pull — measured 0.6-1.5 vs 3.2 GiB/s at 1 GB on
+        # loopback (native.request docstring).  The async prefetch gets
+        # its OWN two-slot rotation (a prefetch in flight must never
+        # share the buffer the current mix is reading) and the sync
+        # path its own single slot — sharing slots across the two paths
+        # could hand a sync pull the buffer an in-flight prefetch is
+        # still writing
         self._bufs = [None, None]
         self._buf_i = 0
+        self._sync_buf = None
         self._mask = [r != peer.rank for r in range(peer.size)]
         if selection == "roundrobin":
             rr = RoundRobin()
@@ -131,12 +135,16 @@ class AsyncPairAverager:
         return self._bufs[i]
 
     def _mix_flat(self, flat, version):
+        import numpy as np
         target = self._pick()
         if target < 0:
             return flat
+        if (self._sync_buf is None
+                or self._sync_buf.nbytes != flat.nbytes):
+            self._sync_buf = np.empty_like(flat)
         theirs = self._peer.request(target, self._name, flat,
                                     version=version,
-                                    out=self._dst(flat))
+                                    out=self._sync_buf)
         return (1.0 - self._mix) * flat + self._mix * theirs
 
     def mix(self, tree, version: int = -1):

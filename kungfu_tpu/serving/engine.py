@@ -558,7 +558,8 @@ class DecodeEngine:
                  attend: str = "auto", mesh=None, tp_axis: str = "tp",
                  kv_dtype=None, speculative: int = 0,
                  prefix_cache: bool = False,
-                 weights_int8: bool = False):
+                 weights_int8: bool = False,
+                 weights_int8_min_size: int = 0):
         if attend not in ("auto", "fused", "gather"):
             raise ValueError(f"attend must be auto|fused|gather, "
                              f"got {attend!r}")
@@ -575,8 +576,15 @@ class DecodeEngine:
             # the HOST tree BEFORE any tp sharding, so scales reduce
             # over the full (global) leading axes and shard alongside
             # their weights (quantize_specs).
+            # weights_int8_min_size quantizes only leaves of at least
+            # that many elements: the per-layer decode dots measure
+            # int8-NEUTRAL at d1024 shapes, so throughput-sensitive
+            # deployments can restrict quantization to the vocab-sized
+            # head (e.g. 10_000_000) — see ops/quant.py's measured
+            # breakdown; residency-motivated ones keep the default 0
             from ..ops.quant import dequantize_weights, quantize_weights
-            params = quantize_weights(params)
+            params = quantize_weights(params,
+                                      min_size=weights_int8_min_size)
             prep = lambda q: dequantize_weights(q, cfg.dtype)
         if mesh is not None:
             G.validate_tp(cfg,

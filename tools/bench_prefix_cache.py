@@ -124,21 +124,21 @@ def _margins_f32(params, cfg, prompts, outputs):
         params)
 
     @jax.jit
-    def fwd(p, toks):
-        return G.forward_local(p, toks, cfg32)
+    def top2(p, toks):
+        # reduce to [rows, T, 2] on device: the full f32 logits tensor
+        # would be ~6 GB at this workload
+        logits = G.forward_local(p, toks, cfg32)
+        vals, _ = jax.lax.top_k(logits, 2)
+        return vals
 
     uids = sorted(prompts)
     batch = np.asarray([prompts[u] + outputs[u] for u in uids], np.int32)
-    logits = np.asarray(fwd(p32, jnp.asarray(batch)))
+    t2 = np.asarray(top2(p32, jnp.asarray(batch)))
     out = {}
     for r, uid in enumerate(uids):
         plen = len(prompts[uid])
-        ms = []
-        for i in range(len(outputs[uid])):
-            row = logits[r, plen - 1 + i]
-            top2 = np.partition(row, -2)[-2:]
-            ms.append(float(top2[1] - top2[0]))
-        out[uid] = ms
+        out[uid] = [float(t2[r, plen - 1 + i, 0] - t2[r, plen - 1 + i, 1])
+                    for i in range(len(outputs[uid]))]
     return out
 
 
