@@ -1,6 +1,13 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast native selftest clean
+.PHONY: check check-fast lint native selftest clean
+
+# Step 0 of the pyramid, also standalone: SPMD-aware static analysis
+# (tools/kfcheck — rank-gated collectives, trace impurity, silent
+# control-plane excepts, ...).  Fails on any non-baselined finding;
+# see docs/static-analysis.md.
+lint:
+	python -m tools.kfcheck
 
 native:
 	$(MAKE) -C native

@@ -70,9 +70,9 @@ def _host_peer():
     """
     try:
         from ..native import installed_peer
-        return installed_peer()
-    except Exception:
+    except ImportError:  # native extension absent: single-controller mode
         return None
+    return installed_peer()
 
 
 class Session:

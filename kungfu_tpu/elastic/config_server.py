@@ -198,9 +198,11 @@ def main(argv=None) -> int:
     print(f"config server listening on {srv.url}"
           + (f" (ttl {args.ttl}s)" if args.ttl else ""), flush=True)
     try:
-        deadline = time.time() + args.ttl if args.ttl else None
+        # monotonic: a wall-clock step (NTP sync on a fresh TPU-VM) must
+        # not expire the TTL early or pin the server alive
+        deadline = time.monotonic() + args.ttl if args.ttl else None
         while srv._server.is_running():
-            if deadline and time.time() > deadline:
+            if deadline and time.monotonic() > deadline:
                 print("ttl expired; shutting down")
                 break
             time.sleep(0.2)

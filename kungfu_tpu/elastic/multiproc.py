@@ -177,7 +177,9 @@ class DistributedElasticTrainer:
         try:
             v, _ = fetch_config(self.we.config_server, timeout=5.0)
             return v
-        except Exception:
+        except (OSError, ValueError, KeyError):
+            # transient config-server failure: poll again next step with
+            # the last version — a resize is only ever DELAYED by this
             return self._last_seen_version
 
     def _rebuild_at(self, peer) -> None:

@@ -228,8 +228,8 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                 _, cluster = fetch_config(self.we.config_server,
                                           timeout=5.0)
                 break
-            except Exception:
-                continue
+            except (OSError, ValueError, KeyError):
+                continue  # retried; exhaustion raises NativeError below
         if cluster is None:
             raise native.NativeError(
                 "sharded elastic: config server unreachable at the "

@@ -192,13 +192,15 @@ class ElasticTrainer:
         """
         if not self.config_server_url:
             raise ValueError("no config server configured")
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             try:
                 version, cluster = fetch_config(self.config_server_url)
                 break
-            except Exception:
-                if time.time() > deadline:
+            except (OSError, ValueError, KeyError):
+                # conn refused / 404-before-first-PUT / truncated JSON:
+                # retry until the deadline, then surface the real error
+                if time.monotonic() > deadline:
                     raise
                 time.sleep(0.1)
         if version == self.config_version:

@@ -27,10 +27,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not prog:
         p.error("missing program")
     hosts = HostList.parse(args.hosts)
-    t0 = time.time()
+    t0 = time.perf_counter()
     rc = distribute(hosts, prog, user=args.user,
                     log_dir=args.logdir or None)
-    print(f"kft-distribute `{' '.join(prog)}` took {time.time() - t0:.1f}s",
+    print(f"kft-distribute `{' '.join(prog)}` took "
+          f"{time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
     return rc
 

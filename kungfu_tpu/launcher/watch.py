@@ -258,6 +258,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     other runners, and the runner exits 0.
     """
     import signal as _signal
+    import sys as _sys
     w = Watcher(job, host, parent, pool,
                 preempt_recover=preempt_recover and bool(config_url))
     wake = threading.Event()
@@ -320,6 +321,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             # Logged so a persistently broken server isn't silent.
             print(f"kft-run: config server {config_url} unreadable "
                   f"({last_err}); starting at version 0", flush=True)
+    poll_failing = False  # one log line per config-server outage
     try:
         w.update(version0, initial)
         global_size = initial.size()
@@ -375,8 +377,16 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                     version, cluster = fetch_config(config_url)
                     global_size = cluster.size()
                     w.update(version, cluster)
-                except Exception:
-                    pass  # config server transient failure: keep procs
+                    poll_failing = False
+                except (OSError, ValueError, KeyError) as e:
+                    # transient config-server failure: keep the current
+                    # workers, but say so once per outage — a dead
+                    # server must not look like a quiet one
+                    if not poll_failing:
+                        print(f"kft-run: config server poll failing "
+                              f"({e}); keeping current workers",
+                              file=_sys.stderr, flush=True)
+                        poll_failing = True
             if stop_when_empty and w.alive() == 0 and (
                     not config_url or global_size == 0
                     or w.all_local_done()):

@@ -634,8 +634,10 @@ def recover_from_failure(timeout: float = 60.0, poll: float = 0.1
     Raises :class:`NativeError` if no new cluster version arrives within
     ``timeout`` (e.g. the failure was not a membership event)."""
     import time as _time
-    deadline = _time.time() + timeout
-    while _time.time() < deadline:
+    # monotonic: an NTP step during the recovery window would otherwise
+    # expire (or extend) the deadline arbitrarily
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
         try:
             changed, detached = resize_from_url()
         except OSError:

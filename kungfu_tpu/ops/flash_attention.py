@@ -473,7 +473,8 @@ def _use_jnp_fallback(q) -> bool:
 def _jnp_flash(q, k, v, causal):
     """Differentiable jnp twin of the kernel: (out, lse [B, H, T] f32)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) / np.sqrt(q.shape[-1])
     if causal:
         Tq, Tk = s.shape[2], s.shape[3]
         mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
@@ -482,7 +483,8 @@ def _jnp_flash(q, k, v, causal):
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p / l[..., None],
-                     v.astype(jnp.float32))
+                     v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype), m + jnp.log(l)
 
 

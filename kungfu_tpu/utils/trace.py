@@ -55,8 +55,12 @@ def scope_stats() -> Dict[str, Tuple[int, float]]:
 
 def log_event(name: str) -> float:
     """Timestamped event mark (reference _log_event); always on — events
-    are cheap and the elastic protocol logs them unconditionally."""
-    ts = time.time()
+    are cheap and the elastic protocol logs them unconditionally.
+
+    Timestamps are ``time.perf_counter()`` — a monotonic timebase, so
+    intervals between events survive NTP steps; they order and diff
+    against each other, not against wall-clock log lines."""
+    ts = time.perf_counter()
     with _lock:
         _events.append((ts, name))
     return ts

@@ -1,0 +1,384 @@
+"""kfcheck: every rule fires on its positive fixture and stays quiet on
+the matching negative; suppression comments and the baseline behave.
+
+The checker is this repo's step 0 of CI (tools/ci.sh) — these tests are
+what keeps its rules from silently rotting as the codebase grows.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.kfcheck import ALL_RULES, Baseline, check_paths  # noqa: E402
+
+RULE_NAMES = {r.name for r in ALL_RULES}
+
+
+def run_on(tmp_path, source, relpath="kungfu_tpu/mod.py"):
+    """Write one fixture file at a repo-relative-looking path and check it."""
+    fp = tmp_path / relpath
+    fp.parent.mkdir(parents=True, exist_ok=True)
+    fp.write_text(textwrap.dedent(source))
+    findings, errors = check_paths([fp.parent], ALL_RULES, tmp_path)
+    assert not errors, errors
+    return findings
+
+
+def rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------ collective-symmetry
+def test_collective_symmetry_positive(tmp_path):
+    fs = run_on(tmp_path, """
+        def adapt(session, rank):
+            if rank == 0:
+                session.all_reduce(x)
+    """)
+    assert rules_fired(fs) == {"collective-symmetry"}
+    assert "rank-gated" in fs[0].message
+    assert fs[0].symbol == "adapt"
+
+
+def test_collective_symmetry_else_branch_and_peer_id(tmp_path):
+    fs = run_on(tmp_path, """
+        def teardown(peer):
+            if peer.peer_id != leader:
+                pass
+            else:
+                peer.barrier()
+    """)
+    assert rules_fired(fs) == {"collective-symmetry"}
+
+
+def test_collective_symmetry_negative(tmp_path):
+    # same collective, but the gate is not rank-shaped and the
+    # rank-gated branch holds no collective
+    fs = run_on(tmp_path, """
+        def adapt(session, rank, enabled):
+            if enabled:
+                session.all_reduce(x)
+            if rank == 0:
+                print("leader")
+    """)
+    assert rules_fired(fs) == set()
+
+
+# --------------------------------------------------------- trace-impurity
+def test_trace_impurity_decorated(tmp_path):
+    fs = run_on(tmp_path, """
+        import jax, time
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x * t
+    """)
+    assert rules_fired(fs) == {"trace-impurity"}
+    assert "time.time" in fs[0].message
+
+
+def test_trace_impurity_by_reference_and_np_random(tmp_path):
+    fs = run_on(tmp_path, """
+        import jax
+        import numpy as np
+
+        def make_step():
+            def body(x):
+                return x + np.random.randn()
+            return jax.jit(body)
+    """)
+    assert rules_fired(fs) == {"trace-impurity"}
+
+
+def test_trace_impurity_same_name_other_scope_is_clean(tmp_path):
+    # a method named like a jitted local function elsewhere in the file
+    # must NOT inherit its traced-ness (lexical scoping)
+    fs = run_on(tmp_path, """
+        import jax, time
+
+        def build():
+            def run(x):
+                return x * 2
+            return jax.jit(run)
+
+        class Engine:
+            def run(self, xs):
+                t0 = time.perf_counter()
+                return t0
+    """)
+    assert rules_fired(fs) == set()
+
+
+def test_trace_impurity_negative_host_fn(tmp_path):
+    fs = run_on(tmp_path, """
+        import time
+
+        def host_timer():
+            return time.time()
+    """)
+    assert rules_fired(fs) == set()
+
+
+# -------------------------------------------------- host-sync-in-hot-path
+def test_host_sync_positive(tmp_path):
+    fs = run_on(tmp_path, """
+        import jax
+
+        def train(steps, step_fn, batches):
+            for b in batches:
+                loss = step_fn(b)
+                print(float(loss))
+                jax.device_get(loss)
+    """)
+    assert rules_fired(fs) == {"host-sync-in-hot-path"}
+    assert len(fs) == 2  # float(loss) + device_get
+
+
+def test_host_sync_block_until_ready(tmp_path):
+    fs = run_on(tmp_path, """
+        def serve_loop(engine, reqs):
+            while reqs:
+                out = engine.step()
+                out.block_until_ready()
+    """)
+    assert rules_fired(fs) == {"host-sync-in-hot-path"}
+
+
+def test_host_sync_negative_outside_loop_or_cold_fn(tmp_path):
+    fs = run_on(tmp_path, """
+        import jax
+
+        def train(step_fn, batches):
+            for b in batches:
+                loss = step_fn(b)
+            return float(loss)     # after the loop: one sync, fine
+
+        def debug_dump(loss):
+            while True:
+                jax.device_get(loss)   # not a hot-path function name
+                break
+    """)
+    assert rules_fired(fs) == set()
+
+
+# ------------------------------------------------------------ silent-except
+def test_silent_except_positive_scoped_dirs(tmp_path):
+    src = """
+        def poll(url):
+            try:
+                fetch(url)
+            except Exception:
+                pass
+    """
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/elastic/mod.py")
+    assert rules_fired(fs) == {"silent-except"}
+    # same code OUTSIDE elastic/launcher/comm is out of scope
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/models/mod.py")
+    assert rules_fired(fs) == set()
+
+
+def test_silent_except_bare_and_negative(tmp_path):
+    fs = run_on(tmp_path, """
+        def a(url):
+            try:
+                fetch(url)
+            except:
+                return None
+
+        def b(url):
+            try:
+                fetch(url)
+            except Exception as e:
+                log.warning("poll failed: %s", e)   # logged: not silent
+
+        def c(url):
+            try:
+                fetch(url)
+            except (OSError, ValueError):
+                pass                                 # narrow: not broad
+    """, relpath="kungfu_tpu/launcher/mod.py")
+    assert [f.symbol for f in fs] == ["a"]
+
+
+# --------------------------------------------------------- unjoined-thread
+def test_unjoined_thread_positive(tmp_path):
+    fs = run_on(tmp_path, """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """)
+    assert rules_fired(fs) == {"unjoined-thread"}
+
+
+def test_unjoined_thread_negatives(tmp_path):
+    fs = run_on(tmp_path, """
+        import threading
+
+        def daemonized(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+
+        def joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+        class S:
+            def start(self, fn):
+                self._t = threading.Thread(target=fn)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=5)
+    """)
+    assert rules_fired(fs) == set()
+
+
+# ------------------------------------------------------------- accum-dtype
+def test_accum_dtype_positive_ops_scope(tmp_path):
+    src = """
+        import jax.numpy as jnp
+
+        def kernel(a, b):
+            return jnp.einsum("ij,jk->ik", a, b)
+    """
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/ops/k.py")
+    assert rules_fired(fs) == {"accum-dtype"}
+    # outside ops/ the rule does not apply
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/models/m.py")
+    assert rules_fired(fs) == set()
+
+
+def test_accum_dtype_matmul_operator_and_negative(tmp_path):
+    fs = run_on(tmp_path, """
+        import jax, jax.numpy as jnp
+
+        def bad(a, b):
+            return a @ b
+
+        def good(a, b):
+            return jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    """, relpath="kungfu_tpu/ops/k.py")
+    assert [f.symbol for f in fs] == ["bad"]
+
+
+# ------------------------------------------------------------- suppression
+def test_suppression_same_line_and_standalone_comment(tmp_path):
+    fs = run_on(tmp_path, """
+        def adapt(session, rank):
+            if rank == 0:
+                session.all_reduce(x)  # kfcheck: disable=collective-symmetry
+            if rank == 1:
+                # kfcheck: disable=collective-symmetry
+                session.barrier()
+    """)
+    assert fs == []
+
+
+def test_suppression_is_per_rule(tmp_path):
+    # disabling an unrelated rule must not silence the finding
+    fs = run_on(tmp_path, """
+        def adapt(session, rank):
+            if rank == 0:
+                session.all_reduce(x)  # kfcheck: disable=accum-dtype
+    """)
+    assert rules_fired(fs) == {"collective-symmetry"}
+
+
+# ---------------------------------------------------------------- baseline
+def _one_finding(tmp_path):
+    return run_on(tmp_path, """
+        def adapt(session, rank):
+            if rank == 0:
+                session.all_reduce(x)
+    """)
+
+
+def test_baseline_grandfathers_and_detects_stale(tmp_path):
+    fs = _one_finding(tmp_path)
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(Baseline.render(fs, {fs[0].key(): "known; audited"}))
+    bl = Baseline.load(bl_path)
+    new, old, stale = bl.split(fs)
+    assert (len(new), len(old), len(stale)) == (0, 1, 0)
+    # finding fixed -> entry goes stale
+    new, old, stale = bl.split([])
+    assert (len(new), len(old), len(stale)) == (0, 0, 1)
+
+
+def test_baseline_is_line_number_insensitive(tmp_path):
+    fs = _one_finding(tmp_path)
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(Baseline.render(fs, {fs[0].key(): "known"}))
+    # same finding, shifted down by unrelated edits above it
+    shifted = run_on(tmp_path, """
+        import os
+
+        X = 1
+
+
+        def adapt(session, rank):
+            if rank == 0:
+                session.all_reduce(x)
+    """)
+    new, old, stale = Baseline.load(bl_path).split(shifted)
+    assert (len(new), len(old), len(stale)) == (0, 1, 0)
+
+
+def test_baseline_requires_justification(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({"version": 1, "entries": [
+        {"rule": "accum-dtype", "path": "p.py", "symbol": "f",
+         "snippet": "a @ b", "why": "  "}]}))
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(bl_path)
+
+
+# --------------------------------------------------------------------- CLI
+def _cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.kfcheck", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_shipped_tree_is_clean():
+    """Acceptance gate: `make lint` (== this invocation) exits 0 on the
+    tree as shipped."""
+    r = _cli([])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_fails_on_introduced_violation(tmp_path):
+    """Acceptance gate: introducing a fixture violation flips the exit
+    code to non-zero (and names the rule)."""
+    bad = tmp_path / "kungfu_tpu" / "ops" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def f(a, b):\n    return a @ b\n")
+    r = _cli(["--no-baseline", str(bad)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "accum-dtype" in r.stdout
+
+
+def test_cli_list_rules_covers_all():
+    r = _cli(["--list-rules"])
+    assert r.returncode == 0
+    for name in RULE_NAMES:
+        assert name in r.stdout
+
+
+def test_shipped_baseline_entries_all_justified():
+    data = json.loads(
+        (REPO / "tools" / "kfcheck" / "baseline.json").read_text())
+    for e in data["entries"]:
+        assert e["why"].strip() and "TODO" not in e["why"], e

@@ -1,0 +1,2 @@
+# Marks tools/ as a package so `python -m tools.kfcheck` works from the
+# repo root (the scripts in here are still runnable as plain files).

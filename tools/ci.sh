@@ -4,6 +4,8 @@
 # cluster workflow adds a two-node elastic test).  This is the one entry
 # point that runs this repo's whole pyramid:
 #
+#   0. kfcheck static analysis (SPMD/TPU hazard rules, tools/kfcheck;
+#      fails on any non-baselined finding)     (~1 s)
 #   1. native build + C++ selftest            (~20 s)
 #   2. pytest suite, sharded across N workers (~15-20 min at -j2 on the
 #      1-core dev VM; ~35 min serial — the suite is full of sleeps and
@@ -18,6 +20,7 @@
 #   tools/ci.sh -j4        # more pytest shards
 #   tools/ci.sh --fast     # native + one smoke shard + dryrun (~8 min)
 set -u
+set -o pipefail
 cd "$(dirname "$0")/.."
 
 JOBS=2
@@ -35,6 +38,9 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
 fail=0
 say() { printf '\n==== %s ====\n' "$*"; }
+
+say "0/3 kfcheck static analysis"
+python -m tools.kfcheck || exit 1
 
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1

@@ -1,0 +1,17 @@
+"""kfcheck — SPMD-aware static analysis for kungfu-tpu.
+
+Catches the bug classes an adaptive collective runtime cannot afford:
+rank-gated collectives (deadlock), impurity inside traced functions
+(stale compiled constants), host syncs in step loops (pipeline stalls),
+silent control-plane excepts (vanishing peer deaths), unjoined threads
+(hung teardown), and bf16-accumulating kernels (precision loss).
+
+Usage: ``python -m tools.kfcheck [paths...]`` from the repo root, or
+``make lint``.  See docs/static-analysis.md for the rule contract,
+suppression comments, and baseline workflow.
+"""
+from .engine import Baseline, Finding, Module, Rule, check_paths
+from .rules import ALL_RULES
+
+__all__ = ["ALL_RULES", "Baseline", "Finding", "Module", "Rule",
+           "check_paths"]

@@ -1,0 +1,91 @@
+"""kfcheck CLI.
+
+    python -m tools.kfcheck                    # check kungfu_tpu/ vs baseline
+    python -m tools.kfcheck path/to/file.py    # check specific paths
+    python -m tools.kfcheck --write-baseline   # regenerate the baseline
+    python -m tools.kfcheck --list-rules
+
+Exit codes: 0 clean (or fully baselined), 1 findings, 2 internal/usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .engine import Baseline, check_paths
+from .rules import ALL_RULES
+
+REPO = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kfcheck")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to check (default: kungfu_tpu/)")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="baseline JSON (grandfathered findings)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, baselined or not")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings, "
+                        "keeping existing justifications")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the OK summary line")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            scope = f"  [scope: {r.path_filter}]" if r.path_filter else ""
+            print(f"{r.name}: {r.doc}{scope}")
+        return 0
+
+    paths = [Path(x) for x in (args.paths or ["kungfu_tpu"])]
+    findings, errors = check_paths(paths, ALL_RULES, REPO)
+    for e in errors:
+        print(f"kfcheck: ERROR {e}", file=sys.stderr)
+
+    if args.write_baseline:
+        old = Baseline.load(Path(args.baseline))
+        whys = {(e["rule"], e["path"], e.get("symbol", "<module>"),
+                 e["snippet"]): e["why"] for e in old.entries}
+        Path(args.baseline).write_text(Baseline.render(findings, whys))
+        print(f"kfcheck: wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, old_findings, stale = findings, [], []
+    else:
+        try:
+            bl = Baseline.load(Path(args.baseline))
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"kfcheck: bad baseline: {e}", file=sys.stderr)
+            return 2
+        new, old_findings, stale = bl.split(findings)
+
+    for f in new:
+        print(f.render())
+    for e in stale:
+        print(f"kfcheck: stale baseline entry (finding fixed — remove "
+              f"it): {e['rule']} {e['path']} :: {e['snippet']}",
+              file=sys.stderr)
+    if new:
+        print(f"\nkfcheck: {len(new)} finding(s) "
+              f"({len(old_findings)} baselined, "
+              f"{len(ALL_RULES)} rules). Fix, add a `# kfcheck: "
+              f"disable=<rule>` with a reason, or baseline with a "
+              f"justification in {args.baseline}.")
+        return 1
+    if errors:
+        return 2
+    if not args.quiet:
+        print(f"kfcheck: OK ({len(old_findings)} baselined finding(s), "
+              f"{len(stale)} stale entr{'y' if len(stale) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""kfcheck rule engine: findings, suppressions, baseline, file walking.
+
+Design notes (why not an off-the-shelf linter): the hazards that matter
+to this repo are SPMD-shaped — a collective reachable from only some
+peers, impurity inside a traced function, a host sync inside the step
+loop — and no generic tool models them.  The engine is deliberately
+small: rules are plain objects with a ``check(module)`` generator, the
+driver parses each file ONCE into a :class:`Module` (ast tree + source
+lines + suppression map) and fans it out to every rule.
+
+Baseline philosophy (mirrors e.g. ruff's ``--add-noqa`` vs a baseline
+file): a finding's identity is (rule, path, enclosing symbol, stripped
+source line) — NOT the line number, so unrelated edits above a
+grandfathered finding don't churn the baseline.  Every baseline entry
+must carry a one-line ``why``; an entry whose finding disappeared is
+reported as stale so the file only ever shrinks.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*kfcheck:\s*disable=([\w,-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # rule name, e.g. "collective-symmetry"
+    path: str       # repo-relative posix path
+    line: int       # 1-based
+    symbol: str     # enclosing def/class qualname, or "<module>"
+    message: str
+    snippet: str    # stripped source of the flagged line (baseline key)
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.snippet)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}\n"
+                f"    {self.snippet}")
+
+
+class Module:
+    """One parsed source file, shared by every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of rule names disabled there ("all" disables every
+        # rule).  A suppression comment covers its own line and, when it
+        # stands alone, the next code line below it.
+        self.suppressed: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.suppressed.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):  # standalone comment line
+                self.suppressed.setdefault(i + 1, set()).update(rules)
+        # enclosing-scope qualnames, resolved once
+        self._symbol_of: Dict[int, str] = {}
+        self._index_symbols(self.tree, [])
+
+    def _index_symbols(self, node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = stack + [child.name]
+                name = ".".join(qual)
+                # innermost scope wins: a nested def re-tags its own
+                # lines after the parent tagged them
+                for sub in ast.walk(child):
+                    ln = getattr(sub, "lineno", None)
+                    if ln is not None:
+                        self._symbol_of[ln] = name
+                self._index_symbols(child, qual)
+
+    def symbol_at(self, line: int) -> str:
+        return self._symbol_of.get(line, "<module>")
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressed.get(line, ())
+        return rule in rules or "all" in rules
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.path, line=line,
+                       symbol=self.symbol_at(line), message=message,
+                       snippet=self.snippet_at(line))
+
+
+class Rule:
+    """Base rule.  Subclasses set ``name``/``doc`` and implement
+    :meth:`check`; ``path_filter`` (regex on the posix relpath) scopes a
+    rule to the directories where its hazard is load-bearing."""
+
+    name: str = ""
+    doc: str = ""
+    path_filter: Optional[str] = None
+
+    def applies_to(self, path: str) -> bool:
+        return self.path_filter is None or bool(
+            re.search(self.path_filter, path))
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- baseline
+@dataclass
+class Baseline:
+    """Checked-in set of grandfathered findings, each with a ``why``."""
+
+    path: Optional[Path] = None
+    entries: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        entries = data.get("entries", [])
+        for e in entries:
+            if not e.get("why", "").strip():
+                raise ValueError(
+                    f"baseline entry without a justification: {e}")
+        return cls(path=path, entries=entries)
+
+    def _keys(self) -> Set[Tuple[str, str, str, str]]:
+        return {(e["rule"], e["path"], e.get("symbol", "<module>"),
+                 e["snippet"]) for e in self.entries}
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """(new, grandfathered, stale-entries)."""
+        keys = self._keys()
+        new = [f for f in findings if f.key() not in keys]
+        old = [f for f in findings if f.key() in keys]
+        live = {f.key() for f in findings}
+        stale = [e for e in self.entries
+                 if (e["rule"], e["path"], e.get("symbol", "<module>"),
+                     e["snippet"]) not in live]
+        return new, old, stale
+
+    @staticmethod
+    def render(findings: Sequence[Finding],
+               whys: Optional[Dict[Tuple, str]] = None) -> str:
+        entries = []
+        seen: Set[Tuple] = set()
+        for f in sorted(findings, key=lambda f: (f.path, f.line)):
+            if f.key() in seen:  # identical lines share one entry
+                continue
+            seen.add(f.key())
+            entries.append({
+                "rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "snippet": f.snippet,
+                "why": (whys or {}).get(f.key(), "TODO: justify or fix"),
+            })
+        return json.dumps({"version": 1, "entries": entries}, indent=2) + "\n"
+
+
+# ----------------------------------------------------------------- driver
+def iter_py_files(paths: Sequence[Path], root: Path) -> Iterator[Path]:
+    for p in paths:
+        p = p if p.is_absolute() else root / p
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def check_paths(paths: Sequence[Path], rules: Iterable[Rule],
+                root: Path) -> Tuple[List[Finding], List[str]]:
+    """Run every rule over every file.  Returns (findings, errors) —
+    a syntactically broken file is an error, not a crash."""
+    rules = list(rules)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for fp in iter_py_files(paths, root):
+        rel = fp.relative_to(root).as_posix() if fp.is_relative_to(root) \
+            else fp.as_posix()
+        try:
+            mod = Module(rel, fp.read_text())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{rel}: unparseable: {e}")
+            continue
+        for rule in rules:
+            if not rule.applies_to(rel):
+                continue
+            for f in rule.check(mod):
+                if not mod.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors

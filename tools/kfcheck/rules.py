@@ -1,0 +1,369 @@
+"""kfcheck rules: the SPMD/TPU hazard patterns this repo has been bitten
+by (or must never be).  Each rule documents its failure mode; the full
+contract (examples, suppression, baselining) is docs/static-analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set
+
+from .engine import Finding, Module, Rule
+
+# Dotted-name helper: "jax.lax.psum" for Attribute chains, "foo" for Name.
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        # foo(...).bar chains: keep the tail we collected
+        pass
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def tail(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+# ------------------------------------------------------ collective-symmetry
+class CollectiveSymmetry(Rule):
+    name = "collective-symmetry"
+    doc = ("collective call reachable from a rank/peer-conditional branch "
+           "— peers disagree on whether the collective runs and the mesh "
+           "deadlocks (or silently diverges)")
+
+    # the session/native/comm collective surface plus jax's SPMD ops
+    COLLECTIVES = {
+        "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
+        "reduce_scatter", "reduce_to_root", "barrier", "consensus",
+        "bytes_consensus", "local_reduce", "local_broadcast",
+        "cross_all_reduce", "gather", "graph_all_reduce",
+        "striped_graph_all_reduce", "hierarchical_all_reduce",
+        "ring_exchange", "psum", "pmean", "pmax", "pmin", "ppermute",
+        "pshuffle", "sync_global_devices", "process_allgather",
+    }
+    RANKISH = re.compile(
+        r"rank|peer_id|peerid|slot|process_index|process_id|proc_id"
+        r"|is_master|is_leader|is_root|is_coordinator|local_master",
+        re.IGNORECASE)
+
+    def _rank_gated(self, test: ast.expr) -> bool:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and self.RANKISH.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    self.RANKISH.search(sub.attr):
+                return True
+            if isinstance(sub, ast.Call):
+                nm = call_name(sub)
+                if self.RANKISH.search(tail(nm)):
+                    return True
+        return False
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        seen = set()  # a call inside nested rank-gated ifs fires once
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.If) or not self._rank_gated(node.test):
+                continue
+            for branch in (node.body, node.orelse):
+                for stmt in branch:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and \
+                                id(sub) not in seen and \
+                                tail(call_name(sub)) in self.COLLECTIVES:
+                            seen.add(id(sub))
+                            yield mod.finding(
+                                self.name, sub,
+                                f"collective `{call_name(sub)}` inside a "
+                                f"rank-gated branch (if at line "
+                                f"{node.lineno}): peers that skip the "
+                                f"branch never join it")
+
+
+# --------------------------------------------------------- trace-impurity
+class TraceImpurity(Rule):
+    name = "trace-impurity"
+    doc = ("host-side impurity (wall clock, np.random, I/O) inside a "
+           "jit/shard_map-traced function — runs once at trace time, "
+           "then the compiled step replays the stale value forever")
+
+    TRACERS = {"jit", "pjit", "shard_map", "smap"}
+    IMPURE = {
+        "time.time": "wall clock is read once at trace time",
+        "time.perf_counter": "timer is read once at trace time",
+        "time.monotonic": "timer is read once at trace time",
+        "time.process_time": "timer is read once at trace time",
+        "datetime.now": "wall clock is read once at trace time",
+        "datetime.utcnow": "wall clock is read once at trace time",
+    }
+    IMPURE_PREFIX = {
+        "np.random": "host RNG fires once at trace time; use jax.random",
+        "numpy.random": "host RNG fires once at trace time; use jax.random",
+        "random": "host RNG fires once at trace time; use jax.random",
+    }
+    IMPURE_BARE = {
+        "open": "file I/O inside a traced function runs at trace time only",
+        "input": "blocking I/O inside a traced function",
+    }
+
+    def _traced_names(self, mod: Module) -> Set[str]:
+        """Function names passed (positionally) to jit/pjit/shard_map
+        anywhere in the file — catches `step = jax.jit(body)` and
+        `jax.jit(shard_map(body, ...))`."""
+        out: Set[tuple] = set()
+        scope_of = self._scope_map(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    tail(call_name(node)) in self.TRACERS:
+                scope = scope_of.get(node, mod.tree)
+                for arg in node.args[:1] + [
+                        kw.value for kw in node.keywords
+                        if kw.arg in ("f", "fun", "func")]:
+                    if isinstance(arg, ast.Name):
+                        out.add((arg.id, scope))
+                    elif isinstance(arg, ast.Call):
+                        # jit(shard_map(body, ...)): unwrap one level
+                        if tail(call_name(arg)) in self.TRACERS and \
+                                arg.args and isinstance(arg.args[0],
+                                                        ast.Name):
+                            out.add((arg.args[0].id, scope))
+        self._scope_of = scope_of
+        return out
+
+    SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+              ast.Module)
+
+    def _scope_map(self, mod: Module):
+        """node -> nearest enclosing lexical scope node."""
+        scope_of = {}
+
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                scope_of[child] = scope
+                visit(child, child if isinstance(child, self.SCOPES)
+                      else scope)
+        visit(mod.tree, mod.tree)
+        return scope_of
+
+    def _is_traced_def(self, fn: ast.AST, traced_names: Set[tuple]) -> bool:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if tail(dotted(target)) in self.TRACERS:
+                return True
+        # traced-by-reference: the jit(name) call must share the def's
+        # lexical scope — a same-named method elsewhere in the file is
+        # NOT the traced function
+        return (fn.name, self._scope_of.get(fn)) in traced_names
+
+    def _impurity(self, nm: str) -> Optional[str]:
+        if nm in self.IMPURE_BARE and "." not in nm:
+            return self.IMPURE_BARE[nm]
+        for full, why in self.IMPURE.items():
+            if nm == full or nm.endswith("." + full):
+                return why
+        for prefix, why in self.IMPURE_PREFIX.items():
+            if nm.startswith(prefix + ".") or \
+                    ("." + prefix + ".") in ("." + nm):
+                return why
+        return None
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        traced = self._traced_names(mod)
+        for node in ast.walk(mod.tree):
+            if not self._is_traced_def(node, traced):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    nm = call_name(sub)
+                    why = self._impurity(nm)
+                    if why:
+                        yield mod.finding(
+                            self.name, sub,
+                            f"`{nm}()` inside traced function "
+                            f"`{node.name}`: {why}")
+
+
+# -------------------------------------------------- host-sync-in-hot-path
+class HostSyncInHotPath(Rule):
+    name = "host-sync-in-hot-path"
+    doc = ("device->host sync inside a training/serving step loop — every "
+           "iteration stalls the XLA pipeline to materialize a host value")
+
+    HOT_FN = re.compile(r"train|serv|decode|fit|run_steps|epoch",
+                        re.IGNORECASE)
+    SYNCS = {"device_get", "block_until_ready"}
+    ARRAYISH = re.compile(r"loss|grad|logit|prob|acc|metric|output",
+                          re.IGNORECASE)
+
+    def _root_name(self, node: ast.AST) -> str:
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else ""
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or not self.HOT_FN.search(fn.name):
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for sub in ast.walk(loop):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    nm = call_name(sub)
+                    t = tail(nm)
+                    if t in self.SYNCS:
+                        yield mod.finding(
+                            self.name, sub,
+                            f"`{nm}()` inside the step loop of "
+                            f"`{fn.name}`: forces a device sync every "
+                            f"iteration")
+                    elif t in ("float", "int") and "." not in nm \
+                            and sub.args and self.ARRAYISH.search(
+                                self._root_name(sub.args[0]) or "\0"):
+                        yield mod.finding(
+                            self.name, sub,
+                            f"`{t}({ast.unparse(sub.args[0])})` inside "
+                            f"the step loop of `{fn.name}`: implicit "
+                            f"device->host sync; hoist or batch it")
+
+
+# ------------------------------------------------------------ silent-except
+class SilentExcept(Rule):
+    name = "silent-except"
+    doc = ("bare `except:` / broad `except Exception:` that swallows the "
+           "error in control-plane code — peer death and resize failures "
+           "vanish instead of driving recovery")
+    path_filter = r"(^|/)(elastic|launcher|comm)(/|$)"
+
+    BROAD = {"Exception", "BaseException"}
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        t = handler.type
+        if isinstance(t, (ast.Name, ast.Attribute)) and \
+                tail(dotted(t)) in self.BROAD:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(tail(dotted(e)) in self.BROAD for e in t.elts)
+        return False
+
+    def _is_silent(self, handler: ast.ExceptHandler) -> bool:
+        """Silent = no re-raise and no call anywhere in the body (a call
+        is the chance to log/record/recover; `pass`/`continue`/bare
+        `return` are not)."""
+        for sub in ast.walk(handler):
+            if isinstance(sub, (ast.Raise, ast.Call)):
+                return False
+        return True
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and \
+                    self._is_broad(node) and self._is_silent(node):
+                what = "bare except" if node.type is None else \
+                    f"except {ast.unparse(node.type)}"
+                yield mod.finding(
+                    self.name, node,
+                    f"{what} swallows the error silently: narrow the "
+                    f"type and/or log it (control-plane failures must "
+                    f"not vanish)")
+
+
+# --------------------------------------------------------- unjoined-thread
+class UnjoinedThread(Rule):
+    name = "unjoined-thread"
+    doc = ("non-daemon threading.Thread with no join in sight — the "
+           "process (worker teardown, test) hangs on exit waiting for it")
+
+    def _daemon_true(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value in (False, None))
+        return False
+
+    def _target_of(self, assign: ast.AST) -> str:
+        if isinstance(assign, ast.Assign) and len(assign.targets) == 1:
+            return dotted(assign.targets[0])
+        return ""
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # one textual pass: names that ever get `.join(` or `.daemon =`
+        joined = set(re.findall(r"([\w.]+)\.join\(", mod.source))
+        daemoned = set(re.findall(r"([\w.]+)\.daemon\s*=\s*True",
+                                  mod.source))
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and tail(call_name(node)) == "Thread"
+                    and call_name(node) in ("Thread", "threading.Thread")):
+                continue
+            if self._daemon_true(node):
+                continue
+            # bound to a name/attr that is later joined or daemonized?
+            parent_target = ""
+            for a in ast.walk(mod.tree):
+                if isinstance(a, ast.Assign) and a.value is node:
+                    parent_target = self._target_of(a)
+            short = tail(parent_target) if parent_target else ""
+            if parent_target and (
+                    parent_target in joined or parent_target in daemoned
+                    or any(j.endswith("." + short) or j == short
+                           for j in joined | daemoned)):
+                continue
+            yield mod.finding(
+                self.name, node,
+                "non-daemon Thread started without a tracked join(): "
+                "pass daemon=True or join it on every exit path")
+
+
+# ------------------------------------------------------------- accum-dtype
+class AccumDtype(Rule):
+    name = "accum-dtype"
+    doc = ("matmul/dot in kernel code without preferred_element_type=f32 "
+           "— bf16 MXU accumulation silently loses ~8 bits of sum "
+           "precision at production sequence lengths")
+    path_filter = r"(^|/)ops(/|$)"
+
+    DOTS = {"dot_general", "dot", "matmul", "einsum", "tensordot"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.MatMult):
+                yield mod.finding(
+                    self.name, node,
+                    "`@` matmul cannot pin the accumulation dtype: use "
+                    "dot_general/einsum with preferred_element_type="
+                    "jnp.float32")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            nm = call_name(node)
+            if tail(nm) not in self.DOTS:
+                continue
+            if any(kw.arg == "preferred_element_type"
+                   for kw in node.keywords):
+                continue
+            yield mod.finding(
+                self.name, node,
+                f"`{nm}` without preferred_element_type: MXU accumulates "
+                f"in the input dtype (bf16) — pass "
+                f"preferred_element_type=jnp.float32")
+
+
+ALL_RULES = [CollectiveSymmetry(), TraceImpurity(), HostSyncInHotPath(),
+             SilentExcept(), UnjoinedThread(), AccumDtype()]
