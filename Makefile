@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest clean
+.PHONY: check check-fast lint native selftest chaos-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -8,6 +8,12 @@
 # see docs/static-analysis.md.
 lint:
 	python -m tools.kfcheck
+
+# kfchaos tier-1 scenario: SIGKILL a rank inside the collective commit,
+# then assert every elastic contract (docs/chaos.md).  Self-skips on
+# images whose jax cannot run the multiprocess data plane.
+chaos-smoke: native
+	python -m kungfu_tpu.chaos.runner --scenario smoke
 
 native:
 	$(MAKE) -C native

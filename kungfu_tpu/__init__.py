@@ -10,6 +10,10 @@ Where the reference runs a Go socket runtime under TF/Torch ops, this
 framework runs `jax.lax` collectives inside jitted, shard_mapped training
 steps — the communication schedule is compiled, not interpreted.
 """
+from .utils.jax_compat import ensure_compat as _ensure_jax_compat
+
+_ensure_jax_compat()  # alias moved jax surfaces (jax.shard_map on 0.4.x)
+
 from . import comm, plan
 from .comm import Session
 from .plan import Cluster, HostList, PeerID, PeerList, Strategy

@@ -1,0 +1,147 @@
+"""kfchaos — deterministic fault injection for the elastic control plane.
+
+KungFu's elastic claims (resize without restart, preemption without
+progress loss) live or die in narrow protocol windows: between a
+replica exchange and the commit record, between a plane teardown and
+the rebuild barrier.  Crashes that only happen "somewhere" never test
+those windows.  This subsystem makes crash points *schedulable and
+reproducible* (Jepsen-style): named injection sites threaded through
+the elastic hot spots, driven by a seeded, serialisable fault plan.
+
+Usage — production code calls :func:`point` at named sites::
+
+    from ..chaos import point as _chaos_point
+    ...
+    _chaos_point("elastic.commit.exchange", rank=p.rank, step=seq,
+                 version=self.version)
+
+Unarmed (no plan), a point is a no-op behind a single module-global
+``None`` check — production pays nothing.  A plan is armed either by
+environment (``KFT_CHAOS_PLAN=/path/plan.json``, read once at import —
+the launcher's workers inherit it) or in-process via :func:`arm`.
+Every fire is journaled (in memory, and to ``KFT_CHAOS_LOG.<pid>``
+when set) so two runs of one plan can be compared event-for-event.
+
+See docs/chaos.md for the site catalogue, plan format, scenario matrix
+and the invariant checkers (:mod:`kungfu_tpu.chaos.invariants`,
+:mod:`kungfu_tpu.chaos.runner`).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .plan import (ACTIONS, ChaosInjected, ChaosRPCDrop, Fault, Plan,
+                   random_plan)
+from .sites import SITES, validate_site
+
+__all__ = [
+    "point", "arm", "disarm", "armed", "fired",
+    "Plan", "Fault", "random_plan", "ChaosInjected", "ChaosRPCDrop",
+    "ACTIONS", "SITES",
+]
+
+
+class _LiveFault:
+    __slots__ = ("fault", "remaining")
+
+    def __init__(self, fault: Fault):
+        self.fault = fault
+        self.remaining = fault.count  # -1 = unlimited
+
+
+class ArmedPlan:
+    """A plan plus its per-process firing state and journal."""
+
+    def __init__(self, plan: Plan, log_path: Optional[str] = None):
+        for f in plan.faults:
+            validate_site(f.site)
+        self.plan = plan
+        self.log_path = log_path
+        self.fired: List[dict] = []
+        self._by_site: Dict[str, List[_LiveFault]] = {}
+        for f in plan.faults:
+            self._by_site.setdefault(f.site, []).append(_LiveFault(f))
+
+    def hit(self, name: str, rank, step, version) -> None:
+        live = self._by_site.get(name)
+        if not live:
+            return
+        for lf in live:
+            if lf.remaining == 0 or not lf.fault.matches(rank, step,
+                                                         version):
+                continue
+            if lf.remaining > 0:
+                lf.remaining -= 1
+            # journal BEFORE executing: a kill must still leave a record
+            self._record(name, rank, step, version, lf.fault.action)
+            lf.fault.execute(name)
+            return  # at most one fault per point
+
+    def _record(self, name, rank, step, version, action) -> None:
+        ev = {"site": name, "action": action, "rank": rank, "step": step,
+              "version": version}
+        self.fired.append(ev)
+        if self.log_path:
+            # open-write-close per event: crash-safe by construction (the
+            # very next thing may be SIGKILL)
+            import json
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(ev) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+
+_armed: Optional[ArmedPlan] = None
+
+
+def point(name: str, *, rank: Optional[int] = None,
+          step: Optional[int] = None,
+          version: Optional[int] = None) -> None:
+    """A named injection site.  No-op unless a plan is armed; when armed,
+    the first matching un-exhausted fault for this site fires (which may
+    sleep, raise, or kill the process — see :mod:`.plan`)."""
+    plan = _armed
+    if plan is None:
+        return
+    plan.hit(name, rank, step, version)
+
+
+def arm(plan: Plan, log_path: Optional[str] = None) -> ArmedPlan:
+    """Install ``plan`` for this process.  Validates every site name.
+    Returns the live :class:`ArmedPlan` (its ``fired`` list is the
+    in-memory journal)."""
+    global _armed
+    _armed = ArmedPlan(plan, log_path=log_path)
+    return _armed
+
+
+def disarm() -> None:
+    """Remove any armed plan; every :func:`point` is a no-op again."""
+    global _armed
+    _armed = None
+
+
+def armed() -> Optional[ArmedPlan]:
+    return _armed
+
+
+def fired() -> List[dict]:
+    """The in-process firing journal (empty when unarmed)."""
+    return list(_armed.fired) if _armed is not None else []
+
+
+def _arm_from_env() -> None:
+    """Read KFT_CHAOS_PLAN exactly once, at import.  A process that sets
+    the env var AFTER importing kungfu_tpu stays unarmed (deliberate:
+    the scenario runner exports the plan for its *worker children*
+    without chaos firing in the runner itself)."""
+    path = os.environ.get("KFT_CHAOS_PLAN", "")
+    if not path:
+        return
+    log = os.environ.get("KFT_CHAOS_LOG", "")
+    arm(Plan.load(path),
+        log_path=f"{log}.{os.getpid()}" if log else None)
+
+
+_arm_from_env()

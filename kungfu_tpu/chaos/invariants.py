@@ -1,0 +1,152 @@
+"""Post-scenario invariant checkers — the elastic module's stated
+contracts, asserted over the event streams a chaos scenario's workers
+emit.
+
+Workers append JSON events (one object per line) as they train; after
+the scenario the runner collects every stream and runs these checkers.
+Event kinds and fields (see the worker template in :mod:`.runner`):
+
+- ``step``:   after every successful step — ``rank, size, version,
+              step, samples``
+- ``commit``: the committed progress pair visible after the step —
+              ``step, samples`` (``committed_*`` fields)
+- ``sync``:   after a recovery/resize restored state — ``step, samples,
+              wsum`` (wsum = squared-norm fingerprint of the params)
+- ``final``:  once, at target — ``step, samples, wsum, size, version``
+- ``detached``: the worker was resized away
+
+Each checker returns a list of violation strings (empty = invariant
+holds), so the runner can report every broken contract at once instead
+of stopping at the first.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+Event = Dict
+
+
+def _by_stream(events: Sequence[Event]) -> Dict[str, List[Event]]:
+    """Group events by their worker stream id (one OS process each)."""
+    out: Dict[str, List[Event]] = {}
+    for e in events:
+        out.setdefault(str(e.get("stream", "?")), []).append(e)
+    return out
+
+
+def check_progress_monotonic(events: Sequence[Event]) -> List[str]:
+    """Committed ``step_count``/``trained_samples`` never regress within
+    one worker's lifetime.  Recovery may re-run steps (the LIVE counters
+    rewind to the last commit), but the committed pair itself must only
+    move forward — a committed value that later shrinks means recovery
+    restored something older than a recorded commit."""
+    bad = []
+    for stream, evs in _by_stream(events).items():
+        hi = (-1, -1)
+        for e in evs:
+            if e.get("kind") != "commit":
+                continue
+            cur = (int(e["samples"]), int(e["step"]))
+            if cur < hi:
+                bad.append(
+                    f"{stream}: committed progress regressed "
+                    f"{hi} -> {cur}")
+            hi = max(hi, cur)
+    return bad
+
+
+def check_no_fresh_start(events: Sequence[Event],
+                         init_wsum: float = 0.0,
+                         atol: float = 1e-12) -> List[str]:
+    """Recovered parameters are never the INIT vector while committed
+    progress is nonzero — the silent-loss failure mode of ADVICE.md-high
+    (survivors re-broadcasting the initial params with their counters
+    intact).  ``init_wsum`` is the fingerprint of the init params
+    (0.0 for the zero-init used by the scenario workers)."""
+    bad = []
+    for e in events:
+        if e.get("kind") not in ("sync", "final"):
+            continue
+        if int(e.get("samples", 0)) > 0 and \
+                abs(float(e.get("wsum", 0.0)) - init_wsum) <= atol:
+            bad.append(
+                f"{e.get('stream')}: {e['kind']} event has nonzero "
+                f"progress (samples={e['samples']}) but init params "
+                f"(wsum={e.get('wsum')}): training progress was lost")
+    return bad
+
+
+def check_single_winner(events: Sequence[Event]) -> List[str]:
+    """Exactly one membership version wins: every worker that reached
+    ``final`` reports the same (version, size), and their progress
+    counters agree (sync training: identical counters)."""
+    finals = [e for e in events if e.get("kind") == "final"]
+    if not finals:
+        return ["no worker reached the target (no final events)"]
+    bad = []
+    vs = {(int(e["version"]), int(e["size"])) for e in finals}
+    if len(vs) != 1:
+        bad.append(f"final membership disagrees across workers: "
+                   f"{sorted(vs)}")
+    progress = {(int(e["samples"]), int(e["step"])) for e in finals}
+    if len(progress) != 1:
+        bad.append(f"final progress disagrees across workers: "
+                   f"{sorted(progress)}")
+    wsums = {e.get("wsum") for e in finals if "wsum" in e}
+    if len(wsums) > 1:
+        bad.append(f"final params disagree across workers: {sorted(wsums)}")
+    return bad
+
+
+def check_no_orphans(pids: Sequence[int]) -> List[str]:
+    """No worker process outlives the scenario (a wedged survivor would
+    leak and poison later port reuse).  ``pids`` are every worker pid
+    the scenario observed."""
+    import os
+    bad = []
+    for pid in pids:
+        try:
+            os.kill(int(pid), 0)
+        except (ProcessLookupError, PermissionError):
+            continue
+        # still signalable: alive (or a zombie we reaped nothing of)
+        try:
+            # don't leave it behind either way
+            os.kill(int(pid), 9)
+        except OSError:
+            pass
+        bad.append(f"worker pid {pid} still alive after the scenario")
+    return bad
+
+
+def check_trajectory(events: Sequence[Event], oracle_wsum,
+                     rtol: float = 1e-4) -> List[str]:
+    """Final parameters match the no-fault oracle trajectory for the
+    number of samples actually trained (``oracle_wsum(samples) ->
+    float``): a lost or zeroed shard diverges here even when counters
+    look healthy."""
+    import math
+    bad = []
+    for e in events:
+        if e.get("kind") != "final" or "wsum" not in e:
+            continue
+        want = float(oracle_wsum(int(e["samples"])))
+        got = float(e["wsum"])
+        if not math.isclose(got, want, rel_tol=rtol, abs_tol=1e-9):
+            bad.append(
+                f"{e.get('stream')}: final wsum {got!r} != oracle "
+                f"{want!r} at samples={e['samples']}")
+    return bad
+
+
+def run_all(events: Sequence[Event], pids: Sequence[int] = (),
+            oracle_wsum=None, init_wsum: float = 0.0) -> List[str]:
+    """Every checker, all violations collected."""
+    bad = []
+    bad += check_progress_monotonic(events)
+    bad += check_no_fresh_start(events, init_wsum=init_wsum)
+    bad += check_single_winner(events)
+    bad += check_no_orphans(pids)
+    if oracle_wsum is not None:
+        bad += check_trajectory(events, oracle_wsum)
+    return bad

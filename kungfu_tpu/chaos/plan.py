@@ -1,0 +1,202 @@
+"""Fault plans: what to inject, where, when — serialisable and replayable.
+
+A plan is a list of :class:`Fault` rules.  Each rule names an injection
+site (see :mod:`kungfu_tpu.chaos.sites`), a match predicate over the
+coordinates the site reports (rank / step / membership version), an
+action, and a fire budget.  Plans are plain JSON so a failing chaos run
+can be re-executed bit-for-bit: nothing in a plan (or in its generation,
+:func:`random_plan`) reads the wall clock or unseeded randomness.
+
+Actions
+-------
+- ``kill``      — SIGKILL the current process (preemption-class death:
+                  the launcher's watcher absorbs it as a shrink)
+- ``exception`` — raise :class:`ChaosInjected` (a
+                  :class:`kungfu_tpu.native.NativeError`): the failure
+                  class every recovery path is written against
+- ``delay``     — sleep ``delay_s`` seconds (straggler / slow link)
+- ``drop-rpc``  — raise :class:`ChaosRPCDrop` (an :class:`OSError`):
+                  the failure class config-server RPC callers retry on
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from .. import native
+from .sites import SITES, validate_site
+
+ACTIONS = ("kill", "exception", "delay", "drop-rpc")
+
+# match-predicate coordinates a site can report
+_COORDS = ("rank", "step", "version")
+
+MatchVal = Optional[Union[int, Sequence[int]]]
+
+
+class ChaosInjected(native.NativeError):
+    """Injected control-plane failure (the class recovery paths catch)."""
+
+
+class ChaosRPCDrop(OSError):
+    """Injected RPC failure (the class config-server callers retry on)."""
+
+
+def _norm_match(v: MatchVal) -> Optional[List[int]]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        raise ValueError(f"bad match value {v!r}")
+    if isinstance(v, int):
+        return [v]
+    out = [int(x) for x in v]
+    if not out:
+        raise ValueError("empty match list matches nothing; use null/None "
+                         "for 'any'")
+    return out
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injection rule.  ``count`` is the fire budget per process
+    (-1 = unlimited); a coordinate predicate of ``None`` matches any
+    value, while a site that does not report that coordinate (passes
+    ``None``) only matches predicates of ``None``."""
+
+    site: str
+    action: str = "exception"
+    rank: MatchVal = None
+    step: MatchVal = None
+    version: MatchVal = None
+    count: int = 1
+    delay_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        validate_site(self.site)
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown action {self.action!r} (one of {ACTIONS})")
+        if self.action == "delay" and self.delay_s <= 0:
+            raise ValueError("delay action needs delay_s > 0")
+        if self.count == 0 or self.count < -1:
+            raise ValueError(f"count must be positive or -1, got {self.count}")
+        self.rank = _norm_match(self.rank)
+        self.step = _norm_match(self.step)
+        self.version = _norm_match(self.version)
+
+    def matches(self, rank: Optional[int], step: Optional[int],
+                version: Optional[int]) -> bool:
+        for want, got in ((self.rank, rank), (self.step, step),
+                          (self.version, version)):
+            if want is not None and got not in want:
+                return False
+        return True
+
+    def execute(self, site: str) -> None:
+        """Perform the action.  ``kill`` does not return."""
+        if self.action == "delay":
+            time.sleep(self.delay_s)
+        elif self.action == "exception":
+            raise ChaosInjected(f"kfchaos: injected failure at {site}")
+        elif self.action == "drop-rpc":
+            raise ChaosRPCDrop(f"kfchaos: injected rpc drop at {site}")
+        elif self.action == "kill":
+            # SIGKILL: a preemption-class death (watcher _PREEMPT_CODES)
+            # with no chance for the victim to limp through more protocol
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # ------------------------------------------------------------- (de)ser
+    def to_dict(self) -> dict:
+        d = {"site": self.site, "action": self.action, "count": self.count}
+        match = {c: getattr(self, c) for c in _COORDS
+                 if getattr(self, c) is not None}
+        if match:
+            d["match"] = match
+        if self.action == "delay":
+            d["delay_s"] = self.delay_s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        extra = set(d) - {"site", "action", "count", "match", "delay_s"}
+        if extra:
+            raise ValueError(f"unknown fault keys {sorted(extra)}")
+        match = d.get("match", {})
+        bad = set(match) - set(_COORDS)
+        if bad:
+            raise ValueError(f"unknown match coordinates {sorted(bad)}")
+        return cls(site=d["site"], action=d.get("action", "exception"),
+                   rank=match.get("rank"), step=match.get("step"),
+                   version=match.get("version"),
+                   count=int(d.get("count", 1)),
+                   delay_s=float(d.get("delay_s", 0.0)))
+
+
+@dataclasses.dataclass
+class Plan:
+    """An ordered list of faults plus the seed that generated it (None
+    for hand-written plans).  First matching fault per point() wins."""
+
+    faults: List[Fault] = dataclasses.field(default_factory=list)
+    seed: Optional[int] = None
+
+    def add(self, site: str, action: str = "exception", *,
+            rank: MatchVal = None, step: MatchVal = None,
+            version: MatchVal = None, count: int = 1,
+            delay_s: float = 0.0) -> "Plan":
+        """Composer: ``Plan().add(...).add(...)``."""
+        self.faults.append(Fault(site=site, action=action, rank=rank,
+                                 step=step, version=version, count=count,
+                                 delay_s=delay_s))
+        return self
+
+    # ------------------------------------------------------------- (de)ser
+    def to_json(self) -> str:
+        return json.dumps({"version": 1, "seed": self.seed,
+                           "faults": [f.to_dict() for f in self.faults]},
+                          indent=2) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "Plan":
+        d = json.loads(text)
+        if d.get("version", 1) != 1:
+            raise ValueError(f"unknown plan format version {d['version']}")
+        return cls(faults=[Fault.from_dict(f) for f in d.get("faults", [])],
+                   seed=d.get("seed"))
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Plan":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+def random_plan(seed: int, n_faults: int = 3,
+                sites: Optional[Sequence[str]] = None,
+                ranks: Sequence[int] = (0, 1),
+                steps: Sequence[int] = tuple(range(1, 16)),
+                actions: Sequence[str] = ("exception", "delay", "kill"),
+                ) -> Plan:
+    """Seeded pseudo-random plan for fuzz-style sweeps.  The same seed
+    always composes the same plan (``random.Random(seed)``; no wall
+    clock), so a sweep that finds a bug is rerun by seed alone."""
+    rng = random.Random(seed)
+    pool = sorted(sites) if sites is not None else sorted(SITES)
+    plan = Plan(seed=seed)
+    for _ in range(n_faults):
+        action = rng.choice(list(actions))
+        plan.add(rng.choice(pool), action,
+                 rank=rng.choice(list(ranks)),
+                 step=rng.choice(list(steps)),
+                 delay_s=round(rng.uniform(0.05, 0.5), 3)
+                 if action == "delay" else 0.0)
+    return plan

@@ -1,0 +1,514 @@
+"""kfchaos scenario runner: drive the multi-process elastic harness
+through a fault plan, then assert the elastic contracts.
+
+Each scenario = (cluster shape, training target, resize schedule, fault
+plan).  The runner spawns the same launcher stack production uses — an
+in-process :class:`~kungfu_tpu.elastic.ConfigServer` plus
+:func:`~kungfu_tpu.launcher.watch.watch_run` with preemption recovery —
+over :class:`~kungfu_tpu.elastic.sharded.ShardedElasticTrainer`
+workers.  The workers inherit ``KFT_CHAOS_PLAN`` and so arm the plan at
+import; the runner process itself stays unarmed (the env var is set
+after :mod:`kungfu_tpu.chaos` was imported — arming is import-time by
+design).
+
+After the job drains, the runner collects every worker's event stream
+and runs the :mod:`~kungfu_tpu.chaos.invariants` checkers, including
+the no-fault trajectory oracle (hand-rolled numpy adam — touching jax
+in the runner process would pin its device count and poison the
+worker env).
+
+CLI::
+
+    python -m kungfu_tpu.chaos.runner --list
+    python -m kungfu_tpu.chaos.runner --scenario smoke
+    python -m kungfu_tpu.chaos.runner --scenario all --out /tmp/chaos
+    python -m kungfu_tpu.chaos.runner --scenario kill-during-commit \
+        --replay-check   # run twice, require identical fault sequences
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import invariants
+from .plan import Fault, Plan
+
+# one logical model shared by every scenario (mirrors the
+# tests/test_elastic_sharded.py workload: ZeRO-3 sharded flat vectors
+# with adam, trajectory-equivalent to replicated sync training)
+_IN, _OUT = 16, 4
+
+WORKER = r"""
+import json, os, signal, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+
+from kungfu_tpu.elastic.sharded import ShardedElasticTrainer
+from kungfu_tpu.launcher import env as E
+
+out_dir = os.environ["KFT_CHAOS_OUT"]
+B = int(os.environ.get("KFT_CHAOS_B", "8"))
+TARGET = int(os.environ["KFT_CHAOS_TARGET"])
+PROPOSE = json.loads(os.environ.get("KFT_CHAOS_PROPOSE", "[]"))
+SNAP = os.environ.get("KFT_CHAOS_SNAP", "1")
+SNAP = "auto" if SNAP == "auto" else int(SNAP)
+we = E.from_env()
+stream = f"{we.self_spec.port}.{os.getpid()}"
+ev_path = os.path.join(out_dir, f"events.{stream}.jsonl")
+with open(os.path.join(out_dir, f"pid.{stream}"), "w") as f:
+    f.write(str(os.getpid()))
+
+def emit(kind, **kw):
+    kw.update(kind=kind, stream=stream)
+    with open(ev_path, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+rng = np.random.RandomState(0)
+X = rng.randn(B, 16).astype(np.float32)
+Y = X @ rng.randn(16, 4).astype(np.float32)
+
+def loss_fn(p, batch):
+    bx, by = batch
+    import jax.numpy as jnp
+    return jnp.mean((bx @ p["w"] + p["b"] - by) ** 2)
+
+try:
+    tr = ShardedElasticTrainer(loss_fn, optax.adam(0.05),
+                               {"w": np.zeros((16, 4), np.float32),
+                                "b": np.zeros((4,), np.float32)},
+                               snapshot_every=SNAP)
+except Exception as e:
+    # a joiner whose first collective was torn up by an injected death
+    # exits with a preemption-class code: the watcher absorbs it as a
+    # shrink instead of failing the whole scenario
+    emit("join_failed", error=repr(e))
+    sys.exit(143)
+
+emit("start", rank=tr.rank, size=tr.size, version=tr.version,
+     step=tr.step_count, samples=tr.trained_samples)
+proposed = set()
+prev_committed = None
+prev_version = tr.version
+while tr.trained_samples < TARGET:
+    loss = tr.step((X, Y))
+    if loss is None:
+        emit("detached", step=tr.step_count, samples=tr.trained_samples)
+        sys.exit(0)
+    if tr.version != prev_version:
+        prev_version = tr.version
+        emit("sync", step=tr.step_count, samples=tr.trained_samples,
+             size=tr.size, version=tr.version)
+    emit("step", rank=tr.rank, size=tr.size, version=tr.version,
+         step=tr.step_count, samples=tr.trained_samples)
+    if tr._committed_progress != prev_committed:
+        prev_committed = tr._committed_progress
+        emit("commit", samples=prev_committed[0], step=prev_committed[1])
+    for st, sz in PROPOSE:
+        if tr.rank == 0 and tr.step_count >= st and (st, sz) not in proposed:
+            proposed.add((st, sz))
+            tr.propose_new_size(sz)
+
+p = tr.current_params()
+wsum = float(np.square(p["w"]).sum() + np.square(p["b"]).sum())
+emit("final", rank=tr.rank, size=tr.size, version=tr.version,
+     step=tr.step_count, samples=tr.trained_samples, wsum=wsum)
+tr.shutdown()
+"""
+
+
+_DATA_PLANE: Optional[bool] = None
+
+_DATA_PLANE_PROBE = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = jax.devices()
+assert len(devs) == 2, devs
+mesh = Mesh(np.array(devs), ("dp",))
+x = jax.device_put(np.ones(2, np.float32), NamedSharding(mesh, P("dp")))
+assert float(jax.jit(jnp.sum)(x)) == 2.0  # spans both processes
+"""
+
+
+def data_plane_supported() -> bool:
+    """True when this jax build can run a GLOBAL computation spanning
+    two OS processes on the CPU backend — the substrate of every
+    scenario in the matrix (and of the multi-process trainer tests,
+    which share this probe via tests/testutil.py).  Older jaxlib CPU
+    backends reject it with "Multiprocess computations aren't
+    implemented"; there the runner SKIPS instead of failing.  Probed
+    once per process with two throwaway subprocesses; override with
+    ``KFT_TESTS_DATA_PLANE=0/1`` to skip the probe."""
+    global _DATA_PLANE
+    if _DATA_PLANE is None:
+        force = os.environ.get("KFT_TESTS_DATA_PLANE", "")
+        if force:
+            _DATA_PLANE = force.lower() not in ("0", "false", "no")
+        else:
+            _DATA_PLANE = _probe_data_plane()
+    return _DATA_PLANE
+
+
+def _probe_data_plane() -> bool:
+    import socket
+    import subprocess
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _DATA_PLANE_PROBE, coord, str(i)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for i in range(2)]
+    try:
+        return all(p.wait(timeout=120) == 0 for p in procs)
+    except subprocess.TimeoutExpired:
+        return False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def oracle_wsum(batch: int, n_steps: int) -> float:
+    """No-fault trajectory fingerprint: numpy adam matching optax
+    defaults over the shared workload (pure numpy — see module doc)."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch, _IN).astype(np.float32)
+    Y = X @ rng.randn(_IN, _OUT).astype(np.float32)
+    w = np.zeros((_IN, _OUT), np.float32)
+    b = np.zeros((_OUT,), np.float32)
+    lr, b1, b2, eps = 0.05, 0.9, 0.999, 1e-8
+    m = {"w": np.zeros_like(w), "b": np.zeros_like(b)}
+    v = {"w": np.zeros_like(w), "b": np.zeros_like(b)}
+    for t in range(1, n_steps + 1):
+        r = X @ w + b - Y
+        gw = (2.0 / r.size) * (X.T @ r)
+        gb = (2.0 / r.size) * r.sum(axis=0)
+        for k, g in (("w", gw), ("b", gb)):
+            m[k] = b1 * m[k] + (1 - b1) * g
+            v[k] = b2 * v[k] + (1 - b2) * g * g
+            mh = m[k] / (1 - b1 ** t)
+            vh = v[k] / (1 - b2 ** t)
+            upd = (-lr * mh / (np.sqrt(vh) + eps)).astype(np.float32)
+            if k == "w":
+                w = w + upd
+            else:
+                b = b + upd
+    return float(np.square(w).sum() + np.square(b).sum())
+
+
+@dataclasses.dataclass
+class Scenario:
+    """One entry of the chaos matrix."""
+
+    name: str
+    desc: str
+    plan: Plan
+    nprocs: int = 2
+    devices_per_proc: int = 2
+    batch: int = 8
+    target_steps: int = 18
+    propose: Sequence[Tuple[int, int]] = ()   # [(after_step, new_size)]
+    snapshot_every: int = 1
+    parent_port: int = 31976
+    timeout_s: float = 300.0
+
+
+def scenarios() -> Dict[str, Scenario]:
+    """The scenario matrix.  ``smoke`` is the tier-1 member; the rest
+    ride the slow tier / `make chaos-smoke`'s full mode."""
+    m = [
+        Scenario(
+            name="kill-during-commit",
+            desc="SIGKILL rank 1 between the replica save and the "
+                 "commit barrier: the un-recorded commit must not "
+                 "count and the survivor must recover from the "
+                 "previous one",
+            plan=Plan(seed=None).add("elastic.commit.exchange", "kill",
+                                     rank=1, step=6),
+            parent_port=31976),
+        Scenario(
+            name="kill-during-rebuild",
+            desc="grow 2->3, then SIGKILL the fresh joiner inside the "
+                 "post-rebuild collective commit: survivors must "
+                 "recover from the PRE-resize history (ADVICE.md-high "
+                 "fault window)",
+            plan=Plan(seed=None).add("elastic.commit.exchange", "kill",
+                                     rank=2),
+            propose=((4, 3),),
+            target_steps=20,
+            parent_port=31977,
+            timeout_s=420.0),
+        Scenario(
+            name="config-outage-mid-resize",
+            desc="config server unreachable (drop-rpc on every fetch) "
+                 "around a voluntary shrink: the resize is delayed, "
+                 "never corrupted",
+            plan=Plan(seed=None).add("config.fetch", "drop-rpc",
+                                     count=8),
+            propose=((4, 1),),
+            target_steps=16,
+            parent_port=31978),
+        Scenario(
+            name="slow-peer-fence",
+            desc="rank 1 stalls 0.3s at three consecutive step fences: "
+                 "lockstep training tolerates stragglers without "
+                 "divergence",
+            plan=Plan(seed=None).add("elastic.step.fence", "delay",
+                                     rank=1, step=[3, 4, 5], count=3,
+                                     delay_s=0.3),
+            target_steps=12,
+            parent_port=31979),
+        Scenario(
+            name="double-resize",
+            desc="two proposals land back-to-back (3->2 and ->3 in one "
+                 "step): the digest consensus must converge on exactly "
+                 "one winning membership",
+            plan=Plan(seed=None),   # no faults: the race IS the chaos
+            nprocs=3,
+            propose=((3, 2), (3, 3)),
+            target_steps=20,
+            parent_port=31980,
+            timeout_s=420.0),
+    ]
+    out = {s.name: s for s in m}
+    out["smoke"] = dataclasses.replace(
+        m[0], name="smoke", target_steps=12,
+        desc="tier-1 smoke: " + m[0].desc, parent_port=31981)
+    return out
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    scenario: str
+    rc: int
+    violations: List[str]
+    events: List[dict]
+    fired: List[dict]        # aggregated chaos journals, sorted
+    out_dir: str
+
+    @property
+    def ok(self) -> bool:
+        return self.rc == 0 and not self.violations
+
+
+@contextlib.contextmanager
+def _scoped_env(updates: Dict[str, str]):
+    old = {k: os.environ.get(k) for k in updates}
+    os.environ.update(updates)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _collect_events(out_dir: str) -> List[dict]:
+    events = []
+    for path in sorted(glob.glob(os.path.join(out_dir, "events.*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    return events
+
+
+def _collect_fired(log_prefix: str) -> List[dict]:
+    fired = []
+    for path in sorted(glob.glob(log_prefix + ".*")):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    fired.append(json.loads(line))
+    # per-process journals are deterministic; the cross-process merge
+    # order is not — compare as a sorted multiset
+    return sorted(fired, key=lambda e: json.dumps(e, sort_keys=True))
+
+
+def run_scenario(sc: Scenario, out_root: Optional[str] = None,
+                 verbose: bool = True) -> ScenarioResult:
+    """Execute one scenario end-to-end and check every invariant."""
+    from ..elastic import ConfigServer, put_config
+    from ..launcher.job import Job
+    from ..launcher.watch import watch_run
+    from ..plan import Cluster, HostList, PeerID
+
+    out_dir = tempfile.mkdtemp(prefix=f"kfchaos-{sc.name}-",
+                               dir=out_root)
+    script = os.path.join(out_dir, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    plan_path = os.path.join(out_dir, "plan.json")
+    sc.plan.save(plan_path)
+    log_prefix = os.path.join(out_dir, "chaos-log")
+
+    env = {
+        "KFT_CHAOS_PLAN": plan_path,
+        "KFT_CHAOS_LOG": log_prefix,
+        "KFT_CHAOS_OUT": out_dir,
+        "KFT_CHAOS_B": str(sc.batch),
+        "KFT_CHAOS_TARGET": str(sc.target_steps * sc.batch),
+        "KFT_CHAOS_PROPOSE": json.dumps([list(p) for p in sc.propose]),
+        "KFT_CHAOS_SNAP": str(sc.snapshot_every),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": ("--xla_force_host_platform_device_count="
+                      f"{sc.devices_per_proc}"),
+        # dead-peer dials must give up fast (same dials the elastic
+        # tests use) or recovery waits out long TCP timeouts
+        "KFT_RECV_TIMEOUT_S": "3",
+        "KFT_CONN_RETRIES": "10",
+    }
+    target = sc.target_steps * sc.batch
+    if verbose:
+        print(f"kfchaos: scenario {sc.name}: {sc.nprocs} procs x "
+              f"{sc.devices_per_proc} devices, target {target} samples, "
+              f"{len(sc.plan.faults)} fault(s), out {out_dir}",
+              flush=True)
+    cluster = Cluster.from_hostlist(
+        HostList.parse(f"127.0.0.1:{sc.nprocs}"), sc.nprocs)
+    srv = ConfigServer().start()
+    try:
+        with _scoped_env(env):
+            put_config(srv.url, cluster)
+            job = Job(prog=sys.executable, args=[script],
+                      config_server=srv.url)
+            rc = watch_run(job, "127.0.0.1",
+                           PeerID("127.0.0.1", sc.parent_port),
+                           cluster, srv.url, poll_interval=0.2,
+                           preempt_recover=True)
+    finally:
+        srv.stop()
+
+    events = _collect_events(out_dir)
+    pids = [int(open(p).read().strip())
+            for p in glob.glob(os.path.join(out_dir, "pid.*"))]
+    violations = []
+    if rc != 0:
+        violations.append(f"job exited rc={rc} (expected 0)")
+    violations += invariants.run_all(
+        events, pids=pids,
+        oracle_wsum=lambda samples: oracle_wsum(
+            sc.batch, samples // sc.batch))
+    res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,
+                         events=events, fired=_collect_fired(log_prefix),
+                         out_dir=out_dir)
+    if verbose:
+        status = "PASS" if res.ok else "FAIL"
+        print(f"kfchaos: scenario {sc.name}: {status} "
+              f"({len(res.fired)} fault(s) fired, "
+              f"{len(events)} events)", flush=True)
+        for v in violations:
+            print(f"kfchaos:   violation: {v}", flush=True)
+    return res
+
+
+def replay_check(sc: Scenario, out_root: Optional[str] = None,
+                 verbose: bool = True) -> bool:
+    """Run a scenario twice off the same plan file; the fault sequences
+    must match event-for-event (the determinism contract)."""
+    a = run_scenario(sc, out_root, verbose=verbose)
+    b = run_scenario(sc, out_root, verbose=verbose)
+    same = a.fired == b.fired
+    if verbose:
+        print(f"kfchaos: replay-check {sc.name}: "
+              f"{'IDENTICAL' if same else 'DIVERGED'} "
+              f"({len(a.fired)} vs {len(b.fired)} fires)", flush=True)
+        if not same:
+            for tag, fires in (("run1", a.fired), ("run2", b.fired)):
+                print(f"kfchaos:   {tag}: {fires}", flush=True)
+    return same and a.ok and b.ok
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="kft-chaos",
+        description="deterministic fault-injection scenarios for the "
+                    "elastic control plane")
+    p.add_argument("--scenario", default="smoke",
+                   help="scenario name, 'all', or 'smoke' (default)")
+    p.add_argument("--out", default=None,
+                   help="directory to keep artifacts under (default: "
+                        "a fresh tempdir)")
+    p.add_argument("--list", action="store_true",
+                   help="list the scenario matrix and exit")
+    p.add_argument("--replay-check", action="store_true",
+                   help="run each scenario twice and require identical "
+                        "fault sequences")
+    p.add_argument("--seed", type=int, default=None,
+                   help="additionally run a random_plan fuzz scenario "
+                        "with this seed (no resize schedule)")
+    args = p.parse_args(argv)
+
+    matrix = scenarios()
+    if args.list:
+        for name, sc in matrix.items():
+            print(f"{name:28s} {sc.desc}")
+        return 0
+    from .. import native
+    if not native.available():
+        print("kfchaos: SKIP (native comm library unavailable)",
+              flush=True)
+        return 0
+    if not data_plane_supported():
+        print("kfchaos: SKIP (this jax build cannot run multiprocess "
+              "CPU computations; scenarios need the real data plane)",
+              flush=True)
+        return 0
+    if args.scenario == "all":
+        picked = [sc for name, sc in matrix.items() if name != "smoke"]
+    else:
+        if args.scenario not in matrix:
+            p.error(f"unknown scenario {args.scenario!r} "
+                    f"(have: {', '.join(matrix)})")
+        picked = [matrix[args.scenario]]
+    if args.seed is not None:
+        from .plan import random_plan
+        picked.append(Scenario(
+            name=f"fuzz-{args.seed}",
+            desc=f"random_plan(seed={args.seed})",
+            plan=random_plan(args.seed,
+                             sites=["elastic.step.fence",
+                                    "elastic.commit.exchange",
+                                    "config.fetch"],
+                             actions=("exception", "delay", "drop-rpc")),
+            parent_port=31982))
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+    ok = True
+    for sc in picked:
+        if args.replay_check:
+            ok = replay_check(sc, args.out) and ok
+        else:
+            ok = run_scenario(sc, args.out).ok and ok
+    print(f"kfchaos: {'ALL SCENARIOS PASSED' if ok else 'FAILURES'}",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

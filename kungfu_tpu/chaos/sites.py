@@ -1,0 +1,85 @@
+"""Catalogue of kfchaos injection sites.
+
+Every ``chaos.point(name, ...)`` threaded through the control plane must
+use a name registered here — :func:`kungfu_tpu.chaos.arm` validates the
+plan's sites against this dict, so a typo in a fault plan fails at arm
+time instead of silently never firing.
+
+To add a site: pick a ``layer.operation[.phase]`` name, register it here
+with one line on WHERE it sits and WHAT a fault there simulates, then
+call ``chaos.point("your.site", rank=..., step=..., version=...)`` at
+the spot (pass whatever coordinates the call site knows; ``None`` for
+the rest).  See docs/chaos.md for the full workflow.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+SITES: Dict[str, str] = {
+    # ------------------------------------------------ elastic trainers
+    "elastic.step.fence": (
+        "start of every step, before the version-fence allreduce — a "
+        "delay here models a straggling peer at the fence; a kill, a "
+        "mid-step preemption"),
+    "elastic.commit.begin": (
+        "entry of _commit, before any state is snapshotted — a kill "
+        "here loses nothing (the previous commit stands)"),
+    "elastic.commit.exchange": (
+        "sharded commit: own blocks saved to the local store, BEFORE "
+        "the replica-exchange barrier — a kill here interrupts the "
+        "collective commit with the new snapshot only partially "
+        "replicated (the fault window of ADVICE.md-high)"),
+    "elastic.commit.record": (
+        "after the replica exchange, immediately before the commit is "
+        "recorded — a kill here tests that an un-recorded commit never "
+        "counts"),
+    "elastic.resize.begin": (
+        "a voluntary resize was agreed at the fence, before the "
+        "pre-resize commit"),
+    "elastic.pre_teardown.begin": (
+        "before departing workers hand their shard blocks to survivors "
+        "(sharded only) — faults here hit the handoff barrier"),
+    "elastic.teardown.begin": (
+        "before the ordered data-plane teardown — a kill here leaves "
+        "the old plane up on the victim while survivors tear down"),
+    "elastic.rebuild.begin": (
+        "entry of _rebuild_at on the NEW membership, before state "
+        "resync — survivors and fresh joiners both pass it"),
+    "elastic.rebuild.before_commit": (
+        "sharded _rebuild_at: new mesh + state are live, immediately "
+        "before the post-rebuild commit re-establishes the replica "
+        "ring — a kill here is the kill-during-rebuild scenario"),
+    "elastic.sync_state.begin": (
+        "entry of _sync_state: membership agreed, committed state "
+        "about to be re-shared/re-sharded"),
+    # ------------------------------------------------ config control plane
+    "config.fetch": (
+        "every GET of (version, cluster) from the config server — "
+        "drop-rpc here models a config-server outage (callers treat "
+        "OSError as a transient poll failure)"),
+    "config.put": (
+        "every PUT/CAS of a cluster to the config server — drop-rpc "
+        "here loses resize proposals"),
+    # ------------------------------------------------ launcher / watcher
+    "launcher.watch.update": (
+        "watcher applying a Stage{version, cluster} diff, before any "
+        "kill/spawn"),
+    "launcher.watch.spawn": (
+        "watcher about to spawn one worker process"),
+    "launcher.watch.kill": (
+        "watcher about to kill one removed worker"),
+    # ------------------------------------------------ model store
+    "store.save": (
+        "ModelStore.save of a pytree (versioned or flat)"),
+    "store.load": (
+        "ModelStore.request of a pytree — an exception here models a "
+        "corrupt/evicted blob"),
+}
+
+
+def validate_site(name: str) -> None:
+    if name not in SITES:
+        known = ", ".join(sorted(SITES))
+        raise ValueError(
+            f"unknown chaos site {name!r} (known sites: {known}); "
+            f"register new sites in kungfu_tpu/chaos/sites.py")

@@ -119,14 +119,19 @@ def initialize(peers: Sequence, rank: int, cluster_version: int = 0,
         # a backend built before initialize() would pin the single-process
         # device set; drop it so the distributed one is built instead
         _clear_backends()
-    jax.config.update("jax_enable_recoverability", True)
+    from .utils.jax_compat import config_flag_supported
+    if config_flag_supported("jax_enable_recoverability"):
+        jax.config.update("jax_enable_recoverability", True)
     # jax's preemption sync manager traps SIGTERM to defer the death to a
     # sync point — but THIS framework's preemption story is the runner's
     # (SIGTERM death -> shrink proposal -> survivors absorb it,
     # launcher/watch.py); a trapped SIGTERM would leave the worker
-    # half-alive and turn the eviction into a late SIGABRT
-    jax.config.update("jax_enable_preemption_service", False)
-    jax.distributed.initialize(
+    # half-alive and turn the eviction into a late SIGABRT.  On a jax
+    # without these flags peer death still surfaces as a RuntimeError
+    # from the failed collective, which the recovery path catches.
+    if config_flag_supported("jax_enable_preemption_service"):
+        jax.config.update("jax_enable_preemption_service", False)
+    kwargs = dict(
         coordinator_address=coord,
         num_processes=n,
         process_id=rank,
@@ -135,6 +140,12 @@ def initialize(peers: Sequence, rank: int, cluster_version: int = 0,
             os.environ.get("KFT_DATA_PLANE_HEARTBEAT_S", "10")),
         shutdown_timeout_seconds=int(
             os.environ.get("KFT_DATA_PLANE_SHUTDOWN_S", "5")))
+    import inspect as _inspect
+    supported = _inspect.signature(jax.distributed.initialize).parameters
+    # elastic-tuned heartbeat/shutdown timeouts exist only on jax builds
+    # with the recoverable runtime; older ones use their fixed defaults
+    jax.distributed.initialize(
+        **{k: v for k, v in kwargs.items() if k in supported})
     _live = (cluster_version, coord, n, rank)
     global _atexit_armed
     if not _atexit_armed:

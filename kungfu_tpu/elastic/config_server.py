@@ -149,6 +149,9 @@ class ConfigServer:
 def fetch_config(url: str, timeout: float = 5.0) -> Tuple[int, Cluster]:
     """GET the current (version, cluster) from a config server URL."""
     import urllib.request
+
+    from ..chaos import point as _chaos_point
+    _chaos_point("config.fetch")
     with urllib.request.urlopen(url, timeout=timeout) as r:
         d = json.loads(r.read().decode())
     return d["version"], Cluster.from_json(json.dumps(d["cluster"]))
@@ -159,6 +162,9 @@ def put_config(url: str, cluster: Cluster, timeout: float = 5.0,
     """PUT a cluster; ``if_version`` makes it a compare-and-swap — the
     server rejects with 409 when its version moved since that fetch."""
     import urllib.request
+
+    from ..chaos import point as _chaos_point
+    _chaos_point("config.put")
     req = urllib.request.Request(url, data=cluster.to_json().encode(),
                                  method="PUT")
     if if_version is not None:

@@ -30,9 +30,27 @@ import numpy as np
 
 from .. import distributed as D
 from .. import native
+from ..chaos import point as _chaos_point
 from ..launcher import env as E
 from . import state as _flags
 from .config_server import fetch_config
+
+
+def _snapshot_budget(default: float = 0.05) -> float:
+    """KFT_SNAPSHOT_BUDGET as a float, warn-and-fallback on malformed
+    values (the KFT_BASE_PORT idiom, plan/hostspec.py) — a typo in an
+    env var must degrade the cadence derivation, not crash the trainer
+    mid-step."""
+    import os
+    import sys
+    raw = os.environ.get("KFT_SNAPSHOT_BUDGET", "")
+    try:
+        budget = float(raw) if raw else default
+    except ValueError:
+        print(f"kft: ignoring malformed KFT_SNAPSHOT_BUDGET={raw!r}; "
+              f"using {default}", file=sys.stderr)
+        return default
+    return max(budget, 1e-6)
 
 
 class DistributedElasticTrainer:
@@ -121,6 +139,8 @@ class DistributedElasticTrainer:
         step whose update came from a rank that never committed it,
         silently skipping data; rank 0's (state, counters) pair is
         always consistent."""
+        _chaos_point("elastic.sync_state.begin", rank=self.peer.rank,
+                     step=self.step_count, version=self.version)
         self._host_params = D.broadcast_host_tree(
             self._host_params, self.peer, root=0,
             name=f"params@{self.version}")
@@ -183,6 +203,8 @@ class DistributedElasticTrainer:
             return self._last_seen_version
 
     def _rebuild_at(self, peer) -> None:
+        _chaos_point("elastic.rebuild.begin", rank=peer.rank,
+                     step=self.step_count, version=peer.token)
         self.peer = peer
         self.version = peer.token
         self._last_seen_version = max(self._last_seen_version, self.version)
@@ -205,6 +227,9 @@ class DistributedElasticTrainer:
         if not D.is_initialized():
             return
         p = self.peer
+        _chaos_point("elastic.teardown.begin",
+                     rank=None if p is None else p.rank,
+                     step=self.step_count, version=self.version)
         try:
             if p is not None and p.size > 1:
                 p.barrier(name=f"plane-down@{self.version}")
@@ -225,6 +250,8 @@ class DistributedElasticTrainer:
         """Snapshot device state + the counters describing it to host —
         the point a recovery or resize restarts from."""
         import jax
+        _chaos_point("elastic.commit.begin", rank=self.peer.rank,
+                     step=self.step_count, version=self.version)
         self._host_params = jax.tree_util.tree_map(np.asarray, self._params)
         self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
         self._committed_progress = (self.trained_samples, self.step_count)
@@ -237,6 +264,8 @@ class DistributedElasticTrainer:
 
     def _resize(self) -> bool:
         """Apply a pending config change; False when detached."""
+        _chaos_point("elastic.resize.begin", rank=self.peer.rank,
+                     step=self.step_count, version=self.version)
         # everyone is at the same fence: commit the live device state so
         # a voluntary resize never discards steps since the last snapshot
         self._commit()
@@ -273,6 +302,8 @@ class DistributedElasticTrainer:
         import jax
         if _flags.is_detached():
             return None
+        _chaos_point("elastic.step.fence", rank=self.peer.rank,
+                     step=self.step_count, version=self.version)
         while True:
             local = (self._fetch_version()
                      if self.step_count % self.poll_every == 0
@@ -292,10 +323,14 @@ class DistributedElasticTrainer:
             try:
                 if not self._resize():
                     return None
-            except native.NativeError as e:
+            except (native.NativeError, OSError) as e:
                 # a peer died DURING the voluntary resize (handoff
-                # barrier, post-rebuild commit, ...): absorb it through
-                # the same recovery path as a mid-step death
+                # barrier, post-rebuild commit, ...) or the config
+                # server dropped out mid-resize (OSError from the
+                # resize fetch): absorb either through the same
+                # recovery path as a mid-step death — its poll loop
+                # retries the config server until the membership
+                # resolves
                 return self._recover(global_batch, cause=e)
             # re-fence on the NEW membership before stepping: a freshly
             # joined worker's first fence must pair with everyone's
@@ -333,9 +368,7 @@ class DistributedElasticTrainer:
                 return self._recover(global_batch, cause=e)
             return lossv
         if self._auto_snap and self.step_count >= 2:
-            import os as _os
-            budget = max(float(_os.environ.get("KFT_SNAPSHOT_BUDGET",
-                                               "0.05")), 1e-6)
+            budget = _snapshot_budget()
             step_s = max(self._last_step_s or 1e-3, 1e-3)
             # 0 = "I never measured a commit" (a joiner restored after
             # the step-1 measurement); the MAX then adopts whichever

@@ -48,14 +48,19 @@ import numpy as np
 
 from .. import distributed as D
 from .. import native
+from ..chaos import point as _chaos_point
 from ..parallel.fsdp import FSDP_AXIS, make_fsdp_step
+from ..plan.cluster import Cluster
 from .config_server import fetch_config
 from .multiproc import DistributedElasticTrainer
 
 # round-1 sync header layout (int64): [has_data, newest_seq, prev_seq,
-# samples@newest, steps@newest, samples@prev, steps@prev, old_ndev,
-# old_nproc, old_rank]
-_HDR = 10
+# samples/steps/ndev/nproc/rank @newest, the same five @prev,
+# committed_steps].  BOTH history slots carry their own mesh layout:
+# after a resize the retained fallback commit may predate the current
+# membership, so its blocks re-shard under ITS (ndev, nproc), not the
+# newest commit's.
+_HDR = 14
 _NO_SEQ = -1
 
 
@@ -175,11 +180,13 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             at = s.index[0].stop
         return int(lo), np.concatenate(datas)
 
-    def _commit(self) -> None:
+    def _commit(self, force: bool = False) -> None:
         seq = self.step_count
-        if seq in self._held_meta:
+        if seq in self._held_meta and not force:
             return  # already committed at this step (resize right after)
         p = self.peer
+        _chaos_point("elastic.commit.begin", rank=p.rank, step=seq,
+                     version=self.version)
         ndev = self.num_devices()
         nproc = p.size
         blocks: Dict[str, np.ndarray] = {}
@@ -189,21 +196,28 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         small = self._small_leaves()
         # ring replica: pull the PREDECESSOR's blocks so any single
         # failure leaves each block on a survivor (rank r's block lives
-        # on r and on (r+1) % n)
+        # on r and on (r+1) % n).  Store keys carry the membership
+        # version: a post-rebuild force commit at the same seq must not
+        # size-conflict with the pre-resize blobs (block length changed
+        # with the mesh).
         held = {p.rank: blocks}
         if nproc > 1:
             for name, b in blocks.items():
-                p.save(f"kftsh:{name}", b, version=seq)
+                p.save(f"kftsh:{name}@{self.version}", b, version=seq)
+            _chaos_point("elastic.commit.exchange", rank=p.rank, step=seq,
+                         version=self.version)
             p.barrier(name=f"kftsh-commit@{self.version}:{seq}")
             pred = (p.rank - 1) % nproc
             _, _, block_len = _layout(self._vec_size, ndev, nproc)
             dt = self._vec_dtypes()
             held[pred] = {
-                name: p.request(pred, f"kftsh:{name}",
+                name: p.request(pred, f"kftsh:{name}@{self.version}",
                                 np.empty(block_len, dt[name]), version=seq)
                 for name in blocks}
         # record only AFTER the exchange: a commit interrupted by a peer
         # death must not count (recovery falls back to the previous one)
+        _chaos_point("elastic.commit.record", rank=p.rank, step=seq,
+                     version=self.version)
         self._held[seq] = held
         self._held_meta[seq] = (self.trained_samples, self.step_count,
                                 small, ndev, nproc, p.rank)
@@ -222,18 +236,38 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         p = self.peer
         if p is None or p.size <= 1 or not self.we.config_server:
             return
-        cluster = None
-        for _ in range(3):  # the handoff is a COLLECTIVE: a member that
-            try:            # silently skipped it would wedge the barrier
-                _, cluster = fetch_config(self.we.config_server,
-                                          timeout=5.0)
-                break
-            except (OSError, ValueError, KeyError):
-                continue  # retried; exhaustion raises NativeError below
-        if cluster is None:
+        _chaos_point("elastic.pre_teardown.begin", rank=p.rank,
+                     step=self.step_count, version=self.version)
+        # the handoff is a COLLECTIVE, so every member must act on ONE
+        # membership delta: rank 0 fetches the target cluster and
+        # broadcasts it over the host plane.  Per-member fetches could
+        # interleave with a newer proposal landing on the config server,
+        # splitting the departing set — some members then skip the
+        # handoff barrier others entered (ADVICE.md sharded.py:234).
+        payload = b""
+        if p.rank == 0:
+            for _ in range(3):
+                try:
+                    _, cluster = fetch_config(self.we.config_server,
+                                              timeout=5.0)
+                    payload = cluster.to_json().encode()
+                    break
+                except (OSError, ValueError, KeyError):
+                    continue  # retried; exhaustion raises below
+        n = p.broadcast(np.asarray([len(payload)], np.int64), root=0,
+                        name=f"kftsh-pre@{self.version}")
+        if int(n[0]) == 0:
+            # rank 0 exhausted its retries: every member learns it from
+            # the same broadcast and fails in unison (no half-entered
+            # barrier)
             raise native.NativeError(
                 "sharded elastic: config server unreachable at the "
                 "pre-teardown handoff; cannot resize safely")
+        buf = np.zeros(int(n[0]), np.uint8)
+        if p.rank == 0:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        buf = p.broadcast(buf, root=0, name=f"kftsh-prec@{self.version}")
+        cluster = Cluster.from_json(buf.tobytes().decode())
         new_specs = {f"{w.host}:{w.port}" for w in cluster.workers}
         old = list(p.peers)
         alive = [i for i, s in enumerate(old) if s in new_specs]
@@ -253,7 +287,7 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                         for i in [(r + k) % len(old)] if i in alive)
             if p.rank == succ and r not in self._held[seq]:
                 self._held[seq][r] = {
-                    name: p.request(r, f"kftsh:{name}",
+                    name: p.request(r, f"kftsh:{name}@{self.version}",
                                     np.empty(block_len, dt[name]),
                                     version=seq)
                     for name in self._vec_names()}
@@ -266,6 +300,9 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         the old-layout blocks overlapping its new device range."""
         p = self.peer
         nproc = 1 if p is None else p.size
+        _chaos_point("elastic.sync_state.begin",
+                     rank=None if p is None else p.rank,
+                     step=self.step_count, version=self.version)
         newest = max(self._held_meta) if self._held_meta else _NO_SEQ
         prev = (max((s for s in self._held_meta if s != newest),
                     default=_NO_SEQ))
@@ -274,21 +311,34 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                 return  # fresh single-process start: _build uses _flat
             hdrs = None
         else:
-            meta_n = self._held_meta.get(newest)
-            meta_p = self._held_meta.get(prev)
+            def slot(meta):
+                # [samples, steps, ndev, nproc, rank-at-commit] — the
+                # rank is the key into _held; p.rank here is already the
+                # NEW membership's rank
+                return ([meta[0], meta[1], meta[3], meta[4], meta[5]]
+                        if meta else [0, 0, 0, 0, -1])
             hdr = np.asarray(
-                [1 if newest != _NO_SEQ else 0, newest, prev,
-                 meta_n[0] if meta_n else 0, meta_n[1] if meta_n else 0,
-                 meta_p[0] if meta_p else 0, meta_p[1] if meta_p else 0,
-                 meta_n[3] if meta_n else 0, meta_n[4] if meta_n else 0,
-                 # rank AT COMMIT TIME (the key into _held) — p.rank
-                 # here is already the NEW membership's rank
-                 meta_n[5] if meta_n else -1], np.int64)
+                [1 if newest != _NO_SEQ else 0, newest, prev]
+                + slot(self._held_meta.get(newest))
+                + slot(self._held_meta.get(prev))
+                + [self._committed_progress[1]], np.int64)
             assert hdr.shape[0] == _HDR
             hdrs = p.all_gather(hdr, name=f"kftsh-hdr@{self.version}")
             if not int(hdrs[:, 0].max()):
-                # nobody holds a commit: fresh start — adopt rank 0's
-                # init vector (base-class semantics)
+                if int(hdrs[:, 13].max()) > 0:
+                    # a member has COMMITTED nonzero progress but no one
+                    # holds a commit: re-initialising from the init
+                    # vector here would silently discard all training
+                    # progress while the counters stay nonzero
+                    # (ADVICE.md-high).  Every member sees the same
+                    # gathered headers, so all raise in unison.
+                    raise native.NativeError(
+                        "sharded elastic: committed progress "
+                        f"(step {int(hdrs[:, 13].max())}) exists but no "
+                        "member holds a commit; refusing to fresh-start "
+                        "over trained state")
+                # genuinely fresh start — adopt rank 0's init vector
+                # (base-class semantics)
                 self._flat = p.broadcast(self._flat, root=0,
                                          name=f"kftsh-init@{self.version}")
                 self._sync_cadence()
@@ -298,23 +348,37 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             holders = {0: (newest, prev)}
             M = newest
             samples, steps, _, old_ndev, old_nproc, _ = self._held_meta[M]
+            old_rank_of = {0: 0}
         else:
             holders = {j: (int(hdrs[j, 1]), int(hdrs[j, 2]))
                        for j in range(nproc) if int(hdrs[j, 0])}
             M = min(n for n, _ in holders.values())
-            rows = [hdrs[j] for j, (n, pr) in holders.items()
-                    if M in (n, pr)]
-            assert rows, "no holder carries the agreed commit"
-            pick = rows[0]
-            if int(pick[1]) == M:
-                samples, steps = int(pick[3]), int(pick[4])
-            else:
-                samples, steps = int(pick[5]), int(pick[6])
-            old_ndev, old_nproc = int(pick[7]), int(pick[8])
+            # each holder reports M's meta from WHICHEVER of its two
+            # history slots carries M — after a resize the fallback slot
+            # may describe a different (ndev, nproc) than the newest
+            picks = []
+            old_rank_of = {}
             for j, (n, pr) in holders.items():
-                assert M in (n, pr), (
-                    f"holder {j} lost commit {M} (has {n}/{pr}): commits "
-                    "drifted more than the 2-deep history covers")
+                if n == M:
+                    picks.append(hdrs[j, 3:8])
+                    old_rank_of[j] = int(hdrs[j, 7])
+                elif pr == M:
+                    picks.append(hdrs[j, 8:13])
+                    old_rank_of[j] = int(hdrs[j, 12])
+                else:
+                    # bare asserts are stripped under python -O; these
+                    # are safety invariants and must stay loud
+                    raise native.NativeError(
+                        f"sharded elastic: holder {j} lost commit {M} "
+                        f"(has {n}/{pr}): commits drifted more than the "
+                        "2-deep history covers")
+            if not picks:
+                raise native.NativeError(
+                    "sharded elastic: no holder carries the agreed "
+                    f"commit {M}")
+            pick = picks[0]
+            samples, steps = int(pick[0]), int(pick[1])
+            old_ndev, old_nproc = int(pick[2]), int(pick[3])
         # --- availability + source assignment ----------------------------
         _, old_chunk, old_block = _layout(self._vec_size, old_ndev,
                                           old_nproc)
@@ -322,13 +386,12 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             avail = np.zeros((1, old_nproc), np.int64)
             for r in self._held.get(M, {}):
                 avail[0, r] = 1
-            old_rank_of = {0: 0}
         else:
             mine = np.zeros(old_nproc, np.int64)
             for r in self._held.get(M, {}):
-                mine[r] = 1
+                if r < old_nproc:
+                    mine[r] = 1
             avail = p.all_gather(mine, name=f"kftsh-avail@{self.version}")
-            old_rank_of = {j: int(hdrs[j, 9]) for j in holders}
         src: Dict[int, int] = {}
         for r in range(old_nproc):
             js = [j for j in range(avail.shape[0]) if avail[j, r]]
@@ -508,12 +571,24 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
     # ----------------------------------------------------------- lifecycle
     def _rebuild_at(self, peer) -> None:
         super()._rebuild_at(peer)
-        # the pulled state was consumed by _build; blocks keyed by the
-        # OLD membership's ranks are meaningless under the new one —
-        # commit immediately so a snapshot exists before the next step
-        self._held.clear()
-        self._held_meta.clear()
-        self._commit()
+        # collective names restart with the membership: a fresh joiner's
+        # _gather_seq begins at 0, so survivors' must too, or the first
+        # post-resize current_params() all_gathers under mismatched
+        # names and wedges until the host-plane timeout (the membership
+        # version in the name keeps per-version counters unique)
+        self._gather_seq = 0
+        _chaos_point("elastic.rebuild.before_commit", rank=peer.rank,
+                     step=self.step_count, version=self.version)
+        # commit immediately so a new-membership snapshot (with its
+        # replica ring) exists before the next step — but KEEP the
+        # old-membership history until that commit is RECORDED: if a
+        # peer dies inside this collective commit, the survivors'
+        # recovery must still find the pre-resize commits.  Each history
+        # entry carries its own (ndev, nproc, rank-at-commit), so
+        # _sync_state re-shards old-layout blocks correctly; clearing
+        # first would leave every survivor empty-handed and turn the
+        # recovery into a silent fresh start over trained state.
+        self._commit(force=True)
 
     # -------------------------------------------------------------- public
     def current_params(self):

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..chaos import point as _chaos_point
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID, PeerList
 from ..elastic.config_server import fetch_config, put_config
@@ -66,9 +67,11 @@ class Watcher:
         with self._lock:
             if version <= self.version:
                 return
+            _chaos_point("launcher.watch.update", version=version)
             want = set(self.local_workers(cluster))
             have = set(self.current)
             for peer in have - want:
+                _chaos_point("launcher.watch.kill", version=version)
                 self.current.pop(peer).kill()
                 chip = self._chip_of.pop(peer, None)
                 if chip is not None and self.pool:
@@ -97,6 +100,7 @@ class Watcher:
             print(f"[watcher] chip pool exhausted; deferring {peer}",
                   file=sys.stderr)
             return False
+        _chaos_point("launcher.watch.spawn", version=version)
         proc = self.job.new_proc(peer, cluster, version, self.parent, chip)
         proc.start()
         self.current[peer] = proc

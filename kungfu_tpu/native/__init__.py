@@ -77,7 +77,15 @@ def _load():
         path = lib_path()
         if not os.path.exists(path):
             build()
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            # a stale .so (built in a different image/libc — e.g. one
+            # with unresolved shm_open) loads as "undefined symbol";
+            # rebuild from source once and retry rather than reporting
+            # the whole native plane unavailable
+            build(force=True)
+            lib = ctypes.CDLL(path)
         c = ctypes.c_void_p
         i32, i64, u32 = ctypes.c_int, ctypes.c_int64, ctypes.c_uint32
         dbl, cstr = ctypes.c_double, ctypes.c_char_p

@@ -114,6 +114,9 @@ class ModelStore:
 
     def save(self, name: str, tree, version: Optional[int] = None) -> None:
         import jax
+
+        from ..chaos import point as _chaos_point
+        _chaos_point("store.save", version=version)
         leaves, _ = jax.tree_util.tree_flatten(tree)
         for i, leaf in enumerate(leaves):
             key = f"{name}/{i}"
@@ -124,6 +127,9 @@ class ModelStore:
 
     def request(self, name: str, template, version: Optional[int] = None):
         import jax
+
+        from ..chaos import point as _chaos_point
+        _chaos_point("store.load", version=version)
         leaves, treedef = jax.tree_util.tree_flatten(template)
         out = []
         for i, leaf in enumerate(leaves):

@@ -1,0 +1,57 @@
+"""Forward-compat shims over the jax API surfaces this framework uses.
+
+The repo targets current jax (``jax.shard_map``, ``jax.typeof``, the
+recoverable-distributed config flags — see tests/test_jax_compat.py),
+but must still import and train on the jax pinned in older images
+(0.4.x), where those names live under ``jax.experimental`` or do not
+exist.  :func:`ensure_compat` installs the aliases once, at package
+import, so every call site can use the current spelling unconditionally.
+
+Only *renames* are shimmed.  Behavioral gaps (e.g. a jax without
+``jax_enable_recoverability`` cannot promise peer death surfaces as a
+catchable error) are handled at the call site by feature-testing
+``jax.config.values`` — see ``distributed.initialize``.
+"""
+from __future__ import annotations
+
+
+def ensure_compat() -> None:
+    """Idempotently alias moved/renamed jax surfaces onto the current
+    names.  Safe to call any number of times, from any thread that runs
+    before the first use (kungfu_tpu/__init__ calls it at import)."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        # jax < 0.5: jax.experimental.shard_map.shard_map
+        from jax.experimental.shard_map import shard_map
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        # jax < 0.6: no lax.axis_size; the static mesh-axis size is in
+        # the trace-time axis env.  Call sites use it for loop bounds
+        # and shapes, so this MUST return a Python int (a psum(1, ...)
+        # would be traced) — axis_frame gives exactly that on 0.4.x.
+        from jax._src import core as _core
+
+        def axis_size(axis_name):
+            frame = _core.axis_frame(axis_name)
+            return int(getattr(frame, "size", frame))
+
+        jax.lax.axis_size = axis_size
+    if not hasattr(jax, "typeof"):
+        # jax < 0.6: the aval accessor is jax.core.get_aval; callers here
+        # only probe optional attrs on the result (e.g. `.vma`) via
+        # getattr-with-default, so the older aval type suffices
+        from jax.core import get_aval
+
+        def typeof(x):
+            return get_aval(x)
+
+        jax.typeof = typeof
+
+
+def config_flag_supported(flag: str) -> bool:
+    """True when this jax build knows the given config option (e.g.
+    ``jax_enable_recoverability``); ``jax.config.update`` on an unknown
+    flag raises instead of ignoring it."""
+    import jax
+    return flag in jax.config.values

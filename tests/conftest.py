@@ -21,6 +21,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-process scenario (chaos matrix, ...); "
+        "skipped unless KFT_SLOW_TESTS=1 — tier-1 keeps one smoke "
+        "member instead")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("KFT_SLOW_TESTS", "") in ("1", "true", "yes"):
+        return
+    skip = pytest.mark.skip(reason="slow tier (set KFT_SLOW_TESTS=1)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def devices():
     ds = jax.devices()

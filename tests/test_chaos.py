@@ -1,0 +1,372 @@
+"""kfchaos: deterministic fault injection for the elastic control plane.
+
+Unit tier (runs everywhere): plan format + validation, seeded plan
+generation, arm/fire semantics (match predicates, fire budgets, the
+journal-before-execute crash-safety rule), env-var arming in a child
+process, unarmed overhead, and every invariant checker positive AND
+negative — the negatives replay the event signatures of the pre-fix
+bugs (ADVICE.md: survivors fresh-starting over trained state).
+
+Scenario tier: the multi-process matrix through elastic/multiproc.py.
+One smoke scenario stays tier-1; the full matrix and the replay-
+determinism check ride the `slow` marker (KFT_SLOW_TESTS=1).  Both need
+the native comm library and a jax that can run multiprocess CPU
+computations (see testutil.data_plane_supported).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import chaos, native  # noqa: E402
+from kungfu_tpu.chaos import (ChaosInjected, ChaosRPCDrop, Fault,  # noqa: E402
+                              Plan, random_plan)
+from kungfu_tpu.chaos import invariants, runner  # noqa: E402
+import testutil  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_plane = pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    chaos.disarm()
+
+
+# ---------------------------------------------------------------- plan format
+def test_plan_roundtrip():
+    p = (Plan(seed=7)
+         .add("elastic.commit.exchange", "kill", rank=1, step=6)
+         .add("config.fetch", "drop-rpc", count=8)
+         .add("elastic.step.fence", "delay", rank=0, step=[3, 4, 5],
+              count=3, delay_s=0.25)
+         .add("store.save", "exception", version=2, count=-1))
+    q = Plan.from_json(p.to_json())
+    assert q.to_json() == p.to_json()
+    assert q.seed == 7
+    assert [f.site for f in q.faults] == [f.site for f in p.faults]
+    assert q.faults[2].step == [3, 4, 5]
+    assert q.faults[2].delay_s == 0.25
+    assert q.faults[3].count == -1
+
+
+def test_plan_save_load(tmp_path):
+    p = Plan().add("elastic.commit.begin", "exception", rank=0)
+    path = p.save(str(tmp_path / "plan.json"))
+    assert Plan.load(path).to_json() == p.to_json()
+
+
+@pytest.mark.parametrize("bad", [
+    dict(site="nope.such.site"),
+    dict(site="config.fetch", action="explode"),
+    dict(site="config.fetch", action="delay"),          # delay_s missing
+    dict(site="config.fetch", count=0),
+    dict(site="config.fetch", count=-2),
+    dict(site="config.fetch", rank=[]),                 # matches nothing
+    dict(site="config.fetch", rank=True),               # bool is not an int
+])
+def test_fault_validation(bad):
+    with pytest.raises(ValueError):
+        Fault(**bad)
+
+
+def test_fault_dict_validation():
+    with pytest.raises(ValueError):
+        Fault.from_dict({"site": "config.fetch", "bogus_key": 1})
+    with pytest.raises(ValueError):
+        Fault.from_dict({"site": "config.fetch", "match": {"host": 3}})
+    with pytest.raises(ValueError):
+        Plan.from_json(json.dumps({"version": 99, "faults": []}))
+
+
+def test_arm_validates_sites():
+    """A typo'd site fails loudly at arm time, not by never firing."""
+    f = Fault(site="store.save")
+    f.site = "store.sav"  # bypass construction-time validation
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        chaos.arm(Plan(faults=[f]))
+
+
+def test_random_plan_is_seed_deterministic():
+    a = random_plan(42, n_faults=5)
+    b = random_plan(42, n_faults=5)
+    assert a.to_json() == b.to_json()
+    assert a.seed == 42
+    assert len(a.faults) == 5
+    assert random_plan(43, n_faults=5).to_json() != a.to_json()
+    sites = ["config.fetch", "elastic.step.fence"]
+    c = random_plan(1, n_faults=8, sites=sites)
+    assert {f.site for f in c.faults} <= set(sites)
+
+
+# ------------------------------------------------------------- fire semantics
+def test_unarmed_point_is_noop():
+    assert chaos.armed() is None
+    chaos.point("elastic.commit.exchange", rank=0, step=1)  # nothing
+    assert chaos.fired() == []
+
+
+def test_match_predicates():
+    chaos.arm(Plan().add("elastic.step.fence", "exception",
+                         rank=1, step=[5, 6], count=-1))
+    # wrong rank / wrong step: no fire
+    chaos.point("elastic.step.fence", rank=0, step=5)
+    chaos.point("elastic.step.fence", rank=1, step=4)
+    # a site that does not report the coordinate never matches a pinned one
+    chaos.point("elastic.step.fence", rank=None, step=5)
+    assert chaos.fired() == []
+    with pytest.raises(ChaosInjected):
+        chaos.point("elastic.step.fence", rank=1, step=6)
+    assert len(chaos.fired()) == 1
+
+
+def test_fire_budget_and_first_match_wins():
+    chaos.arm(Plan()
+              .add("config.fetch", "delay", count=2, delay_s=0.001)
+              .add("config.fetch", "drop-rpc", count=1))
+    chaos.point("config.fetch")   # delay #1
+    chaos.point("config.fetch")   # delay #2 (budget exhausted after)
+    with pytest.raises(ChaosRPCDrop):
+        chaos.point("config.fetch")  # falls through to the second rule
+    chaos.point("config.fetch")   # both exhausted: no-op
+    acts = [e["action"] for e in chaos.fired()]
+    assert acts == ["delay", "delay", "drop-rpc"]
+
+
+def test_exception_classes_match_recovery_paths():
+    """Injected faults must be the classes production code already
+    handles: ChaosInjected a NativeError, ChaosRPCDrop an OSError."""
+    assert issubclass(ChaosInjected, native.NativeError)
+    assert issubclass(ChaosRPCDrop, OSError)
+
+
+def test_delay_action_sleeps():
+    chaos.arm(Plan().add("store.load", "delay", delay_s=0.05))
+    t0 = time.perf_counter()
+    chaos.point("store.load")
+    assert time.perf_counter() - t0 >= 0.045
+
+
+def test_journal_written_before_execute(tmp_path):
+    """The journal entry lands BEFORE the action runs, so even a kill
+    leaves a record (here: the exception is raised after the record)."""
+    log = str(tmp_path / "log")
+    chaos.arm(Plan().add("config.put", "exception"), log_path=log)
+    with pytest.raises(ChaosInjected):
+        chaos.point("config.put")
+    ev = [json.loads(x) for x in open(log).read().splitlines()]
+    assert ev == [{"site": "config.put", "action": "exception",
+                   "rank": None, "step": None, "version": None}]
+    assert chaos.fired() == ev
+
+
+def test_replay_same_plan_same_journal():
+    """Determinism at the unit level: the same plan over the same call
+    sequence produces the identical journal, twice."""
+    plan = Plan.from_json(random_plan(
+        9, n_faults=4, sites=["elastic.step.fence"],
+        actions=("delay",)).to_json())
+    journals = []
+    for _ in range(2):
+        chaos.arm(plan)
+        for step in range(1, 16):
+            for rank in (0, 1):
+                chaos.point("elastic.step.fence", rank=rank, step=step)
+        journals.append(chaos.fired())
+        chaos.disarm()
+    assert journals[0] == journals[1]
+    assert journals[0]  # the seeded plan does fire on this sweep
+
+
+def test_unarmed_overhead_negligible():
+    """No plan loaded => a single module-global check per point().  The
+    bound is deliberately generous (CI boxes are noisy); the property
+    that matters is O(1) per call with no allocation."""
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        chaos.point("elastic.step.fence", rank=0, step=1)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"{n} unarmed points took {dt:.3f}s"
+
+
+def test_env_arming_and_kill_journal(tmp_path):
+    """A child process with KFT_CHAOS_PLAN set arms at import; a kill
+    fault SIGKILLs it mid-point, and the crash-safe journal still holds
+    the record.  Also proves arming is import-time only: this pytest
+    process sets the env var for the CHILD and stays unarmed."""
+    plan = Plan().add("store.save", "kill", rank=0)
+    plan_path = plan.save(str(tmp_path / "plan.json"))
+    log_prefix = str(tmp_path / "chaos-log")
+    env = dict(os.environ, KFT_CHAOS_PLAN=plan_path,
+               KFT_CHAOS_LOG=log_prefix, JAX_PLATFORMS="cpu")
+    code = (
+        "from kungfu_tpu import chaos\n"
+        "assert chaos.armed() is not None\n"
+        "chaos.point('store.save', rank=0)\n"
+        "print('UNREACHABLE')\n")
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == -9, (r.returncode, r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+    logs = [p for p in os.listdir(tmp_path)
+            if p.startswith("chaos-log.")]
+    assert len(logs) == 1
+    ev = [json.loads(x)
+          for x in open(tmp_path / logs[0]).read().splitlines()]
+    assert ev == [{"site": "store.save", "action": "kill", "rank": 0,
+                   "step": None, "version": None}]
+
+
+def test_env_var_after_import_stays_unarmed(monkeypatch, tmp_path):
+    plan_path = Plan().add("config.fetch").save(str(tmp_path / "p.json"))
+    monkeypatch.setenv("KFT_CHAOS_PLAN", plan_path)
+    assert chaos.armed() is None      # module imported long before
+    chaos.point("config.fetch")       # still a no-op
+
+
+# --------------------------------------------------------- invariant checkers
+def _ev(kind, stream="w0", **kw):
+    kw.update(kind=kind, stream=stream)
+    return kw
+
+
+def test_progress_monotonic_checker():
+    ok = [_ev("commit", samples=8, step=1), _ev("commit", samples=16, step=2)]
+    assert invariants.check_progress_monotonic(ok) == []
+    # a later commit with LESS progress = recovery restored pre-commit state
+    bad = ok + [_ev("commit", samples=8, step=1)]
+    out = invariants.check_progress_monotonic(bad)
+    assert len(out) == 1 and "regressed" in out[0]
+    # regression on another stream is independent
+    other = ok + [_ev("commit", stream="w1", samples=24, step=3)]
+    assert invariants.check_progress_monotonic(other) == []
+
+
+def test_no_fresh_start_checker():
+    """The ADVICE.md-high signature: counters say trained, params say
+    init vector."""
+    ok = [_ev("sync", samples=32, step=4, wsum=1.25),
+          _ev("final", samples=64, step=8, wsum=2.5)]
+    assert invariants.check_no_fresh_start(ok) == []
+    lost = [_ev("sync", samples=32, step=4, wsum=0.0)]
+    out = invariants.check_no_fresh_start(lost)
+    assert len(out) == 1 and "lost" in out[0]
+    # zero params with zero progress is a legitimate fresh start
+    assert invariants.check_no_fresh_start(
+        [_ev("sync", samples=0, step=0, wsum=0.0)]) == []
+
+
+def test_single_winner_checker():
+    ok = [_ev("final", stream="w0", version=3, size=2, samples=64, step=8,
+              wsum=2.5),
+          _ev("final", stream="w1", version=3, size=2, samples=64, step=8,
+              wsum=2.5)]
+    assert invariants.check_single_winner(ok) == []
+    assert invariants.check_single_winner([]) == [
+        "no worker reached the target (no final events)"]
+    split = [dict(ok[0]), dict(ok[1], version=4, size=3)]
+    assert any("membership disagrees" in v
+               for v in invariants.check_single_winner(split))
+    drift = [dict(ok[0]), dict(ok[1], samples=72, step=9)]
+    assert any("progress disagrees" in v
+               for v in invariants.check_single_winner(drift))
+    forked = [dict(ok[0]), dict(ok[1], wsum=9.9)]
+    assert any("params disagree" in v
+               for v in invariants.check_single_winner(forked))
+
+
+def test_trajectory_checker():
+    oracle = lambda samples: 0.5 * samples  # noqa: E731
+    ok = [_ev("final", samples=16, step=2, wsum=8.0)]
+    assert invariants.check_trajectory(ok, oracle) == []
+    diverged = [_ev("final", samples=16, step=2, wsum=7.0)]
+    out = invariants.check_trajectory(diverged, oracle)
+    assert len(out) == 1 and "oracle" in out[0]
+
+
+def test_no_orphans_checker():
+    gone = subprocess.Popen([sys.executable, "-c", "pass"])
+    gone.wait()
+    assert invariants.check_no_orphans([gone.pid]) == []
+    leaked = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(600)"])
+    try:
+        out = invariants.check_no_orphans([leaked.pid])
+        assert len(out) == 1 and "still alive" in out[0]
+    finally:
+        leaked.wait(timeout=30)   # the checker itself killed it
+    assert leaked.returncode == -9
+
+
+def test_run_all_aggregates():
+    events = [_ev("commit", samples=16, step=2),
+              _ev("commit", samples=8, step=1),       # regression
+              _ev("final", samples=16, step=2, wsum=0.0,   # fresh start
+                  version=1, size=2)]
+    out = invariants.run_all(events)
+    assert any("regressed" in v for v in out)
+    assert any("lost" in v for v in out)
+
+
+# ------------------------------------------------------------ scenario matrix
+def test_scenario_matrix_well_formed():
+    m = runner.scenarios()
+    assert "smoke" in m
+    ports = [sc.parent_port for sc in m.values()]
+    assert len(set(ports)) == len(ports), "parent ports must not collide"
+    for sc in m.values():
+        chaos.arm(sc.plan)            # validates every site name
+        chaos.disarm()
+        assert Plan.from_json(sc.plan.to_json()).to_json() == \
+            sc.plan.to_json()
+    assert m["smoke"].target_steps <= m["kill-during-commit"].target_steps
+
+
+def test_oracle_wsum_deterministic():
+    a = runner.oracle_wsum(8, 12)
+    assert a == runner.oracle_wsum(8, 12)
+    assert a > 0.0
+    assert runner.oracle_wsum(8, 6) != a
+
+
+@needs_plane
+def test_scenario_smoke(tmp_path):
+    """Tier-1 member of the matrix: kill rank 1 inside the collective
+    commit; every elastic contract must hold afterwards."""
+    sc = runner.scenarios()["smoke"]
+    res = runner.run_scenario(sc, out_root=str(tmp_path))
+    assert res.ok, res.violations
+    assert any(e["action"] == "kill" for e in res.fired), \
+        "the planned fault never fired"
+
+
+@pytest.mark.slow
+@needs_plane
+@pytest.mark.parametrize("name", ["kill-during-commit",
+                                  "kill-during-rebuild",
+                                  "config-outage-mid-resize",
+                                  "slow-peer-fence",
+                                  "double-resize"])
+def test_scenario_matrix(name, tmp_path):
+    res = runner.run_scenario(runner.scenarios()[name],
+                              out_root=str(tmp_path))
+    assert res.ok, res.violations
+
+
+@pytest.mark.slow
+@needs_plane
+def test_scenario_replay_determinism(tmp_path):
+    """The same plan file replays to the identical fault sequence."""
+    assert runner.replay_check(runner.scenarios()["smoke"],
+                               out_root=str(tmp_path))

@@ -11,7 +11,15 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
+import testutil
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not testutil.data_plane_supported(),
+    reason="needs a multiprocess-capable jax CPU backend")
 
 WORKER = textwrap.dedent("""
     import jax

@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kungfu_tpu import native  # noqa: E402
 from kungfu_tpu.plan import Cluster, HostList, PeerID  # noqa: E402
+import testutil  # noqa: E402
 
 # shared worker scaffolding: both workers train the same sync-DP least-
 # squares model and report "size:ndev:trained:wsum:phases" (parsed by
@@ -92,7 +93,9 @@ while tr.trained_samples < TARGET:
 """ + WORKER_EPILOGUE
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_resize_live_multiprocess_data_plane(tmp_path, monkeypatch):
     from kungfu_tpu.elastic import ConfigServer, fetch_config, put_config
     from kungfu_tpu.launcher.job import Job
@@ -170,7 +173,9 @@ while tr.trained_samples < TARGET:
 )
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_grow_beyond_initial_membership(tmp_path, monkeypatch):
     """Growing the live data plane PAST its original size: 2 procs x 4
     devices propose 3; the watcher spawns a process that never existed

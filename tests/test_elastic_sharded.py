@@ -29,6 +29,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kungfu_tpu import native  # noqa: E402
 from kungfu_tpu.plan import Cluster, HostList, PeerID  # noqa: E402
+import testutil  # noqa: E402
 
 WORKER_PRELUDE = r"""
 import os, signal, sys, time
@@ -129,7 +130,9 @@ while tr.trained_samples < TARGET:
 """ + WORKER_EPILOGUE
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_preempt_resharded_recovery(tmp_path, monkeypatch):
     """SIGTERM a worker holding 1/2 of the sharded state: the survivor
     rebuilds the full flat vectors from its own blocks plus the ring
@@ -224,7 +227,9 @@ while tr.trained_samples < TARGET:
 assert "int(redid)" in CADENCE_WORKER
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_sharded_preempt_with_commit_cadence(tmp_path, monkeypatch):
     """snapshot_every=3 with a SIGTERM at step 7: the survivor must
     re-shard from the step-6 ring-replica commit and REDO step 7 — a
@@ -316,7 +321,9 @@ tr.shutdown()
 """
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_auto_snapshot_cadence(tmp_path, monkeypatch):
     """snapshot_every="auto" derives the commit cadence from measured
     commit/step cost under a budget, AGREED across processes (the
@@ -379,7 +386,9 @@ while tr.trained_samples < TARGET:
 """ + WORKER_EPILOGUE
 
 
-@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
 def test_voluntary_shrink_handoff(tmp_path, monkeypatch):
     """3 procs x 2 devices shrink to 1 in one step: ranks 1 AND 2 both
     depart, so rank 1's block replica (held by rank 2) departs with it —

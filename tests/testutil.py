@@ -2,6 +2,18 @@
 import jax
 import numpy as np
 
+def data_plane_supported() -> bool:
+    """True when this jax build can run a GLOBAL computation spanning two
+    OS processes on the CPU backend (the substrate of every multi-process
+    trainer test: DistributedElasticTrainer, ShardedElasticTrainer, the
+    chaos scenario matrix).  Older jaxlib CPU backends reject it with
+    "Multiprocess computations aren't implemented" — those tests must
+    SKIP there, not fail.  One probe implementation, shared with the
+    chaos scenario runner (which self-skips off the same answer);
+    override with KFT_TESTS_DATA_PLANE=0/1 to skip the probe."""
+    from kungfu_tpu.chaos.runner import data_plane_supported as probe
+    return probe()
+
 
 def tree_allclose(a, b, rtol=2e-4, atol=2e-5):
     """Assert two pytrees match leaf-for-leaf within tolerance."""

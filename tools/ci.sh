@@ -9,7 +9,8 @@
 #   1. native build + C++ selftest            (~20 s)
 #   2. pytest suite, sharded across N workers (~15-20 min at -j2 on the
 #      1-core dev VM; ~35 min serial — the suite is full of sleeps and
-#      subprocess waits, so sharding pays even without cores)
+#      subprocess waits, so sharding pays even without cores), then the
+#      serial perf tier and the kfchaos smoke scenario (full run only)
 #   3. the driver's dryrun_multichip on a virtual 8-device CPU mesh
 #      (multi-chip shardings compile + execute, incl. the multi-process
 #      elastic resize)                        (~3-5 min)
@@ -83,6 +84,12 @@ else
   say "2b/3 perf tier (serial)"
   KFT_PERF_ENFORCE=1 python -m pytest \
       tests/test_pipeline.py::test_pp_bubble_sweep_harness -q || fail=1
+
+  # kfchaos smoke: SIGKILL a rank inside the collective commit, assert
+  # every elastic contract (docs/chaos.md).  Full run only; self-skips
+  # (rc 0) on images whose jax lacks the multiprocess CPU data plane.
+  say "2c/3 kfchaos smoke scenario"
+  python -m kungfu_tpu.chaos.runner --scenario smoke || fail=1
 fi
 
 say "3/3 dryrun_multichip(8)"
