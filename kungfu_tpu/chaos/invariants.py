@@ -11,7 +11,10 @@ Event kinds and fields (see the worker template in :mod:`.runner`):
 - ``commit``: the committed progress pair visible after the step —
               ``step, samples`` (``committed_*`` fields)
 - ``sync``:   after a recovery/resize restored state — ``step, samples,
-              wsum`` (wsum = squared-norm fingerprint of the params)
+              size, version`` (``wsum`` — the squared-norm fingerprint
+              of the params — is optional here: computing it is a
+              collective the worker cannot run mid-loop; checkers that
+              need it skip events without it)
 - ``final``:  once, at target — ``step, samples, wsum, size, version``
 - ``detached``: the worker was resized away
 
@@ -62,13 +65,16 @@ def check_no_fresh_start(events: Sequence[Event],
     progress is nonzero — the silent-loss failure mode of ADVICE.md-high
     (survivors re-broadcasting the initial params with their counters
     intact).  ``init_wsum`` is the fingerprint of the init params
-    (0.0 for the zero-init used by the scenario workers)."""
+    (0.0 for the zero-init used by the scenario workers).  Events that
+    carry no ``wsum`` say nothing about the params and are skipped —
+    defaulting a missing fingerprint to 0.0 would equal the zero-init
+    fingerprint and flag every healthy recovery."""
     bad = []
     for e in events:
-        if e.get("kind") not in ("sync", "final"):
+        if e.get("kind") not in ("sync", "final") or "wsum" not in e:
             continue
         if int(e.get("samples", 0)) > 0 and \
-                abs(float(e.get("wsum", 0.0)) - init_wsum) <= atol:
+                abs(float(e["wsum"]) - init_wsum) <= atol:
             bad.append(
                 f"{e.get('stream')}: {e['kind']} event has nonzero "
                 f"progress (samples={e['samples']}) but init params "
@@ -98,21 +104,41 @@ def check_single_winner(events: Sequence[Event]) -> List[str]:
     return bad
 
 
-def check_no_orphans(pids: Sequence[int]) -> List[str]:
+def _cmdline_has(pid: int, marker: str) -> bool:
+    """True when ``/proc/<pid>/cmdline`` contains ``marker``.  False on
+    any read failure (no /proc, process gone mid-read): when identity
+    cannot be confirmed, the pid is treated as not-ours."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            raw = f.read()
+    except OSError:
+        return False
+    return marker.encode() in raw
+
+
+def check_no_orphans(pids: Sequence[int],
+                     marker: Optional[str] = None) -> List[str]:
     """No worker process outlives the scenario (a wedged survivor would
     leak and poison later port reuse).  ``pids`` are every worker pid
-    the scenario observed."""
+    the scenario observed.  By checker time the OS may have recycled a
+    long-reaped pid onto an unrelated process, so when ``marker`` is
+    given (the runner passes the scenario's unique worker-script path)
+    a pid is only treated — and SIGKILLed — as a leaked worker if its
+    cmdline still carries it; anything else is left alone."""
     import os
     bad = []
     for pid in pids:
+        pid = int(pid)
         try:
-            os.kill(int(pid), 0)
+            os.kill(pid, 0)
         except (ProcessLookupError, PermissionError):
             continue
         # still signalable: alive (or a zombie we reaped nothing of)
+        if marker is not None and not _cmdline_has(pid, marker):
+            continue  # recycled pid: not our worker, do NOT kill it
         try:
             # don't leave it behind either way
-            os.kill(int(pid), 9)
+            os.kill(pid, 9)
         except OSError:
             pass
         bad.append(f"worker pid {pid} still alive after the scenario")
@@ -140,13 +166,14 @@ def check_trajectory(events: Sequence[Event], oracle_wsum,
 
 
 def run_all(events: Sequence[Event], pids: Sequence[int] = (),
-            oracle_wsum=None, init_wsum: float = 0.0) -> List[str]:
+            oracle_wsum=None, init_wsum: float = 0.0,
+            pid_marker: Optional[str] = None) -> List[str]:
     """Every checker, all violations collected."""
     bad = []
     bad += check_progress_monotonic(events)
     bad += check_no_fresh_start(events, init_wsum=init_wsum)
     bad += check_single_winner(events)
-    bad += check_no_orphans(pids)
+    bad += check_no_orphans(pids, marker=pid_marker)
     if oracle_wsum is not None:
         bad += check_trajectory(events, oracle_wsum)
     return bad

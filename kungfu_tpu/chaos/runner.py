@@ -229,7 +229,10 @@ class Scenario:
     target_steps: int = 18
     propose: Sequence[Tuple[int, int]] = ()   # [(after_step, new_size)]
     snapshot_every: int = 1
-    parent_port: int = 31976
+    # None = bind an OS-assigned free port at run time, so concurrent
+    # chaos runs (or a parallel pytest shard alongside `make
+    # chaos-smoke`) never collide on the parent port
+    parent_port: Optional[int] = None
     timeout_s: float = 300.0
 
 
@@ -244,8 +247,7 @@ def scenarios() -> Dict[str, Scenario]:
                  "count and the survivor must recover from the "
                  "previous one",
             plan=Plan(seed=None).add("elastic.commit.exchange", "kill",
-                                     rank=1, step=6),
-            parent_port=31976),
+                                     rank=1, step=6)),
         Scenario(
             name="kill-during-rebuild",
             desc="grow 2->3, then SIGKILL the fresh joiner inside the "
@@ -256,7 +258,6 @@ def scenarios() -> Dict[str, Scenario]:
                                      rank=2),
             propose=((4, 3),),
             target_steps=20,
-            parent_port=31977,
             timeout_s=420.0),
         Scenario(
             name="config-outage-mid-resize",
@@ -266,8 +267,7 @@ def scenarios() -> Dict[str, Scenario]:
             plan=Plan(seed=None).add("config.fetch", "drop-rpc",
                                      count=8),
             propose=((4, 1),),
-            target_steps=16,
-            parent_port=31978),
+            target_steps=16),
         Scenario(
             name="slow-peer-fence",
             desc="rank 1 stalls 0.3s at three consecutive step fences: "
@@ -276,8 +276,7 @@ def scenarios() -> Dict[str, Scenario]:
             plan=Plan(seed=None).add("elastic.step.fence", "delay",
                                      rank=1, step=[3, 4, 5], count=3,
                                      delay_s=0.3),
-            target_steps=12,
-            parent_port=31979),
+            target_steps=12),
         Scenario(
             name="double-resize",
             desc="two proposals land back-to-back (3->2 and ->3 in one "
@@ -287,13 +286,12 @@ def scenarios() -> Dict[str, Scenario]:
             nprocs=3,
             propose=((3, 2), (3, 3)),
             target_steps=20,
-            parent_port=31980,
             timeout_s=420.0),
     ]
     out = {s.name: s for s in m}
     out["smoke"] = dataclasses.replace(
         m[0], name="smoke", target_steps=12,
-        desc="tier-1 smoke: " + m[0].desc, parent_port=31981)
+        desc="tier-1 smoke: " + m[0].desc)
     return out
 
 
@@ -349,6 +347,17 @@ def _collect_fired(log_prefix: str) -> List[dict]:
     return sorted(fired, key=lambda e: json.dumps(e, sort_keys=True))
 
 
+def _free_port() -> int:
+    """An OS-assigned free TCP port (the socket-probe idiom of
+    :func:`_probe_data_plane`): bound, read, released.  The tiny reuse
+    race is far better than fixed per-scenario constants, which made
+    two concurrent chaos runs collide deterministically."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                  verbose: bool = True) -> ScenarioResult:
     """Execute one scenario end-to-end and check every invariant."""
@@ -390,6 +399,7 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
               flush=True)
     cluster = Cluster.from_hostlist(
         HostList.parse(f"127.0.0.1:{sc.nprocs}"), sc.nprocs)
+    parent_port = sc.parent_port if sc.parent_port else _free_port()
     srv = ConfigServer().start()
     try:
         with _scoped_env(env):
@@ -397,7 +407,7 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
             job = Job(prog=sys.executable, args=[script],
                       config_server=srv.url)
             rc = watch_run(job, "127.0.0.1",
-                           PeerID("127.0.0.1", sc.parent_port),
+                           PeerID("127.0.0.1", parent_port),
                            cluster, srv.url, poll_interval=0.2,
                            preempt_recover=True)
     finally:
@@ -412,7 +422,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
     violations += invariants.run_all(
         events, pids=pids,
         oracle_wsum=lambda samples: oracle_wsum(
-            sc.batch, samples // sc.batch))
+            sc.batch, samples // sc.batch),
+        # the scenario's tempdir-unique script path identifies OUR
+        # workers: a recycled pid must never be mistaken for an orphan
+        pid_marker=script)
     res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,
                          events=events, fired=_collect_fired(log_prefix),
                          out_dir=out_dir)
@@ -495,8 +508,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                              sites=["elastic.step.fence",
                                     "elastic.commit.exchange",
                                     "config.fetch"],
-                             actions=("exception", "delay", "drop-rpc")),
-            parent_port=31982))
+                             actions=("exception", "delay", "drop-rpc"))))
     if args.out:
         os.makedirs(args.out, exist_ok=True)
     ok = True

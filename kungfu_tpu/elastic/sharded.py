@@ -376,9 +376,20 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                 raise native.NativeError(
                     "sharded elastic: no holder carries the agreed "
                     f"commit {M}")
-            pick = picks[0]
-            samples, steps = int(pick[0]), int(pick[1])
-            old_ndev, old_nproc = int(pick[2]), int(pick[3])
+            # every holder must describe M identically (the recorded
+            # rank differs per holder; samples/steps/layout must not).
+            # A force-commit interrupted mid-record can leave survivors
+            # with SAME-seq records of DIFFERENT layouts — trusting one
+            # of them would pull blocks with the wrong size/offsets, so
+            # refuse loudly instead.
+            metas = {tuple(int(x) for x in pk[:4]) for pk in picks}
+            if len(metas) != 1:
+                raise native.NativeError(
+                    f"sharded elastic: holders disagree on commit {M}'s "
+                    f"(samples, steps, ndev, nproc): {sorted(metas)}; "
+                    "an interrupted commit left mixed-layout records — "
+                    "refusing to re-shard from inconsistent history")
+            samples, steps, old_ndev, old_nproc = metas.pop()
         # --- availability + source assignment ----------------------------
         _, old_chunk, old_block = _layout(self._vec_size, old_ndev,
                                           old_nproc)

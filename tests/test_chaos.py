@@ -265,6 +265,11 @@ def test_no_fresh_start_checker():
     # zero params with zero progress is a legitimate fresh start
     assert invariants.check_no_fresh_start(
         [_ev("sync", samples=0, step=0, wsum=0.0)]) == []
+    # an event with NO fingerprint says nothing about the params: the
+    # worker's sync emit carries none (a missing wsum must not default
+    # to the init fingerprint and flag every healthy recovery)
+    assert invariants.check_no_fresh_start(
+        [_ev("sync", samples=32, step=4, size=2, version=1)]) == []
 
 
 def test_single_winner_checker():
@@ -309,6 +314,29 @@ def test_no_orphans_checker():
     assert leaked.returncode == -9
 
 
+@pytest.mark.skipif(not os.path.exists("/proc"),
+                    reason="identity check reads /proc/<pid>/cmdline")
+def test_no_orphans_checker_spares_recycled_pids():
+    """With a marker, a signalable pid whose cmdline is NOT our worker
+    (the OS recycled it onto an innocent process) is left alone; a
+    matching one is still reported and killed."""
+    bystander = subprocess.Popen([sys.executable, "-c",
+                                  "import time; time.sleep(600)"])
+    try:
+        assert invariants.check_no_orphans(
+            [bystander.pid], marker="kfchaos-no-such-worker.py") == []
+        assert bystander.poll() is None   # untouched
+        out = invariants.check_no_orphans([bystander.pid],
+                                          marker="time.sleep(600)")
+        assert len(out) == 1 and "still alive" in out[0]
+        bystander.wait(timeout=30)        # the checker killed it
+        assert bystander.returncode == -9
+    finally:
+        if bystander.poll() is None:
+            bystander.kill()
+            bystander.wait()
+
+
 def test_run_all_aggregates():
     events = [_ev("commit", samples=16, step=2),
               _ev("commit", samples=8, step=1),       # regression
@@ -323,8 +351,10 @@ def test_run_all_aggregates():
 def test_scenario_matrix_well_formed():
     m = runner.scenarios()
     assert "smoke" in m
-    ports = [sc.parent_port for sc in m.values()]
-    assert len(set(ports)) == len(ports), "parent ports must not collide"
+    # no fixed parent ports: each run binds an OS-assigned one, so two
+    # concurrent chaos runs (or a pytest shard alongside `make
+    # chaos-smoke`) cannot collide
+    assert all(sc.parent_port is None for sc in m.values())
     for sc in m.values():
         chaos.arm(sc.plan)            # validates every site name
         chaos.disarm()
