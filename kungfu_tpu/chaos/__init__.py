@@ -82,6 +82,13 @@ class ArmedPlan:
         ev = {"site": name, "action": action, "rank": rank, "step": step,
               "version": version}
         self.fired.append(ev)
+        # mirror into the kftrace stream (no-op unless kftrace is armed):
+        # injected faults land on the same timeline as the resize spans
+        # they perturb, so a chaos scenario's trace shows cause + effect
+        from ..trace import event as _trace_event
+        _trace_event(f"chaos.{name}", category="chaos", rank=rank,
+                     step=step, version=version,
+                     attrs={"action": action})
         if self.log_path:
             # open-write-close per event: crash-safe by construction (the
             # very next thing may be SIGKILL)

@@ -303,6 +303,10 @@ class ScenarioResult:
     events: List[dict]
     fired: List[dict]        # aggregated chaos journals, sorted
     out_dir: str
+    # per-rank kftrace JSONL streams + crash dumps left in out_dir —
+    # every kfchaos failure ships its own timeline (merge them with
+    # `python tools/kftrace_merge.py <out_dir>`)
+    trace_files: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -379,6 +383,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         "KFT_CHAOS_PLAN": plan_path,
         "KFT_CHAOS_LOG": log_prefix,
         "KFT_CHAOS_OUT": out_dir,
+        # workers arm kftrace at import: per-rank JSONL streams (and
+        # crash dumps for faulted workers) land in out_dir as scenario
+        # artifacts next to the event/journal files
+        "KFT_TRACE_DIR": out_dir,
         "KFT_CHAOS_B": str(sc.batch),
         "KFT_CHAOS_TARGET": str(sc.target_steps * sc.batch),
         "KFT_CHAOS_PROPOSE": json.dumps([list(p) for p in sc.propose]),
@@ -426,14 +434,17 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         # the scenario's tempdir-unique script path identifies OUR
         # workers: a recycled pid must never be mistaken for an orphan
         pid_marker=script)
+    trace_files = sorted(glob.glob(os.path.join(out_dir,
+                                                "kftrace*.jsonl")))
     res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,
                          events=events, fired=_collect_fired(log_prefix),
-                         out_dir=out_dir)
+                         out_dir=out_dir, trace_files=trace_files)
     if verbose:
         status = "PASS" if res.ok else "FAIL"
         print(f"kfchaos: scenario {sc.name}: {status} "
               f"({len(res.fired)} fault(s) fired, "
-              f"{len(events)} events)", flush=True)
+              f"{len(events)} events, "
+              f"{len(trace_files)} trace stream(s))", flush=True)
         for v in violations:
             print(f"kfchaos:   violation: {v}", flush=True)
     return res

@@ -27,6 +27,7 @@ from ..plan.topology import (GraphPair, Strategy, auto_select,
                              generate)
 from . import collectives as C
 from .mesh import PEER_AXIS, flat_mesh
+from ..trace import event as _trace_event
 from ..utils.trace import trace_scope
 
 
@@ -240,10 +241,20 @@ class Session:
 
     def record(self, name: str, nbytes: int, seconds: float) -> None:
         """Feed one sample into the named throughput stat — used by the
-        eager collectives and by monitor.StepMonitor around jitted steps."""
+        eager collectives and by monitor.StepMonitor around jitted steps.
+
+        Each sample is mirrored into the kftrace stream (per-name
+        collective spans on the cluster timeline; one predicate when
+        disarmed) and into the monitor's per-name latency summary, which
+        /metrics renders as a Prometheus summary."""
         with self._lock:
             stat = self._stats.setdefault(name, StrategyStat())
             stat.update(nbytes, seconds)
+        _trace_event(name, category="collective", version=self.version,
+                     dur=seconds, attrs={"nbytes": nbytes})
+        from ..monitor import get_monitor  # deferred: monitor is optional
+        get_monitor().observe("kungfu_tpu_collective_seconds", seconds,
+                              labels={"name": name})
 
     def wire_algorithm(self) -> str:
         """The on-wire cost family of the current strategy (for

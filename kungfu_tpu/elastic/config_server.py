@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler
 from typing import Optional, Tuple
 
 from ..plan.cluster import Cluster
+from ..trace import span as _trace_span
 from ..utils.http import BackgroundHTTPServer
 
 
@@ -46,6 +47,14 @@ def _make_handler(state: _State, server_ref):
                 self.wfile.write(body)
 
         def do_GET(self):
+            # request handling is a kftrace span (category "config"):
+            # control-plane latency shows up on the cluster timeline
+            # next to the resize phases it gates
+            with _trace_span("config.request", category="config",
+                             attrs={"method": "GET", "path": self.path}):
+                self._get()
+
+        def _get(self):
             if self.path.startswith("/stop"):
                 self._send(200, b'{"ok": true}')
                 server_ref.shutdown_async()
@@ -70,6 +79,11 @@ def _make_handler(state: _State, server_ref):
             return self.rfile.read(n)
 
         def do_PUT(self):
+            with _trace_span("config.request", category="config",
+                             attrs={"method": "PUT", "path": self.path}):
+                self._put()
+
+        def _put(self):
             raw = self._read_body()
             try:
                 c = Cluster.from_json(raw.decode())
@@ -101,9 +115,12 @@ def _make_handler(state: _State, server_ref):
         do_POST = do_PUT
 
         def do_DELETE(self):
-            with state.lock:
-                state.cluster = None
-            self._send(200, b'{"ok": true}')
+            with _trace_span("config.request", category="config",
+                             attrs={"method": "DELETE",
+                                    "path": self.path}):
+                with state.lock:
+                    state.cluster = None
+                self._send(200, b'{"ok": true}')
 
     return Handler
 

@@ -32,6 +32,7 @@ from .. import distributed as D
 from .. import native
 from ..chaos import point as _chaos_point
 from ..launcher import env as E
+from ..trace import event as _trace_event, span as _trace_span
 from . import state as _flags
 from .config_server import fetch_config
 
@@ -141,6 +142,12 @@ class DistributedElasticTrainer:
         always consistent."""
         _chaos_point("elastic.sync_state.begin", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
+        with _trace_span("elastic.sync_state", category="elastic",
+                         rank=self.peer.rank, step=self.step_count,
+                         version=self.version):
+            self._sync_state_inner()
+
+    def _sync_state_inner(self) -> None:
         self._host_params = D.broadcast_host_tree(
             self._host_params, self.peer, root=0,
             name=f"params@{self.version}")
@@ -205,17 +212,22 @@ class DistributedElasticTrainer:
     def _rebuild_at(self, peer) -> None:
         _chaos_point("elastic.rebuild.begin", rank=peer.rank,
                      step=self.step_count, version=peer.token)
-        self.peer = peer
-        self.version = peer.token
-        self._last_seen_version = max(self._last_seen_version, self.version)
-        # fence rounds restart at every membership version: a freshly
-        # joined worker counts from 0, so survivors must too (collective
-        # names must match across the new membership)
-        self._round = 0
-        D.reinit(peer.peers, peer.rank, peer.token,
-                 local_device_ids=self.we.chip_ids)
-        self._sync_state()
-        self._build()
+        with _trace_span("elastic.rebuild", category="elastic",
+                         rank=peer.rank, step=self.step_count,
+                         version=peer.token,
+                         attrs={"size": peer.size}):
+            self.peer = peer
+            self.version = peer.token
+            self._last_seen_version = max(self._last_seen_version,
+                                          self.version)
+            # fence rounds restart at every membership version: a freshly
+            # joined worker counts from 0, so survivors must too
+            # (collective names must match across the new membership)
+            self._round = 0
+            D.reinit(peer.peers, peer.rank, peer.token,
+                     local_device_ids=self.we.chip_ids)
+            self._sync_state()
+            self._build()
 
     def _teardown_plane_ordered(self) -> None:
         """Take the LIVE data plane down while the old membership is
@@ -230,6 +242,12 @@ class DistributedElasticTrainer:
         _chaos_point("elastic.teardown.begin",
                      rank=None if p is None else p.rank,
                      step=self.step_count, version=self.version)
+        with _trace_span("elastic.teardown", category="elastic",
+                         rank=None if p is None else p.rank,
+                         step=self.step_count, version=self.version):
+            self._teardown_inner(p)
+
+    def _teardown_inner(self, p) -> None:
         try:
             if p is not None and p.size > 1:
                 p.barrier(name=f"plane-down@{self.version}")
@@ -252,9 +270,14 @@ class DistributedElasticTrainer:
         import jax
         _chaos_point("elastic.commit.begin", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
-        self._host_params = jax.tree_util.tree_map(np.asarray, self._params)
-        self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
-        self._committed_progress = (self.trained_samples, self.step_count)
+        with _trace_span("elastic.commit", category="elastic",
+                         rank=self.peer.rank, step=self.step_count,
+                         version=self.version):
+            self._host_params = jax.tree_util.tree_map(np.asarray,
+                                                       self._params)
+            self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
+            self._committed_progress = (self.trained_samples,
+                                        self.step_count)
 
     def _pre_teardown(self) -> None:
         """Hook between the pre-resize commit and the plane teardown,
@@ -266,18 +289,31 @@ class DistributedElasticTrainer:
         """Apply a pending config change; False when detached."""
         _chaos_point("elastic.resize.begin", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
-        # everyone is at the same fence: commit the live device state so
-        # a voluntary resize never discards steps since the last snapshot
-        self._commit()
-        self._pre_teardown()
-        # the old plane comes down FIRST, with everyone still alive —
-        # after resize_from_url the old host membership no longer exists
-        # to sequence the teardown
-        self._teardown_plane_ordered()
-        changed, detach = native.resize_from_url()
-        if detach:
-            return False
-        self._rebuild_at(native.installed_peer())
+        import time as _time
+        _t0 = _time.perf_counter()
+        with _trace_span("elastic.resize", category="elastic",
+                         rank=self.peer.rank, step=self.step_count,
+                         version=self.version) as _sp:
+            # everyone is at the same fence: commit the live device state
+            # so a voluntary resize never discards steps since the last
+            # snapshot
+            self._commit()
+            self._pre_teardown()
+            # the old plane comes down FIRST, with everyone still alive —
+            # after resize_from_url the old host membership no longer
+            # exists to sequence the teardown
+            self._teardown_plane_ordered()
+            changed, detach = native.resize_from_url()
+            if detach:
+                _trace_event("elastic.detach", category="elastic",
+                             step=self.step_count, version=self.version)
+                return False
+            self._rebuild_at(native.installed_peer())
+            if _sp is not None:
+                _sp.set(new_size=self.peer.size)
+        from ..monitor import get_monitor
+        get_monitor().observe("kungfu_tpu_resize_seconds",
+                              _time.perf_counter() - _t0)
         return True
 
     def _recover(self, batch, cause=None) -> Optional[float]:
@@ -285,6 +321,9 @@ class DistributedElasticTrainer:
         shrink over the host plane, rebuild, and REDO the interrupted
         step(s) from the last committed snapshot."""
         D.shutdown()
+        _trace_event("elastic.recover.begin", category="elastic",
+                     step=self.step_count, version=self.version,
+                     attrs={"cause": type(cause).__name__ if cause else None})
         try:
             peer = native.recover_from_failure(timeout=self.recover_timeout)
         except native.NativeError as e:

@@ -50,6 +50,7 @@ from .. import distributed as D
 from .. import native
 from ..chaos import point as _chaos_point
 from ..parallel.fsdp import FSDP_AXIS, make_fsdp_step
+from ..trace import span as _trace_span
 from ..plan.cluster import Cluster
 from .config_server import fetch_config
 from .multiproc import DistributedElasticTrainer
@@ -187,6 +188,11 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         p = self.peer
         _chaos_point("elastic.commit.begin", rank=p.rank, step=seq,
                      version=self.version)
+        with _trace_span("elastic.commit", category="elastic",
+                         rank=p.rank, step=seq, version=self.version):
+            self._commit_inner(p, seq)
+
+    def _commit_inner(self, p, seq: int) -> None:
         ndev = self.num_devices()
         nproc = p.size
         blocks: Dict[str, np.ndarray] = {}
@@ -238,6 +244,12 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             return
         _chaos_point("elastic.pre_teardown.begin", rank=p.rank,
                      step=self.step_count, version=self.version)
+        with _trace_span("elastic.pre_teardown", category="elastic",
+                         rank=p.rank, step=self.step_count,
+                         version=self.version):
+            self._pre_teardown_inner(p)
+
+    def _pre_teardown_inner(self, p) -> None:
         # the handoff is a COLLECTIVE, so every member must act on ONE
         # membership delta: rank 0 fetches the target cluster and
         # broadcasts it over the host plane.  Per-member fetches could
@@ -303,6 +315,12 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         _chaos_point("elastic.sync_state.begin",
                      rank=None if p is None else p.rank,
                      step=self.step_count, version=self.version)
+        with _trace_span("elastic.sync_state", category="elastic",
+                         rank=None if p is None else p.rank,
+                         step=self.step_count, version=self.version):
+            self._sync_resharded(p, nproc)
+
+    def _sync_resharded(self, p, nproc: int) -> None:
         newest = max(self._held_meta) if self._held_meta else _NO_SEQ
         prev = (max((s for s in self._held_meta if s != newest),
                     default=_NO_SEQ))

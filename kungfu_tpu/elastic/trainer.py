@@ -144,6 +144,7 @@ class ElasticTrainer:
         session rebuild → state re-sync (survivor replicas kept, newcomer
         lanes cloned from lane 0) → progress sync.
         """
+        from ..trace import event as _trace_event, span as _trace_span
         from ..utils.trace import log_event
         if new_size == self.n:
             return False
@@ -151,6 +152,8 @@ class ElasticTrainer:
             raise ValueError(f"size {new_size} exceeds capacity {self.max_size}")
         if new_size <= 0:
             log_event(f"resize-detach:{self.n}->0")
+            _trace_event("elastic.detach", category="elastic",
+                         step=self.step_count, version=self.version)
             _flags.set_detached(True)
             return True
         # consensus fence on the proposal (trivially true single-controller,
@@ -162,23 +165,29 @@ class ElasticTrainer:
         log_event(f"resize-begin:{self.n}->{new_size}")
         t0 = time.perf_counter()
         self.last_resize_compiled = new_size not in self._step_cache
-        self._host_params = jax.tree_util.tree_map(
-            lambda t: np.asarray(t), self.params)
-        if self.has_model_state:
-            self._host_mstate = jax.tree_util.tree_map(
-                lambda t: np.asarray(t), self.model_state)
-        host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
-                                          self.opt_state)
-        self.version += 1
-        _flags.bump_cluster_version()
-        self._install(new_size, fresh_opt=False)
-        self.opt_state = _restack(host_opt, new_size, self.mesh)
-        self.session.barrier()
+        with _trace_span("elastic.resize", category="elastic",
+                         step=self.step_count, version=self.version,
+                         attrs={"from": self.n, "to": new_size}):
+            self._host_params = jax.tree_util.tree_map(
+                lambda t: np.asarray(t), self.params)
+            if self.has_model_state:
+                self._host_mstate = jax.tree_util.tree_map(
+                    lambda t: np.asarray(t), self.model_state)
+            host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
+                                              self.opt_state)
+            self.version += 1
+            _flags.bump_cluster_version()
+            self._install(new_size, fresh_opt=False)
+            self.opt_state = _restack(host_opt, new_size, self.mesh)
+            self.session.barrier()
         # NOTE: jit compilation is lazy — the FIRST step at the new size
         # pays the (possibly cached) compile; measure resize cost as
         # last_resize_seconds + (first-step - steady-step) latency, as
         # benchmarks/resize_cost.py does
         self.last_resize_seconds = time.perf_counter() - t0
+        from ..monitor import get_monitor
+        get_monitor().observe("kungfu_tpu_resize_seconds",
+                              self.last_resize_seconds)
         log_event(f"resize-end:{new_size}")
         log_event(f"resize-cost:{self.last_resize_seconds:.3f}s"
                   f"{':new-step-fn' if self.last_resize_compiled else ''}")

@@ -200,15 +200,29 @@ def propose_exclusion(config_url: str, dead: set, retries: int = 8
 
 def _start_debug_server(w: "Watcher", port: int):
     """HTTP endpoint dumping the runner's applied Stage history + live
-    worker state (reference: runner -debug-port, handler.go:117-122)."""
+    worker state (reference: runner -debug-port, handler.go:117-122),
+    plus ``/cluster_metrics`` — every live worker's /metrics endpoint
+    scraped and merged with per-worker instance labels
+    (kungfu_tpu.monitor.cluster; docs/monitoring.md)."""
     import json as _json
     from http.server import BaseHTTPRequestHandler
 
+    from ..monitor import cluster as _cluster
     from ..utils.http import BackgroundHTTPServer
 
     def factory(_srv):
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                if self.path.startswith("/cluster_metrics"):
+                    with w._lock:
+                        targets = [(p.host, p.port) for p in w.current]
+                    body = _cluster.aggregate(targets).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 with w._lock:
                     body = _json.dumps({
                         "host": w.host,

@@ -1,4 +1,5 @@
-"""Online monitoring: byte counters, rates, and a /metrics endpoint.
+"""Online monitoring: byte counters, rates, histograms, and a /metrics
+endpoint.
 
 Reference: srcs/go/monitor/ — per-peer egress/ingress byte counters with
 rates over a period, served as plaintext Prometheus-style /metrics on
@@ -10,17 +11,70 @@ collective the session records payload sizes; for compiled steps the
 per-step collective volume is estimated from the gradient byte count and
 the algorithm's cost model (ring allreduce moves 2(n-1)/n × bytes over
 ICI).  Rates come from a monotonic-clock window.
+
+Beyond the reference's counters this module adds Prometheus summaries
+(:class:`Summary` — step time, resize duration, collective latency) and
+gauges sourced from the monitoring optimizers (gradient noise scale /
+variance, :func:`publish_optimizer_gauges`); the launcher aggregates
+every live worker's endpoint into ``/cluster_metrics``
+(:mod:`kungfu_tpu.monitor.cluster`).  See docs/monitoring.md.
 """
 from __future__ import annotations
 
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.http import BackgroundHTTPServer
 
 MONITOR_PORT_OFFSET = 10000  # reference: monitor starts at worker port+10000
+
+# metric families with committed HELP text (anything else renders with a
+# generic line); real Prometheus scrapers need the # TYPE to ingest
+_HELP = {
+    "kungfu_tpu_egress_bytes_total":
+        "Cumulative collective payload bytes sent, per target.",
+    "kungfu_tpu_ingress_bytes_total":
+        "Cumulative collective payload bytes received, per target.",
+    "kungfu_tpu_step_seconds":
+        "Training step wall time (StepMonitor).",
+    "kungfu_tpu_resize_seconds":
+        "Elastic resize duration (teardown through rebuild).",
+    "kungfu_tpu_collective_seconds":
+        "Eager collective latency, per operation name.",
+    "kungfu_tpu_grad_noise_scale":
+        "Simple gradient noise scale from the monitoring optimizer.",
+    "kungfu_tpu_grad_variance":
+        "Cross-peer gradient variance from the monitoring optimizer.",
+    "kungfu_tpu_provider_errors_total":
+        "Metric provider callables that raised during a scrape.",
+}
+
+
+def _esc(value) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_str(labels: Optional[Dict[str, str]],
+                extra: Optional[Dict[str, str]] = None) -> str:
+    merged: Dict[str, str] = dict(labels or ())
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _meta_lines(name: str, mtype: str, seen: set) -> List[str]:
+    if name in seen:
+        return []
+    seen.add(name)
+    help_text = _HELP.get(name, f"{name} (kungfu_tpu metric).")
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {mtype}"]
 
 
 def allreduce_bytes_on_wire(payload_bytes: int, n: int,
@@ -46,6 +100,7 @@ class RateCounter:
         self._window_start = time.monotonic()
         self._window_bytes = 0
         self._last_rate = 0.0
+        self._rolled = False  # becomes True once the first window closed
 
     def add(self, n: int) -> None:
         with self._lock:
@@ -63,25 +118,100 @@ class RateCounter:
         once it is at least ``period`` old, so a /metrics scrape and the
         adaptation loop polling together both see the full rate
         (reference: monitor.go computes rates on a fixed-period ticker).
+
+        Before the FIRST window has rolled there is no ``_last_rate``
+        yet, but traffic may well have flowed — a scrape right after
+        startup must not report 0.0, so the not-yet-rolled first window
+        reports its partial ``window_bytes/dt`` instead.
         """
         with self._lock:
             now = time.monotonic()
             dt = now - self._window_start
             if dt < period:
+                if not self._rolled and dt > 0.0:
+                    return self._window_bytes / dt
                 return self._last_rate
-            self._last_rate = self._window_bytes / dt
+            self._last_rate = self._window_bytes / dt if dt > 0 else 0.0
             self._window_bytes = 0
             self._window_start = now
+            self._rolled = True
             return self._last_rate
 
 
+class Summary:
+    """Prometheus summary: count, sum, and quantiles over a sliding
+    sample window (step time, resize duration, collective latency —
+    the reference renders peer latencies similarly, monitor.go).
+
+    Quantiles are computed over the most recent ``window`` samples —
+    exact over that window, O(window log window) per render, bounded
+    memory; fine for control-plane cardinalities (a scrape every few
+    seconds, hundreds of samples)."""
+
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, window: int = 512):
+        import collections
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._recent = collections.deque(maxlen=max(1, int(window)))
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._recent.append(v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            data = sorted(self._recent)
+        if not data:
+            return float("nan")
+        idx = min(len(data) - 1, max(0, int(round(q * (len(data) - 1)))))
+        return data[idx]
+
+    def render(self, name: str,
+               labels: Optional[Dict[str, str]] = None) -> List[str]:
+        with self._lock:
+            data = sorted(self._recent)
+            count, total = self._count, self._sum
+        lines = []
+        for q in self.QUANTILES:
+            if data:
+                idx = min(len(data) - 1,
+                          max(0, int(round(q * (len(data) - 1)))))
+                val = data[idx]
+                lines.append(f"{name}{_labels_str(labels, {'quantile': q})}"
+                             f" {val:.9g}")
+        lines.append(f"{name}_sum{_labels_str(labels)} {total:.9g}")
+        lines.append(f"{name}_count{_labels_str(labels)} {count}")
+        return lines
+
+
 class Monitor:
-    """Per-target egress/ingress accounting (targets = peers or mesh axes)."""
+    """Per-target egress/ingress accounting (targets = peers or mesh
+    axes), plus summaries and gauges for the cluster metrics plane."""
 
     def __init__(self) -> None:
         self._egress: Dict[str, RateCounter] = {}
         self._ingress: Dict[str, RateCounter] = {}
         self._providers = []  # extra metric-line sources (native counters)
+        self._provider_errors = 0
+        # (metric, sorted-labels-tuple) -> Summary / float
+        self._summaries: Dict[tuple, Summary] = {}
+        self._gauges: Dict[tuple, float] = {}
         self._lock = threading.Lock()
 
     def add_provider(self, fn) -> None:
@@ -111,24 +241,114 @@ class Monitor:
             keys = list(self._egress)
         return {k: self._egress[k].rate() for k in keys}
 
+    # ------------------------------------------------- summaries / gauges
+    @staticmethod
+    def _key(metric: str, labels: Optional[Dict[str, str]]) -> tuple:
+        return (metric, tuple(sorted((labels or {}).items())))
+
+    def observe(self, metric: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        """Feed one sample into a summary (created on first use)."""
+        key = self._key(metric, labels)
+        with self._lock:
+            s = self._summaries.get(key)
+            if s is None:
+                s = self._summaries[key] = Summary()
+        s.observe(value)
+
+    def summary(self, metric: str,
+                labels: Optional[Dict[str, str]] = None
+                ) -> Optional[Summary]:
+        with self._lock:
+            return self._summaries.get(self._key(metric, labels))
+
+    def set_gauge(self, metric: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[self._key(metric, labels)] = float(value)
+
+    # ---------------------------------------------------------- rendering
     def render_metrics(self) -> str:
-        """Prometheus-style plaintext (reference: monitor.go /metrics)."""
-        lines = []
+        """Prometheus-style plaintext (reference: monitor.go /metrics),
+        with ``# HELP``/``# TYPE`` metadata and escaped label values so
+        real Prometheus scrapers ingest it cleanly."""
+        lines: List[str] = []
+        seen: set = set()
         with self._lock:
             eg = dict(self._egress)
             ig = dict(self._ingress)
+            gauges = dict(self._gauges)
+            summaries = dict(self._summaries)
+        if eg:
+            lines += _meta_lines("kungfu_tpu_egress_bytes_total",
+                                 "counter", seen)
         for k, c in sorted(eg.items()):
-            lines.append(f'kungfu_tpu_egress_bytes_total{{target="{k}"}} {c.total()}')
+            lines.append(f'kungfu_tpu_egress_bytes_total'
+                         f'{{target="{_esc(k)}"}} {c.total()}')
+        if ig:
+            lines += _meta_lines("kungfu_tpu_ingress_bytes_total",
+                                 "counter", seen)
         for k, c in sorted(ig.items()):
-            lines.append(f'kungfu_tpu_ingress_bytes_total{{target="{k}"}} {c.total()}')
+            lines.append(f'kungfu_tpu_ingress_bytes_total'
+                         f'{{target="{_esc(k)}"}} {c.total()}')
+        for (metric, labels), val in sorted(gauges.items()):
+            lines += _meta_lines(metric, "gauge", seen)
+            lines.append(f"{metric}{_labels_str(dict(labels))} {val:.9g}")
+        for (metric, labels), s in sorted(summaries.items()):
+            lines += _meta_lines(metric, "summary", seen)
+            lines.extend(s.render(metric, dict(labels)))
         with self._lock:
             providers = list(self._providers)
         for fn in providers:
             try:
                 lines.extend(fn())
-            except Exception:  # a dead provider must not break /metrics
-                pass
+            except Exception as e:  # a dead provider must not break /metrics
+                with self._lock:
+                    self._provider_errors += 1
+                lines.append(f"# provider error: {type(e).__name__}")
+        with self._lock:
+            errs = self._provider_errors
+        if errs:
+            lines += _meta_lines("kungfu_tpu_provider_errors_total",
+                                 "counter", seen)
+            lines.append(f"kungfu_tpu_provider_errors_total {errs}")
         return "\n".join(lines) + "\n"
+
+
+def _walk_monitor_states(obj, out: Dict[str, float]) -> None:
+    """Recursively find monitoring-optimizer states in an opt-state
+    pytree.  NamedTuple states are tuples, so plain tuple recursion
+    reaches them at any nesting depth (chained transforms)."""
+    import numpy as np
+    ns = getattr(obj, "noise_scale", None)
+    if ns is not None:
+        out["kungfu_tpu_grad_noise_scale"] = float(
+            np.asarray(ns).reshape(-1)[0])
+    var = getattr(obj, "variance", None)
+    if var is not None:
+        out["kungfu_tpu_grad_variance"] = float(
+            np.asarray(var).reshape(-1)[0])
+    if isinstance(obj, (tuple, list)):
+        for item in obj:
+            _walk_monitor_states(item, out)
+    elif isinstance(obj, dict):
+        for item in obj.values():
+            _walk_monitor_states(item, out)
+
+
+def publish_optimizer_gauges(opt_state,
+                             monitor: Optional[Monitor] = None
+                             ) -> Dict[str, float]:
+    """Export the monitoring optimizers' running statistics (gradient
+    noise scale, cross-peer gradient variance — optimizers/monitors.py)
+    as /metrics gauges.  Call between steps; returns what was found
+    (empty when the optimizer chain carries no monitor state)."""
+    found: Dict[str, float] = {}
+    _walk_monitor_states(opt_state, found)
+    mon = monitor if monitor is not None else get_monitor()
+    for name, value in found.items():
+        mon.set_gauge(name, value)
+    return found
 
 
 class StepMonitor:
@@ -173,9 +393,11 @@ class StepMonitor:
         if exc[0] is None:
             dt = time.perf_counter() - self._t0
             self._session.record(self._name, self.nbytes, dt)
-            get_monitor().egress(allreduce_bytes_on_wire(
+            mon = get_monitor()
+            mon.egress(allreduce_bytes_on_wire(
                 self.nbytes, self._session.size,
                 self._session.wire_algorithm()))
+            mon.observe("kungfu_tpu_step_seconds", dt)
         return False
 
 

@@ -1,0 +1,112 @@
+"""Cluster metrics aggregation: one endpoint for the whole job.
+
+Reference: each peer serves its own /metrics (monitor.go:58-104) and the
+operator scrapes N endpoints.  Here the LAUNCHER's watcher — which
+already knows the live local membership — scrapes every worker's
+``/metrics`` (worker port + :data:`~kungfu_tpu.monitor.MONITOR_PORT_OFFSET`)
+and serves the merged view at ``/cluster_metrics`` on its debug port
+(launcher/watch.py), so one curl shows the cluster: per-worker egress
+counters, step-time and resize-duration summaries, monitoring-optimizer
+gauges.
+
+Merging is label-based, the standard Prometheus federation shape: every
+sample line gains an ``instance="host:port"`` label identifying its
+worker (port = the WORKER's port, not the metrics port — it matches the
+peer list operators already know), ``# HELP``/``# TYPE`` metadata is
+deduplicated across workers, and per-target ``kungfu_tpu_worker_up``
+gauges record scrape health so a wedged worker is visible rather than
+silently absent.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from . import MONITOR_PORT_OFFSET, _esc
+
+__all__ = ["scrape", "merge_metrics", "aggregate", "MONITOR_PORT_OFFSET"]
+
+# `name{labels} value` | `name value` (+ optional timestamp); group 1 =
+# metric name, 2 = existing label body (no braces), 3 = rest
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?( .*)$")
+
+
+def scrape(host: str, port: int, timeout: float = 2.0) -> str:
+    """GET one worker's /metrics (metrics port, i.e. worker port +
+    MONITOR_PORT_OFFSET already applied by the caller)."""
+    import urllib.request
+    url = f"http://{host}:{port}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _relabel(text: str, instance: str, meta_seen: set) -> List[str]:
+    """Inject ``instance`` into every sample line; pass metadata through
+    once per metric family across the whole merge."""
+    out: List[str] = []
+    for line in text.splitlines():
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            # dedupe "# HELP name ..." / "# TYPE name ..." on (kind, name)
+            parts = line.split(None, 3)
+            key = tuple(parts[:3])
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                if key in meta_seen:
+                    continue
+                meta_seen.add(key)
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue  # torn line from a worker mid-write: drop, not fatal
+        name, labels, rest = m.group(1), m.group(2), m.group(3)
+        inst = f'instance="{_esc(instance)}"'
+        body = f"{inst},{labels}" if labels else inst
+        out.append(f"{name}{{{body}}}{rest}")
+    return out
+
+
+def merge_metrics(per_worker: Iterable[Tuple[str, str]]) -> str:
+    """Merge ``(instance, metrics_text)`` pairs into one exposition."""
+    meta_seen: set = set()
+    lines: List[str] = []
+    for instance, text in per_worker:
+        lines.extend(_relabel(text, instance, meta_seen))
+    return "\n".join(lines) + "\n"
+
+
+def aggregate(targets: Iterable[Tuple[str, int]],
+              timeout: float = 2.0) -> str:
+    """Scrape every ``(host, worker_port)`` target's metrics endpoint
+    and merge.  Unreachable workers contribute ``kungfu_tpu_worker_up 0``
+    instead of failing the whole aggregation — /cluster_metrics must
+    stay useful exactly when part of the cluster is sick."""
+    scraped: List[Tuple[str, str]] = []
+    ups: List[Tuple[str, int]] = []
+    for host, port in targets:
+        instance = f"{host}:{port}"
+        try:
+            scraped.append(
+                (instance, scrape(host, port + MONITOR_PORT_OFFSET,
+                                  timeout=timeout)))
+            ups.append((instance, 1))
+        except (OSError, ValueError) as e:
+            ups.append((instance, 0))
+            scraped.append(
+                (instance, f"# scrape failed: {type(e).__name__}\n"))
+    body = merge_metrics(scraped)
+    up_lines = ["# HELP kungfu_tpu_worker_up 1 when the worker's "
+                "/metrics endpoint answered the aggregation scrape.",
+                "# TYPE kungfu_tpu_worker_up gauge"]
+    for instance, up in ups:
+        up_lines.append(
+            f'kungfu_tpu_worker_up{{instance="{_esc(instance)}"}} {up}')
+    workers = len(ups)
+    up_lines.append("# HELP kungfu_tpu_cluster_workers workers known to "
+                    "this launcher at aggregation time.")
+    up_lines.append("# TYPE kungfu_tpu_cluster_workers gauge")
+    up_lines.append(f"kungfu_tpu_cluster_workers {workers}")
+    return body + "\n".join(up_lines) + "\n"

@@ -195,15 +195,7 @@ class NativePeer:
         if pending:
             _cf.wait(pending, timeout=30.0)
         self._pending.clear()
-        if self._metrics_provider is not None:
-            # unregister BEFORE freeing the handle: a late /metrics render
-            # must never call into a dead native peer
-            from .. import monitor as M
-            M.get_monitor().remove_provider(self._metrics_provider)
-            self._metrics_provider = None
-        if self._metrics_server is not None:
-            self._metrics_server.stop()
-            self._metrics_server = None
+        _stop_metrics(self)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -749,3 +741,18 @@ def _maybe_start_metrics(p: NativePeer, worker_port: int) -> None:
         return
     p._metrics_provider = native_lines
     M.get_monitor().add_provider(native_lines)
+
+
+def _stop_metrics(p) -> None:
+    """Tear down what :func:`_maybe_start_metrics` installed: unregister
+    the provider BEFORE the handle dies (a late /metrics render must
+    never call into a dead native peer), then stop the endpoint.
+    Factored out of ``NativePeer.close`` so the provider lifecycle is
+    testable without a native rendezvous (tests/test_store_monitor.py)."""
+    if p._metrics_provider is not None:
+        from .. import monitor as M
+        M.get_monitor().remove_provider(p._metrics_provider)
+        p._metrics_provider = None
+    if p._metrics_server is not None:
+        p._metrics_server.stop()
+        p._metrics_server = None

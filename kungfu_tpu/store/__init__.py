@@ -116,25 +116,40 @@ class ModelStore:
         import jax
 
         from ..chaos import point as _chaos_point
+        from ..trace import span as _trace_span
         _chaos_point("store.save", version=version)
-        leaves, _ = jax.tree_util.tree_flatten(tree)
-        for i, leaf in enumerate(leaves):
-            key = f"{name}/{i}"
-            if version is None:
-                self._flat.set(key, np.asarray(leaf))
-            else:
-                self._vs.save(version, key, np.asarray(leaf))
+        with _trace_span("store.save", category="store", version=version,
+                         attrs={"blob": name}) as sp:
+            leaves, _ = jax.tree_util.tree_flatten(tree)
+            nbytes = 0
+            for i, leaf in enumerate(leaves):
+                key = f"{name}/{i}"
+                arr = np.asarray(leaf)
+                nbytes += arr.nbytes
+                if version is None:
+                    self._flat.set(key, arr)
+                else:
+                    self._vs.save(version, key, arr)
+            if sp is not None:
+                sp.set(nbytes=nbytes)
 
     def request(self, name: str, template, version: Optional[int] = None):
         import jax
 
         from ..chaos import point as _chaos_point
+        from ..trace import span as _trace_span
         _chaos_point("store.load", version=version)
-        leaves, treedef = jax.tree_util.tree_flatten(template)
-        out = []
-        for i, leaf in enumerate(leaves):
-            key = f"{name}/{i}"
-            arr = (self._flat.get(key) if version is None
-                   else self._vs.get(version, key))
-            out.append(arr.reshape(np.asarray(leaf).shape))
-        return jax.tree_util.tree_unflatten(treedef, out)
+        with _trace_span("store.load", category="store", version=version,
+                         attrs={"blob": name}) as sp:
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            out = []
+            nbytes = 0
+            for i, leaf in enumerate(leaves):
+                key = f"{name}/{i}"
+                arr = (self._flat.get(key) if version is None
+                       else self._vs.get(version, key))
+                nbytes += arr.nbytes
+                out.append(arr.reshape(np.asarray(leaf).shape))
+            if sp is not None:
+                sp.set(nbytes=nbytes)
+            return jax.tree_util.tree_unflatten(treedef, out)

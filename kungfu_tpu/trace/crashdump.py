@@ -1,0 +1,93 @@
+"""Crash dump: ship the flight-recorder tail with every failure.
+
+On an unhandled exception or a SIGTERM (the preemption-class signal
+TPU-VM eviction and the watcher's reconcile kills deliver), the
+recorder's ring is dumped to a per-rank file
+
+    <dir>/kftrace-crash.r<rank>.<pid>.jsonl
+
+so a dead worker leaves its own timeline behind — the kfchaos runner
+collects these as scenario artifacts.  SIGKILL cannot be caught; the
+streaming JSONL sink (flushed per event) covers that case instead.
+
+The handlers CHAIN: the previous excepthook still runs, and after the
+SIGTERM dump the default disposition is restored and the signal
+re-raised, so the process still dies BY SIGTERM — the watcher's
+preemption detection keys on that returncode (launcher/watch.py
+_PREEMPT_CODES) and must keep seeing -15.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+_installed_dir: Optional[str] = None
+
+
+def crash_path(out_dir: str) -> str:
+    from . import recorder
+    rec = recorder()
+    rank = getattr(rec, "rank", None)
+    tag = f"r{rank}" if rank is not None else "rx"
+    return os.path.join(out_dir, f"kftrace-crash.{tag}.{os.getpid()}.jsonl")
+
+
+def dump_now(out_dir: Optional[str] = None) -> Optional[str]:
+    """Write the recorder tail; returns the path (None when disarmed)."""
+    from . import armed, dump
+    out = out_dir or _installed_dir
+    if out is None or not armed():
+        return None
+    path = crash_path(out)
+    try:
+        dump(path)
+    except OSError as e:  # a full/readonly disk must not mask the crash
+        print(f"kftrace: crash dump to {path} failed: {e}",
+              file=sys.stderr)
+        return None
+    return path
+
+
+def install(out_dir: str) -> None:
+    """Install the excepthook + SIGTERM dump handlers (idempotent)."""
+    global _installed_dir
+    already = _installed_dir is not None
+    _installed_dir = out_dir
+    if already:
+        return
+
+    prev_hook = sys.excepthook
+
+    def _hook(etype, value, tb):
+        dump_now()
+        prev_hook(etype, value, tb)
+
+    sys.excepthook = _hook
+
+    # signal handlers only install from the main thread (the launcher's
+    # watch loop owns SIGTERM in runner processes; workers import this
+    # from their main thread)
+    if threading.current_thread() is not threading.main_thread():
+        return
+    try:
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            dump_now()
+            if callable(prev_term):
+                prev_term(signum, frame)
+                return
+            # restore the default disposition and re-raise: the process
+            # must still die with returncode -SIGTERM (preemption class)
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError) as e:
+        # embedded interpreters can refuse signal.signal; tracing is
+        # best-effort observability, never a crash source
+        print(f"kftrace: SIGTERM dump handler not installed: {e}",
+              file=sys.stderr)

@@ -1,0 +1,158 @@
+"""Merge per-worker kftrace JSONL streams into one Chrome-trace JSON.
+
+Each worker's stream carries its OWN clock: monotonic timestamps (which
+survive NTP steps but start at an arbitrary per-process zero) plus one
+anchor record pairing a wall-clock reading with a monotonic reading
+taken at the same instant.  The merger aligns streams by mapping every
+monotonic timestamp through its stream's anchor onto the shared
+wall-clock axis, then rebases to the earliest event so the timeline
+starts at t=0.  A 5-worker elastic run thus renders as ONE timeline —
+resize spans from every rank, in true cross-rank order (bounded by
+inter-host NTP skew, which on a TPU pod is far below the
+tens-of-milliseconds resize phases this exists to show).
+
+Output is the Chrome trace-event format (Perfetto, chrome://tracing,
+``about:tracing``): spans become complete events (``ph: "X"``), instants
+become instant events (``ph: "i"``), and each stream gets a
+``process_name`` metadata row naming its rank and pid.
+
+CLI (also exposed as ``tools/kftrace_merge.py``)::
+
+    python -m kungfu_tpu.trace.merge /path/to/run-dir -o trace.json
+    python -m kungfu_tpu.trace.merge w0.jsonl w1.jsonl -o trace.json
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["load_stream", "merge", "discover", "main"]
+
+# sink streams are kftrace.r<rank>.<pid>.jsonl; crash dumps
+# (kftrace-crash.*) replay the same ring and are excluded by default
+STREAM_GLOB = "kftrace.*.jsonl"
+
+
+def load_stream(path: str) -> Tuple[Optional[dict], List[dict]]:
+    """(anchor, events) of one JSONL stream.  Truncated trailing lines
+    (a worker killed mid-write) are dropped, not fatal."""
+    anchor = None
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write from a killed worker
+            if rec.get("kind") == "anchor":
+                anchor = rec
+            else:
+                events.append(rec)
+    return anchor, events
+
+
+def discover(inputs: Sequence[str], include_crash: bool = False
+             ) -> List[str]:
+    """Expand directories to their contained streams; pass files through."""
+    out: List[str] = []
+    for inp in inputs:
+        if os.path.isdir(inp):
+            out.extend(sorted(glob.glob(os.path.join(inp, STREAM_GLOB))))
+            if include_crash:
+                out.extend(sorted(glob.glob(
+                    os.path.join(inp, "kftrace-crash.*.jsonl"))))
+        else:
+            out.append(inp)
+    return out
+
+
+def merge(paths: Sequence[str]) -> dict:
+    """Chrome-trace dict from per-worker streams (see module doc)."""
+    streams = []
+    for path in paths:
+        anchor, events = load_stream(path)
+        if not events and anchor is None:
+            continue
+        streams.append((path, anchor, events))
+    if not streams:
+        raise ValueError("no kftrace events found in inputs")
+
+    def wall_of(anchor: Optional[dict], ts: float) -> float:
+        if anchor is None:
+            # no anchor (hand-rolled stream): treat ts as already-wall
+            return ts
+        return anchor["wall"] + (ts - anchor["mono"])
+
+    base = min(wall_of(a, ev["ts"])
+               for _, a, evs in streams for ev in evs)
+    trace_events: List[dict] = []
+    for i, (path, anchor, events) in enumerate(streams):
+        os_pid = (anchor or {}).get("pid", i)
+        rank = (anchor or {}).get("rank")
+        # timeline row id: rank when known (unique cluster-wide, stable
+        # across runs — OS pids are neither: they collide across hosts
+        # and recycle), else the OS pid
+        pid = rank if rank is not None else os_pid
+        label = (f"rank {rank} (pid {os_pid})" if rank is not None
+                 else f"pid {os_pid} ({os.path.basename(path)})")
+        trace_events.append({"name": "process_name", "ph": "M",
+                             "pid": pid, "tid": 0,
+                             "args": {"name": label}})
+        for ev in events:
+            ts_us = (wall_of(anchor, ev["ts"]) - base) * 1e6
+            args = dict(ev.get("attrs") or ())
+            for k in ("step", "version", "rank"):
+                if ev.get(k) is not None:
+                    args[k] = ev[k]
+            out = {"name": ev.get("name", "?"),
+                   "cat": ev.get("cat", "event"),
+                   "pid": pid, "tid": 0,
+                   "ts": ts_us, "args": args}
+            if ev.get("dur") is not None:
+                out["ph"] = "X"
+                out["dur"] = ev["dur"] * 1e6
+            else:
+                out["ph"] = "i"
+                out["s"] = "p"
+            trace_events.append(out)
+    # stable sort so readers (and tests) see one monotonic timeline;
+    # metadata events carry no ts and sort first
+    trace_events.sort(key=lambda e: e.get("ts", -1.0))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="kftrace-merge",
+        description="join per-worker kftrace JSONL into one Chrome-trace "
+                    "JSON (open in Perfetto / chrome://tracing)")
+    p.add_argument("inputs", nargs="+",
+                   help="stream files and/or directories containing "
+                        "kftrace.*.jsonl")
+    p.add_argument("-o", "--out", default="trace.json",
+                   help="output path (default trace.json)")
+    p.add_argument("--include-crash", action="store_true",
+                   help="also merge kftrace-crash.* dumps (duplicates "
+                        "ring events already present in live streams)")
+    args = p.parse_args(argv)
+    paths = discover(args.inputs, include_crash=args.include_crash)
+    if not paths:
+        p.error(f"no kftrace streams under {args.inputs}")
+    doc = merge(paths)
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+    print(f"kftrace-merge: {len(paths)} stream(s), {n} events "
+          f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -11,20 +11,36 @@ profile, annotate the XLA timeline via ``jax.profiler.TraceAnnotation`` —
 so the same scope names appear in host-side stats and in XProf/TensorBoard
 device traces.  ``start_capture``/``stop_capture`` wrap ``jax.profiler``
 for on-demand device trace dumps.
+
+This module is the lightweight per-process aggregate view (scope call
+counts/totals, an event mark list); the STRUCTURED per-event stream —
+rank/pid/step/version-tagged records in a bounded flight recorder with
+a JSONL sink and a cross-worker merger — is :mod:`kungfu_tpu.trace`
+(kftrace, docs/monitoring.md).  Scopes and events here mirror into
+kftrace when it is armed, so both views agree.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .. import trace as _kftrace
 
 ENABLE_ENV = "KFT_CONFIG_ENABLE_TRACE"
 
+# events are always-on (the elastic protocol logs them unconditionally)
+# so the list must be bounded: a long-running worker logging resize
+# events forever must not leak memory.  The cap is generous — resize
+# events arrive at human timescales.
+EVENTS_LIMIT = 65536
+
 _lock = threading.Lock()
 _scopes: Dict[str, Tuple[int, float]] = {}   # name -> (count, total_s)
-_events: List[Tuple[float, str]] = []
+_events: Deque[Tuple[float, str]] = collections.deque(maxlen=EVENTS_LIMIT)
 
 
 def enabled() -> bool:
@@ -33,18 +49,32 @@ def enabled() -> bool:
 
 @contextlib.contextmanager
 def trace_scope(name: str):
-    """Time a scope (reference TRACE_SCOPE).  No-op unless enabled."""
+    """Time a scope (reference TRACE_SCOPE).  No-op unless enabled.
+
+    The duration is recorded on the EXCEPTION path too — a scope that
+    died mid-flight is accounted under ``<name> [failed]`` (losing the
+    sample entirely would hide exactly the slow-then-crashed cases a
+    trace exists to show)."""
     if not enabled():
         yield
         return
     import jax
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
-    dt = time.perf_counter() - t0
-    with _lock:
-        c, tot = _scopes.get(name, (0, 0.0))
-        _scopes[name] = (c + 1, tot + dt)
+    failed = False
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        key = f"{name} [failed]" if failed else name
+        with _lock:
+            c, tot = _scopes.get(key, (0, 0.0))
+            _scopes[key] = (c + 1, tot + dt)
+        _kftrace.event(name, category="scope", dur=dt,
+                       attrs={"failed": True} if failed else None)
 
 
 def scope_stats() -> Dict[str, Tuple[int, float]]:
@@ -59,10 +89,13 @@ def log_event(name: str) -> float:
 
     Timestamps are ``time.perf_counter()`` — a monotonic timebase, so
     intervals between events survive NTP steps; they order and diff
-    against each other, not against wall-clock log lines."""
+    against each other, not against wall-clock log lines.  Each mark is
+    mirrored into the kftrace flight recorder (one predicate when
+    disarmed), where it also gains rank/pid and the wall-clock anchor."""
     ts = time.perf_counter()
     with _lock:
         _events.append((ts, name))
+    _kftrace.event(name, category="event")
     return ts
 
 

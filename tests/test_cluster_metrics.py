@@ -1,0 +1,116 @@
+"""/cluster_metrics: the launcher-side aggregation of every live
+worker's /metrics endpoint (kungfu_tpu.monitor.cluster; reference
+contrast: monitor.go serves per-peer endpoints only — the operator had
+to scrape N workers; here the watcher merges them)."""
+import sys
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                Monitor)
+from kungfu_tpu.monitor import cluster as mcluster
+
+
+# ------------------------------------------------------------- relabeling
+def test_merge_metrics_injects_instance_and_dedupes_meta():
+    a = ("# HELP m_total help text\n"
+         "# TYPE m_total counter\n"
+         'm_total{target="ici"} 5\n'
+         "plain_metric 1.5\n")
+    b = ("# HELP m_total help text\n"
+         "# TYPE m_total counter\n"
+         'm_total{target="ici"} 7\n')
+    merged = mcluster.merge_metrics([("h0:31100", a), ("h1:31101", b)])
+    assert merged.count("# TYPE m_total counter") == 1  # deduped
+    assert 'm_total{instance="h0:31100",target="ici"} 5' in merged
+    assert 'm_total{instance="h1:31101",target="ici"} 7' in merged
+    assert 'plain_metric{instance="h0:31100"} 1.5' in merged
+
+
+def test_merge_metrics_escapes_instance_label():
+    merged = mcluster.merge_metrics([('h"0:1', "m 1\n")])
+    assert 'instance="h\\"0:1"' in merged
+
+
+# ----------------------------------------------------------- aggregation
+def _worker_monitor(i: int) -> Monitor:
+    mon = Monitor()
+    mon.egress(1000 * (i + 1), "ici")
+    for v in (0.01, 0.02, 0.03):
+        mon.observe("kungfu_tpu_step_seconds", v * (i + 1))
+    mon.set_gauge("kungfu_tpu_grad_noise_scale", 2.0 + i)
+    return mon
+
+
+def test_aggregate_two_live_workers_and_one_dead():
+    servers = [MetricsServer(_worker_monitor(i)).start() for i in (0, 1)]
+    try:
+        targets = [("127.0.0.1", s.port - MONITOR_PORT_OFFSET)
+                   for s in servers]
+        targets.append(("127.0.0.1", 1))  # nothing listens on 10001
+        body = mcluster.aggregate(targets)
+    finally:
+        for s in servers:
+            s.stop()
+    i0 = f"127.0.0.1:{targets[0][1]}"
+    i1 = f"127.0.0.1:{targets[1][1]}"
+    # egress counters from both live workers, instance-labeled
+    assert (f'kungfu_tpu_egress_bytes_total{{instance="{i0}",'
+            f'target="ici"}} 1000') in body
+    assert (f'kungfu_tpu_egress_bytes_total{{instance="{i1}",'
+            f'target="ici"}} 2000') in body
+    # at least one histogram/summary family with metadata
+    assert "# TYPE kungfu_tpu_step_seconds summary" in body
+    assert f'kungfu_tpu_step_seconds_count{{instance="{i0}"}} 3' in body
+    assert 'quantile="0.5"' in body
+    # gauges from the monitoring optimizers' export path
+    assert "# TYPE kungfu_tpu_grad_noise_scale gauge" in body
+    # scrape health: live workers up, dead worker visible as up 0
+    assert f'kungfu_tpu_worker_up{{instance="{i0}"}} 1' in body
+    assert f'kungfu_tpu_worker_up{{instance="{i1}"}} 1' in body
+    assert 'kungfu_tpu_worker_up{instance="127.0.0.1:1"} 0' in body
+    assert "kungfu_tpu_cluster_workers 3" in body
+
+
+# ------------------------------------------- the watcher's debug endpoint
+class _AliveProc:
+    def poll(self):
+        return None
+
+
+def test_watcher_serves_cluster_metrics():
+    """The launcher watcher's debug server aggregates >= 2 live workers
+    at /cluster_metrics (the acceptance shape: real HTTP on both sides)."""
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import Watcher, _start_debug_server
+    from kungfu_tpu.plan import PeerID
+
+    servers = [MetricsServer(_worker_monitor(i)).start() for i in (0, 1)]
+    dbg = None
+    try:
+        job = Job(prog=sys.executable, args=["-c", "pass"])
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 1))
+        w.current = {
+            PeerID("127.0.0.1", s.port - MONITOR_PORT_OFFSET, i):
+                _AliveProc()
+            for i, s in enumerate(servers)}
+        dbg = _start_debug_server(w, 0)
+        url = f"http://127.0.0.1:{dbg.port}/cluster_metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        # the plain debug dump coexists on the same server
+        dump = urllib.request.urlopen(
+            f"http://127.0.0.1:{dbg.port}/", timeout=10).read().decode()
+    finally:
+        if dbg is not None:
+            dbg.stop()
+        for s in servers:
+            s.stop()
+    instances = sorted(f"127.0.0.1:{s.port - MONITOR_PORT_OFFSET}"
+                       for s in servers)
+    for inst in instances:
+        assert f'kungfu_tpu_worker_up{{instance="{inst}"}} 1' in body
+        assert f'instance="{inst}",target="ici"' in body
+    assert "# TYPE kungfu_tpu_step_seconds summary" in body
+    assert "kungfu_tpu_cluster_workers 2" in body
+    assert '"host": "127.0.0.1"' in dump

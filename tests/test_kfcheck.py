@@ -179,7 +179,12 @@ def test_silent_except_positive_scoped_dirs(tmp_path):
     """
     fs = run_on(tmp_path, src, relpath="kungfu_tpu/elastic/mod.py")
     assert rules_fired(fs) == {"silent-except"}
-    # same code OUTSIDE elastic/launcher/comm is out of scope
+    # the observability plane is in scope too (kftrace + monitor)
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/trace/mod.py")
+    assert rules_fired(fs) == {"silent-except"}
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/monitor/mod.py")
+    assert rules_fired(fs) == {"silent-except"}
+    # same code OUTSIDE the control/observability planes is out of scope
     fs = run_on(tmp_path, src, relpath="kungfu_tpu/models/mod.py")
     assert rules_fired(fs) == set()
 

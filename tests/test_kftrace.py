@@ -1,0 +1,339 @@
+"""kftrace: structured tracing, flight recorder, merger, crash dumps
+(docs/monitoring.md; reference contrast: srcs/go/monitor + the
+TRACE_SCOPE macros — the reference never had a cross-worker timeline)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import trace as kftrace
+from kungfu_tpu.trace import merge as kfmerge
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    kftrace.disarm()
+    yield
+    kftrace.disarm()
+
+
+# ------------------------------------------------------------- recorder
+def test_disarmed_by_default():
+    assert not kftrace.armed()
+    kftrace.event("noop")
+    with kftrace.span("noop2"):
+        pass
+    assert kftrace.tail() == []
+
+
+def test_event_records_structured_fields():
+    kftrace.arm(rank=7)
+    kftrace.event("elastic.detach", category="elastic", step=12,
+                  version=3, attrs={"why": "shrink"})
+    (ev,) = kftrace.tail()
+    assert ev["name"] == "elastic.detach"
+    assert ev["cat"] == "elastic"
+    assert ev["rank"] == 7
+    assert ev["pid"] == os.getpid()
+    assert ev["step"] == 12
+    assert ev["version"] == 3
+    assert ev["attrs"] == {"why": "shrink"}
+    assert isinstance(ev["ts"], float)
+
+
+def test_span_records_duration_and_failure():
+    kftrace.arm()
+    with kftrace.span("ok", category="elastic"):
+        time.sleep(0.002)
+    with pytest.raises(RuntimeError):
+        with kftrace.span("bad", category="elastic"):
+            raise RuntimeError("boom")
+    ok, bad = kftrace.tail()
+    assert ok["name"] == "ok" and ok["dur"] >= 0.002
+    # the failed scope still carries its duration, tagged as failed
+    assert bad["name"] == "bad" and bad["dur"] >= 0
+    assert bad["attrs"]["error"] == "RuntimeError"
+
+
+def test_span_set_attaches_attrs():
+    kftrace.arm()
+    with kftrace.span("store.save", category="store") as sp:
+        sp.set(nbytes=1234)
+    (ev,) = kftrace.tail()
+    assert ev["attrs"]["nbytes"] == 1234
+
+
+def test_ring_is_bounded():
+    kftrace.arm(capacity=4)
+    for i in range(10):
+        kftrace.event(f"e{i}")
+    names = [e["name"] for e in kftrace.tail()]
+    assert names == ["e6", "e7", "e8", "e9"]
+
+
+def test_jsonl_sink_and_anchor(tmp_path):
+    rec = kftrace.arm(sink_dir=str(tmp_path), rank=3)
+    kftrace.event("x", attrs={"k": "v"})
+    kftrace.disarm()  # closes the sink
+    assert os.path.basename(rec.sink_path).startswith("kftrace.r3.")
+    lines = [json.loads(l) for l in open(rec.sink_path)]
+    assert lines[0]["kind"] == "anchor"
+    assert lines[0]["rank"] == 3
+    assert lines[0]["pid"] == os.getpid()
+    # the anchor pairs one wall reading with one monotonic reading
+    assert lines[0]["wall"] == pytest.approx(time.time(), abs=120)
+    assert lines[1]["name"] == "x"
+
+
+def test_dump_writes_ring_tail(tmp_path):
+    kftrace.arm(capacity=8)
+    for i in range(3):
+        kftrace.event(f"e{i}")
+    path = str(tmp_path / "dump.jsonl")
+    assert kftrace.dump(path) == 3
+    anchor, events = kfmerge.load_stream(path)
+    assert anchor is not None
+    assert [e["name"] for e in events] == ["e0", "e1", "e2"]
+
+
+def test_unarmed_overhead_single_predicate():
+    """Disarmed sites pay one module-global check (the chaos.point
+    discipline; bound generous for noisy CI boxes)."""
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        kftrace.event("elastic.step", step=1, version=0)
+    dt_event = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with kftrace.span("elastic.step", step=1, version=0):
+            pass
+    dt_span = time.perf_counter() - t0
+    assert dt_event < 2.0, f"{n} unarmed events took {dt_event:.3f}s"
+    assert dt_span < 2.0, f"{n} unarmed spans took {dt_span:.3f}s"
+
+
+# ----------------------------------------------- instrumented call sites
+def test_session_record_mirrors_collectives(devices):
+    from kungfu_tpu.comm.session import Session
+    kftrace.arm()
+    s = Session(mesh=None)
+    s.record("g0", 4096, 0.005)
+    evs = [e for e in kftrace.tail() if e["cat"] == "collective"]
+    assert evs and evs[-1]["name"] == "g0"
+    assert evs[-1]["dur"] == 0.005
+    assert evs[-1]["attrs"]["nbytes"] == 4096
+    # the always-on side: a per-name latency summary on /metrics
+    from kungfu_tpu.monitor import get_monitor
+    summ = get_monitor().summary("kungfu_tpu_collective_seconds",
+                                 labels={"name": "g0"})
+    assert summ is not None and summ.count >= 1
+
+
+def test_store_spans_carry_bytes():
+    from kungfu_tpu.store import ModelStore
+    kftrace.arm()
+    ms = ModelStore()
+    tree = {"w": np.zeros((8, 4), np.float32)}
+    ms.save("m", tree, version=1)
+    ms.request("m", tree, version=1)
+    save, load = [e for e in kftrace.tail() if e["cat"] == "store"]
+    assert save["name"] == "store.save"
+    assert save["attrs"]["nbytes"] == 8 * 4 * 4
+    assert save["version"] == 1 and save["dur"] >= 0
+    assert load["name"] == "store.load"
+    assert load["attrs"]["nbytes"] == 8 * 4 * 4
+
+
+def test_config_server_requests_traced():
+    from kungfu_tpu.elastic.config_server import (ConfigServer,
+                                                  fetch_config,
+                                                  put_config)
+    from kungfu_tpu.plan import Cluster, HostList
+    kftrace.arm()
+    srv = ConfigServer().start()
+    try:
+        put_config(srv.url, Cluster.from_hostlist(
+            HostList.parse("127.0.0.1:2"), 2))
+        fetch_config(srv.url)
+    finally:
+        srv.stop()
+    reqs = [e for e in kftrace.tail() if e["name"] == "config.request"]
+    methods = {e["attrs"]["method"] for e in reqs}
+    assert {"PUT", "GET"} <= methods
+    assert all(e["dur"] >= 0 for e in reqs)
+
+
+def test_chaos_firings_mirrored():
+    from kungfu_tpu import chaos
+    from kungfu_tpu.chaos import Plan
+    kftrace.arm()
+    chaos.arm(Plan().add("elastic.step.fence", "delay", rank=0, step=1,
+                         delay_s=0.001))
+    try:
+        chaos.point("elastic.step.fence", rank=0, step=1, version=5)
+    finally:
+        chaos.disarm()
+    (ev,) = [e for e in kftrace.tail() if e["cat"] == "chaos"]
+    assert ev["name"] == "chaos.elastic.step.fence"
+    assert ev["attrs"]["action"] == "delay"
+    assert ev["rank"] == 0 and ev["step"] == 1 and ev["version"] == 5
+
+
+def test_log_event_mirrors_into_kftrace():
+    from kungfu_tpu.utils import trace as utrace
+    kftrace.arm()
+    utrace.log_event("resize-begin:2->4")
+    names = [e["name"] for e in kftrace.tail()]
+    assert "resize-begin:2->4" in names
+
+
+def test_elastic_resize_span_single_controller(devices):
+    import jax.numpy as jnp
+    import optax
+
+    import kungfu_tpu.optimizers as kfopt
+    from kungfu_tpu.elastic.trainer import ElasticTrainer
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    rng = np.random.RandomState(0)
+    init = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+    t = ElasticTrainer(loss_fn, lambda n: kfopt.synchronous_sgd(
+        optax.sgd(0.1)), init, init_size=2)
+    kftrace.arm()
+    t.resize(4)
+    spans = [e for e in kftrace.tail()
+             if e["name"] == "elastic.resize" and "dur" in e]
+    assert len(spans) == 1
+    assert spans[0]["attrs"] == {"from": 2, "to": 4}
+    assert spans[0]["cat"] == "elastic"
+    # the resize duration also lands on /metrics as a summary
+    from kungfu_tpu.monitor import get_monitor
+    summ = get_monitor().summary("kungfu_tpu_resize_seconds")
+    assert summ is not None and summ.count >= 1
+
+
+# ---------------------------------------------------------------- merger
+def _write_stream(tmp_path, rank, wall0, mono0, events):
+    """Hand-rolled stream with a controlled anchor."""
+    path = tmp_path / f"kftrace.r{rank}.{1000 + rank}.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "anchor", "wall": wall0,
+                            "mono": mono0, "pid": 1000 + rank,
+                            "rank": rank}) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def test_merge_aligns_clocks_across_ranks(tmp_path):
+    # rank 0: mono zero at 5000; rank 1: mono zero at 17 — raw ts are
+    # wildly incomparable, the wall anchors line them up
+    p0 = _write_stream(
+        tmp_path, 0, wall0=1000.0, mono0=5000.0,
+        events=[{"ts": 5000.010, "name": "elastic.resize",
+                 "cat": "elastic", "rank": 0, "dur": 0.050},
+                {"ts": 5000.100, "name": "late0", "cat": "event",
+                 "rank": 0}])
+    p1 = _write_stream(
+        tmp_path, 1, wall0=1000.0, mono0=17.0,
+        events=[{"ts": 17.040, "name": "elastic.resize",
+                 "cat": "elastic", "rank": 1, "dur": 0.030}])
+    doc = kfmerge.merge([p0, p1])
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    by_name = {e["name"]: e for e in evs}
+    # wall order: r0 resize @1000.010, r1 resize @1000.040, late0 @1000.100
+    assert [e["name"] for e in evs] == ["elastic.resize",
+                                       "elastic.resize", "late0"]
+    assert by_name["late0"]["ts"] > evs[1]["ts"]
+    assert evs[0]["pid"] == 0 and evs[1]["pid"] == 1
+    # spans carry microsecond durations
+    assert evs[0]["ph"] == "X" and evs[0]["dur"] == pytest.approx(50000)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_merge_tolerates_torn_tail(tmp_path):
+    path = _write_stream(tmp_path, 0, 1000.0, 0.0,
+                         [{"ts": 0.1, "name": "a", "cat": "event"}])
+    with open(path, "a") as f:
+        f.write('{"ts": 0.2, "name": "torn')  # killed mid-write
+    doc = kfmerge.merge([path])
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert names == ["a"]
+
+
+def test_merge_cli_end_to_end(tmp_path):
+    _write_stream(tmp_path, 0, 1000.0, 0.0,
+                  [{"ts": 0.1, "name": "a", "cat": "event"}])
+    _write_stream(tmp_path, 1, 1000.0, 50.0,
+                  [{"ts": 50.2, "name": "b", "cat": "elastic",
+                    "dur": 0.01}])
+    out = tmp_path / "trace.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kftrace_merge.py"),
+         str(tmp_path), "-o", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.load(open(out))
+    assert {e["name"] for e in doc["traceEvents"] if e["ph"] != "M"} == \
+        {"a", "b"}
+
+
+def test_merge_empty_inputs_raise(tmp_path):
+    with pytest.raises(ValueError):
+        kfmerge.merge([])
+
+
+# ------------------------------------------------------------ crash dump
+def test_crash_dump_on_unhandled_exception(tmp_path):
+    code = (
+        "from kungfu_tpu import trace\n"
+        "assert trace.armed()\n"
+        "trace.event('before-crash', category='elastic')\n"
+        "raise RuntimeError('boom')\n")
+    env = dict(os.environ, KFT_TRACE_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO)
+    assert proc.returncode == 1
+    crashes = [f for f in os.listdir(tmp_path)
+               if f.startswith("kftrace-crash.")]
+    assert len(crashes) == 1, os.listdir(tmp_path)
+    _, events = kfmerge.load_stream(str(tmp_path / crashes[0]))
+    assert [e["name"] for e in events] == ["before-crash"]
+    assert "RuntimeError: boom" in proc.stderr  # original hook still ran
+
+
+def test_crash_dump_on_sigterm_preserves_signal_death(tmp_path):
+    """The dump must not eat the SIGTERM death: the watcher's preemption
+    detection keys on returncode -15 (launcher/watch.py)."""
+    code = (
+        "import os, signal, time\n"
+        "from kungfu_tpu import trace\n"
+        "trace.event('pre-term', category='elastic')\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "time.sleep(30)\n")
+    env = dict(os.environ, KFT_TRACE_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO)
+    assert proc.returncode == -signal.SIGTERM, (proc.returncode,
+                                                proc.stderr)
+    crashes = [f for f in os.listdir(tmp_path)
+               if f.startswith("kftrace-crash.")]
+    assert len(crashes) == 1, os.listdir(tmp_path)
+    _, events = kfmerge.load_stream(str(tmp_path / crashes[0]))
+    assert [e["name"] for e in events] == ["pre-term"]

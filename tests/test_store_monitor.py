@@ -6,7 +6,8 @@ import numpy as np
 import pytest
 
 from kungfu_tpu.monitor import (MetricsServer, Monitor, RateCounter,
-                                allreduce_bytes_on_wire)
+                                Summary, allreduce_bytes_on_wire,
+                                publish_optimizer_gauges)
 from kungfu_tpu.store import (ConflictError, ModelStore, Store,
                               VersionedStore)
 
@@ -80,6 +81,148 @@ class TestMonitor:
             assert 'kungfu_tpu_ingress_bytes_total{target="ici"} 999' in body
         finally:
             srv.stop()
+
+    def test_rate_counter_first_window_not_zero(self):
+        """A scrape right after startup must see window_bytes/dt, not a
+        0.0 placeholder for a window that never rolled (satellite fix)."""
+        c = RateCounter()
+        c.add(5000)
+        time.sleep(0.01)
+        r = c.rate(period=60.0)  # far from rolling
+        assert r > 0
+        # after the first roll, behavior is the classic last-rate hold
+        c2 = RateCounter()
+        c2.add(100)
+        time.sleep(0.03)
+        rolled = c2.rate(period=0.02)
+        held = c2.rate(period=60.0)
+        assert held == rolled
+
+    def test_render_metrics_metadata_and_escaping(self):
+        mon = Monitor()
+        mon.egress(7, 'tar"get\\x\n')
+        body = mon.render_metrics()
+        assert "# HELP kungfu_tpu_egress_bytes_total" in body
+        assert "# TYPE kungfu_tpu_egress_bytes_total counter" in body
+        # backslash, quote, and newline all escaped per Prometheus
+        assert 'target="tar\\"get\\\\x\\n"' in body
+
+    def test_summary_quantiles_and_render(self):
+        s = Summary()
+        for v in range(1, 101):
+            s.observe(v / 100.0)
+        assert s.count == 100
+        assert s.sum == pytest.approx(50.5)
+        assert s.quantile(0.5) == pytest.approx(0.5, abs=0.02)
+        lines = s.render("step_seconds", {"role": "train"})
+        assert any(l.startswith('step_seconds{quantile="0.5",'
+                                'role="train"}') or
+                   l.startswith('step_seconds{role="train",'
+                                'quantile="0.5"}')
+                   for l in lines)
+        assert 'step_seconds_count{role="train"} 100' in lines
+
+    def test_monitor_summary_and_gauge_render(self):
+        mon = Monitor()
+        mon.observe("kungfu_tpu_resize_seconds", 0.25)
+        mon.observe("kungfu_tpu_resize_seconds", 0.35)
+        mon.set_gauge("kungfu_tpu_grad_variance", 0.125)
+        body = mon.render_metrics()
+        assert "# TYPE kungfu_tpu_resize_seconds summary" in body
+        assert "kungfu_tpu_resize_seconds_count 2" in body
+        assert "kungfu_tpu_resize_seconds_sum 0.6" in body
+        assert "# TYPE kungfu_tpu_grad_variance gauge" in body
+        assert "kungfu_tpu_grad_variance 0.125" in body
+
+    def test_provider_errors_are_counted_not_fatal(self):
+        mon = Monitor()
+        mon.egress(1, "ici")
+
+        def bad():
+            raise RuntimeError("dead provider")
+        mon.add_provider(bad)
+        body = mon.render_metrics()
+        assert 'kungfu_tpu_egress_bytes_total{target="ici"} 1' in body
+        assert "kungfu_tpu_provider_errors_total 1" in body
+
+
+class TestNativeProviderLifecycle:
+    """The native metrics provider path (native._maybe_start_metrics /
+    _stop_metrics): provider lines appear in /metrics, and removal on
+    shutdown actually stops them (satellite coverage; runs without the
+    native lib — the path only touches the peer's counters API)."""
+
+    class _StubPeer:
+        size = 2
+        rank = 0
+        _metrics_server = None
+        _metrics_provider = None
+
+        def egress_bytes(self, j):
+            return 111 * (j + 1)
+
+    def _free_worker_port(self):
+        import socket
+
+        from kungfu_tpu.monitor import MONITOR_PORT_OFFSET
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1] - MONITOR_PORT_OFFSET
+
+    def test_provider_lines_served_then_removed(self, monkeypatch):
+        from kungfu_tpu import monitor as M
+        from kungfu_tpu import native
+        monkeypatch.setenv("KFT_CONFIG_ENABLE_MONITORING", "1")
+        p = self._StubPeer()
+        native._maybe_start_metrics(p, self._free_worker_port())
+        assert p._metrics_server is not None
+        port = p._metrics_server.port
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=5).read().decode()
+            # rank 0 skips itself; peer 1's counter is served
+            assert 'kft_peer_egress_bytes_total{peer="1"} 222' in body
+        finally:
+            native._stop_metrics(p)
+        # provider unregistered: a fresh render has no native lines
+        assert "kft_peer_egress_bytes_total" not in \
+            M.get_monitor().render_metrics()
+        assert p._metrics_provider is None and p._metrics_server is None
+        # and the endpoint is gone
+        with pytest.raises(OSError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                   timeout=2)
+
+    def test_disabled_env_is_a_noop(self, monkeypatch):
+        from kungfu_tpu import native
+        monkeypatch.delenv("KFT_CONFIG_ENABLE_MONITORING", raising=False)
+        p = self._StubPeer()
+        native._maybe_start_metrics(p, self._free_worker_port())
+        assert p._metrics_server is None and p._metrics_provider is None
+
+
+def test_publish_optimizer_gauges():
+    """Gauges sourced from the monitoring optimizers: the walker finds
+    NoiseScaleState / GradVarianceState anywhere in the opt-state tree
+    and exports their running statistics to /metrics."""
+    import jax.numpy as jnp
+
+    from kungfu_tpu.optimizers.monitors import (GradVarianceState,
+                                                NoiseScaleState)
+    ns = NoiseScaleState(base=(), ema_s=jnp.asarray(2.0),
+                         ema_g2=jnp.asarray(1.0),
+                         noise_scale=jnp.asarray(2.5),
+                         step=jnp.asarray(3))
+    gv = GradVarianceState(base=(ns,), variance=jnp.asarray(0.75),
+                           step=jnp.asarray(3))
+    mon = Monitor()
+    found = publish_optimizer_gauges((gv,), monitor=mon)
+    assert found == {"kungfu_tpu_grad_noise_scale": 2.5,
+                     "kungfu_tpu_grad_variance": 0.75}
+    body = mon.render_metrics()
+    assert "kungfu_tpu_grad_noise_scale 2.5" in body
+    assert "kungfu_tpu_grad_variance 0.75" in body
 
 
 def test_step_monitor_feeds_session_stats():

@@ -48,6 +48,33 @@ def test_events_always_on():
     assert trace.events()[-1] == (t, "sync-begin")
 
 
+def test_events_list_is_bounded():
+    # always-on marks must not leak memory on a long-running worker
+    assert trace.EVENTS_LIMIT > 0
+    assert trace._events.maxlen == trace.EVENTS_LIMIT
+
+
+def test_scope_records_duration_on_exception_path():
+    """A scope that raises still accounts its duration, tagged as
+    failed — losing the sample would hide exactly the
+    slow-then-crashed cases (satellite fix: the accounting used to sit
+    after the yield outside any finally)."""
+    os.environ[trace.ENABLE_ENV] = "1"
+    with pytest.raises(RuntimeError):
+        with trace.trace_scope("doomed"):
+            raise RuntimeError("boom")
+    stats = trace.scope_stats()
+    assert "doomed" not in stats          # success bucket untouched
+    assert stats["doomed [failed]"][0] == 1
+    assert stats["doomed [failed]"][1] >= 0
+    # a later successful run of the same scope lands in its own bucket
+    with trace.trace_scope("doomed"):
+        pass
+    stats = trace.scope_stats()
+    assert stats["doomed"][0] == 1
+    assert stats["doomed [failed]"][0] == 1
+
+
 def test_resize_logs_events(devices):
     import jax.numpy as jnp
     import optax

@@ -1,0 +1,109 @@
+"""kftrace over a REAL multi-worker elastic run: per-rank JSONL streams
+from a chaos-harness scenario, joined by the merger into one Chrome
+trace with resize-phase spans from every rank, cross-rank timestamps
+aligned via the wall/monotonic anchors.
+
+Uses the kfchaos scenario runner as the multi-process harness (it
+already arms KFT_TRACE_DIR for its workers) — first with NO faults and
+a voluntary shrink (both ranks live through a full resize), then the
+tier-1 kill scenario (the killed rank's stream must still carry its
+pre-death spans).  Gated like the rest of the scenario tier: needs the
+native comm library and a multiprocess-capable jax CPU backend.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import native  # noqa: E402
+from kungfu_tpu.chaos import Plan, runner  # noqa: E402
+from kungfu_tpu.trace import merge as kfmerge  # noqa: E402
+import testutil  # noqa: E402
+
+needs_plane = pytest.mark.skipif(
+    not native.available() or not testutil.data_plane_supported(),
+    reason="needs native lib + multiprocess-capable jax CPU backend")
+
+# the elastic phase spans instrumentation must produce on a resize
+RESIZE_PHASES = {"elastic.resize", "elastic.commit", "elastic.teardown"}
+
+
+def _merged(res):
+    paths = kfmerge.discover([res.out_dir])
+    assert paths, f"no kftrace streams in {res.out_dir}"
+    return kfmerge.merge(paths)
+
+
+def _spans_by_rank(doc):
+    out = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X" and e.get("cat") == "elastic":
+            out.setdefault(e["pid"], []).append(e)
+    return out
+
+
+@needs_plane
+def test_voluntary_resize_traces_every_rank(tmp_path):
+    """2 workers, voluntary shrink to 1: both ranks' streams carry the
+    resize phases; the merged timeline is one monotonic sequence."""
+    sc = runner.Scenario(
+        name="trace-voluntary-shrink",
+        desc="no faults; rank 0 proposes 2->1 — kftrace artifact check",
+        plan=Plan(seed=None),
+        nprocs=2,
+        propose=((4, 1),),
+        target_steps=12)
+    res = runner.run_scenario(sc, out_root=str(tmp_path))
+    assert res.ok, res.violations
+    assert len(res.trace_files) >= 2, res.trace_files
+
+    doc = _merged(res)
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), "merged timeline is not monotonic"
+    by_rank = _spans_by_rank(doc)
+    # every rank of the job contributed elastic spans — including the
+    # one that detached (it ran the resize protocol before exiting)
+    assert set(by_rank) >= {0, 1}, sorted(by_rank)
+    for rank, spans in sorted(by_rank.items()):
+        names = {s["name"] for s in spans}
+        assert RESIZE_PHASES <= names, (rank, sorted(names))
+        # per-rank order: within one rank spans are monotonic too
+        rts = [s["ts"] for s in spans]
+        assert rts == sorted(rts)
+    # the merged doc is valid chrome-trace JSON end-to-end
+    out = tmp_path / "trace.json"
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    assert json.load(open(out))["traceEvents"]
+
+
+@needs_plane
+def test_kill_scenario_ships_timelines(tmp_path):
+    """The tier-1 kill scenario leaves trace artifacts for every worker
+    incarnation, and the killed rank's stream still holds the spans
+    recorded before its death (the flushed-JSONL contract), with the
+    chaos injection mirrored onto the same timeline."""
+    res = runner.run_scenario(runner.scenarios()["smoke"],
+                              out_root=str(tmp_path))
+    assert res.ok, res.violations
+    assert any(e["action"] == "kill" for e in res.fired)
+    assert len(res.trace_files) >= 2, res.trace_files
+    doc = _merged(res)
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    # the injected kill appears in the trace stream (category chaos),
+    # mirrored from the chaos journal at fire time
+    chaos_evs = [e for e in evs if e["cat"] == "chaos"]
+    assert any(e["name"] == "chaos.elastic.commit.exchange"
+               for e in chaos_evs), [e["name"] for e in chaos_evs]
+    by_rank = _spans_by_rank(doc)
+    # the killed rank (1) recorded commit spans before dying
+    assert 1 in by_rank
+    assert any(s["name"] == "elastic.commit" for s in by_rank[1])
+    # the survivor's recovery produced rebuild/sync_state spans
+    assert 0 in by_rank
+    names0 = {s["name"] for s in by_rank[0]}
+    assert "elastic.sync_state" in names0, sorted(names0)

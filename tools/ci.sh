@@ -43,6 +43,11 @@ say() { printf '\n==== %s ====\n' "$*"; }
 say "0/3 kfcheck static analysis"
 python -m tools.kfcheck || exit 1
 
+# metrics/trace smoke: a real /metrics endpoint scraped over HTTP plus
+# the kftrace merger over a 2-worker fixture (~2 s; docs/monitoring.md)
+say "0b/3 metrics + trace smoke"
+python tools/metrics_trace_smoke.py || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

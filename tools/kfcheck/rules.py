@@ -246,7 +246,7 @@ class SilentExcept(Rule):
     doc = ("bare `except:` / broad `except Exception:` that swallows the "
            "error in control-plane code — peer death and resize failures "
            "vanish instead of driving recovery")
-    path_filter = r"(^|/)(elastic|launcher|comm|chaos|store)(/|$)"
+    path_filter = r"(^|/)(elastic|launcher|comm|chaos|store|trace|monitor)(/|$)"
 
     BROAD = {"Exception", "BaseException"}
 

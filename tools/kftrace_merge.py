@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Merge per-worker kftrace JSONL streams into one Chrome-trace JSON.
+
+Thin CLI wrapper over :mod:`kungfu_tpu.trace.merge` (kept at tools/
+level alongside the other operator entry points)::
+
+    python tools/kftrace_merge.py /tmp/kfchaos-run -o trace.json
+
+Open the result in https://ui.perfetto.dev or chrome://tracing.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu.trace.merge import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

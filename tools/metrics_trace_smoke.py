@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Metrics + trace smoke for CI (tools/ci.sh, fast path).
+
+Three cheap end-to-end checks, no pytest, no multi-process plane:
+
+1. /metrics — start a real :class:`~kungfu_tpu.monitor.MetricsServer`,
+   feed counters, a summary, and a gauge, scrape it over HTTP, and
+   assert the Prometheus shape (# HELP/# TYPE metadata, escaped labels,
+   summary quantile/sum/count lines).
+2. kftrace — arm the recorder with a JSONL sink, emit spans/events for
+   two fake workers (distinct wall anchors, as two hosts would have),
+   and
+3. merger — run the ``tools/kftrace_merge.py`` CLI on that 2-worker
+   fixture and validate the resulting Chrome-trace JSON: both pids
+   present, spans aligned onto one monotonic timeline.
+
+Exit 0 on success, 1 with a message on any failure.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def check_metrics() -> None:
+    from kungfu_tpu.monitor import MetricsServer, Monitor
+    mon = Monitor()
+    mon.egress(12345, "dcn")
+    mon.ingress(999, 'ici"quoted')          # exercises label escaping
+    for v in (0.01, 0.02, 0.03):
+        mon.observe("kungfu_tpu_step_seconds", v)
+    mon.set_gauge("kungfu_tpu_grad_noise_scale", 3.5)
+    srv = MetricsServer(mon).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        srv.stop()
+    for needle in (
+            "# TYPE kungfu_tpu_egress_bytes_total counter",
+            "# HELP kungfu_tpu_egress_bytes_total",
+            'kungfu_tpu_egress_bytes_total{target="dcn"} 12345',
+            'target="ici\\"quoted"',
+            "# TYPE kungfu_tpu_step_seconds summary",
+            'kungfu_tpu_step_seconds{quantile="0.5"}',
+            "kungfu_tpu_step_seconds_count 3",
+            "# TYPE kungfu_tpu_grad_noise_scale gauge",
+            "kungfu_tpu_grad_noise_scale 3.5"):
+        assert needle in body, f"missing {needle!r} in /metrics:\n{body}"
+
+
+def make_fixture(out_dir: str) -> None:
+    """Two per-worker streams with deliberately different anchors (the
+    merger must align them via wall-mono anchor pairs, not raw ts)."""
+    from kungfu_tpu.trace import Recorder
+    for rank in (0, 1):
+        rec = Recorder(sink_dir=out_dir, rank=rank)
+        # skew this worker's monotonic zero: same wall instant, very
+        # different raw perf_counter values
+        rec.anchor_mono -= rank * 1000.0
+        with open(rec.sink_path, "w") as f:
+            f.write(json.dumps(rec._anchor_record()) + "\n")
+        base = rec.anchor_mono
+        rec.record("elastic.resize", "elastic", rank=rank, step=4,
+                   version=1, ts=base + 0.010, dur=0.050)
+        rec.record("elastic.sync_state", "elastic", rank=rank, step=4,
+                   version=1, ts=base + 0.020, dur=0.010)
+        rec.record("config.fetch", "config", rank=rank, ts=base + 0.005)
+        rec.close()
+
+
+def check_merge() -> None:
+    tmp = tempfile.mkdtemp(prefix="kftrace-smoke-")
+    make_fixture(tmp)
+    out = os.path.join(tmp, "trace.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "kftrace_merge.py"),
+         tmp, "-o", out],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    pids = {e["pid"] for e in evs}
+    assert len(pids) == 2, f"expected 2 worker pids, got {pids}"
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), "merged timeline is not monotonic"
+    spans = [e for e in evs if e["ph"] == "X"
+             and e["name"] == "elastic.resize"]
+    assert len(spans) == 2, "resize span missing from a rank"
+    # anchors differ by 1000s of monotonic skew; aligned output must
+    # span only the ~50ms the events actually cover
+    assert max(ts) - min(ts) < 1e6, "anchor alignment failed"
+
+
+def main() -> int:
+    check_metrics()
+    print("metrics-smoke: /metrics OK")
+    check_merge()
+    print("metrics-smoke: kftrace merge OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
