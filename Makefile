@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest chaos-smoke clean
+.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -14,6 +14,12 @@ lint:
 # images whose jax cannot run the multiprocess data plane.
 chaos-smoke: native
 	python -m kungfu_tpu.chaos.runner --scenario smoke
+
+# kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
+# the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json
+# (docs/elastic.md "Async commit pipeline").  CI runs `--smoke`.
+snapshot-bench:
+	python tools/bench_snapshot.py
 
 native:
 	$(MAKE) -C native

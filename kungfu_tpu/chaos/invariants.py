@@ -145,6 +145,33 @@ def check_no_orphans(pids: Sequence[int],
     return bad
 
 
+def check_sync_from_committed(events: Sequence[Event]) -> List[str]:
+    """Every recovery/resize restore lands EXACTLY on a commit some
+    worker recorded: a ``sync`` event's restored progress pair must
+    equal a published ``commit`` pair (kfsnap contract — a snapshot
+    that was dispatched/joined but never published must not be
+    restorable; the kill-during-async-commit scenario kills inside
+    that window).  Zero-progress syncs (fresh joiners adopting the
+    seq-0 snapshot) say nothing and are skipped.  Commit events are
+    collected order-insensitively: the async committer may publish a
+    commit after another stream already synced to it."""
+    commits = {(int(e["samples"]), int(e["step"]))
+               for e in events if e.get("kind") == "commit"}
+    bad = []
+    for e in events:
+        if e.get("kind") != "sync":
+            continue
+        pair = (int(e.get("samples", 0)), int(e.get("step", 0)))
+        if pair[0] <= 0:
+            continue
+        if pair not in commits:
+            bad.append(
+                f"{e.get('stream')}: sync restored progress {pair} that "
+                f"no worker ever recorded as a commit: recovery restored "
+                f"a torn/unpublished snapshot")
+    return bad
+
+
 def check_trajectory(events: Sequence[Event], oracle_wsum,
                      rtol: float = 1e-4) -> List[str]:
     """Final parameters match the no-fault oracle trajectory for the
@@ -172,6 +199,7 @@ def run_all(events: Sequence[Event], pids: Sequence[int] = (),
     bad = []
     bad += check_progress_monotonic(events)
     bad += check_no_fresh_start(events, init_wsum=init_wsum)
+    bad += check_sync_from_committed(events)
     bad += check_single_winner(events)
     bad += check_no_orphans(pids, marker=pid_marker)
     if oracle_wsum is not None:

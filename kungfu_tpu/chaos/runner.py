@@ -260,6 +260,15 @@ def scenarios() -> Dict[str, Scenario]:
             target_steps=20,
             timeout_s=420.0),
         Scenario(
+            name="kill-during-async-commit",
+            desc="SIGKILL rank 1 inside the kfsnap publish window "
+                 "(snapshot dispatched and joined, commit record not "
+                 "yet published): the unpublished snapshot must never "
+                 "count — recovery restarts from the previous durable "
+                 "commit with the trajectory oracle intact",
+            plan=Plan(seed=None).add("snapshot.commit", "kill",
+                                     rank=1, step=6)),
+        Scenario(
             name="config-outage-mid-resize",
             desc="config server unreachable (drop-rpc on every fetch) "
                  "around a voluntary shrink: the resize is delayed, "

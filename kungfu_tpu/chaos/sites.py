@@ -52,6 +52,14 @@ SITES: Dict[str, str] = {
     "elastic.sync_state.begin": (
         "entry of _sync_state: membership agreed, committed state "
         "about to be re-shared/re-sharded"),
+    "snapshot.commit": (
+        "kfsnap publish window (elastic/snapshot.py): the snapshot is "
+        "fully joined on host (and, sharded, replica-exchanged) but the "
+        "commit record is NOT yet published — a kill here proves an "
+        "unpublished snapshot never counts and recovery restarts from "
+        "the previous durable commit; fires on the async committer "
+        "thread (replicated trainers) or inline before the sharded "
+        "record"),
     # ------------------------------------------------ config control plane
     "config.fetch": (
         "every GET of (version, cluster) from the config server — "

@@ -114,8 +114,12 @@ def save_npz(path: str, tree) -> None:
     """Flat ``.npz`` dump of a pytree (reference: the elastic hook's final
     variable snapshot, hooks/elastic.py:80-87).  Lossy: keys are the
     flattened key-paths; use :class:`Checkpointer` for real resume."""
+    # kfsnap: dispatch every leaf's device->host transfer before the
+    # first is joined (kungfu_tpu.elastic.snapshot), instead of one
+    # blocking per-leaf copy at a time
+    from .elastic.snapshot import snapshot as _snapshot
     flat = {}
-    for kp, leaf in jax.tree_util.tree_leaves_with_path(tree):
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(_snapshot(tree)):
         key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                        for k in kp)
         flat[key] = np.asarray(leaf)

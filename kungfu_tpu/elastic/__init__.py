@@ -1,6 +1,7 @@
 """Elastic cluster membership (config server, resize protocol, policies)."""
-from . import state
+from . import snapshot, state
 from .config_server import ConfigServer, fetch_config, put_config
+from .snapshot import AsyncCommitter
 from .dataset import ElasticDataShard
 from .policy import (BasePolicy, PolicyContext, PolicyRunner,
                      ScheduledResizePolicy)
@@ -10,7 +11,8 @@ from .multiproc import DistributedElasticTrainer
 from .sharded import ShardedElasticTrainer
 
 __all__ = [
-    "state", "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
+    "snapshot", "state", "AsyncCommitter",
+    "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
     "DistributedElasticTrainer", "ShardedElasticTrainer",
     "BasePolicy", "PolicyContext", "PolicyRunner", "ScheduledResizePolicy",
     "Stage", "StepSchedule", "ElasticDataShard",

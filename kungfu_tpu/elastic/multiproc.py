@@ -35,6 +35,7 @@ from ..launcher import env as E
 from ..trace import event as _trace_event, span as _trace_span
 from . import state as _flags
 from .config_server import fetch_config
+from .snapshot import AsyncCommitter
 
 
 def _snapshot_budget(default: float = 0.05) -> float:
@@ -63,9 +64,13 @@ class DistributedElasticTrainer:
     every member agrees whether to step or resize first (the reference
     fences every cluster change with a consensus round, peer.go:186);
     (2) the jitted DP step over the global device mesh (params replicated,
-    batch sharded over devices, gradient pmean compiled by XLA); (3) a
-    host snapshot of the new state — the committed point a preemption
-    recovery restarts from.
+    batch sharded over devices, gradient pmean compiled by XLA); (3) at
+    the commit cadence, an INITIATED host snapshot of the new state —
+    kfsnap (elastic/snapshot.py) dispatches every device buffer's
+    ``copy_to_host_async`` and a background committer joins and
+    publishes the commit record, so the step never blocks on D2H and
+    the committed point a preemption recovery restarts from is always
+    a fully-published snapshot.
 
     ``step()`` expects the GLOBAL batch (identical numpy on every
     process; jax places each process's addressable shard).  Returns the
@@ -96,7 +101,12 @@ class DistributedElasticTrainer:
         self._auto_commit_s = 0.0  # measured at step 1 in auto mode; a
         # joiner restored into an auto run may derive with 0 — the
         # cadence allreduce-MAX adopts the survivors' real value
+        self._auto_join_s = 0.0  # async tail of the measured commit
         self._last_step_s: Optional[float] = None
+        # kfsnap: commits are initiated by step() and finished (join +
+        # publish) on this background committer — step() never blocks
+        # on the device->host transfer (elastic/snapshot.py)
+        self._committer = AsyncCommitter()
         self.we = E.from_env()
         if self.we.singleton:
             raise RuntimeError(
@@ -265,19 +275,67 @@ class DistributedElasticTrainer:
         D.shutdown()
 
     def _commit(self) -> None:
-        """Snapshot device state + the counters describing it to host —
-        the point a recovery or resize restarts from."""
-        import jax
+        """INITIATE a snapshot of device state + the counters describing
+        it — the point a recovery or resize restarts from.
+
+        kfsnap pipeline: this dispatches every leaf's
+        ``copy_to_host_async`` (all transfers overlap) and returns; the
+        background committer joins and then publishes host state and
+        progress ATOMICALLY (state first, counters last), so
+        ``_committed_progress`` never points at a torn snapshot — a
+        death between dispatch and publish recovers from the previous
+        durable commit (kfchaos ``snapshot.commit``).  Callers that
+        need the commit durable NOW follow with :meth:`_commit_drain`.
+        """
         _chaos_point("elastic.commit.begin", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
+        progress = (self.trained_samples, self.step_count)
+
+        def publish(host) -> None:
+            # runs on the committer thread: install the host state
+            # BEFORE the progress record (each assignment is atomic
+            # under the GIL; readers drain first anyway)
+            self._host_params, self._host_opt = host
+            self._committed_progress = progress
+
         with _trace_span("elastic.commit", category="elastic",
                          rank=self.peer.rank, step=self.step_count,
                          version=self.version):
-            self._host_params = jax.tree_util.tree_map(np.asarray,
-                                                       self._params)
-            self._host_opt = jax.tree_util.tree_map(np.asarray, self._opt)
-            self._committed_progress = (self.trained_samples,
-                                        self.step_count)
+            self._committer.initiate((self._params, self._opt), publish,
+                                     rank=self.peer.rank,
+                                     step=self.step_count,
+                                     version=self.version)
+
+    def _commit_drain(self) -> None:
+        """Block until the last initiated commit is durable (published).
+        No-op for the sharded sibling, whose commit is a synchronous
+        collective.  Re-raises a failed in-flight commit; the previous
+        published commit stands."""
+        self._committer.drain()
+
+    def _drain_quietly(self, where: str) -> None:
+        """Drain on a path that must proceed regardless (recovery,
+        shutdown): a failed in-flight commit is logged, not fatal —
+        the previous durable commit is the recovery point."""
+        import sys
+        try:
+            self._commit_drain()
+        except Exception as e:
+            print(f"kft: in-flight commit abandoned at {where}: {e!r}",
+                  file=sys.stderr)
+
+    def _measure_commit(self) -> None:
+        """One fully-drained commit, split into the BLOCKING cost the
+        step pays (kfsnap dispatch; the whole commit when commits are
+        synchronous) and the async join tail — the two inputs of the
+        auto-cadence derivation."""
+        import time as _time
+        t0 = _time.perf_counter()
+        self._commit()
+        self._auto_commit_s = _time.perf_counter() - t0
+        self._commit_drain()
+        self._auto_join_s = (_time.perf_counter() - t0
+                             - self._auto_commit_s)
 
     def _pre_teardown(self) -> None:
         """Hook between the pre-resize commit and the plane teardown,
@@ -296,8 +354,11 @@ class DistributedElasticTrainer:
                          version=self.version) as _sp:
             # everyone is at the same fence: commit the live device state
             # so a voluntary resize never discards steps since the last
-            # snapshot
+            # snapshot.  The commit must be DURABLE before the plane
+            # comes down — the post-rebuild state broadcast reads the
+            # published host snapshot — so this is a drain point.
             self._commit()
+            self._commit_drain()
             self._pre_teardown()
             # the old plane comes down FIRST, with everyone still alive —
             # after resize_from_url the old host membership no longer
@@ -321,6 +382,10 @@ class DistributedElasticTrainer:
         shrink over the host plane, rebuild, and REDO the interrupted
         step(s) from the last committed snapshot."""
         D.shutdown()
+        # settle the commit pipeline before rebuilding: _sync_state
+        # broadcasts the PUBLISHED host snapshot, so an in-flight commit
+        # must either land or be abandoned (previous commit stands)
+        self._drain_quietly("recovery")
         _trace_event("elastic.recover.begin", category="elastic",
                      step=self.step_count, version=self.version,
                      attrs={"cause": type(cause).__name__ if cause else None})
@@ -399,10 +464,7 @@ class DistributedElasticTrainer:
             # compile-inflated first step would underestimate the
             # cadence by the compile/step ratio
             try:
-                import time as _time
-                t0 = _time.perf_counter()
-                self._commit()
-                self._auto_commit_s = _time.perf_counter() - t0
+                self._measure_commit()
             except native.NativeError as e:
                 return self._recover(global_batch, cause=e)
             return lossv
@@ -411,10 +473,16 @@ class DistributedElasticTrainer:
             step_s = max(self._last_step_s or 1e-3, 1e-3)
             # 0 = "I never measured a commit" (a joiner restored after
             # the step-1 measurement); the MAX then adopts whichever
-            # member did measure
+            # member did measure.  Two constraints: the BLOCKING cost
+            # (the kfsnap dispatch; the full commit for the sharded
+            # sibling's synchronous collective) amortizes under the
+            # budget, and the async join tail fits inside the cadence
+            # window so commits never queue behind each other.
             cadence = (0 if self._auto_commit_s == 0.0 else
-                       max(1, int(np.ceil(
-                           self._auto_commit_s / (budget * step_s)))))
+                       max(1,
+                           int(np.ceil(self._auto_commit_s
+                                       / (budget * step_s))),
+                           int(np.ceil(self._auto_join_s / step_s))))
             # the cadence gates COLLECTIVE commits: every process must
             # adopt the same one, not its locally-measured one
             if self.peer.size > 1:
@@ -430,10 +498,7 @@ class DistributedElasticTrainer:
                 # after step 1): measure one collective commit together
                 # now and derive at the next step
                 try:
-                    import time as _time
-                    t0 = _time.perf_counter()
-                    self._commit()
-                    self._auto_commit_s = _time.perf_counter() - t0
+                    self._measure_commit()
                 except native.NativeError as e:
                     return self._recover(global_batch, cause=e)
                 return lossv
@@ -469,11 +534,14 @@ class DistributedElasticTrainer:
         return len(jax.devices())
 
     def current_params(self):
+        self._commit_drain()  # surface the newest durable commit
         return self._host_params
 
     def shutdown(self) -> None:
         """Ordered end-of-job teardown (all members should call it)."""
+        self._drain_quietly("shutdown")
         self._teardown_plane_ordered()
+        self._committer.close()
 
     def propose_new_size(self, n: int) -> bool:
         """Rank-0 convenience: PUT a resized cluster to the config server

@@ -52,6 +52,7 @@ from ..chaos import point as _chaos_point
 from ..parallel.fsdp import FSDP_AXIS, make_fsdp_step
 from ..trace import span as _trace_span
 from ..plan.cluster import Cluster
+from . import snapshot as _kfsnap
 from .config_server import fetch_config
 from .multiproc import DistributedElasticTrainer
 
@@ -167,19 +168,36 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
     def _local_block(self, garr) -> Tuple[int, np.ndarray]:
         """This process's contiguous padded block of a sharded vector:
         (padded start offset, data)."""
-        shards = sorted(garr.addressable_shards,
-                        key=lambda s: s.index[0].start)
-        lo = shards[0].index[0].start
-        datas = []
-        at = lo
-        for s in shards:
-            assert s.index[0].start == at, (
-                "non-contiguous addressable shards: device order does not "
-                "group this process's devices; sharded elastic requires "
-                "jax.distributed's per-process-contiguous device ids")
-            datas.append(np.asarray(s.data))
-            at = s.index[0].stop
-        return int(lo), np.concatenate(datas)
+        return self._local_blocks([("_", garr)])["_"]
+
+    def _local_blocks(self, vectors) -> Dict[str, Tuple[int, np.ndarray]]:
+        """Local blocks of SEVERAL sharded vectors, with every shard's
+        device->host transfer dispatched before the first join (kfsnap:
+        the commit used to ``np.asarray`` one shard at a time, so
+        params and each optimizer vector serialised behind each other).
+        The join happens on the SAME single-device arrays the dispatch
+        touched — jax caches the host copy per array object."""
+        pending = []
+        for name, garr in vectors:
+            shards = sorted(garr.addressable_shards,
+                            key=lambda s: s.index[0].start)
+            datas = [s.data for s in shards]
+            pending.append((name, shards, datas,
+                            _kfsnap.dispatch(datas)))
+        out: Dict[str, Tuple[int, np.ndarray]] = {}
+        for name, shards, datas, pend in pending:
+            host = pend.join()
+            lo = shards[0].index[0].start
+            at = lo
+            for s in shards:
+                assert s.index[0].start == at, (
+                    "non-contiguous addressable shards: device order "
+                    "does not group this process's devices; sharded "
+                    "elastic requires jax.distributed's per-process-"
+                    "contiguous device ids")
+                at = s.index[0].stop
+            out[name] = (int(lo), np.concatenate(host))
+        return out
 
     def _commit(self, force: bool = False) -> None:
         seq = self.step_count
@@ -195,10 +213,12 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
     def _commit_inner(self, p, seq: int) -> None:
         ndev = self.num_devices()
         nproc = p.size
-        blocks: Dict[str, np.ndarray] = {}
-        for name, garr in self._global_vectors():
-            _, data = self._local_block(garr)
-            blocks[name] = data
+        # kfsnap fan-out: params + every mirroring optimizer vector
+        # dispatch their D2H together, then join — transfers overlap
+        # instead of serialising per vector/shard
+        blocks: Dict[str, np.ndarray] = {
+            name: data for name, (_, data) in
+            self._local_blocks(self._global_vectors()).items()}
         small = self._small_leaves()
         # ring replica: pull the PREDECESSOR's blocks so any single
         # failure leaves each block on a survivor (rank r's block lives
@@ -224,14 +244,22 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         # death must not count (recovery falls back to the previous one)
         _chaos_point("elastic.commit.record", rank=p.rank, step=seq,
                      version=self.version)
-        self._held[seq] = held
-        self._held_meta[seq] = (self.trained_samples, self.step_count,
-                                small, ndev, nproc, p.rank)
-        for old in sorted(self._held_meta):
-            if old < seq and len(self._held_meta) > 2:
-                self._held_meta.pop(old)
-                self._held.pop(old, None)
-        self._committed_progress = (self.trained_samples, self.step_count)
+        # the kfsnap publish window: snapshot fully on host + replicated,
+        # record not yet visible — the same site the async committer
+        # fires, so kill-during-async-commit covers both trainers
+        _chaos_point("snapshot.commit", rank=p.rank, step=seq,
+                     version=self.version)
+        with _trace_span("snapshot.publish", category="snapshot",
+                         rank=p.rank, step=seq, version=self.version):
+            self._held[seq] = held
+            self._held_meta[seq] = (self.trained_samples, self.step_count,
+                                    small, ndev, nproc, p.rank)
+            for old in sorted(self._held_meta):
+                if old < seq and len(self._held_meta) > 2:
+                    self._held_meta.pop(old)
+                    self._held.pop(old, None)
+            self._committed_progress = (self.trained_samples,
+                                        self.step_count)
 
     # ------------------------------------------------- voluntary handoff
     def _pre_teardown(self) -> None:
@@ -568,8 +596,15 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
 
                 def of(pos):
                     s, e = pos * chunk, (pos + 1) * chunk
-                    out = np.zeros(chunk, dt[name])
                     cs, ce = max(s, lo), min(e, hi)
+                    if (cs, ce) == (s, e):
+                        # fully covered: hand device_put a zero-copy
+                        # VIEW of the assembled canonical range instead
+                        # of double-buffering every interior chunk (the
+                        # kfsnap read-tier discipline; only boundary
+                        # chunks that need zero padding still copy)
+                        return canon[s - lo:e - lo]
+                    out = np.zeros(chunk, dt[name])
                     if ce > cs:
                         out[cs - s:ce - s] = canon[cs - lo:ce - lo]
                     return out

@@ -1,0 +1,292 @@
+"""kfsnap — asynchronous, pipelined, zero-copy state snapshots for the
+elastic commit path.
+
+The elastic trainers' recoverable-state commit used to be a per-leaf
+blocking ``tree_map(np.asarray, tree)``: each leaf's device->host copy
+was issued, waited for, and then handed to the store behind a defensive
+copy.  At model scale that serialises every transfer and every memcpy —
+``ELASTIC_OVERHEAD.json`` measured the 5.3 GB params+adam state of the
+470M GPT at 139.5 s (0.04 GiB/s) against a 0.697 s step, so the
+auto-cadence tuner backed ``snapshot_every`` off to ~4000 and a
+preemption replayed up to ~4000 steps.
+
+kfsnap splits the commit into pipelined phases:
+
+- **dispatch** — :func:`dispatch` calls ``copy_to_host_async()`` on
+  EVERY device buffer first, without waiting on any of them: all D2H
+  transfers overlap each other, and because dispatch is all ``step()``
+  pays, they also overlap the next dispatched training step.
+- **join** — ``np.asarray`` per leaf picks up the completed transfers
+  (jax caches the host copy the async dispatch produced; on the CPU
+  backend the "copy" is already a zero-copy view of the committed
+  buffer, so the join is free).
+- **handoff** — the host tree moves into the store by OWNERSHIP
+  TRANSFER (:meth:`kungfu_tpu.store.Store.set_owned` /
+  ``ModelStore.save_owned``): no defensive copy, and leaves above
+  ``KFT_SNAP_CHUNK_MB`` are stored as chunk *views* so multi-GB blobs
+  stream through the store/p2p plane in bounded pieces instead of as
+  single monoliths.
+- **publish** — only after the join completed does the commit record
+  (progress counters + host state) become visible.  Progress can never
+  point at a torn snapshot; the kfchaos ``snapshot.commit`` site fires
+  in exactly that window and the ``kill-during-async-commit`` scenario
+  proves a kill there recovers from the previous durable commit.
+
+:class:`AsyncCommitter` runs join+publish on a background thread with a
+one-deep pipeline (double buffering): ``step()`` initiates commit ``k``
+while commit ``k-1`` is still joining; initiating while the previous
+commit is in flight waits for it first, so at most two snapshots' worth
+of host views are ever live.
+
+Every phase is traced (kftrace spans ``snapshot.dispatch`` / ``.join``
+/ ``.handoff`` / ``.publish``), the durable-commit latency feeds the
+Prometheus summary ``kungfu_tpu_snapshot_seconds`` and the achieved
+join bandwidth the ``kungfu_tpu_snapshot_d2h_gib_s`` gauge
+(docs/monitoring.md).  ``tools/bench_snapshot.py`` tracks the
+trajectory against the legacy path and gates CI on it.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..chaos import point as _chaos_point
+from ..trace import span as _trace_span
+
+__all__ = [
+    "PendingSnapshot", "AsyncCommitter", "dispatch", "snapshot",
+    "chunk_threshold_bytes", "DEFAULT_CHUNK_MB",
+]
+
+DEFAULT_CHUNK_MB = 64
+
+
+def chunk_threshold_bytes(default_mb: float = DEFAULT_CHUNK_MB) -> int:
+    """``KFT_SNAP_CHUNK_MB`` as bytes, warn-and-fallback on malformed
+    values (the KFT_SNAPSHOT_BUDGET idiom): store leaves larger than
+    this as chunk views instead of single monolithic blobs."""
+    raw = os.environ.get("KFT_SNAP_CHUNK_MB", "")
+    try:
+        mb = float(raw) if raw else float(default_mb)
+    except ValueError:
+        print(f"kft: ignoring malformed KFT_SNAP_CHUNK_MB={raw!r}; "
+              f"using {default_mb}", file=sys.stderr)
+        mb = float(default_mb)
+    return max(1, int(mb * (1 << 20)))
+
+
+def _leaf_nbytes(leaf) -> int:
+    nb = getattr(leaf, "nbytes", None)
+    return int(nb) if nb is not None else 0
+
+
+class PendingSnapshot:
+    """A dispatched-but-not-joined device->host snapshot.
+
+    Holds references to the device arrays (they must stay alive until
+    the join — jax arrays are immutable and the trainers never donate
+    their state buffers, so the values cannot change under us).
+    ``join()`` materialises the host tree; ``join_s`` / ``nbytes`` then
+    describe the transfer for metrics.
+    """
+
+    __slots__ = ("_leaves", "_treedef", "nbytes", "dispatch_s", "join_s")
+
+    def __init__(self, leaves, treedef, nbytes: int, dispatch_s: float):
+        self._leaves = leaves
+        self._treedef = treedef
+        self.nbytes = nbytes
+        self.dispatch_s = dispatch_s
+        self.join_s: Optional[float] = None
+
+    def join(self):
+        """Wait for every transfer and return the host pytree.  On the
+        CPU backend ``np.asarray`` of a committed single-device array is
+        a zero-copy view; on accelerators it picks up the host copy the
+        dispatch already started, so N leaves cost max(transfer) rather
+        than sum(transfer)."""
+        import jax
+        t0 = time.perf_counter()
+        with _trace_span("snapshot.join", category="snapshot",
+                         attrs={"nbytes": self.nbytes}) as sp:
+            host = [np.asarray(leaf) for leaf in self._leaves]
+            self.join_s = time.perf_counter() - t0
+            if sp is not None and self.join_s > 0:
+                sp.set(gib_s=self.nbytes / self.join_s / (1 << 30))
+        # drop the device references: a joined snapshot must not pin
+        # device buffers beyond the join (the host views keep their own
+        # backing alive)
+        self._leaves = host
+        return jax.tree_util.tree_unflatten(self._treedef, host)
+
+
+def dispatch(tree) -> PendingSnapshot:
+    """Fan out ``copy_to_host_async()`` over every device leaf of
+    ``tree`` and return immediately.
+
+    This is the only part of a snapshot the training step has to pay:
+    one async enqueue per buffer.  Non-device leaves (numpy, scalars)
+    pass through untouched and cost nothing at join time either.
+    """
+    import jax
+    t0 = time.perf_counter()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    nbytes = 0
+    with _trace_span("snapshot.dispatch", category="snapshot") as sp:
+        for leaf in leaves:
+            nbytes += _leaf_nbytes(leaf)
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        if sp is not None:
+            sp.set(nbytes=nbytes, leaves=len(leaves))
+    return PendingSnapshot(leaves, treedef, nbytes,
+                           time.perf_counter() - t0)
+
+
+def snapshot(tree):
+    """Pipelined synchronous snapshot: dispatch every D2H transfer, then
+    join — the drop-in replacement for ``tree_map(np.asarray, tree)``
+    wherever the caller needs the host tree *now* (resize drains,
+    ``save_npz``).  For the step-time commit path use
+    :class:`AsyncCommitter`, which moves the join off the step thread
+    entirely."""
+    return dispatch(tree).join()
+
+
+class AsyncCommitter:
+    """Double-buffered background commit pipeline.
+
+    ``initiate(tree, publish)`` dispatches the D2H fan-out on the
+    calling thread (cheap) and hands join+publish to the committer
+    thread; ``publish(host_tree)`` runs ON THE COMMITTER THREAD once the
+    snapshot is fully on host, and is the only place a commit becomes
+    visible — it must atomically install the host state *then* the
+    progress record, so a reader never observes progress pointing at a
+    torn snapshot.  The kfchaos ``snapshot.commit`` site fires after the
+    join, immediately before publish: a SIGKILL there must leave the
+    previous durable commit as the recovery point
+    (``kill-during-async-commit`` scenario).
+
+    At most ONE commit is in flight: initiating while the previous one
+    is still joining first waits for it (bounded memory — two snapshots'
+    host views at peak).  A failed join/publish is captured and
+    re-raised on the initiating thread at the next ``initiate()`` or
+    ``drain()``; the previous published commit stands.
+    """
+
+    def __init__(self, name: str = "kfsnap-committer"):
+        self._cv = threading.Condition()
+        self._job = None  # (PendingSnapshot, publish, coords, t0)
+        self._inflight = 0
+        self._published = 0
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------- public
+    def initiate(self, tree, publish: Callable, *,
+                 rank: Optional[int] = None, step: Optional[int] = None,
+                 version: Optional[int] = None) -> float:
+        """Dispatch a snapshot of ``tree`` and queue join+publish.
+        Blocks only while the PREVIOUS commit has not finished (the
+        dispatch itself overlaps that join).  Returns the dispatch
+        duration in seconds (the blocking cost the step paid)."""
+        ps = dispatch(tree)
+        with self._cv:
+            self._raise_pending_locked()
+            while self._job is not None and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                raise RuntimeError("AsyncCommitter is closed")
+            self._job = (ps, publish, (rank, step, version),
+                         time.perf_counter())
+            self._inflight += 1
+            self._cv.notify_all()
+        return ps.dispatch_s
+
+    def drain(self) -> None:
+        """Block until every initiated commit has published (or failed).
+        Re-raises the first pipeline error and clears it — the previous
+        durable publish stands, exactly as if that commit had never been
+        initiated."""
+        with self._cv:
+            while self._inflight and self._error is None:
+                self._cv.wait()
+            self._raise_pending_locked()
+
+    @property
+    def inflight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+    @property
+    def published(self) -> int:
+        """Commits successfully published since construction."""
+        with self._cv:
+            return self._published
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Finish any in-flight commit and stop the committer thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+
+    # -------------------------------------------------------- internals
+    def _raise_pending_locked(self) -> None:
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._job is None and not self._closed:
+                    self._cv.wait()
+                if self._job is None:
+                    return  # closed and drained
+                ps, publish, (rank, step, version), t0 = self._job
+            ok = False
+            try:
+                host = ps.join()
+                # the commit becomes durable HERE: a kill before this
+                # point must leave the previous publish as the recovery
+                # point (kfchaos kill-during-async-commit)
+                _chaos_point("snapshot.commit", rank=rank, step=step,
+                             version=version)
+                with _trace_span("snapshot.publish", category="snapshot",
+                                 rank=rank, step=step, version=version,
+                                 attrs={"nbytes": ps.nbytes}):
+                    publish(host)
+                ok = True
+                self._observe(ps, time.perf_counter() - t0)
+            # deferred, not swallowed: the error is re-raised on the
+            # initiating thread at the next drain()/initiate()
+            # kfcheck: disable=silent-except
+            except BaseException as e:
+                with self._cv:
+                    self._error = e
+            finally:
+                with self._cv:
+                    self._job = None
+                    self._inflight -= 1
+                    if ok:
+                        self._published += 1
+                    self._cv.notify_all()
+
+    @staticmethod
+    def _observe(ps: PendingSnapshot, total_s: float) -> None:
+        from ..monitor import get_monitor
+        mon = get_monitor()
+        mon.observe("kungfu_tpu_snapshot_seconds", total_s)
+        if ps.join_s and ps.nbytes:
+            mon.set_gauge("kungfu_tpu_snapshot_d2h_gib_s",
+                          ps.nbytes / ps.join_s / (1 << 30))

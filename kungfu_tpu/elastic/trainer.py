@@ -33,6 +33,7 @@ from ..plan.topology import Strategy
 from ..training import build_train_step, build_train_step_with_state
 from . import state as _flags
 from .config_server import fetch_config
+from .snapshot import snapshot as _snapshot
 
 
 def _restack(host_tree, n_new: int, mesh):
@@ -168,13 +169,15 @@ class ElasticTrainer:
         with _trace_span("elastic.resize", category="elastic",
                          step=self.step_count, version=self.version,
                          attrs={"from": self.n, "to": new_size}):
-            self._host_params = jax.tree_util.tree_map(
-                lambda t: np.asarray(t), self.params)
+            # kfsnap: ONE dispatch fan-out over params + model state +
+            # optimizer state, so every device->host transfer of the
+            # pre-resize snapshot overlaps (elastic/snapshot.py)
+            self._host_params, host_mstate, host_opt = _snapshot(
+                (self.params,
+                 self.model_state if self.has_model_state else None,
+                 self.opt_state))
             if self.has_model_state:
-                self._host_mstate = jax.tree_util.tree_map(
-                    lambda t: np.asarray(t), self.model_state)
-            host_opt = jax.tree_util.tree_map(lambda t: np.asarray(t),
-                                              self.opt_state)
+                self._host_mstate = host_mstate
             self.version += 1
             _flags.bump_cluster_version()
             self._install(new_size, fresh_opt=False)
@@ -239,15 +242,17 @@ class ElasticTrainer:
         return self.trained_samples
 
     def current_params(self, lane: int = 0):
-        return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
-                                      self.params)
+        # kfsnap: dispatch every leaf's D2H before the first join,
+        # then slice the requested lane off the host views
+        return jax.tree_util.tree_map(lambda t: t[lane],
+                                      _snapshot(self.params))
 
     def current_model_state(self, lane: int = 0):
         """One lane's non-trained model state (BN running stats) for eval."""
         if not self.has_model_state:
             raise ValueError("trainer was built without model state")
-        return jax.tree_util.tree_map(lambda t: np.asarray(t)[lane],
-                                      self.model_state)
+        return jax.tree_util.tree_map(lambda t: t[lane],
+                                      _snapshot(self.model_state))
 
     # ------------------------------------------------------------ checkpoints
     def save_checkpoint(self, ckpt, force: bool = False) -> bool:
@@ -260,8 +265,8 @@ class ElasticTrainer:
         state = {
             "model": self.current_params(0),
             "opt": jax.tree_util.tree_map(
-                lambda t: np.asarray(np.asarray(t)[0]),  # 0-d stays ndarray
-                self.opt_state),
+                lambda t: np.asarray(t[0]),  # 0-d stays ndarray
+                _snapshot(self.opt_state)),
         }
         if self.has_model_state:
             state["mstate"] = self.current_model_state(0)
@@ -292,12 +297,10 @@ class ElasticTrainer:
         # invariant of _host_params if an incompatible checkpoint raises)
         self.params = params
         self.opt_state = opt_state
-        self._host_params = jax.tree_util.tree_map(
-            lambda t: np.asarray(t), self.params)
+        self._host_params = _snapshot(self.params)
         if self.has_model_state:
             self.model_state = mstate
-            self._host_mstate = jax.tree_util.tree_map(
-                lambda t: np.asarray(t), self.model_state)
+            self._host_mstate = _snapshot(self.model_state)
         if meta:
             self.trained_samples = int(meta.get("trained_samples", 0))
             self.step_count = int(meta.get("step_count", step))

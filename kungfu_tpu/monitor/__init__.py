@@ -49,6 +49,11 @@ _HELP = {
         "Cross-peer gradient variance from the monitoring optimizer.",
     "kungfu_tpu_provider_errors_total":
         "Metric provider callables that raised during a scrape.",
+    "kungfu_tpu_snapshot_seconds":
+        "Durable snapshot commit latency, kfsnap initiate->publish "
+        "(elastic/snapshot.py).",
+    "kungfu_tpu_snapshot_d2h_gib_s":
+        "Achieved device->host bandwidth of the last kfsnap join phase.",
 }
 
 

@@ -5,6 +5,21 @@ versionedstore.go:7-61 (window of 3 versions serving the p2p model
 exchange).  In the TPU framework this backs asynchronous model exchange
 between *controller processes* (multi-host pair averaging) and checkpoint
 handoff; intra-mesh exchange uses collective_permute instead.
+
+Two access tiers per blob (the kfsnap zero-copy contract,
+:mod:`kungfu_tpu.elastic.snapshot`):
+
+- **copying**: ``set``/``get`` keep the reference semantics — the store
+  owns a private copy, callers get private copies back.
+- **zero-copy**: ``set_owned`` transfers ownership of the caller's array
+  into the store (no defensive copy; the blob is marked read-only so an
+  accidental writer fails loudly), and ``get_view`` returns a read-only
+  view of the stored bytes.  A multi-GB snapshot handed over by kfsnap
+  is therefore memcpy'd zero extra times on its way to the store.
+
+``ModelStore`` additionally chunks leaves above ``KFT_SNAP_CHUNK_MB``
+(default 64 MB) so large blobs stream through the store/p2p plane in
+bounded pieces instead of as single monoliths.
 """
 from __future__ import annotations
 
@@ -27,6 +42,11 @@ class Store:
         self._lock = threading.RLock()
         self._blobs: Dict[str, np.ndarray] = {}
 
+    def _check_size(self, name: str, arr: np.ndarray) -> None:
+        old = self._blobs.get(name)
+        if old is not None and old.nbytes != arr.nbytes:
+            raise ConflictError(f"blob {name!r} size mismatch")
+
     def create(self, name: str, value) -> None:
         arr = np.asarray(value)
         with self._lock:
@@ -40,16 +60,38 @@ class Store:
     def set(self, name: str, value) -> None:
         arr = np.asarray(value)
         with self._lock:
-            old = self._blobs.get(name)
-            if old is not None and old.nbytes != arr.nbytes:
-                raise ConflictError(f"blob {name!r} size mismatch")
+            self._check_size(name, arr)
             self._blobs[name] = arr.copy()
+
+    def set_owned(self, name: str, value) -> None:
+        """Ownership-transfer set: store ``value`` WITHOUT the defensive
+        copy.  The caller hands the array over and must not mutate it
+        afterwards (kfsnap hands over joined host views of immutable
+        device buffers, where mutation is impossible anyway).  The blob
+        is marked read-only so an accidental later writer raises instead
+        of silently corrupting the committed snapshot."""
+        arr = np.asarray(value)
+        arr.setflags(write=False)
+        with self._lock:
+            self._check_size(name, arr)
+            self._blobs[name] = arr
 
     def get(self, name: str) -> np.ndarray:
         with self._lock:
             if name not in self._blobs:
                 raise KeyError(name)
             return self._blobs[name].copy()
+
+    def get_view(self, name: str) -> np.ndarray:
+        """Zero-copy read-only view of a blob (the kfsnap read tier):
+        no allocation, no memcpy — the caller sees the store's bytes and
+        cannot write through them."""
+        with self._lock:
+            if name not in self._blobs:
+                raise KeyError(name)
+            view = self._blobs[name].view()
+        view.setflags(write=False)
+        return view
 
     def exists(self, name: str) -> bool:
         with self._lock:
@@ -70,19 +112,36 @@ class VersionedStore:
         self._window = window
         self._versions: Dict[int, Store] = {}
 
+    def _slot(self, version: int) -> Store:
+        st = self._versions.get(version)
+        if st is None:
+            st = self._versions[version] = Store()
+            self._gc()
+        return st
+
     def save(self, version: int, name: str, value) -> None:
         with self._lock:
-            st = self._versions.get(version)
-            if st is None:
-                st = self._versions[version] = Store()
-                self._gc()
-            st.set(name, value)
+            self._slot(version).set(name, value)
+
+    def save_owned(self, version: int, name: str, value) -> None:
+        """Ownership-transfer save (see :meth:`Store.set_owned`)."""
+        with self._lock:
+            self._slot(version).set_owned(name, value)
 
     def get(self, version: int, name: str) -> np.ndarray:
         with self._lock:
             if version not in self._versions:
                 raise KeyError(f"version {version} evicted or absent")
             return self._versions[version].get(name)
+
+    def get_view(self, version: int, name: str) -> np.ndarray:
+        """Zero-copy read-only view (see :meth:`Store.get_view`) — the
+        read path for consumers that re-shard or stream multi-GB blobs
+        and must not double-buffer them."""
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(f"version {version} evicted or absent")
+            return self._versions[version].get_view(name)
 
     def latest_version(self) -> Optional[int]:
         with self._lock:
@@ -93,6 +152,17 @@ class VersionedStore:
             for v in sorted(self._versions, reverse=True):
                 if self._versions[v].exists(name):
                     return v, self._versions[v].get(name)
+            raise KeyError(name)
+
+    def get_latest_view(self, name: str) -> Tuple[int, np.ndarray]:
+        """Newest version holding ``name``, as a zero-copy read-only
+        view.  NOTE: the view aliases the stored bytes; it stays valid
+        even if the version is later GC'd (numpy keeps the base alive),
+        but it never sees subsequent ``set``s."""
+        with self._lock:
+            for v in sorted(self._versions, reverse=True):
+                if self._versions[v].exists(name):
+                    return v, self._versions[v].get_view(name)
             raise KeyError(name)
 
     def versions(self) -> List[int]:
@@ -106,13 +176,31 @@ class VersionedStore:
 
 class ModelStore:
     """Model-exchange facade over VersionedStore: save/request whole pytrees
-    (reference: Save/SaveVersion/Request/RequestRank, peer/p2p.go:16-35)."""
+    (reference: Save/SaveVersion/Request/RequestRank, peer/p2p.go:16-35).
+
+    ``save`` keeps copy semantics; ``save_owned`` is the kfsnap
+    zero-copy handoff.  Both pipeline the device->host transfers
+    (:func:`kungfu_tpu.elastic.snapshot.snapshot`) and chunk leaves
+    above the ``KFT_SNAP_CHUNK_MB`` threshold."""
 
     def __init__(self, window: int = DEFAULT_WINDOW):
         self._vs = VersionedStore(window)
         self._flat = Store()
 
+    # ------------------------------------------------------------- save
     def save(self, name: str, tree, version: Optional[int] = None) -> None:
+        self._save(name, tree, version, owned=False)
+
+    def save_owned(self, name: str, tree,
+                   version: Optional[int] = None) -> None:
+        """Zero-copy save: the host leaves of ``tree`` are handed to the
+        store by ownership transfer (no defensive copy) — the kfsnap
+        commit handoff.  The caller must not mutate the leaves after
+        this call."""
+        self._save(name, tree, version, owned=True)
+
+    def _save(self, name: str, tree, version: Optional[int],
+              owned: bool) -> None:
         import jax
 
         from ..chaos import point as _chaos_point
@@ -120,19 +208,49 @@ class ModelStore:
         _chaos_point("store.save", version=version)
         with _trace_span("store.save", category="store", version=version,
                          attrs={"blob": name}) as sp:
-            leaves, _ = jax.tree_util.tree_flatten(tree)
+            # pipelined D2H: every leaf's transfer is dispatched before
+            # the first is joined (no-op for host trees)
+            from ..elastic import snapshot as _kfsnap
+            host = _kfsnap.snapshot(tree)
+            leaves, _ = jax.tree_util.tree_flatten(host)
+            threshold = _kfsnap.chunk_threshold_bytes()
             nbytes = 0
-            for i, leaf in enumerate(leaves):
-                key = f"{name}/{i}"
-                arr = np.asarray(leaf)
-                nbytes += arr.nbytes
-                if version is None:
-                    self._flat.set(key, arr)
-                else:
-                    self._vs.save(version, key, arr)
+            with _trace_span("snapshot.handoff", category="snapshot",
+                             attrs={"blob": name, "owned": owned}):
+                for i, leaf in enumerate(leaves):
+                    arr = np.asarray(leaf)
+                    nbytes += arr.nbytes
+                    self._put_leaf(f"{name}/{i}", arr, version, owned,
+                                   threshold)
             if sp is not None:
                 sp.set(nbytes=nbytes)
 
+    def _put_leaf(self, key: str, arr: np.ndarray,
+                  version: Optional[int], owned: bool,
+                  threshold: int) -> None:
+        """Store one leaf, as chunk views above the size threshold so a
+        multi-GB blob streams in bounded pieces.  Chunks of an owned
+        save are views into the caller's array — still zero-copy."""
+        def put(k: str, a: np.ndarray) -> None:
+            if version is None:
+                (self._flat.set_owned if owned else self._flat.set)(k, a)
+            else:
+                (self._vs.save_owned if owned
+                 else self._vs.save)(version, k, a)
+
+        if arr.nbytes > threshold and arr.size > 1:
+            flat = (arr.reshape(-1) if arr.flags["C_CONTIGUOUS"]
+                    else np.ravel(arr))
+            per = max(1, threshold // max(1, arr.dtype.itemsize))
+            nchunks = -(-arr.size // per)
+            put(f"{key}.meta",
+                np.asarray([nchunks, per] + list(arr.shape), np.int64))
+            for j in range(nchunks):
+                put(f"{key}.c{j}", flat[j * per:(j + 1) * per])
+        else:
+            put(key, arr)
+
+    # ---------------------------------------------------------- request
     def request(self, name: str, template, version: Optional[int] = None):
         import jax
 
@@ -145,11 +263,38 @@ class ModelStore:
             out = []
             nbytes = 0
             for i, leaf in enumerate(leaves):
-                key = f"{name}/{i}"
-                arr = (self._flat.get(key) if version is None
-                       else self._vs.get(version, key))
+                arr = self._get_leaf(f"{name}/{i}", version)
                 nbytes += arr.nbytes
-                out.append(arr.reshape(np.asarray(leaf).shape))
+                # the template contributes SHAPE only: read it off the
+                # leaf directly — np.asarray(leaf) here would D2H the
+                # whole model when the template is a live jax tree
+                shape = getattr(leaf, "shape", None)
+                if shape is None:
+                    shape = np.shape(leaf)
+                out.append(arr.reshape(shape))
             if sp is not None:
                 sp.set(nbytes=nbytes)
             return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _get_leaf(self, key: str, version: Optional[int]) -> np.ndarray:
+        """One leaf back out of the store, reassembling chunked blobs.
+        Chunks are read through the zero-copy view tier, so reassembly
+        costs exactly one copy (view -> output), not two."""
+        get = (self._flat.get if version is None
+               else lambda k: self._vs.get(version, k))
+        get_view = (self._flat.get_view if version is None
+                    else lambda k: self._vs.get_view(version, k))
+        try:
+            return get(key)
+        except KeyError:
+            meta = get_view(f"{key}.meta")
+        nchunks = int(meta[0])
+        shape = tuple(int(x) for x in meta[2:])
+        first = get_view(f"{key}.c0")
+        out = np.empty(int(np.prod(shape, dtype=np.int64)), first.dtype)
+        at = 0
+        for j in range(nchunks):
+            c = first if j == 0 else get_view(f"{key}.c{j}")
+            out[at:at + c.size] = c
+            at += c.size
+        return out.reshape(shape)

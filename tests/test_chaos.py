@@ -300,6 +300,38 @@ def test_trajectory_checker():
     assert len(out) == 1 and "oracle" in out[0]
 
 
+def test_sync_from_committed_checker():
+    """kfsnap publish contract: a recovery restore must land EXACTLY on
+    a commit some worker recorded — never on a snapshot that was
+    dispatched/joined but not published (kill-during-async-commit)."""
+    ok = [_ev("commit", samples=8, step=1),
+          _ev("sync", stream="w1", samples=8, step=1, size=1, version=2)]
+    assert invariants.check_sync_from_committed(ok) == []
+    # commit events may arrive (be collected) AFTER the sync that used
+    # them — the async committer publishes on its own thread
+    late = [_ev("sync", samples=8, step=1), _ev("commit", samples=8, step=1)]
+    assert invariants.check_sync_from_committed(late) == []
+    # a zero-progress sync (fresh joiner on the seq-0 snapshot) is fine
+    assert invariants.check_sync_from_committed(
+        [_ev("sync", samples=0, step=0)]) == []
+    torn = [_ev("commit", samples=8, step=1),
+            _ev("sync", stream="w1", samples=16, step=2)]
+    out = invariants.check_sync_from_committed(torn)
+    assert len(out) == 1 and "torn/unpublished" in out[0]
+
+
+def test_snapshot_commit_site_registered():
+    """The kfsnap publish window is an armable site: plans targeting it
+    validate, and the async-commit scenario is in the matrix."""
+    from kungfu_tpu.chaos.sites import SITES, validate_site
+    validate_site("snapshot.commit")
+    assert "publish" in SITES["snapshot.commit"]
+    m = runner.scenarios()
+    sc = m["kill-during-async-commit"]
+    assert sc.plan.faults[0].site == "snapshot.commit"
+    assert sc.plan.faults[0].action == "kill"
+
+
 def test_no_orphans_checker():
     gone = subprocess.Popen([sys.executable, "-c", "pass"])
     gone.wait()

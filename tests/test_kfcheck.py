@@ -168,6 +168,49 @@ def test_host_sync_negative_outside_loop_or_cold_fn(tmp_path):
     assert rules_fired(fs) == set()
 
 
+def test_host_sync_tree_map_on_commit_path(tmp_path):
+    """The kfsnap bug class: whole-tree per-leaf D2H on a step/commit
+    path — direct callable, lambda wrapper, and device_get all flagged,
+    and the message points at the kfsnap replacement."""
+    fs = run_on(tmp_path, """
+        import jax
+        import numpy as np
+
+        def _commit(self):
+            self._host = jax.tree_util.tree_map(np.asarray, self._params)
+
+        def resize(self):
+            h = jax.tree_util.tree_map(lambda t: np.asarray(t),
+                                       self.params)
+
+        def sync_state(self):
+            return jax.tree_util.tree_map(jax.device_get, self.opt)
+    """)
+    assert rules_fired(fs) == {"host-sync-in-hot-path"}
+    assert len(fs) == 3
+    assert all("elastic.snapshot" in f.message for f in fs)
+
+
+def test_host_sync_tree_map_cold_path_ok(tmp_path):
+    """A one-time init/broadcast helper may materialise the whole tree;
+    only step/commit-path function names are in scope."""
+    fs = run_on(tmp_path, """
+        import jax
+        import numpy as np
+
+        def _init_state(self, init_params):
+            self._host = jax.tree_util.tree_map(np.asarray, init_params)
+
+        def broadcast_host_tree(tree):
+            return jax.tree_util.tree_map(np.asarray, tree)
+
+        def _commit(self):
+            # tree_map without a sync callable is fine
+            return jax.tree_util.tree_map(lambda t: t * 2, self.params)
+    """)
+    assert rules_fired(fs) == set()
+
+
 # ------------------------------------------------------------ silent-except
 def test_silent_except_positive_scoped_dirs(tmp_path):
     src = """

@@ -1,0 +1,353 @@
+"""kfsnap (kungfu_tpu/elastic/snapshot.py): the async, pipelined,
+zero-copy snapshot/commit engine behind the elastic trainers' commit
+path — dispatch/join semantics, the background committer's publish
+contract (progress never points at a torn snapshot), and the store's
+ownership-transfer + chunking tiers.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.elastic import snapshot as kfsnap
+from kungfu_tpu.store import ModelStore, Store, VersionedStore
+
+
+class FakeDeviceLeaf:
+    """A device-array stand-in whose transfer cost is explicit: dispatch
+    must call ``copy_to_host_async`` (cheap), and only the join may
+    materialise (``__array__``, configurable delay/failure) — the
+    deterministic way to assert 'step() no longer blocks on D2H'."""
+
+    def __init__(self, value, join_delay=0.0, fail=False):
+        self.value = np.asarray(value)
+        self.join_delay = join_delay
+        self.fail = fail
+        self.dispatched = 0
+        self.materialised = 0
+        self.shape = self.value.shape
+        self.dtype = self.value.dtype
+        self.nbytes = self.value.nbytes
+
+    def copy_to_host_async(self):
+        self.dispatched += 1
+
+    def __array__(self, dtype=None, copy=None):
+        self.materialised += 1
+        if self.fail:
+            raise RuntimeError("injected join failure")
+        if self.join_delay:
+            time.sleep(self.join_delay)
+        return self.value
+
+
+# --------------------------------------------------------- dispatch/join
+def test_snapshot_bit_identical_to_sync_path():
+    tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "nested": (jnp.ones((2, 2), jnp.bfloat16),
+                       [jnp.asarray(7, jnp.int32), np.arange(3.0)]),
+            "scalar": 2.5,
+            "none": None}
+    got = kfsnap.snapshot(tree)
+    ref = jax.tree_util.tree_map(np.asarray, tree)
+    ga, ra = jax.tree_util.tree_flatten(got), jax.tree_util.tree_flatten(ref)
+    assert ga[1] == ra[1]  # structure preserved
+    for a, b in zip(ga[0], ra[0]):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b)
+
+
+def test_dispatch_fans_out_without_materialising():
+    """The acceptance bound: dispatch touches every leaf's async-copy
+    hook and materialises NOTHING — all the waiting happens at join."""
+    leaves = [FakeDeviceLeaf(np.full(64, i), join_delay=0.02)
+              for i in range(4)]
+    tree = {"l": leaves}
+    t0 = time.perf_counter()
+    pend = kfsnap.dispatch(tree)
+    dispatch_s = time.perf_counter() - t0
+    assert all(l.dispatched == 1 for l in leaves)
+    assert all(l.materialised == 0 for l in leaves)
+    t0 = time.perf_counter()
+    host = pend.join()
+    join_s = time.perf_counter() - t0
+    assert all(l.materialised == 1 for l in leaves)
+    # dispatch must be far cheaper than the join it overlaps with
+    assert dispatch_s < join_s / 4, (dispatch_s, join_s)
+    assert pend.nbytes == sum(l.nbytes for l in leaves)
+    for i, arr in enumerate(host["l"]):
+        assert np.array_equal(arr, np.full(64, i))
+
+
+# ----------------------------------------------------------- committer
+def test_committer_initiate_returns_before_publish():
+    """step() only *initiates*: with a slow join, initiate() must hand
+    back control while the commit is still in flight; drain() then
+    observes the publish."""
+    cm = kfsnap.AsyncCommitter()
+    try:
+        leaf = FakeDeviceLeaf(np.arange(8), join_delay=0.15)
+        published = []
+        t0 = time.perf_counter()
+        cm.initiate({"p": leaf}, lambda h: published.append(h))
+        initiate_s = time.perf_counter() - t0
+        assert initiate_s < 0.1, initiate_s
+        assert published == []  # still joining
+        cm.drain()
+        assert len(published) == 1
+        assert np.array_equal(published[0]["p"], np.arange(8))
+        assert cm.published == 1 and cm.inflight == 0
+    finally:
+        cm.close()
+
+
+def test_committer_single_inflight_publishes_in_order():
+    cm = kfsnap.AsyncCommitter()
+    try:
+        order = []
+        for i in range(4):
+            leaf = FakeDeviceLeaf(np.full(4, i), join_delay=0.02)
+            cm.initiate({"p": leaf}, lambda h, i=i: order.append(i))
+        cm.drain()
+        assert order == [0, 1, 2, 3]
+    finally:
+        cm.close()
+
+
+def test_committer_failed_join_reraises_and_recovers():
+    """A failed in-flight commit surfaces on the initiating thread at
+    drain(), and the pipeline keeps working afterwards — the previous
+    published commit stands (the recovery contract)."""
+    cm = kfsnap.AsyncCommitter()
+    try:
+        published = []
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(4))},
+                    lambda h: published.append("ok1"))
+        cm.drain()
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(4), fail=True)},
+                    lambda h: published.append("bad"))
+        with pytest.raises(RuntimeError, match="injected join failure"):
+            cm.drain()
+        # error cleared; pipeline usable again
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(4))},
+                    lambda h: published.append("ok2"))
+        cm.drain()
+        assert published == ["ok1", "ok2"]
+        assert cm.published == 2
+    finally:
+        cm.close()
+
+
+def test_committer_publish_is_atomic_state_then_progress():
+    """The publish callback pattern the trainers use: host state is
+    installed before the progress record, so a concurrent reader never
+    sees progress pointing at a torn snapshot."""
+    cm = kfsnap.AsyncCommitter()
+    state = {"host": None, "progress": (0, 0)}
+    seen = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            prog = state["progress"]
+            host = state["host"]
+            if prog != (0, 0):
+                seen.append(host is not None)
+        return None
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        def publish(host):
+            state["host"] = host
+            state["progress"] = (8, 1)
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(16), join_delay=0.05)},
+                    publish)
+        cm.drain()
+        time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        cm.close()
+    assert state["progress"] == (8, 1)
+    assert seen and all(seen)  # progress visible => state visible
+
+
+def test_committer_close_rejects_new_work():
+    cm = kfsnap.AsyncCommitter()
+    cm.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        cm.initiate({"p": np.ones(2)}, lambda h: None)
+
+
+def test_committer_metrics_published():
+    from kungfu_tpu.monitor import get_monitor
+    cm = kfsnap.AsyncCommitter()
+    try:
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(1024, np.float32),
+                                         join_delay=0.01)},
+                    lambda h: None)
+        cm.drain()
+    finally:
+        cm.close()
+    summ = get_monitor().summary("kungfu_tpu_snapshot_seconds")
+    assert summ is not None and summ.count >= 1
+    body = get_monitor().render_metrics()
+    assert "kungfu_tpu_snapshot_d2h_gib_s" in body
+
+
+def test_committer_traces_phases():
+    from kungfu_tpu import trace as kftrace
+    kftrace.arm()
+    try:
+        cm = kfsnap.AsyncCommitter()
+        cm.initiate({"p": FakeDeviceLeaf(np.ones(8))}, lambda h: None,
+                    rank=3, step=7, version=2)
+        cm.drain()
+        cm.close()
+        names = [e["name"] for e in kftrace.tail()
+                 if e["cat"] == "snapshot"]
+        assert "snapshot.dispatch" in names
+        assert "snapshot.join" in names
+        assert "snapshot.publish" in names
+        pub = [e for e in kftrace.tail()
+               if e["name"] == "snapshot.publish"][-1]
+        assert pub["rank"] == 3 and pub["step"] == 7
+    finally:
+        kftrace.disarm()
+
+
+# ------------------------------------------------------- store handoff
+def test_store_owned_tier_is_zero_copy_and_readonly():
+    s = Store()
+    a = np.arange(16, dtype=np.float32)
+    s.set_owned("x", a)
+    view = s.get_view("x")
+    assert np.shares_memory(view, a)
+    assert not view.flags.writeable
+    with pytest.raises(ValueError):
+        view[0] = 1.0
+    # the copying tier still hands out private copies
+    got = s.get("x")
+    got[0] = 99.0
+    assert s.get_view("x")[0] == 0.0
+    # set() never aliases the caller's array
+    b = np.arange(16, dtype=np.float32)
+    s.set("y", b)
+    assert not np.shares_memory(s.get_view("y"), b)
+
+
+def test_versioned_store_view_paths():
+    vs = VersionedStore(window=2)
+    a = np.full(4, 7.0)
+    vs.save_owned(1, "m", a)
+    vs.save(2, "m", np.full(4, 8.0))
+    assert np.shares_memory(vs.get_view(1, "m"), a)
+    v, latest = vs.get_latest_view("m")
+    assert v == 2 and latest[0] == 8.0 and not latest.flags.writeable
+    # copying getters unchanged
+    assert vs.get(1, "m")[0] == 7.0
+    with pytest.raises(KeyError):
+        vs.get_view(9, "m")
+
+
+def test_model_store_save_owned_chunks_large_leaves(monkeypatch):
+    monkeypatch.setenv("KFT_SNAP_CHUNK_MB", "0.001")  # ~1 KiB threshold
+    ms = ModelStore()
+    big = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    tree = {"big": big, "small": np.ones(3, np.float32)}
+    ms.save_owned("m", tree, version=1)
+    names = ms._vs._versions[1].names()
+    assert "m/0.meta" in names and "m/0.c0" in names
+    assert "m/1" in names  # the small leaf stayed whole
+    # zero-copy: a stored chunk aliases the caller's array
+    assert np.shares_memory(ms._vs.get_view(1, "m/0.c0"), big)
+    got = ms.request("m", tree, version=1)
+    assert got["big"].dtype == big.dtype
+    assert np.array_equal(got["big"], big)
+    assert np.array_equal(got["small"], tree["small"])
+
+
+def test_model_store_save_copies_but_still_chunks(monkeypatch):
+    monkeypatch.setenv("KFT_SNAP_CHUNK_MB", "0.001")
+    ms = ModelStore()
+    big = np.arange(2048, dtype=np.float32)
+    ms.save("m", {"b": big}, version=3)
+    assert not np.shares_memory(ms._vs.get_view(3, "m/0.c0"), big)
+    got = ms.request("m", {"b": big}, version=3)
+    assert np.array_equal(got["b"], big)
+
+
+def test_model_store_request_template_never_materialised():
+    """Satellite regression: the template contributes SHAPE only — a
+    live device tree as template must not be transferred to host."""
+
+    class TemplateLeaf:
+        shape = (8, 4)
+        dtype = np.float32
+
+        def __array__(self, dtype=None, copy=None):
+            raise AssertionError("template leaf was materialised (D2H)")
+
+    ms = ModelStore()
+    data = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ms.save("m", {"w": data}, version=1)
+    got = ms.request("m", {"w": TemplateLeaf()}, version=1)
+    assert np.array_equal(got["w"], data)
+
+
+def test_chunk_threshold_env_warn_and_fallback(monkeypatch, capsys):
+    monkeypatch.setenv("KFT_SNAP_CHUNK_MB", "not-a-number")
+    assert kfsnap.chunk_threshold_bytes() == \
+        kfsnap.DEFAULT_CHUNK_MB * (1 << 20)
+    assert "KFT_SNAP_CHUNK_MB" in capsys.readouterr().err
+    monkeypatch.setenv("KFT_SNAP_CHUNK_MB", "2")
+    assert kfsnap.chunk_threshold_bytes() == 2 * (1 << 20)
+
+
+# ------------------------------------------------- trainer integration
+def test_elastic_trainer_resize_through_kfsnap(devices):
+    """The in-process trainer's resize snapshots through kfsnap: the
+    whole 8->4->8 round-trip must keep the trajectory intact (values
+    identical to what the device state held before the resize)."""
+    import optax
+
+    from kungfu_tpu.elastic import ElasticTrainer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 2).astype(np.float32)
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((bx @ p["w"] - by) ** 2)
+
+    tr = ElasticTrainer(loss_fn, lambda n: optax.sgd(0.05),
+                        {"w": np.zeros((8, 2), np.float32)}, init_size=8)
+    for _ in range(3):
+        tr.step((X, Y))
+    before = tr.current_params(0)
+    tr.resize(4)
+    after = tr.current_params(0)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tr.resize(8)
+    tr.step((X, Y))  # still trains at the regrown size
+
+
+def test_save_npz_roundtrip_through_kfsnap(tmp_path):
+    from kungfu_tpu.checkpoint import load_npz, restore_npz_like, save_npz
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": {"c": jnp.ones(4, jnp.int32)}}
+    path = str(tmp_path / "state.npz")
+    save_npz(path, tree)
+    back = restore_npz_like(tree, load_npz(path))
+    for a, b in zip(jax.tree_util.tree_leaves(back),
+                    jax.tree_util.tree_leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -48,6 +48,12 @@ python -m tools.kfcheck || exit 1
 say "0b/3 metrics + trace smoke"
 python tools/metrics_trace_smoke.py || exit 1
 
+# kfsnap micro-bench smoke: the async zero-copy commit path must hold
+# >= 3x the legacy per-leaf path's end-to-end throughput with a
+# bit-identical restore (~5 s; docs/elastic.md "Async commit pipeline")
+say "0c/3 kfsnap snapshot micro-bench"
+python tools/bench_snapshot.py --smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

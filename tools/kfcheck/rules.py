@@ -198,11 +198,18 @@ class TraceImpurity(Rule):
 class HostSyncInHotPath(Rule):
     name = "host-sync-in-hot-path"
     doc = ("device->host sync inside a training/serving step loop — every "
-           "iteration stalls the XLA pipeline to materialize a host value")
+           "iteration stalls the XLA pipeline to materialize a host value; "
+           "also flags whole-tree tree_map(np.asarray|jax.device_get, ...) "
+           "on step/commit/resize paths (use kungfu_tpu.elastic.snapshot)")
 
     HOT_FN = re.compile(r"train|serv|decode|fit|run_steps|epoch",
                         re.IGNORECASE)
+    # step/commit-path functions where a serial per-leaf tree_map D2H is
+    # the kfsnap bug class (ELASTIC_OVERHEAD.json: 139 s for 5.3 GB)
+    COMMIT_FN = re.compile(r"step|commit|snapshot|resize|sync",
+                           re.IGNORECASE)
     SYNCS = {"device_get", "block_until_ready"}
+    TREE_SYNCS = {"asarray", "device_get"}
     ARRAYISH = re.compile(r"loss|grad|logit|prob|acc|metric|output",
                           re.IGNORECASE)
 
@@ -211,10 +218,47 @@ class HostSyncInHotPath(Rule):
             node = node.value
         return node.id if isinstance(node, ast.Name) else ""
 
+    def _tree_map_sync(self, call: ast.Call) -> Optional[str]:
+        """The dotted sync name when ``call`` is a
+        ``tree_map(np.asarray, ...)`` / ``tree_map(jax.device_get, ...)``
+        (directly or wrapped in a lambda), else None."""
+        if tail(call_name(call)) != "tree_map" or not call.args:
+            return None
+        f = call.args[0]
+        if isinstance(f, ast.Lambda):
+            for sub in ast.walk(f):
+                if isinstance(sub, ast.Call) and \
+                        tail(call_name(sub)) in self.TREE_SYNCS:
+                    return call_name(sub)
+            return None
+        nm = dotted(f)
+        return nm if tail(nm) in self.TREE_SYNCS else None
+
+    def _check_tree_maps(self, mod: Module, fn: ast.AST,
+                         seen: set) -> Iterator[Finding]:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or id(sub) in seen:
+                continue
+            nm = self._tree_map_sync(sub)
+            if nm:
+                seen.add(id(sub))
+                yield mod.finding(
+                    self.name, sub,
+                    f"`tree_map({nm}, ...)` in `{fn.name}`: a serial "
+                    f"per-leaf device->host copy on a step/commit path "
+                    f"— route it through kungfu_tpu.elastic.snapshot "
+                    f"(kfsnap dispatches every copy_to_host_async "
+                    f"first, then joins; AsyncCommitter moves the join "
+                    f"off the step thread)")
+
     def check(self, mod: Module) -> Iterator[Finding]:
+        seen: set = set()  # a call inside nested matching defs fires once
         for fn in ast.walk(mod.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    or not self.HOT_FN.search(fn.name):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if self.COMMIT_FN.search(fn.name) or self.HOT_FN.search(fn.name):
+                yield from self._check_tree_maps(mod, fn, seen)
+            if not self.HOT_FN.search(fn.name):
                 continue
             for loop in ast.walk(fn):
                 if not isinstance(loop, (ast.For, ast.While)):
