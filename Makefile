@@ -9,11 +9,15 @@
 lint:
 	python -m tools.kfcheck
 
-# kfchaos tier-1 scenario: SIGKILL a rank inside the collective commit,
-# then assert every elastic contract (docs/chaos.md).  Self-skips on
-# images whose jax cannot run the multiprocess data plane.
+# kfchaos tier-1 scenarios: SIGKILL a rank inside the collective commit,
+# then SIGKILL+restart the WAL-backed config server mid-resize (kfguard;
+# --replay-check runs it twice and requires identical fault journals),
+# asserting every elastic contract each time (docs/chaos.md).  Self-skips
+# on images whose jax cannot run the multiprocess data plane.
 chaos-smoke: native
 	python -m kungfu_tpu.chaos.runner --scenario smoke
+	python -m kungfu_tpu.chaos.runner \
+	    --scenario config-server-crash-restart-mid-resize --replay-check
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

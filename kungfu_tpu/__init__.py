@@ -183,6 +183,9 @@ def propose_new_size(new_size: int) -> bool:
 
     from .elastic import config_server as _cs
     try:
+        # routed through the kfguard rpc layer (utils/rpc.py): breaker,
+        # classification, epoch check — every failure class lands in
+        # the OSError family caught below
         version, cluster = _cs.fetch_config(url)
         resized = cluster.resize(int(new_size))
         # CAS on the fetched version: a concurrent proposal (409) loses

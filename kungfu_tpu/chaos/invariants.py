@@ -172,6 +172,40 @@ def check_sync_from_committed(events: Sequence[Event]) -> List[str]:
     return bad
 
 
+def check_version_monotonic_across_epochs(events: Sequence[Event]
+                                          ) -> List[str]:
+    """The config server's version counter — the fencing token every
+    worker carries — never regresses within one server epoch (kfguard).
+
+    ``config`` events are observations of the server's
+    ``(epoch, version)`` over time (the crash-restart scenarios' runner
+    samples GET /config into the event stream).  A WAL-backed server
+    that crashes and restarts replays its log: same epoch, version
+    strictly continues — no violation.  A server that genuinely lost
+    state must SAY so by changing epoch; a version that shrinks under
+    an unchanged epoch (including the legacy no-epoch ``None`` ==
+    ``None`` case — the reborn-version-0 server this invariant exists
+    to catch) is a fencing-token regression: in-flight resizes now
+    fence against the wrong counter."""
+    bad = []
+    last: Dict[str, tuple] = {}
+    for e in events:
+        if e.get("kind") != "config":
+            continue
+        key = str(e.get("stream", "?"))
+        ep, v = e.get("epoch"), int(e["version"])
+        prev = last.get(key)
+        if prev is not None:
+            pep, pv = prev
+            if ep == pep and v < pv:
+                bad.append(
+                    f"{key}: config version regressed {pv} -> {v} "
+                    f"within epoch {ep!r}: the server lost its fencing "
+                    f"counter without declaring a new epoch")
+        last[key] = (ep, v)
+    return bad
+
+
 def check_trajectory(events: Sequence[Event], oracle_wsum,
                      rtol: float = 1e-4) -> List[str]:
     """Final parameters match the no-fault oracle trajectory for the
@@ -201,6 +235,7 @@ def run_all(events: Sequence[Event], pids: Sequence[int] = (),
     bad += check_no_fresh_start(events, init_wsum=init_wsum)
     bad += check_sync_from_committed(events)
     bad += check_single_winner(events)
+    bad += check_version_monotonic_across_epochs(events)
     bad += check_no_orphans(pids, marker=pid_marker)
     if oracle_wsum is not None:
         bad += check_trajectory(events, oracle_wsum)

@@ -34,6 +34,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import invariants
@@ -86,7 +87,10 @@ try:
     tr = ShardedElasticTrainer(loss_fn, optax.adam(0.05),
                                {"w": np.zeros((16, 4), np.float32),
                                 "b": np.zeros((4,), np.float32)},
-                               snapshot_every=SNAP)
+                               snapshot_every=SNAP,
+                               recover_timeout=float(
+                                   os.environ.get("KFT_CHAOS_RECOVER_S",
+                                                  "60")))
 except Exception as e:
     # a joiner whose first collective was torn up by an injected death
     # exits with a preemption-class code: the watcher absorbs it as a
@@ -234,6 +238,20 @@ class Scenario:
     # chaos-smoke`) never collide on the parent port
     parent_port: Optional[int] = None
     timeout_s: float = 300.0
+    # kfguard crash-restart scenarios: "inproc" (default) embeds the
+    # config server in the runner; "wal"/"legacy" run it as a
+    # SUBPROCESS (`python -m kungfu_tpu.elastic.config_server`) so the
+    # runner can SIGKILL + restart it mid-scenario — "wal" restarts
+    # from a -state-dir (version/epoch continue), "legacy" restarts
+    # empty and is naively re-seeded (the reborn-counter failure mode)
+    server: str = "inproc"
+    # SIGKILL the subprocess server once this config version is
+    # observed (mid-resize when it equals the proposal's version)
+    restart_at_version: Optional[int] = None
+    # regex that MUST match at least one invariant violation — the
+    # scenario DEMONSTRATES a failure mode; matching violations count
+    # as the expected outcome, not errors
+    expect_violation: Optional[str] = None
 
 
 def scenarios() -> Dict[str, Scenario]:
@@ -268,6 +286,39 @@ def scenarios() -> Dict[str, Scenario]:
                  "commit with the trajectory oracle intact",
             plan=Plan(seed=None).add("snapshot.commit", "kill",
                                      rank=1, step=6)),
+        Scenario(
+            name="config-server-crash-restart-mid-resize",
+            desc="SIGKILL the WAL-backed config server the moment a "
+                 "shrink proposal lands (version 2), restart it from "
+                 "its -state-dir: the version counter and epoch must "
+                 "STRICTLY CONTINUE (check_version_monotonic_across_"
+                 "epochs), the resize completes, no fresh start, one "
+                 "winner.  A few client fetches are also dropped so "
+                 "the kfguard retry path is exercised on the same run",
+            plan=Plan(seed=None).add("config.fetch", "drop-rpc",
+                                     count=4),
+            propose=((4, 1),),
+            target_steps=16,
+            timeout_s=420.0,
+            server="wal",
+            restart_at_version=2),
+        Scenario(
+            name="config-server-crash-restart-legacy",
+            desc="the SAME crash+restart against the legacy in-memory "
+                 "server (naively re-seeded by the operator): the "
+                 "reborn version counter regresses under an unchanged "
+                 "(absent) epoch — check_version_monotonic_across_"
+                 "epochs must TRIP, demonstrating why the WAL + epoch "
+                 "exist.  Training itself still completes: survivors "
+                 "ignore the stale low versions",
+            plan=Plan(seed=None).add("config.fetch", "drop-rpc",
+                                     count=4),
+            propose=((4, 1),),
+            target_steps=16,
+            timeout_s=420.0,
+            server="legacy",
+            restart_at_version=2,
+            expect_violation="regressed .* within epoch"),
         Scenario(
             name="config-outage-mid-resize",
             desc="config server unreachable (drop-rpc on every fetch) "
@@ -371,6 +422,167 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class _SubprocessConfigServer:
+    """A config server the runner can SIGKILL and restart — the fault
+    the crash-restart scenarios schedule.  Spawned with a CLEAN env
+    (no ``KFT_CHAOS_*``): the restart orchestration IS the fault; the
+    server process itself stays unarmed so replay-check journals only
+    contain worker-side fires."""
+
+    def __init__(self, port: int, state_dir: Optional[str] = None,
+                 legacy: bool = False):
+        self.port = port
+        self.state_dir = state_dir
+        self.legacy = legacy
+        self.proc = None
+        self.url = f"http://127.0.0.1:{port}/config"
+
+    def _cmd(self) -> List[str]:
+        cmd = [sys.executable, "-m", "kungfu_tpu.elastic.config_server",
+               "-port", str(self.port), "-host", "127.0.0.1"]
+        if self.state_dir:
+            cmd += ["-state-dir", self.state_dir]
+        if self.legacy:
+            cmd += ["-legacy"]
+        return cmd
+
+    def spawn(self, wait_s: float = 90.0) -> None:
+        import subprocess
+        import time
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("KFT_CHAOS", "KFT_TRACE"))}
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(self._cmd(), env=env,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if _raw_get(self.url) is not None:
+                return
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"config server subprocess died rc="
+                    f"{self.proc.returncode} before serving")
+            time.sleep(0.1)
+        raise RuntimeError(f"config server on :{self.port} not up "
+                           f"after {wait_s}s")
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait()
+
+
+def _raw_get(url: str, timeout: float = 1.0) -> Optional[dict]:
+    """GET a config body WITHOUT the kfguard client: the observer must
+    see (and record) exactly what the server says — including the
+    regressions the epoch-aware client would refuse.  A 404 still
+    yields its body (version + epoch ride the error payload)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return _json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return _json.loads(e.read().decode())
+        except ValueError:
+            return None
+    except (OSError, ValueError):
+        return None
+
+
+def _raw_put(url: str, cluster_json: dict, timeout: float = 5.0) -> None:
+    import json as _json
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=_json.dumps(cluster_json).encode(), method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        r.read()
+
+
+class _CrashRestartOrchestrator(threading.Thread):
+    """Samples the server's (epoch, version) into the scenario event
+    stream (kind="config", stream="config-server") and performs the
+    scheduled SIGKILL + restart once ``restart_at_version`` is
+    observed.  For the legacy server it then re-seeds the config the
+    way a naive operator would — replaying every cluster it saw, in
+    version order — which restarts the version counter at 1: the
+    regression ``check_version_monotonic_across_epochs`` exists to
+    catch."""
+
+    def __init__(self, sc: Scenario, srv: _SubprocessConfigServer,
+                 out_dir: str):
+        super().__init__(daemon=True, name=f"kfchaos-observer-{sc.name}")
+        self.sc = sc
+        self.srv = srv
+        self.path = os.path.join(out_dir, "events.config-server.jsonl")
+        self.stop_event = threading.Event()
+        self.restarted = False
+        self._seen_clusters: List[Tuple[int, dict]] = []
+        self._last = None
+
+    def _emit(self, kind: str, **kw) -> None:
+        kw.update(kind=kind, stream="config-server")
+        with open(self.path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+
+    def _observe(self) -> Optional[dict]:
+        d = _raw_get(self.srv.url)
+        if d is None or "version" not in d:
+            return None
+        pair = (d.get("epoch"), int(d["version"]))
+        if pair != self._last:
+            self._last = pair
+            self._emit("config", epoch=pair[0], version=pair[1])
+        if "cluster" in d and not any(v == d["version"]
+                                      for v, _ in self._seen_clusters):
+            self._seen_clusters.append((int(d["version"]), d["cluster"]))
+        return d
+
+    def run(self) -> None:
+        import time
+        while not self.stop_event.is_set():
+            d = self._observe()
+            if (d is not None and not self.restarted
+                    and self.sc.restart_at_version is not None
+                    and int(d.get("version", 0))
+                    >= self.sc.restart_at_version):
+                self.restarted = True
+                self._emit("server_restart", phase="kill",
+                           at_version=int(d["version"]))
+                self.srv.kill()
+                self.srv.spawn()
+                if self.sc.server == "legacy":
+                    # the naive operator re-seed: replay every cluster
+                    # in version order; each PUT lands at a REBORN
+                    # version counter (1, 2, ...) — observed between
+                    # PUTs so the regression is deterministic
+                    for _, cj in sorted(self._seen_clusters):
+                        try:
+                            _raw_put(self.srv.url, cj)
+                        except OSError as e:
+                            self._emit("reseed_failed", error=repr(e))
+                            break
+                        self._observe()
+                self._emit("server_restart", phase="up")
+            self.stop_event.wait(0.05)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.join(timeout=10)
+
+
 def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                  verbose: bool = True) -> ScenarioResult:
     """Execute one scenario end-to-end and check every invariant."""
@@ -408,6 +620,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         "KFT_RECV_TIMEOUT_S": "3",
         "KFT_CONN_RETRIES": "10",
     }
+    if sc.server != "inproc":
+        # a subprocess server restart pays a full interpreter + jax
+        # import before it serves again; survivors must out-wait it
+        env["KFT_CHAOS_RECOVER_S"] = "180"
     target = sc.target_steps * sc.batch
     if verbose:
         print(f"kfchaos: scenario {sc.name}: {sc.nprocs} procs x "
@@ -417,18 +633,43 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
     cluster = Cluster.from_hostlist(
         HostList.parse(f"127.0.0.1:{sc.nprocs}"), sc.nprocs)
     parent_port = sc.parent_port if sc.parent_port else _free_port()
-    srv = ConfigServer().start()
+    srv = sub = observer = None
+    if sc.server == "inproc":
+        srv = ConfigServer().start()
+        url = srv.url
+    else:
+        # kfguard crash-restart harness: the server lives in its OWN
+        # process so the runner can SIGKILL it mid-resize
+        state_dir = (os.path.join(out_dir, "config-state")
+                     if sc.server == "wal" else None)
+        sub = _SubprocessConfigServer(_free_port(), state_dir=state_dir,
+                                      legacy=(sc.server == "legacy"))
+        sub.spawn()
+        url = sub.url
+        observer = _CrashRestartOrchestrator(sc, sub, out_dir)
     try:
         with _scoped_env(env):
-            put_config(srv.url, cluster)
+            put_config(url, cluster)
+            if observer is not None:
+                observer.start()
             job = Job(prog=sys.executable, args=[script],
-                      config_server=srv.url)
+                      config_server=url)
             rc = watch_run(job, "127.0.0.1",
                            PeerID("127.0.0.1", parent_port),
-                           cluster, srv.url, poll_interval=0.2,
+                           cluster, url, poll_interval=0.2,
                            preempt_recover=True)
     finally:
-        srv.stop()
+        if observer is not None:
+            observer.stop()
+        if srv is not None:
+            srv.stop()
+        if sub is not None:
+            sub.stop()
+        # each scenario talks to a fresh server incarnation on a fresh
+        # port; drop this process's breaker/epoch state so back-to-back
+        # scenarios (replay-check) never inherit stale fencing marks
+        from ..utils import rpc as _rpc
+        _rpc.reset(url)
 
     events = _collect_events(out_dir)
     pids = [int(open(p).read().strip())
@@ -443,6 +684,18 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         # the scenario's tempdir-unique script path identifies OUR
         # workers: a recycled pid must never be mistaken for an orphan
         pid_marker=script)
+    if sc.expect_violation:
+        # demonstration scenarios: the named violation is the EXPECTED
+        # outcome — it must trip, and tripping is success
+        import re as _re
+        matched = [v for v in violations
+                   if _re.search(sc.expect_violation, v)]
+        violations = [v for v in violations if v not in matched]
+        if not matched:
+            violations.append(
+                f"expected a violation matching "
+                f"{sc.expect_violation!r}; none tripped — the failure "
+                f"mode this scenario demonstrates did not reproduce")
     trace_files = sorted(glob.glob(os.path.join(out_dir,
                                                 "kftrace*.jsonl")))
     res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,

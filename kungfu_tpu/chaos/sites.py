@@ -68,6 +68,24 @@ SITES: Dict[str, str] = {
     "config.put": (
         "every PUT/CAS of a cluster to the config server — drop-rpc "
         "here loses resize proposals"),
+    "config.wal.append": (
+        "server side, inside the version-bump critical section, BEFORE "
+        "the WAL record is appended+fsync'd — a kill here crashes the "
+        "server with the transition un-acked: restart must serve the "
+        "previous version (write-ahead discipline, kfguard)"),
+    "config.restart": (
+        "server side, at boot with a -state-dir, before WAL replay — "
+        "a delay here stretches the outage a crash-restart causes; a "
+        "kill models a crash loop"),
+    "rpc.attempt": (
+        "kfguard rpc client (utils/rpc.py), before every HTTP attempt "
+        "— drop-rpc here exercises the retry/backoff/deadline path "
+        "deterministically; fires once per ATTEMPT, unlike "
+        "config.fetch/put which fire once per logical call"),
+    "heartbeat.miss": (
+        "worker liveness lease renewal, before the POST /heartbeat — "
+        "drop-rpc here ages the worker's lease WITHOUT hanging the "
+        "worker, driving the watcher's expired-lease escalation"),
     # ------------------------------------------------ launcher / watcher
     "launcher.watch.update": (
         "watcher applying a Stage{version, cluster} diff, before any "

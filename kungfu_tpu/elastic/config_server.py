@@ -3,11 +3,34 @@
 Reference: srcs/go/kungfu/elastic/configserver/configserver.go:42-110 and
 the standalone binary (srcs/go/cmd/kungfu-config-server). Schema:
 
-- GET    /config  -> {"version": N, "cluster": {...}}   (404 when cleared)
-- PUT    /config  <- cluster JSON (validated; version++)
+- GET    /config  -> {"version": N, "cluster": {...}, "epoch": E}
+                     (404 when cleared/unseeded; body carries the
+                     current version + epoch so clients can still fence)
+- PUT    /config  <- cluster JSON (validated; version++; optional
+                     ``If-Match: <version>`` turns it into a CAS — 409
+                     carries the server's current version + epoch)
 - POST   /config  <- same as PUT (initial set)
-- DELETE /config  -> clears the config
+- DELETE /config  -> clears the config.  The version still BUMPS and a
+                     ``cleared`` history entry is recorded, so a CAS
+                     holding a pre-clear version cannot win across it
+- GET    /history -> bounded list of recent transitions
+- POST   /heartbeat <- {"peer", "rank", "step", "version"} worker
+                     liveness lease renewal (kfguard)
+- GET    /health  -> {"epoch", "version", "leases": {peer: {age_s,
+                     rank, step, version, beats}}} — last-seen ages the
+                     watcher escalates on (hung-worker detection)
 - GET    /stop    -> shuts the server down (TTL analogue)
+
+Durability (kfguard): with a ``state_dir``, every ``(epoch, version,
+cluster)`` transition is appended to an fsync'd JSONL write-ahead log
+BEFORE it is applied or acknowledged.  On restart the WAL is replayed:
+the version counter — the fencing token every worker carries — and the
+current cluster continue exactly where they stopped, under the SAME
+epoch.  When the WAL is absent or torn, the server stamps a fresh
+random epoch instead: clients see the epoch change and know the server
+genuinely lost state, rather than trusting a reborn version 0
+(PAPERS.md lineage: Raft-style durable-log discipline — write-ahead,
+replay, new term on state loss).
 
 Runs in-process on a background thread (embeddable into the launcher the
 way kungfu-run embeds it via -builtin-config-port).
@@ -15,13 +38,108 @@ way kungfu-run embeds it via -builtin-config-port).
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ..chaos import point as _chaos_point
 from ..plan.cluster import Cluster
 from ..trace import span as _trace_span
+from ..utils import rpc as _rpc
 from ..utils.http import BackgroundHTTPServer
+
+# mirror of Watcher.HISTORY_LIMIT (launcher/watch.py): both planes keep
+# the same bounded window of recent transitions — unbounded history was
+# a slow leak on long elastic jobs
+HISTORY_LIMIT = 64
+
+# a lease this stale is an artifact of a long-gone worker, not liveness
+# signal; pruned on the next heartbeat so the table stays bounded by
+# the set of RECENT peers, not every port the job ever used
+LEASE_PRUNE_S = 600.0
+
+
+def _fresh_epoch() -> int:
+    """A new server-incarnation epoch.  Only (in)equality matters —
+    same epoch == same fencing line for the version counter — so 48
+    random bits beat a timestamp (two servers born in the same
+    millisecond must not share an epoch)."""
+    return int.from_bytes(os.urandom(6), "big")
+
+
+class _WAL:
+    """Append-only, fsync'd JSONL of ``(epoch, version, cluster)``
+    transitions.  Discipline: append + fsync BEFORE the in-memory state
+    mutates or the client is acked — a torn tail line is therefore
+    provably un-acked and replay of the intact prefix loses nothing the
+    outside world ever saw."""
+
+    FILENAME = "config-wal.jsonl"
+
+    def __init__(self, state_dir: str):
+        self.path = os.path.join(state_dir, self.FILENAME)
+        self._f = None
+
+    def replay(self) -> Tuple[Optional[int], int, Optional[Cluster],
+                              List[dict], bool]:
+        """-> (epoch, version, cluster, history, torn).  ``epoch`` is
+        None when no record was readable (absent/empty/corrupt-at-head
+        WAL); ``torn`` flags any unreadable content after the intact
+        prefix."""
+        epoch: Optional[int] = None
+        version = 0
+        cluster: Optional[Cluster] = None
+        history: List[dict] = []
+        torn = False
+        try:
+            f = open(self.path, "r")
+        except FileNotFoundError:
+            return epoch, version, cluster, history, torn
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                    v = int(d["version"])
+                    ep = int(d["epoch"])
+                    cj = d.get("cluster")
+                    c = (Cluster.from_json(json.dumps(cj))
+                         if cj is not None else None)
+                except (ValueError, KeyError, TypeError) as e:
+                    # torn record: the intact prefix is the state; the
+                    # tail was never acked (fsync-before-ack)
+                    import sys
+                    print(f"kft-config: WAL {self.path} torn at "
+                          f"{line[:60]!r} ({e}); replaying the intact "
+                          f"prefix", file=sys.stderr)
+                    torn = True
+                    break
+                epoch, version, cluster = ep, v, c
+                if c is not None:
+                    history.append({"version": v, "size": c.size()})
+                else:
+                    history.append({"version": v, "cleared": True})
+        return epoch, version, cluster, history[-HISTORY_LIMIT:], torn
+
+    def append(self, epoch: int, version: int,
+               cluster: Optional[Cluster]) -> None:
+        rec = {"epoch": epoch, "version": version,
+               "cluster": (json.loads(cluster.to_json())
+                           if cluster is not None else None)}
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 class _State:
@@ -29,7 +147,35 @@ class _State:
         self.lock = threading.Lock()
         self.version = 0
         self.cluster: Optional[Cluster] = None
-        self.history = []
+        self.history: List[dict] = []
+        self.epoch: int = 0
+        self.legacy = False      # emulate the pre-kfguard server: no
+        #                          epoch in any body (chaos demo / compat)
+        self.wal: Optional[_WAL] = None
+        # peer -> {"mono", "rank", "step", "version", "beats"}
+        self.leases: Dict[str, Dict] = {}
+
+    def epoch_fields(self) -> dict:
+        return {} if self.legacy else {"epoch": self.epoch}
+
+    def record(self, cluster: Optional[Cluster]) -> int:
+        """Version bump + WAL append + history, under ``self.lock``
+        (caller holds it).  Write-ahead: the WAL append happens BEFORE
+        the in-memory transition; an fsync failure leaves state
+        untouched and the caller reports 500."""
+        new_version = self.version + 1
+        if self.wal is not None:
+            _chaos_point("config.wal.append", version=new_version)
+            self.wal.append(self.epoch, new_version, cluster)
+        self.version = new_version
+        self.cluster = cluster
+        if cluster is not None:
+            self.history.append({"version": new_version,
+                                 "size": cluster.size()})
+        else:
+            self.history.append({"version": new_version, "cleared": True})
+        del self.history[:-HISTORY_LIMIT]
+        return new_version
 
 
 def _make_handler(state: _State, server_ref):
@@ -64,14 +210,37 @@ def _make_handler(state: _State, server_ref):
                     body = json.dumps(state.history).encode()
                 self._send(200, body)
                 return
+            if self.path.startswith("/health"):
+                self._health()
+                return
             with state.lock:
                 if state.cluster is None:
-                    self._send(404, b'{"error": "no config"}')
+                    # 404 still reports version + epoch: a client can
+                    # tell "cleared at v7" from "fresh empty server"
+                    self._send(404, json.dumps(
+                        {"error": "no config", "version": state.version,
+                         **state.epoch_fields()}).encode())
                     return
                 body = json.dumps({
                     "version": state.version,
                     "cluster": json.loads(state.cluster.to_json()),
+                    **state.epoch_fields(),
                 }).encode()
+            self._send(200, body)
+
+        def _health(self):
+            now = time.monotonic()
+            with state.lock:
+                leases = {
+                    peer: {"age_s": round(now - d["mono"], 3),
+                           "rank": d.get("rank"),
+                           "step": d.get("step"),
+                           "version": d.get("version"),
+                           "beats": d.get("beats", 0)}
+                    for peer, d in state.leases.items()}
+                body = json.dumps({"version": state.version,
+                                   "leases": leases,
+                                   **state.epoch_fields()}).encode()
             self._send(200, body)
 
         def _read_body(self) -> bytes:
@@ -82,6 +251,40 @@ def _make_handler(state: _State, server_ref):
             with _trace_span("config.request", category="config",
                              attrs={"method": "PUT", "path": self.path}):
                 self._put()
+
+        def do_POST(self):
+            with _trace_span("config.request", category="config",
+                             attrs={"method": "POST", "path": self.path}):
+                if self.path.startswith("/heartbeat"):
+                    self._heartbeat()
+                else:
+                    self._put()
+
+        def _heartbeat(self):
+            raw = self._read_body()
+            try:
+                d = json.loads(raw.decode())
+                peer = str(d["peer"])
+            except (ValueError, KeyError) as e:
+                self._send(400, json.dumps(
+                    {"error": f"bad heartbeat: {e}"}).encode())
+                return
+            now = time.monotonic()
+            with state.lock:
+                prev = state.leases.get(peer)
+                state.leases[peer] = {
+                    "mono": now,
+                    "rank": d.get("rank"),
+                    "step": d.get("step"),
+                    "version": d.get("version"),
+                    "beats": (prev["beats"] + 1 if prev else 1),
+                }
+                for p in [p for p, l in state.leases.items()
+                          if now - l["mono"] > LEASE_PRUNE_S]:
+                    del state.leases[p]
+                body = json.dumps({"ok": True,
+                                   **state.epoch_fields()}).encode()
+            self._send(200, body)
 
         def _put(self):
             raw = self._read_body()
@@ -101,35 +304,80 @@ def _make_handler(state: _State, server_ref):
                     return
             with state.lock:
                 if expect is not None and expect != state.version:
+                    # the 409 body carries the CURRENT version (and
+                    # epoch): the loser refetches without another GET
                     self._send(409, json.dumps(
                         {"error": "version conflict",
-                         "version": state.version}).encode())
+                         "version": state.version,
+                         **state.epoch_fields()}).encode())
                     return
-                state.version += 1
-                state.cluster = c
-                state.history.append({"version": state.version,
-                                      "size": c.size()})
-                body = json.dumps({"version": state.version}).encode()
+                try:
+                    new_version = state.record(c)
+                except OSError as e:
+                    # WAL append failed: nothing was applied
+                    self._send(500, json.dumps(
+                        {"error": f"wal append failed: {e}"}).encode())
+                    return
+                body = json.dumps({"version": new_version,
+                                   **state.epoch_fields()}).encode()
             self._send(200, body)
-
-        do_POST = do_PUT
 
         def do_DELETE(self):
             with _trace_span("config.request", category="config",
                              attrs={"method": "DELETE",
                                     "path": self.path}):
                 with state.lock:
-                    state.cluster = None
+                    # clearing BUMPS the version and records a
+                    # ``cleared`` transition: a CAS holding a pre-clear
+                    # version must lose across the clear
+                    try:
+                        state.record(None)
+                    except OSError as e:
+                        self._send(500, json.dumps(
+                            {"error": f"wal append failed: {e}"}
+                        ).encode())
+                        return
                 self._send(200, b'{"ok": true}')
 
     return Handler
 
 
 class ConfigServer:
-    """In-process elastic config server."""
+    """In-process elastic config server.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``state_dir`` arms the write-ahead log (see module doc): version
+    counter and cluster survive a crash+restart under the same epoch.
+    ``legacy`` emulates the pre-kfguard server (no epoch anywhere) —
+    kept for the chaos demonstration of WHY epochs exist and for
+    clients that cannot tolerate unknown fields."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 state_dir: Optional[str] = None, legacy: bool = False):
         self._state = _State()
+        st = self._state
+        st.legacy = legacy
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+            st.wal = _WAL(state_dir)
+            # a (re)start with a state dir is a chaos-schedulable moment:
+            # delay extends the outage window, kill models a crash loop
+            _chaos_point("config.restart")
+            epoch, version, cluster, history, torn = st.wal.replay()
+            st.version = version
+            st.cluster = cluster
+            st.history = history
+            if epoch is not None and not torn:
+                st.epoch = epoch  # clean replay: fencing line continues
+            else:
+                st.epoch = _fresh_epoch()
+                if torn:
+                    import sys
+                    print(f"kft-config: torn WAL in {state_dir}; "
+                          f"resuming at version {version} under FRESH "
+                          f"epoch {st.epoch} (clients will see the "
+                          f"state-loss signal)", file=sys.stderr)
+        else:
+            st.epoch = _fresh_epoch()
         self._server = BackgroundHTTPServer(
             lambda srv: _make_handler(self._state, srv), host, port)
 
@@ -141,53 +389,104 @@ class ConfigServer:
     def url(self) -> str:
         return f"http://{self._server.host}:{self._server.port}/config"
 
+    @property
+    def epoch(self) -> int:
+        return self._state.epoch
+
     def start(self) -> "ConfigServer":
         self._server.start()
         return self
 
     def stop(self) -> None:
         self._server.stop()
+        if self._state.wal is not None:
+            self._state.wal.close()
 
     # -- direct (in-process) access used by the embedded mode ---------------
     def put_cluster(self, cluster: Cluster) -> int:
         cluster.validate()
         with self._state.lock:
-            self._state.version += 1
-            self._state.cluster = cluster
-            self._state.history.append({"version": self._state.version,
-                                        "size": cluster.size()})
-            return self._state.version
+            return self._state.record(cluster)
 
     def get_cluster(self) -> Tuple[int, Optional[Cluster]]:
         with self._state.lock:
             return self._state.version, self._state.cluster
 
 
-def fetch_config(url: str, timeout: float = 5.0) -> Tuple[int, Cluster]:
-    """GET the current (version, cluster) from a config server URL."""
-    import urllib.request
+def _health_url(url: str, path: str) -> str:
+    """Map a ``.../config`` URL onto a sibling endpoint of the same
+    server (``/health``, ``/heartbeat``)."""
+    if url.endswith("/config"):
+        return url[: -len("/config")] + path
+    return url.rstrip("/") + path
 
-    from ..chaos import point as _chaos_point
+
+def fetch_config(url: str, timeout: float = 5.0,
+                 deadline: Optional[float] = None,
+                 retry_unseeded: bool = False) -> Tuple[int, Cluster]:
+    """GET the current (version, cluster) from a config server URL.
+
+    Routed through the kfguard rpc layer (:mod:`kungfu_tpu.utils.rpc`):
+    per-attempt ``timeout``, optional overall ``deadline`` budget with
+    jittered backoff (None = single attempt — poll loops bring their
+    own cadence), circuit breaking, and the epoch-aware check that
+    refuses version regressions from a reborn server.  Back-compat:
+    servers that send no ``epoch`` are tolerated."""
     _chaos_point("config.fetch")
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        d = json.loads(r.read().decode())
-    return d["version"], Cluster.from_json(json.dumps(d["cluster"]))
+
+    def parse(raw: bytes) -> Tuple[int, Cluster]:
+        d = json.loads(raw.decode())
+        version = d["version"]
+        cluster = Cluster.from_json(json.dumps(d["cluster"]))
+        _rpc.note_config(url, d.get("epoch"), version)
+        return version, cluster
+
+    return _rpc.call(url, attempt_timeout=timeout, deadline=deadline,
+                     retry_unseeded=retry_unseeded, check=parse)
 
 
 def put_config(url: str, cluster: Cluster, timeout: float = 5.0,
-               if_version: Optional[int] = None) -> int:
+               if_version: Optional[int] = None,
+               deadline: Optional[float] = None) -> int:
     """PUT a cluster; ``if_version`` makes it a compare-and-swap — the
-    server rejects with 409 when its version moved since that fetch."""
-    import urllib.request
-
-    from ..chaos import point as _chaos_point
+    server rejects with 409 when its version moved since that fetch.
+    The 409 (an ``urllib.error.HTTPError``) is terminal by design: the
+    caller must refetch before retrying a CAS."""
     _chaos_point("config.put")
-    req = urllib.request.Request(url, data=cluster.to_json().encode(),
-                                 method="PUT")
+    headers = {}
     if if_version is not None:
-        req.add_header("If-Match", str(if_version))
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read().decode())["version"]
+        headers["If-Match"] = str(if_version)
+
+    def parse(raw: bytes) -> int:
+        d = json.loads(raw.decode())
+        version = d["version"]
+        _rpc.note_config(url, d.get("epoch"), version)
+        return version
+
+    return _rpc.call(url, method="PUT", body=cluster.to_json().encode(),
+                     headers=headers, attempt_timeout=timeout,
+                     deadline=deadline, check=parse)
+
+
+def fetch_health(url: str, timeout: float = 2.0) -> dict:
+    """GET the worker lease table from a config server's ``/health``
+    (``url`` may be the ``/config`` URL).  Returns the raw dict:
+    ``{"epoch", "version", "leases": {peer: {age_s, ...}}}``."""
+    raw = _rpc.call(_health_url(url, "/health"), attempt_timeout=timeout)
+    return json.loads(raw.decode())
+
+
+def post_heartbeat(url: str, peer: str, *, rank: Optional[int] = None,
+                   step: Optional[int] = None,
+                   version: Optional[int] = None,
+                   timeout: float = 2.0) -> None:
+    """POST one liveness lease renewal for ``peer`` (``host:port``).
+    Single attempt by design: a missed beat IS the signal the lease
+    mechanism exists to expose — retrying it would mask a hung path."""
+    body = json.dumps({"peer": peer, "rank": rank, "step": step,
+                       "version": version}).encode()
+    _rpc.call(_health_url(url, "/heartbeat"), method="POST", body=body,
+              attempt_timeout=timeout)
 
 
 def main(argv=None) -> int:
@@ -197,9 +496,10 @@ def main(argv=None) -> int:
 
         python -m kungfu_tpu.elastic.config_server -port 9100 -ttl 120
         python -m kungfu_tpu.elastic.config_server -port 9100 -H 10.0.0.1:4 -np 4
+        python -m kungfu_tpu.elastic.config_server -port 9100 \\
+            -state-dir /var/lib/kft-config   # crash-survivable
     """
     import argparse
-    import time
 
     from ..plan.hostspec import HostList
 
@@ -212,14 +512,26 @@ def main(argv=None) -> int:
                    help="optional initial host list")
     p.add_argument("-np", type=int, default=0,
                    help="initial worker count (with -H)")
+    p.add_argument("-state-dir", dest="state_dir", default="",
+                   help="durable state directory: an fsync'd WAL of "
+                        "every transition, replayed on restart so the "
+                        "version counter (the fencing token) survives "
+                        "crashes")
+    p.add_argument("-legacy", action="store_true",
+                   help="emulate the pre-kfguard server: no epoch in "
+                        "any response (chaos demo / strict back-compat)")
     args = p.parse_args(argv)
 
-    srv = ConfigServer(host=args.host, port=args.port).start()
+    srv = ConfigServer(host=args.host, port=args.port,
+                       state_dir=args.state_dir or None,
+                       legacy=args.legacy).start()
     if args.hosts and args.np:
         hl = HostList.parse(args.hosts)
         srv.put_cluster(Cluster.from_hostlist(hl, args.np))
-    print(f"config server listening on {srv.url}"
-          + (f" (ttl {args.ttl}s)" if args.ttl else ""), flush=True)
+    print(f"config server listening on {srv.url} epoch {srv.epoch}"
+          + (f" (ttl {args.ttl}s)" if args.ttl else "")
+          + (f" (state-dir {args.state_dir})" if args.state_dir else ""),
+          flush=True)
     try:
         # monotonic: a wall-clock step (NTP sync on a fresh TPU-VM) must
         # not expire the TTL early or pin the server alive

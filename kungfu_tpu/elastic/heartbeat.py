@@ -1,0 +1,136 @@
+"""Worker liveness leases (kfguard): step-pumped heartbeats.
+
+The watcher's ``reap()`` only sees workers that DIE — a worker hung in
+a collective (peer deadlock, stuck DMA, livelocked resize) keeps its
+process alive and stalls the whole cluster forever.  Leases close that
+gap: every trainer step renews a TTL lease on the config server
+(``POST /heartbeat``), the server serves last-seen ages on
+``/health``, and the watcher escalates an expired lease into the same
+``propose_exclusion`` shrink path a preemption death takes (AntMan-style
+non-disruptive degradation: survivors keep training at the reduced
+membership).
+
+The critical design point: :meth:`HeartbeatSender.beat` must be called
+from the STEP PATH, not a timer thread.  A free-running timer would
+keep renewing the lease of a worker whose step loop is wedged —
+exactly the failure leases exist to expose.  ``beat()`` is a
+nanosecond-cheap monotonic check that, at most once per
+``KFT_HEARTBEAT_S``, hands the payload to a daemon sender thread; the
+HTTP POST itself never blocks a step.
+
+Env dials (documented in docs/elastic.md):
+
+- ``KFT_HEARTBEAT_S``    — renewal interval, seconds (default 2.0;
+  0 disables the sender entirely)
+- ``KFT_LEASE_TTL_S``    — watcher-side expiry age (default 0 =
+  observe-only: /health and the lease-age gauge stay live, but no
+  escalation — long XLA compiles between steps make an unconditional
+  default unsafe)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..chaos import point as _chaos_point
+
+
+class HeartbeatSender:
+    """Step-pumped lease renewals to a config server.
+
+    ``beat(rank=, step=, version=)`` is the per-step call; the POST
+    rides a daemon thread so a slow/dead server costs the step nothing
+    (and a missed POST is *signal*, never retried — see
+    :func:`~kungfu_tpu.elastic.config_server.post_heartbeat`)."""
+
+    def __init__(self, url: str, peer: str, interval_s: float = 2.0):
+        import time
+        self.url = url
+        self.peer = peer
+        self.interval_s = max(0.1, float(interval_s))
+        self.misses = 0
+        self.sent = 0
+        self._last = -float("inf")
+        self._mono = time.monotonic
+        self._pending: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"kft-heartbeat-{peer}")
+        self._thread.start()
+
+    # ------------------------------------------------------------- step side
+    def beat(self, *, rank: Optional[int] = None,
+             step: Optional[int] = None,
+             version: Optional[int] = None) -> bool:
+        """Renew the lease if the interval elapsed; returns True when a
+        renewal was handed to the sender.  Cheap no-op otherwise."""
+        now = self._mono()
+        if now - self._last < self.interval_s:
+            return False
+        self._last = now
+        with self._lock:
+            self._pending = {"rank": rank, "step": step,
+                             "version": version}
+        self._wake.set()
+        return True
+
+    # ----------------------------------------------------------- sender side
+    def _run(self) -> None:
+        from .config_server import post_heartbeat
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop:
+                return
+            with self._lock:
+                payload, self._pending = self._pending, None
+            if payload is None:
+                continue
+            try:
+                # schedulable miss: drop-rpc/delay here ages the lease
+                # without hanging the worker (docs/chaos.md)
+                _chaos_point("heartbeat.miss", rank=payload["rank"],
+                             step=payload["step"],
+                             version=payload["version"])
+                post_heartbeat(self.url, self.peer, **payload)
+                self.sent += 1
+            except (OSError, ValueError) as e:
+                # a missed beat is the signal, not an error to fight:
+                # count it (and say so once per outage-ish burst)
+                self.misses += 1
+                if self.misses in (1, 10, 100):
+                    import sys
+                    print(f"kft: heartbeat to {self.url} failing "
+                          f"({e!r}); {self.misses} missed", flush=True,
+                          file=sys.stderr)
+                from ..monitor import get_monitor
+                get_monitor().inc("kungfu_tpu_heartbeat_misses_total",
+                                  labels={"peer": self.peer})
+
+    def stop(self, join_timeout: float = 2.0) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=join_timeout)
+
+    # -------------------------------------------------------------- factory
+    @classmethod
+    def from_env(cls, we) -> Optional["HeartbeatSender"]:
+        """Build from the launcher env ABI (None when there is no
+        config server, no self spec, or KFT_HEARTBEAT_S=0)."""
+        import os
+        import sys
+        if not getattr(we, "config_server", None) or we.self_spec is None:
+            return None
+        raw = os.environ.get("KFT_HEARTBEAT_S", "")
+        try:
+            interval = float(raw) if raw else 2.0
+        except ValueError:
+            print(f"kft: ignoring malformed KFT_HEARTBEAT_S={raw!r}; "
+                  f"using 2.0", file=sys.stderr)
+            interval = 2.0
+        if interval <= 0:
+            return None
+        peer = f"{we.self_spec.host}:{we.self_spec.port}"
+        return cls(we.config_server, peer, interval_s=interval)

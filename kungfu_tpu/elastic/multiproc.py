@@ -122,6 +122,15 @@ class DistributedElasticTrainer:
         # sync does is RECEIVE from the joiner)
         self._init_state(init_params)
         self._committed_progress = (0, 0)
+        # kfguard liveness lease: pumped from step() so a HUNG step loop
+        # stops renewing and the watcher escalates (elastic/heartbeat.py);
+        # registered before the first compile so /health shows the worker
+        # from birth
+        from .heartbeat import HeartbeatSender
+        self._heartbeat = HeartbeatSender.from_env(self.we)
+        if self._heartbeat is not None:
+            self._heartbeat.beat(rank=self.we.rank(), step=0,
+                                 version=self.we.cluster_version)
         self.peer = native.default_peer()
         self.version = self.peer.token
         self._last_seen_version = self.version
@@ -406,6 +415,12 @@ class DistributedElasticTrainer:
         import jax
         if _flags.is_detached():
             return None
+        if self._heartbeat is not None:
+            # lease renewal rides the step path BY DESIGN: a wedged
+            # step loop must stop beating (see elastic/heartbeat.py)
+            self._heartbeat.beat(rank=self.peer.rank,
+                                 step=self.step_count,
+                                 version=self.version)
         _chaos_point("elastic.step.fence", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
         while True:
@@ -539,6 +554,8 @@ class DistributedElasticTrainer:
 
     def shutdown(self) -> None:
         """Ordered end-of-job teardown (all members should call it)."""
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
         self._drain_quietly("shutdown")
         self._teardown_plane_ordered()
         self._committer.close()

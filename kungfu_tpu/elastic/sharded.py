@@ -286,14 +286,19 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         # handoff barrier others entered (ADVICE.md sharded.py:234).
         payload = b""
         if p.rank == 0:
-            for _ in range(3):
-                try:
-                    _, cluster = fetch_config(self.we.config_server,
-                                              timeout=5.0)
-                    payload = cluster.to_json().encode()
-                    break
-                except (OSError, ValueError, KeyError):
-                    continue  # retried; exhaustion raises below
+            try:
+                # bounded retry budget via the kfguard rpc layer (was a
+                # bare 3x tight loop); exhaustion leaves payload empty
+                # and every member fails in unison below
+                _, cluster = fetch_config(self.we.config_server,
+                                          timeout=5.0, deadline=8.0)
+                payload = cluster.to_json().encode()
+            except (OSError, ValueError, KeyError) as e:
+                # the zero-length broadcast below IS the error path:
+                # every member raises the same NativeError together
+                import sys as _sys
+                print(f"kftsh: config fetch failed at the pre-teardown "
+                      f"handoff: {e!r}", file=_sys.stderr, flush=True)
         n = p.broadcast(np.asarray([len(payload)], np.int64), root=0,
                         name=f"kftsh-pre@{self.version}")
         if int(n[0]) == 0:

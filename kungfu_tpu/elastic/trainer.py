@@ -204,17 +204,13 @@ class ElasticTrainer:
         """
         if not self.config_server_url:
             raise ValueError("no config server configured")
-        deadline = time.monotonic() + timeout
-        while True:
-            try:
-                version, cluster = fetch_config(self.config_server_url)
-                break
-            except (OSError, ValueError, KeyError):
-                # conn refused / 404-before-first-PUT / truncated JSON:
-                # retry until the deadline, then surface the real error
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(0.1)
+        # kfguard rpc layer owns the retry loop: jittered backoff under
+        # one overall deadline budget, then the REAL last error surfaces
+        # (conn refused / 404-before-first-PUT / truncated JSON are all
+        # retried; utils/rpc.py)
+        version, cluster = fetch_config(self.config_server_url,
+                                        deadline=timeout,
+                                        retry_unseeded=True)
         if version == self.config_version:
             return False, False  # already applied this server config
         changed = self.resize(min(cluster.size(), self.max_size))

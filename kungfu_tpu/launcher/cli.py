@@ -48,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="elastic config server URL")
     p.add_argument("-builtin-config-port", type=int, default=0,
                    help="embed a config server on this port")
+    p.add_argument("-state-dir", dest="state_dir", default="",
+                   help="durable state dir for the builtin config "
+                        "server: an fsync'd WAL replayed on restart so "
+                        "version fencing tokens survive a launcher "
+                        "crash (kfguard; docs/elastic.md)")
     from ..plan.hostspec import DEFAULT_WORKER_PORT as _BP
     p.add_argument("-port-range",
                    default=f"{_BP}-{_BP + 99}",
@@ -133,9 +138,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     config_url = args.config_server
     server = None
     if args.builtin_config_port or (args.watch and not config_url):
-        server = ConfigServer(port=args.builtin_config_port).start()
+        server = ConfigServer(port=args.builtin_config_port,
+                              state_dir=args.state_dir or None).start()
         config_url = server.url
-        put_config(config_url, cluster)
+        if args.state_dir and server.get_cluster()[1] is not None:
+            # WAL replay already restored a cluster: keep its version
+            # counter (re-PUTting would bump the fencing token and
+            # force every worker through one needless resize)
+            v, c = server.get_cluster()
+            print(f"kft-run: builtin config server resumed at "
+                  f"version {v} ({c.size()} workers) from "
+                  f"{args.state_dir}", flush=True)
+        else:
+            put_config(config_url, cluster)
+    elif args.state_dir:
+        print("kft-run: -state-dir only applies to the builtin config "
+              "server; ignored", file=sys.stderr)
 
     job = Job(prog=prog[0], args=prog[1:],
               strategy=Strategy.parse(args.strategy),

@@ -20,7 +20,8 @@ from typing import Callable, Dict, List, Optional
 from ..chaos import point as _chaos_point
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID, PeerList
-from ..elastic.config_server import fetch_config, put_config
+from ..elastic.config_server import fetch_config, fetch_health, put_config
+from ..utils import rpc as _rpc
 from .job import ChipPool, Job
 from .proc import Proc
 
@@ -170,10 +171,16 @@ def propose_exclusion(config_url: str, dead: set, retries: int = 8
 
     Returns the new version, the current version when another runner
     already absorbed the deaths (lost the CAS race benignly), or None
-    when removing them would empty the cluster (caller should fail)."""
+    when removing them would empty the cluster (caller should fail).
+
+    CAS losses back off with jitter (kfguard ``rpc.Backoff``) instead
+    of re-fetching in a tight loop: a 409 storm from concurrent shrink
+    proposals must not hammer the server that is coordinating the very
+    recovery it is part of."""
     import sys as _sys
     import urllib.error
     from .control import push_stage
+    backoff = _rpc.Backoff()
     for _ in range(retries):
         version, cluster = fetch_config(config_url)
         workers = [w for w in cluster.workers if w not in dead]
@@ -186,7 +193,8 @@ def propose_exclusion(config_url: str, dead: set, retries: int = 8
             new_version = put_config(config_url, shrunk,
                                      if_version=version)
         except urllib.error.HTTPError as e:
-            if e.code == 409:  # lost a CAS race: re-fetch and retry
+            if e.code == 409:  # lost a CAS race: back off, re-fetch
+                backoff.sleep()
                 continue
             raise
         acked = push_stage(list(cluster.runners), new_version, shrunk)
@@ -213,6 +221,18 @@ def _start_debug_server(w: "Watcher", port: int):
     def factory(_srv):
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                if self.path.startswith("/metrics"):
+                    # the RUNNER's own metrics (lease-age gauges, rpc
+                    # retry counters) — /cluster_metrics below is the
+                    # workers' merged view
+                    from ..monitor import get_monitor
+                    body = get_monitor().render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path.startswith("/cluster_metrics"):
                     with w._lock:
                         targets = [(p.host, p.port) for p in w.current]
@@ -320,26 +340,61 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     # reference runner likewise takes Stage{version} from the server)
     version0 = 0
     if config_url:
-        import json as _json
-        import urllib.error
-        for _ in range(10):  # brief retry: server may be starting up
-            try:
-                version0, initial = fetch_config(config_url)
-                break
-            except (urllib.error.URLError, OSError, ValueError,
-                    KeyError, _json.JSONDecodeError) as e:
-                # expected while the server boots (conn refused) or
-                # before any PUT (404); anything else should surface
-                last_err = e
-                time.sleep(0.2)
-        else:
+        try:
+            # bootstrap budget rides the kfguard rpc layer: per-attempt
+            # timeout + one overall deadline with jittered backoff,
+            # retrying conn-refused (server booting) AND 404 (no PUT
+            # yet) — the two "not ready yet" classes the old hand-rolled
+            # 10x0.2s loop conflated with real failures
+            version0, initial = fetch_config(config_url, deadline=2.0,
+                                             retry_unseeded=True)
+        except (OSError, ValueError, KeyError) as e:
             # still unseeded: spawn from the provided cluster at version
             # 0; a later PUT of the same cluster costs the workers one
             # benign in-process rebuild (resize_from_url), nothing more.
             # Logged so a persistently broken server isn't silent.
             print(f"kft-run: config server {config_url} unreadable "
-                  f"({last_err}); starting at version 0", flush=True)
+                  f"({e}); starting at version 0", flush=True)
     poll_failing = False  # one log line per config-server outage
+    # kfguard liveness leases: workers renew a TTL lease on the config
+    # server from their STEP path; a lease older than KFT_LEASE_TTL_S
+    # marks a HUNG worker — alive for reap(), dead for the collective —
+    # and is escalated into the same propose_exclusion shrink a
+    # preemption death takes.  0 (the default) = observe-only: gauges
+    # and /health stay live, no escalation (long XLA compiles between
+    # steps make an unconditional default unsafe; docs/elastic.md).
+    try:
+        lease_ttl = float(os.environ.get("KFT_LEASE_TTL_S", "0") or 0)
+    except ValueError:
+        print(f"kft-run: ignoring malformed KFT_LEASE_TTL_S="
+              f"{os.environ.get('KFT_LEASE_TTL_S')!r}; leases "
+              f"observe-only", file=_sys.stderr, flush=True)
+        lease_ttl = 0.0
+    escalated: set = set()   # peers already proposed, per version
+    escalated_version = -1
+
+    def _expired_leases(health: dict) -> set:
+        """Local live peers whose lease the server last saw more than
+        ``lease_ttl`` seconds ago.  Peers that never registered are
+        never escalated (a worker may legitimately predate its first
+        heartbeat — spawn, import, compile)."""
+        leases = health.get("leases", {})
+        out = set()
+        with w._lock:
+            local = list(w.current)
+        for peer in local:
+            lease = leases.get(f"{peer.host}:{peer.port}")
+            if lease is None:
+                continue
+            age = float(lease.get("age_s", 0.0))
+            from ..monitor import get_monitor
+            get_monitor().set_gauge(
+                "kungfu_tpu_lease_age_seconds", age,
+                labels={"peer": f"{peer.host}:{peer.port}"})
+            if lease_ttl > 0 and age > lease_ttl:
+                out.add(peer)
+        return out
+
     try:
         w.update(version0, initial)
         global_size = initial.size()
@@ -405,6 +460,38 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                               f"({e}); keeping current workers",
                               file=_sys.stderr, flush=True)
                         poll_failing = True
+                else:
+                    # liveness leases — only when enabled (the default
+                    # watch loop must not grow an extra HTTP request
+                    # per poll), and skipped while the poll itself is
+                    # failing: an unreachable server says nothing
+                    # about the workers
+                    if lease_ttl > 0:
+                        if escalated_version != w.version:
+                            escalated = set()
+                            escalated_version = w.version
+                        try:
+                            expired = _expired_leases(
+                                fetch_health(config_url)) - escalated
+                        except (OSError, ValueError, KeyError):
+                            expired = set()  # e.g. pre-kfguard server
+                        if expired:
+                            print(f"kft-run: liveness lease expired "
+                                  f"(> {lease_ttl}s) for "
+                                  f"{sorted(str(p) for p in expired)};"
+                                  f" escalating hung worker(s) into a "
+                                  f"shrink", file=_sys.stderr,
+                                  flush=True)
+                            escalated |= expired
+                            try:
+                                if propose_exclusion(config_url,
+                                                     expired) is None:
+                                    w.failed = 1
+                                    continue
+                            except (OSError, ValueError):
+                                # server flaked between /health and
+                                # the CAS: retry at the next poll
+                                escalated -= expired
             if stop_when_empty and w.alive() == 0 and (
                     not config_url or global_size == 0
                     or w.all_local_done()):

@@ -54,6 +54,18 @@ _HELP = {
         "(elastic/snapshot.py).",
     "kungfu_tpu_snapshot_d2h_gib_s":
         "Achieved device->host bandwidth of the last kfsnap join phase.",
+    "kungfu_tpu_rpc_retries_total":
+        "Control-plane RPC attempts retried by the kfguard rpc layer "
+        "(utils/rpc.py), per server and failure kind.",
+    "kungfu_tpu_rpc_outage_seconds":
+        "Duration of the last completed config-server outage seen by "
+        "the kfguard rpc layer, per server.",
+    "kungfu_tpu_lease_age_seconds":
+        "Age of each local worker's liveness lease as seen by the "
+        "watcher (kfguard heartbeats; stale = hung worker).",
+    "kungfu_tpu_heartbeat_misses_total":
+        "Worker liveness lease renewals that failed to reach the "
+        "config server.",
 }
 
 
@@ -217,6 +229,7 @@ class Monitor:
         # (metric, sorted-labels-tuple) -> Summary / float
         self._summaries: Dict[tuple, Summary] = {}
         self._gauges: Dict[tuple, float] = {}
+        self._counters: Dict[tuple, float] = {}
         self._lock = threading.Lock()
 
     def add_provider(self, fn) -> None:
@@ -272,6 +285,19 @@ class Monitor:
         with self._lock:
             self._gauges[self._key(metric, labels)] = float(value)
 
+    def inc(self, metric: str, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        """Bump a monotonic counter (rendered with `# TYPE counter`):
+        rpc retries, heartbeat misses — events, not samples."""
+        key = self._key(metric, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def counter(self, metric: str,
+                labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._counters.get(self._key(metric, labels), 0.0)
+
     # ---------------------------------------------------------- rendering
     def render_metrics(self) -> str:
         """Prometheus-style plaintext (reference: monitor.go /metrics),
@@ -283,6 +309,7 @@ class Monitor:
             eg = dict(self._egress)
             ig = dict(self._ingress)
             gauges = dict(self._gauges)
+            counters = dict(self._counters)
             summaries = dict(self._summaries)
         if eg:
             lines += _meta_lines("kungfu_tpu_egress_bytes_total",
@@ -298,6 +325,9 @@ class Monitor:
                          f'{{target="{_esc(k)}"}} {c.total()}')
         for (metric, labels), val in sorted(gauges.items()):
             lines += _meta_lines(metric, "gauge", seen)
+            lines.append(f"{metric}{_labels_str(dict(labels))} {val:.9g}")
+        for (metric, labels), val in sorted(counters.items()):
+            lines += _meta_lines(metric, "counter", seen)
             lines.append(f"{metric}{_labels_str(dict(labels))} {val:.9g}")
         for (metric, labels), s in sorted(summaries.items()):
             lines += _meta_lines(metric, "summary", seen)

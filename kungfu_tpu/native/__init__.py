@@ -559,6 +559,10 @@ def resize_from_url(timeout: float = 5.0):
     me = f"{we.self_spec.host}:{we.self_spec.port}"
     changed = False
     while True:
+        # single attempt by design (kfguard rpc layer: deadline=None);
+        # recover_from_failure owns the poll cadence for outages, and
+        # the per-server circuit breaker turns a dead server into a
+        # microsecond failure here instead of a full connect timeout
         version, cluster = _cs.fetch_config(we.config_server,
                                             timeout=timeout)
         p = installed_peer()

@@ -355,6 +355,16 @@ def test_no_orphans_checker_spares_recycled_pids():
     bystander = subprocess.Popen([sys.executable, "-c",
                                   "import time; time.sleep(600)"])
     try:
+        # poll-with-deadline for the exec to land: between fork and
+        # execve /proc/<pid>/cmdline still shows the PARENT's argv (no
+        # marker), and under whole-suite load on a 1-core box that
+        # window stretches past any fixed assumption
+        deadline = time.monotonic() + 30
+        while (not invariants._cmdline_has(bystander.pid,
+                                           "time.sleep(600)")
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert invariants._cmdline_has(bystander.pid, "time.sleep(600)")
         assert invariants.check_no_orphans(
             [bystander.pid], marker="kfchaos-no-such-worker.py") == []
         assert bystander.poll() is None   # untouched

@@ -101,6 +101,16 @@ else
   # (rc 0) on images whose jax lacks the multiprocess CPU data plane.
   say "2c/3 kfchaos smoke scenario"
   python -m kungfu_tpu.chaos.runner --scenario smoke || fail=1
+
+  # kfguard proof: SIGKILL + restart the WAL-backed config server
+  # mid-resize; version/epoch must strictly continue
+  # (check_version_monotonic_across_epochs) and --replay-check requires
+  # two runs with identical fault journals.  Same data-plane self-skip
+  # as the rest of the matrix.
+  say "2d/3 kfchaos config-server crash-restart (kfguard WAL)"
+  python -m kungfu_tpu.chaos.runner \
+      --scenario config-server-crash-restart-mid-resize \
+      --replay-check || fail=1
 fi
 
 say "3/3 dryrun_multichip(8)"

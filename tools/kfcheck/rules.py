@@ -290,7 +290,10 @@ class SilentExcept(Rule):
     doc = ("bare `except:` / broad `except Exception:` that swallows the "
            "error in control-plane code — peer death and resize failures "
            "vanish instead of driving recovery")
-    path_filter = r"(^|/)(elastic|launcher|comm|chaos|store|trace|monitor)(/|$)"
+    # utils/rpc.py is control-plane code living under utils (the
+    # kfguard rpc client): scoped by file, not by widening all of utils
+    path_filter = (r"(^|/)(elastic|launcher|comm|chaos|store|trace"
+                   r"|monitor)(/|$)|(^|/)utils/rpc\.py$")
 
     BROAD = {"Exception", "BaseException"}
 
