@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench clean
+.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -18,6 +18,13 @@ chaos-smoke: native
 	python -m kungfu_tpu.chaos.runner --scenario smoke
 	python -m kungfu_tpu.chaos.runner \
 	    --scenario config-server-crash-restart-mid-resize --replay-check
+
+# kfdoctor smoke: metrics/trace plumbing plus the diagnosis plane —
+# a watcher /findings endpoint must attribute a 10x step-time skew to
+# the slow worker, and the kft-doctor CLI must diagnose a saved history
+# fixture (docs/monitoring.md "Diagnosis (kfdoctor)").
+doctor-smoke:
+	python tools/metrics_trace_smoke.py
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

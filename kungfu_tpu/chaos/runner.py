@@ -252,6 +252,13 @@ class Scenario:
     # scenario DEMONSTRATES a failure mode; matching violations count
     # as the expected outcome, not errors
     expect_violation: Optional[str] = None
+    # kfdoctor proof loop (monitor/doctor.py): {"kind": K, "rank": R}
+    # requires the doctor — scraping live worker /metrics during the run
+    # — to raise a K finding naming rank R (and never misattribute it);
+    # {"absent_kind": K} requires NO K finding on the whole run (the
+    # false-positive guard for the clean twin).  Enabling this exports
+    # KFT_CONFIG_ENABLE_MONITORING=1 so workers serve /metrics.
+    doctor_expect: Optional[Dict[str, object]] = None
 
 
 def scenarios() -> Dict[str, Scenario]:
@@ -337,6 +344,29 @@ def scenarios() -> Dict[str, Scenario]:
                                      rank=1, step=[3, 4, 5], count=3,
                                      delay_s=0.3),
             target_steps=12),
+        Scenario(
+            name="straggler-doctor",
+            desc="rank 1 stalls 0.25s at EVERY fence from step 2: the "
+                 "kfdoctor suite (scraping each worker's /metrics into "
+                 "its history ring) must attribute the slowdown to rank "
+                 "1 — a straggler Finding naming that rank, no other",
+            plan=Plan(seed=None).add("elastic.step.fence", "delay",
+                                     rank=1, step=list(range(2, 30)),
+                                     count=28, delay_s=0.25),
+            nprocs=3,
+            target_steps=16,
+            timeout_s=420.0,
+            doctor_expect={"kind": "straggler", "rank": 1}),
+        Scenario(
+            name="straggler-doctor-clean",
+            desc="the same 3-proc workload with NO faults: the doctor "
+                 "must stay silent — a straggler finding here is a "
+                 "false positive",
+            plan=Plan(seed=None),
+            nprocs=3,
+            target_steps=16,
+            timeout_s=420.0,
+            doctor_expect={"absent_kind": "straggler"}),
         Scenario(
             name="double-resize",
             desc="two proposals land back-to-back (3->2 and ->3 in one "
@@ -583,6 +613,52 @@ class _CrashRestartOrchestrator(threading.Thread):
         self.join(timeout=10)
 
 
+class _DoctorSampler(threading.Thread):
+    """The kfdoctor proof loop for ``doctor_expect`` scenarios: scrape
+    every worker's /metrics into a private history ring and diagnose
+    each sample period, accumulating the first sighting of every
+    distinct finding.  A PRIVATE monitor keeps the sampler's
+    finding-gauges out of the runner process's global /metrics (back-to
+    -back scenarios must not inherit each other's
+    ``kungfu_tpu_finding_active`` state).  ``cluster.aggregate`` already
+    absorbs dead or not-yet-bound targets as ``worker_up 0``, so a
+    worker that hasn't opened its metrics port yet is a non-event here,
+    not an error."""
+
+    def __init__(self, cluster, out_dir: str):
+        super().__init__(daemon=True, name="kfchaos-doctor")
+        from ..monitor import Monitor
+        from ..monitor.doctor import Doctor
+        from ..monitor.history import MetricsHistory
+        peers = list(cluster.workers)
+        self.targets = [(p.host, p.port) for p in peers]
+        self.ranks = {f"{p.host}:{p.port}": i
+                      for i, p in enumerate(peers)}
+        self.doctor = Doctor(history=MetricsHistory(window=256),
+                             monitor=Monitor())
+        self.path = os.path.join(out_dir, "findings.json")
+        self.stop_event = threading.Event()
+        # first to_dict() per Finding.key(): scenario-level evidence
+        self.seen: Dict[Tuple[str, str], dict] = {}
+
+    def run(self) -> None:
+        from ..monitor import cluster as _mcluster
+        while not self.stop_event.is_set():
+            _mcluster.aggregate(self.targets, timeout=1.0,
+                                history=self.doctor.history)
+            for f in self.doctor.diagnose(ranks=self.ranks):
+                self.seen.setdefault(f.key(), f.to_dict())
+            self.stop_event.wait(0.4)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.join(timeout=10)
+        with open(self.path, "w") as f:
+            json.dump(sorted(self.seen.values(),
+                             key=lambda d: (d["kind"], str(d["rank"]))),
+                      f, indent=2)
+
+
 def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                  verbose: bool = True) -> ScenarioResult:
     """Execute one scenario end-to-end and check every invariant."""
@@ -624,6 +700,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         # a subprocess server restart pays a full interpreter + jax
         # import before it serves again; survivors must out-wait it
         env["KFT_CHAOS_RECOVER_S"] = "180"
+    if sc.doctor_expect is not None:
+        # workers must serve /metrics (worker port + offset) for the
+        # doctor sampler to scrape step-time summaries
+        env["KFT_CONFIG_ENABLE_MONITORING"] = "1"
     target = sc.target_steps * sc.batch
     if verbose:
         print(f"kfchaos: scenario {sc.name}: {sc.nprocs} procs x "
@@ -633,7 +713,7 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
     cluster = Cluster.from_hostlist(
         HostList.parse(f"127.0.0.1:{sc.nprocs}"), sc.nprocs)
     parent_port = sc.parent_port if sc.parent_port else _free_port()
-    srv = sub = observer = None
+    srv = sub = observer = sampler = None
     if sc.server == "inproc":
         srv = ConfigServer().start()
         url = srv.url
@@ -652,6 +732,9 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
             put_config(url, cluster)
             if observer is not None:
                 observer.start()
+            if sc.doctor_expect is not None:
+                sampler = _DoctorSampler(cluster, out_dir)
+                sampler.start()
             job = Job(prog=sys.executable, args=[script],
                       config_server=url)
             rc = watch_run(job, "127.0.0.1",
@@ -659,6 +742,8 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                            cluster, url, poll_interval=0.2,
                            preempt_recover=True)
     finally:
+        if sampler is not None:
+            sampler.stop()
         if observer is not None:
             observer.stop()
         if srv is not None:
@@ -696,6 +781,31 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                 f"expected a violation matching "
                 f"{sc.expect_violation!r}; none tripped — the failure "
                 f"mode this scenario demonstrates did not reproduce")
+    if sc.doctor_expect:
+        found = list(sampler.seen.values()) if sampler is not None else []
+        exp_kind = sc.doctor_expect.get("kind")
+        absent = sc.doctor_expect.get("absent_kind")
+        if exp_kind is not None:
+            exp_rank = sc.doctor_expect.get("rank")
+            hits = [d for d in found if d.get("kind") == exp_kind]
+            if not any(d.get("rank") == exp_rank for d in hits):
+                violations.append(
+                    f"doctor: expected a {exp_kind!r} finding naming "
+                    f"rank {exp_rank}; saw ranks "
+                    f"{sorted(str(d.get('rank')) for d in hits)}")
+            wrong = [d for d in hits if d.get("rank") != exp_rank]
+            if wrong:
+                violations.append(
+                    f"doctor: {exp_kind!r} misattributed to rank(s) "
+                    f"{sorted(str(d.get('rank')) for d in wrong)} "
+                    f"(only rank {exp_rank} was delayed)")
+        if absent is not None:
+            spurious = [d for d in found if d.get("kind") == absent]
+            if spurious:
+                violations.append(
+                    f"doctor: spurious {absent!r} finding(s) on a "
+                    f"clean run: ranks "
+                    f"{sorted(str(d.get('rank')) for d in spurious)}")
     trace_files = sorted(glob.glob(os.path.join(out_dir,
                                                 "kftrace*.jsonl")))
     res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,

@@ -413,8 +413,18 @@ class DistributedElasticTrainer:
     def step(self, global_batch) -> Optional[float]:
         """One fenced, elastic training step; None once detached."""
         import jax
+        import time as _time
         if _flags.is_detached():
             return None
+        # straggler-attributable timing for the cluster metrics plane
+        # (monitor/doctor.py): a rank's OWN step time is the wall time
+        # minus what it spent WAITING at the version fence.  A slow rank
+        # carries its slowness in own-time; its peers carry it in fence
+        # wait — so kungfu_tpu_step_seconds skew names the straggler and
+        # collective_seconds{name="step_fence"} feeds the interference
+        # detector instead of smearing one rank's stall over everyone.
+        _t_entry = _time.perf_counter()
+        _fence_wait = 0.0
         if self._heartbeat is not None:
             # lease renewal rides the step path BY DESIGN: a wedged
             # step loop must stop beating (see elastic/heartbeat.py)
@@ -428,6 +438,7 @@ class DistributedElasticTrainer:
                      if self.step_count % self.poll_every == 0
                      else self._last_seen_version)
             self._last_seen_version = max(self._last_seen_version, local)
+            _t_fence = _time.perf_counter()
             try:
                 agreed = int(self.peer.all_reduce(
                     np.asarray([self._last_seen_version], np.int64),
@@ -435,6 +446,7 @@ class DistributedElasticTrainer:
                     name=f"fence@{self.version}:{self._round}")[0])
             except native.NativeError as e:
                 return self._recover(global_batch, cause=e)
+            _fence_wait += _time.perf_counter() - _t_fence
             self._round += 1
             self._last_seen_version = max(self._last_seen_version, agreed)
             if agreed <= self.version:
@@ -454,7 +466,6 @@ class DistributedElasticTrainer:
             # re-fence on the NEW membership before stepping: a freshly
             # joined worker's first fence must pair with everyone's
         try:
-            import time as _time
             _t0 = _time.perf_counter()
             batch = jax.device_put(global_batch, self._batch_sharding)
             params, opt, loss = self._step(self._params, self._opt, batch)
@@ -469,6 +480,13 @@ class DistributedElasticTrainer:
                 raise
             return self._recover(global_batch, cause=e)
         self._params, self._opt = params, opt
+        from ..monitor import get_monitor
+        _mon = get_monitor()
+        _mon.observe("kungfu_tpu_step_seconds",
+                     _time.perf_counter() - _t_entry - _fence_wait)
+        if _fence_wait > 0:
+            _mon.observe("kungfu_tpu_collective_seconds", _fence_wait,
+                         labels={"name": "step_fence"})
         self.step_count += 1
         leaf = jax.tree_util.tree_leaves(global_batch)[0]
         self.trained_samples += int(leaf.shape[0])

@@ -206,17 +206,50 @@ def propose_exclusion(config_url: str, dead: set, retries: int = 8
     return None
 
 
-def _start_debug_server(w: "Watcher", port: int):
+def _doctor_targets(w: "Watcher"):
+    """Scrape targets + instance->rank map for the doctor: the full
+    cluster membership when known (remote workers' /metrics are
+    reachable over the network), else the local live set."""
+    with w._lock:
+        cluster = w._last_cluster
+        peers = (list(cluster.workers) if cluster is not None
+                 else sorted(w.current))
+    targets = [(p.host, p.port) for p in peers]
+    ranks = {f"{p.host}:{p.port}": i for i, p in enumerate(peers)}
+    return targets, ranks
+
+
+def _doctor_tick(w: "Watcher", doctor):
+    """One diagnosis pass: scrape every worker into the history ring,
+    fold in the runner's own metrics (lease ages, rpc outage gauges —
+    the control-plane signals), and run the detectors."""
+    from ..monitor import get_monitor
+    from ..monitor import cluster as _cluster
+    from ..monitor.doctor import RUNNER_INSTANCE
+    targets, ranks = _doctor_targets(w)
+    _cluster.aggregate(targets, history=doctor.history)
+    doctor.observe(RUNNER_INSTANCE, get_monitor().render_metrics())
+    return doctor.diagnose(ranks=ranks, version=w.version)
+
+
+def _start_debug_server(w: "Watcher", port: int, doctor=None):
     """HTTP endpoint dumping the runner's applied Stage history + live
     worker state (reference: runner -debug-port, handler.go:117-122),
     plus ``/cluster_metrics`` — every live worker's /metrics endpoint
-    scraped and merged with per-worker instance labels
-    (kungfu_tpu.monitor.cluster; docs/monitoring.md)."""
+    scraped and merged with per-worker instance labels — and
+    ``/findings`` — the kfdoctor diagnosis (each hit scrapes one more
+    snapshot into the history window and re-runs the detectors)
+    (kungfu_tpu.monitor.cluster, monitor/doctor.py; docs/monitoring.md).
+    """
     import json as _json
     from http.server import BaseHTTPRequestHandler
 
     from ..monitor import cluster as _cluster
+    from ..monitor.doctor import Doctor
     from ..utils.http import BackgroundHTTPServer
+
+    if doctor is None:
+        doctor = Doctor()
 
     def factory(_srv):
         class Handler(BaseHTTPRequestHandler):
@@ -236,9 +269,22 @@ def _start_debug_server(w: "Watcher", port: int):
                 if self.path.startswith("/cluster_metrics"):
                     with w._lock:
                         targets = [(p.host, p.port) for p in w.current]
-                    body = _cluster.aggregate(targets).encode()
+                    body = _cluster.aggregate(
+                        targets, history=doctor.history).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/findings"):
+                    findings = _doctor_tick(w, doctor)
+                    body = _json.dumps({
+                        "version": w.version,
+                        "findings": [f.to_dict() for f in findings],
+                    }, indent=2).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -266,7 +312,9 @@ def _start_debug_server(w: "Watcher", port: int):
     # endpoint is likewise an operator-local tool); set KFT_DEBUG_BIND to
     # widen deliberately
     bind = os.environ.get("KFT_DEBUG_BIND", "127.0.0.1")
-    return BackgroundHTTPServer(factory, host=bind, port=port).start()
+    srv = BackgroundHTTPServer(factory, host=bind, port=port).start()
+    srv.doctor = doctor  # reachable for tests and the watch loop
+    return srv
 
 
 def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
@@ -324,7 +372,24 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
         exited.set()
         wake.set()
 
-    debug = _start_debug_server(w, debug_port) if debug_port else None
+    # kfdoctor (docs/monitoring.md "Diagnosis"): KFT_DOCTOR_SCRAPE_S > 0
+    # makes the watch loop itself scrape + diagnose periodically (so
+    # finding gauges and traces exist without anyone curling /findings);
+    # KFT_PEER_PROBE_S > 0 starts the host-plane peer-latency prober.
+    from ..monitor.doctor import Doctor, PeerLatencyProber
+    try:
+        doctor_scrape_s = float(
+            os.environ.get("KFT_DOCTOR_SCRAPE_S", "0") or 0)
+    except ValueError:
+        print(f"kft-run: ignoring malformed KFT_DOCTOR_SCRAPE_S="
+              f"{os.environ.get('KFT_DOCTOR_SCRAPE_S')!r}; doctor "
+              f"scraping disabled", file=_sys.stderr, flush=True)
+        doctor_scrape_s = 0.0
+    doctor = Doctor() if (doctor_scrape_s > 0 or debug_port) else None
+    doctor_last = -float("inf")
+    prober = PeerLatencyProber.from_env(lambda: _doctor_targets(w)[0])
+    debug = (_start_debug_server(w, debug_port, doctor=doctor)
+             if debug_port else None)
     control = None
     try:
         from .control import ControlServer
@@ -492,6 +557,11 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                                 # server flaked between /health and
                                 # the CAS: retry at the next poll
                                 escalated -= expired
+            if doctor_scrape_s > 0 and doctor is not None:
+                now = time.monotonic()
+                if now - doctor_last >= doctor_scrape_s:
+                    doctor_last = now
+                    _doctor_tick(w, doctor)
             if stop_when_empty and w.alive() == 0 and (
                     not config_url or global_size == 0
                     or w.all_local_done()):
@@ -501,6 +571,8 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     finally:
         if prev_term is not None:
             _signal.signal(_signal.SIGTERM, prev_term)
+        if prober is not None:
+            prober.stop()
         if control is not None:
             control.stop()
         if debug is not None:

@@ -21,6 +21,8 @@ every live worker's endpoint into ``/cluster_metrics``
 """
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler
@@ -66,7 +68,33 @@ _HELP = {
     "kungfu_tpu_heartbeat_misses_total":
         "Worker liveness lease renewals that failed to reach the "
         "config server.",
+    "kungfu_tpu_finding_active":
+        "1 while a kfdoctor finding is active, per kind and rank "
+        "(monitor/doctor.py; 0 on clear so dashboards see recovery).",
+    "kungfu_tpu_peer_latency_seconds":
+        "Host-plane peer probe round-trip to each worker's /metrics "
+        "endpoint (kfdoctor PeerLatencyProber), per peer.",
+    "kungfu_tpu_peer_probe_failures_total":
+        "Peer-latency probes that failed to reach the peer, per peer.",
+    "kungfu_tpu_serving_queue_wait_seconds":
+        "Serving: request wall time from submit to slot admission.",
+    "kungfu_tpu_serving_prefill_seconds":
+        "Serving: prefill dispatch-to-sync latency per admitted batch.",
+    "kungfu_tpu_serving_decode_token_seconds":
+        "Serving: decode latency per emitted token (batch step time / "
+        "tokens emitted that tick).",
+    "kungfu_tpu_serving_prefix_hit_rate":
+        "Serving: fraction of admitted requests that hit the prefix "
+        "cache (lifetime).",
+    "kungfu_tpu_serving_prefix_token_reuse":
+        "Serving: fraction of prompt tokens served from the prefix "
+        "cache instead of prefilled (lifetime).",
 }
+
+# satellite guard: a buggy caller labeling by request id would grow the
+# scrape output (and every Summary window) without bound — cap distinct
+# label-sets per metric, warn once, and drop the excess
+DEFAULT_MAX_LABELSETS = 256
 
 
 def _esc(value) -> str:
@@ -231,6 +259,16 @@ class Monitor:
         self._gauges: Dict[tuple, float] = {}
         self._counters: Dict[tuple, float] = {}
         self._lock = threading.Lock()
+        raw = os.environ.get("KFT_METRIC_MAX_LABELSETS", "")
+        try:
+            self._max_labelsets = int(raw) if raw else DEFAULT_MAX_LABELSETS
+        except ValueError:
+            print(f"kft: ignoring malformed KFT_METRIC_MAX_LABELSETS="
+                  f"{raw!r}; using {DEFAULT_MAX_LABELSETS}",
+                  file=sys.stderr)
+            self._max_labelsets = DEFAULT_MAX_LABELSETS
+        self._labelsets: Dict[str, int] = {}   # metric -> distinct keys
+        self._cap_warned: set = set()
 
     def add_provider(self, fn) -> None:
         """Register a zero-arg callable returning extra metrics lines."""
@@ -264,6 +302,25 @@ class Monitor:
     def _key(metric: str, labels: Optional[Dict[str, str]]) -> tuple:
         return (metric, tuple(sorted((labels or {}).items())))
 
+    def _admit(self, key: tuple, table: Dict[tuple, object]) -> bool:
+        """Under self._lock: allow a NEW label-set for a metric only
+        below the per-metric cap.  Existing series keep updating — the
+        cap bounds growth, it never freezes live data."""
+        if key in table:
+            return True
+        metric = key[0]
+        n = self._labelsets.get(metric, 0)
+        if n >= self._max_labelsets:
+            if metric not in self._cap_warned:
+                self._cap_warned.add(metric)
+                print(f"kft: metric {metric} hit the "
+                      f"{self._max_labelsets} label-set cap "
+                      f"(KFT_METRIC_MAX_LABELSETS); dropping new "
+                      f"label-sets", file=sys.stderr)
+            return False
+        self._labelsets[metric] = n + 1
+        return True
+
     def observe(self, metric: str, value: float,
                 labels: Optional[Dict[str, str]] = None) -> None:
         """Feed one sample into a summary (created on first use)."""
@@ -271,6 +328,8 @@ class Monitor:
         with self._lock:
             s = self._summaries.get(key)
             if s is None:
+                if not self._admit(key, self._summaries):
+                    return
                 s = self._summaries[key] = Summary()
         s.observe(value)
 
@@ -282,8 +341,11 @@ class Monitor:
 
     def set_gauge(self, metric: str, value: float,
                   labels: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(metric, labels)
         with self._lock:
-            self._gauges[self._key(metric, labels)] = float(value)
+            if not self._admit(key, self._gauges):
+                return
+            self._gauges[key] = float(value)
 
     def inc(self, metric: str, value: float = 1.0,
             labels: Optional[Dict[str, str]] = None) -> None:
@@ -291,6 +353,8 @@ class Monitor:
         rpc retries, heartbeat misses — events, not samples."""
         key = self._key(metric, labels)
         with self._lock:
+            if not self._admit(key, self._counters):
+                return
             self._counters[key] = self._counters.get(key, 0.0) + value
 
     def counter(self, metric: str,

@@ -19,8 +19,9 @@ silently absent.
 """
 from __future__ import annotations
 
+import http.client
 import re
-from typing import Dict, Iterable, List, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 from . import MONITOR_PORT_OFFSET, _esc
 
@@ -79,21 +80,31 @@ def merge_metrics(per_worker: Iterable[Tuple[str, str]]) -> str:
 
 
 def aggregate(targets: Iterable[Tuple[str, int]],
-              timeout: float = 2.0) -> str:
+              timeout: float = 2.0,
+              history: Optional["object"] = None) -> str:
     """Scrape every ``(host, worker_port)`` target's metrics endpoint
     and merge.  Unreachable workers contribute ``kungfu_tpu_worker_up 0``
     instead of failing the whole aggregation — /cluster_metrics must
-    stay useful exactly when part of the cluster is sick."""
+    stay useful exactly when part of the cluster is sick.  That covers
+    connect failures AND mid-read deaths: a worker that sends headers
+    then wedges raises ``http.client.HTTPException`` (IncompleteRead),
+    not just OSError (timeouts are OSError since py3.10).
+
+    ``history``: an optional
+    :class:`~kungfu_tpu.monitor.history.MetricsHistory` that each
+    successful scrape is appended to (the kfdoctor window ring)."""
     scraped: List[Tuple[str, str]] = []
     ups: List[Tuple[str, int]] = []
     for host, port in targets:
         instance = f"{host}:{port}"
         try:
-            scraped.append(
-                (instance, scrape(host, port + MONITOR_PORT_OFFSET,
-                                  timeout=timeout)))
+            text = scrape(host, port + MONITOR_PORT_OFFSET,
+                          timeout=timeout)
+            scraped.append((instance, text))
             ups.append((instance, 1))
-        except (OSError, ValueError) as e:
+            if history is not None:
+                history.observe_text(instance, text)
+        except (OSError, ValueError, http.client.HTTPException) as e:
             ups.append((instance, 0))
             scraped.append(
                 (instance, f"# scrape failed: {type(e).__name__}\n"))

@@ -1,0 +1,517 @@
+"""kfdoctor: turn the cluster's raw telemetry into structured findings.
+
+The paper's monitoring plane exists to be *acted on* — interference
+detection and peer-latency monitoring (srcs/go/monitor/,
+session/monitoring.go) feed strategy adaptation.  After PR 3/5 this repo
+emits every raw signal (step-time and collective-latency summaries,
+lease ages, rpc outage gauges, heartbeat-miss counters) but nothing
+interprets them.  This module is that layer:
+
+- detectors run over a :class:`~kungfu_tpu.monitor.history.MetricsHistory`
+  of per-instance scrape windows and emit :class:`Finding` records:
+
+  * **straggler** — an instance whose step-time p50 exceeds the cluster
+    median by ``KFT_DOCTOR_SKEW``x for ``KFT_DOCTOR_WINDOWS``
+    consecutive windows (which rank, how far, how long);
+  * **interference** — a collective whose recent p50 latency regressed
+    ``KFT_DOCTOR_REGRESS``x against its own rolling baseline, per
+    collective name (the paper's interference signal);
+  * **control-plane** — lease-age spikes, growing heartbeat misses, or
+    rpc outages in the *launcher's* own metrics, attributed to the peer
+    or server they name.
+
+- :class:`Doctor` wraps history + detectors + export: findings are
+  kftrace-traced on raise/clear, exported as
+  ``kungfu_tpu_finding_active{kind,rank}`` gauges, served as
+  ``/findings`` JSON from the watcher debug port (launcher/watch.py),
+  and rendered as a human report by the ``kft-doctor`` CLI
+  (``python -m kungfu_tpu.monitor.doctor``).
+
+- :class:`PeerLatencyProber` is the paper's host-plane peer-latency
+  monitor: a daemon thread pings each peer's /metrics endpoint over the
+  kfguard rpc client and feeds ``kungfu_tpu_peer_latency_seconds``.
+
+Thresholds are env knobs, documented in docs/monitoring.md
+("Diagnosis (kfdoctor)"); chaos scenario ``straggler-doctor`` proves the
+loop end-to-end (an injected per-rank delay must surface as a straggler
+finding naming that rank).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from . import MONITOR_PORT_OFFSET, Monitor, get_monitor
+from .history import MetricsHistory
+
+__all__ = ["Finding", "Doctor", "PeerLatencyProber", "render_report",
+           "detect_stragglers", "detect_interference",
+           "detect_control_plane", "RUNNER_INSTANCE"]
+
+# the launcher's own metrics live in the history under this pseudo
+# instance (lease ages, rpc outage gauges — the control-plane signals)
+RUNNER_INSTANCE = "runner"
+
+SEV_WARN = "warn"
+SEV_CRITICAL = "critical"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"kft-doctor: ignoring malformed {name}={raw!r}; "
+              f"using {default}", file=sys.stderr)
+        return default
+
+
+def _lower_median(values: List[float]) -> float:
+    """Median that degenerates to min() at n=2: with two workers the
+    'cluster median' must be the FAST one, or a straggler would drag
+    its own baseline up and hide."""
+    s = sorted(values)
+    return s[(len(s) - 1) // 2]
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnosis: what is wrong, where, how bad, what to do.
+
+    ``evidence`` holds the metric values the detector decided on (JSON
+    scalars only — findings travel over /findings and kftrace attrs).
+    ``version`` is the elastic membership version the diagnosis was made
+    under, when the caller knows it — rank numbering is only meaningful
+    relative to a membership."""
+    kind: str                      # straggler | interference | control-plane
+    severity: str                  # warn | critical
+    instance: str                  # host:port (or config-server url)
+    rank: Optional[int]
+    windows: int                   # consecutive windows of evidence
+    evidence: Dict[str, object]
+    action: str
+    version: Optional[int] = None
+    detected_ts: float = 0.0
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Finding":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def key(self) -> Tuple[str, str]:
+        """Identity for active-set tracking and gauge labels: the rank
+        when known (stable across re-scrapes), else the instance."""
+        return (self.kind,
+                str(self.rank) if self.rank is not None else self.instance)
+
+    def describe(self) -> str:
+        who = f"rank {self.rank} ({self.instance})" \
+            if self.rank is not None else self.instance
+        ev = ", ".join(f"{k}={v}" for k, v in sorted(self.evidence.items()))
+        return (f"[{self.severity}] {self.kind}: {who} — {ev} "
+                f"({self.windows} window(s))")
+
+
+def _fresh_instances(history: MetricsHistory, stale_s: float,
+                     exclude_runner: bool = True) -> List[str]:
+    """Instances still being scraped: a worker that left the membership
+    keeps its old snapshots in the ring; diagnosing those would blame a
+    ghost."""
+    newest = history.latest_ts()
+    out = []
+    for inst in history.instances():
+        if exclude_runner and inst == RUNNER_INSTANCE:
+            continue
+        snaps = history.snapshots(inst)
+        if not snaps:
+            continue
+        if newest is not None and newest - snaps[-1].ts > stale_s:
+            continue
+        out.append(inst)
+    return out
+
+
+def detect_stragglers(history: MetricsHistory, *,
+                      skew: float = 1.5, min_windows: int = 3,
+                      stale_s: float = 60.0,
+                      ranks: Optional[Dict[str, int]] = None,
+                      version: Optional[int] = None) -> List[Finding]:
+    """Per-rank step-time skew: an instance whose step p50 exceeds the
+    cluster (lower-)median by ``skew``x in each of the last
+    ``min_windows`` windows.  Requires >= 2 comparable instances — a
+    lone worker has no cluster to lag behind."""
+    series: Dict[str, List[float]] = {}
+    for inst in _fresh_instances(history, stale_s):
+        pts = history.series(inst, "kungfu_tpu_step_seconds",
+                             {"quantile": "0.5"})
+        if len(pts) >= min_windows:
+            series[inst] = [v for _ts, v in pts[-min_windows:]]
+    if len(series) < 2:
+        return []
+    medians = [_lower_median([vals[w] for vals in series.values()])
+               for w in range(min_windows)]
+    findings: List[Finding] = []
+    for inst, vals in sorted(series.items()):
+        ratios = [v / m for v, m in zip(vals, medians) if m > 0]
+        if len(ratios) < min_windows or not all(r > skew for r in ratios):
+            continue
+        mean_ratio = sum(ratios) / len(ratios)
+        findings.append(Finding(
+            kind="straggler",
+            severity=SEV_CRITICAL if mean_ratio > 2 * skew else SEV_WARN,
+            instance=inst,
+            rank=(ranks or {}).get(inst),
+            windows=min_windows,
+            evidence={"step_p50_s": round(vals[-1], 6),
+                      "cluster_median_s": round(medians[-1], 6),
+                      "skew_ratio": round(mean_ratio, 3)},
+            action="inspect the host (co-tenancy, thermal throttle, IO); "
+                   "if persistent, exclude the rank via propose_exclusion "
+                   "or rebalance its shard",
+            version=version, detected_ts=time.time()))
+    return findings
+
+
+def detect_interference(history: MetricsHistory, *,
+                        regress: float = 2.0, min_windows: int = 3,
+                        stale_s: float = 60.0,
+                        ranks: Optional[Dict[str, int]] = None,
+                        version: Optional[int] = None) -> List[Finding]:
+    """Collective-latency regression vs a rolling baseline, per
+    collective name: recent mean p50 > ``regress`` x the median of the
+    older windows (the paper's interference signal — network/ICI
+    contention shows up in collectives before it shows up in loss)."""
+    findings: List[Finding] = []
+    for inst in _fresh_instances(history, stale_s):
+        for cname in history.label_values(
+                inst, "kungfu_tpu_collective_seconds", "name"):
+            pts = history.series(inst, "kungfu_tpu_collective_seconds",
+                                 {"name": cname, "quantile": "0.5"})
+            # need a baseline at least as long as the recent window
+            if len(pts) < 2 * min_windows:
+                continue
+            baseline_vals = [v for _ts, v in pts[:-min_windows]]
+            recent_vals = [v for _ts, v in pts[-min_windows:]]
+            baseline = _lower_median(baseline_vals)
+            recent = sum(recent_vals) / len(recent_vals)
+            if baseline <= 0 or recent <= regress * baseline:
+                continue
+            ratio = recent / baseline
+            findings.append(Finding(
+                kind="interference",
+                severity=SEV_CRITICAL if ratio > 2 * regress else SEV_WARN,
+                instance=inst,
+                rank=(ranks or {}).get(inst),
+                windows=min_windows,
+                evidence={"collective": cname,
+                          "recent_p50_s": round(recent, 6),
+                          "baseline_p50_s": round(baseline, 6),
+                          "regress_ratio": round(ratio, 3)},
+                action="check for co-located jobs / link contention on the "
+                       "instance; consider switching strategy "
+                       "(session.auto_adapt) or draining the noisy neighbor",
+                version=version, detected_ts=time.time()))
+    return findings
+
+
+def detect_control_plane(history: MetricsHistory, *,
+                         lease_age_s: float = 10.0, outage_s: float = 5.0,
+                         miss_delta: float = 3.0, min_windows: int = 3,
+                         ranks: Optional[Dict[str, int]] = None,
+                         version: Optional[int] = None) -> List[Finding]:
+    """Control-plane correlation over the LAUNCHER's own metrics
+    (fed into the history as instance ``runner``): stale liveness
+    leases, growing heartbeat misses, and rpc outages, attributed to the
+    peer/server their labels name."""
+    snaps = history.snapshots(RUNNER_INSTANCE)
+    if not snaps:
+        return []
+    latest = snaps[-1]
+    now = time.time()
+    findings: List[Finding] = []
+    for (name, labels), value in sorted(latest.samples.items()):
+        lab = dict(labels)
+        if name == "kungfu_tpu_lease_age_seconds" and value > lease_age_s:
+            peer = lab.get("peer", "?")
+            findings.append(Finding(
+                kind="control-plane", severity=SEV_CRITICAL,
+                instance=peer, rank=(ranks or {}).get(peer), windows=1,
+                evidence={"signal": "lease-age",
+                          "lease_age_s": round(value, 3),
+                          "threshold_s": lease_age_s},
+                action="worker step loop is likely wedged (hung collective "
+                       "/ stuck DMA); the watcher escalates at "
+                       "KFT_LEASE_TTL_S — or exclude the rank now",
+                version=version, detected_ts=now))
+        elif name == "kungfu_tpu_rpc_outage_seconds" and value > outage_s:
+            server = lab.get("server", "?")
+            findings.append(Finding(
+                kind="control-plane", severity=SEV_WARN,
+                instance=server, rank=None, windows=1,
+                evidence={"signal": "rpc-outage",
+                          "outage_s": round(value, 3),
+                          "threshold_s": outage_s},
+                action="config server was unreachable; check its host / "
+                       "restart it (the WAL makes restarts safe)",
+                version=version, detected_ts=now))
+    # heartbeat misses: a *growing* counter over the recent windows — the
+    # absolute value only says a worker once had a bad day
+    recent = snaps[-(min_windows + 1):]
+    if len(recent) >= 2:
+        for (name, labels), last_v in sorted(recent[-1].samples.items()):
+            if name != "kungfu_tpu_heartbeat_misses_total":
+                continue
+            first_v = recent[0].samples.get((name, labels), 0.0)
+            delta = last_v - first_v
+            if delta < miss_delta:
+                continue
+            peer = dict(labels).get("peer", "?")
+            findings.append(Finding(
+                kind="control-plane", severity=SEV_WARN,
+                instance=peer, rank=(ranks or {}).get(peer),
+                windows=len(recent) - 1,
+                evidence={"signal": "heartbeat-misses",
+                          "missed": delta,
+                          "threshold": miss_delta},
+                action="worker cannot reach the config server; check "
+                       "DNS/routes from that host — its lease will "
+                       "expire if this continues",
+                version=version, detected_ts=now))
+    return findings
+
+
+class Doctor:
+    """History + detector suite + export.
+
+    ``diagnose()`` runs every detector over the current history and
+    handles the side channels: new findings (and clears) are
+    kftrace-traced, and every active finding holds a
+    ``kungfu_tpu_finding_active{kind,rank}`` gauge at 1 (cleared ones
+    drop to 0, so dashboards see recovery, not just silence).
+
+    Thresholds resolve from env once at construction:
+
+    =====================  =======  =====================================
+    env                    default  meaning
+    =====================  =======  =====================================
+    KFT_DOCTOR_SKEW        1.5      straggler: step-p50 / cluster median
+    KFT_DOCTOR_WINDOWS     3        consecutive windows of evidence
+    KFT_DOCTOR_REGRESS     2.0      interference: recent / baseline p50
+    KFT_DOCTOR_LEASE_S     10.0     control-plane: lease age alarm
+    KFT_DOCTOR_OUTAGE_S    5.0      control-plane: rpc outage alarm
+    KFT_DOCTOR_MISSES      3        control-plane: heartbeat-miss growth
+    KFT_DOCTOR_STALE_S     60.0     ignore instances not scraped lately
+    =====================  =======  =====================================
+    """
+
+    def __init__(self, history: Optional[MetricsHistory] = None,
+                 window: int = 64,
+                 monitor: Optional[Monitor] = None):
+        self.history = history if history is not None \
+            else MetricsHistory(window=window)
+        self._mon = monitor
+        self.skew = _env_float("KFT_DOCTOR_SKEW", 1.5)
+        self.min_windows = max(1, int(_env_float("KFT_DOCTOR_WINDOWS", 3)))
+        self.regress = _env_float("KFT_DOCTOR_REGRESS", 2.0)
+        self.lease_age_s = _env_float("KFT_DOCTOR_LEASE_S", 10.0)
+        self.outage_s = _env_float("KFT_DOCTOR_OUTAGE_S", 5.0)
+        self.miss_delta = _env_float("KFT_DOCTOR_MISSES", 3.0)
+        self.stale_s = _env_float("KFT_DOCTOR_STALE_S", 60.0)
+        self._active: Dict[Tuple[str, str], Finding] = {}
+        self.last: List[Finding] = []
+
+    def observe(self, instance: str, text: str,
+                ts: Optional[float] = None) -> None:
+        """Feed one instance's raw /metrics text into the history."""
+        self.history.observe_text(instance, text, ts=ts)
+
+    def diagnose(self, ranks: Optional[Dict[str, int]] = None,
+                 version: Optional[int] = None) -> List[Finding]:
+        findings = (
+            detect_stragglers(self.history, skew=self.skew,
+                              min_windows=self.min_windows,
+                              stale_s=self.stale_s,
+                              ranks=ranks, version=version)
+            + detect_interference(self.history, regress=self.regress,
+                                  min_windows=self.min_windows,
+                                  stale_s=self.stale_s,
+                                  ranks=ranks, version=version)
+            + detect_control_plane(self.history,
+                                   lease_age_s=self.lease_age_s,
+                                   outage_s=self.outage_s,
+                                   miss_delta=self.miss_delta,
+                                   min_windows=self.min_windows,
+                                   ranks=ranks, version=version))
+        self._export(findings)
+        self.last = findings
+        return findings
+
+    def _export(self, findings: List[Finding]) -> None:
+        """Gauges + trace on the ACTIVE-SET TRANSITIONS — re-diagnosing
+        an unchanged cluster re-emits nothing."""
+        from .. import trace as _trace
+        mon = self._mon if self._mon is not None else get_monitor()
+        now_active = {f.key(): f for f in findings}
+        for key in self._active:
+            if key not in now_active:
+                mon.set_gauge("kungfu_tpu_finding_active", 0.0,
+                              labels={"kind": key[0], "rank": key[1]})
+                _trace.event("doctor.cleared", category="doctor",
+                             attrs={"kind": key[0], "rank": key[1]})
+        for key, f in now_active.items():
+            mon.set_gauge("kungfu_tpu_finding_active", 1.0,
+                          labels={"kind": key[0], "rank": key[1]})
+            if key not in self._active:
+                _trace.event("doctor.finding", category="doctor",
+                             rank=f.rank, version=f.version,
+                             attrs=f.to_dict())
+        self._active = now_active
+
+
+def render_report(findings: Iterable[Finding]) -> str:
+    """The ``kft-doctor`` human report: one block per finding, worst
+    first."""
+    order = {SEV_CRITICAL: 0, SEV_WARN: 1}
+    fs = sorted(findings, key=lambda f: (order.get(f.severity, 2), f.kind))
+    if not fs:
+        return "kft-doctor: no findings — cluster looks healthy\n"
+    out = [f"kft-doctor: {len(fs)} finding(s)"]
+    for f in fs:
+        out.append("  " + f.describe())
+        out.append(f"      action: {f.action}")
+        if f.version is not None:
+            out.append(f"      membership version: {f.version}")
+    return "\n".join(out) + "\n"
+
+
+class PeerLatencyProber:
+    """Host-plane peer-latency monitor (the paper's peer-latency probe):
+    a daemon thread that, every ``interval_s``, GETs each peer's
+    /metrics endpoint through the kfguard rpc client and feeds the
+    round-trip into ``kungfu_tpu_peer_latency_seconds{peer=...}``
+    (failures count ``kungfu_tpu_peer_probe_failures_total``).
+
+    ``targets_fn`` returns the CURRENT ``[(host, worker_port), ...]`` —
+    membership changes between probes are picked up for free."""
+
+    def __init__(self, targets_fn, interval_s: float = 2.0,
+                 attempt_timeout: float = 1.0,
+                 monitor: Optional[Monitor] = None):
+        self._targets_fn = targets_fn
+        self.interval_s = max(0.05, float(interval_s))
+        self.attempt_timeout = float(attempt_timeout)
+        self._mon = monitor
+        self.probes = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kft-peer-prober")
+
+    def start(self) -> "PeerLatencyProber":
+        self._thread.start()
+        return self
+
+    def probe_once(self) -> None:
+        from ..utils import rpc as _rpc
+        mon = self._mon if self._mon is not None else get_monitor()
+        for host, port in list(self._targets_fn()):
+            peer = f"{host}:{port}"
+            url = (f"http://{host}:{port + MONITOR_PORT_OFFSET}/metrics")
+            t0 = time.perf_counter()
+            try:
+                _rpc.call(url, attempt_timeout=self.attempt_timeout)
+                mon.observe("kungfu_tpu_peer_latency_seconds",
+                            time.perf_counter() - t0,
+                            labels={"peer": peer})
+                self.probes += 1
+            except (OSError, ValueError):
+                # an unreachable peer IS the measurement: count it (the
+                # doctor and operators read the counter, not a log)
+                self.failures += 1
+                mon.inc("kungfu_tpu_peer_probe_failures_total",
+                        labels={"peer": peer})
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.probe_once()
+            self._stop.wait(self.interval_s)
+
+    def stop(self, join_timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout)
+
+    @classmethod
+    def from_env(cls, targets_fn) -> Optional["PeerLatencyProber"]:
+        """KFT_PEER_PROBE_S > 0 enables probing at that interval."""
+        interval = _env_float("KFT_PEER_PROBE_S", 0.0)
+        if interval <= 0:
+            return None
+        return cls(targets_fn, interval_s=interval).start()
+
+
+# ----------------------------------------------------------------- CLI
+def _findings_from_url(url: str) -> List[Finding]:
+    import urllib.request
+    if not url.rstrip("/").endswith("/findings"):
+        url = url.rstrip("/") + "/findings"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        doc = json.loads(r.read().decode())
+    rows = doc["findings"] if isinstance(doc, dict) else doc
+    return [Finding.from_dict(d) for d in rows]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="kft-doctor",
+        description="diagnose a kungfu_tpu cluster: straggler / "
+                    "interference / control-plane findings from the "
+                    "watcher's /findings endpoint or a saved metrics "
+                    "history (docs/monitoring.md)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="watcher debug address (e.g. "
+                     "http://127.0.0.1:PORT); /findings is appended")
+    src.add_argument("--history", metavar="FILE.jsonl",
+                     help="offline: a MetricsHistory JSONL capture to "
+                          "run the detectors over")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw findings JSON instead of the report")
+    ap.add_argument("--fail-on-critical", action="store_true",
+                    help="exit 1 when any critical finding is active "
+                         "(for CI/cron gates)")
+    args = ap.parse_args(argv)
+    if args.url:
+        try:
+            findings = _findings_from_url(args.url)
+        except (OSError, ValueError) as e:
+            # a dead watcher is an answer, not a traceback
+            print(f"kft-doctor: cannot reach {args.url}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        doc = Doctor(history=MetricsHistory.load(args.history),
+                     monitor=Monitor())  # offline: no global gauges
+        findings = doc.diagnose()
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        sys.stdout.write(render_report(findings))
+    if args.fail_on_critical and any(
+            f.severity == SEV_CRITICAL for f in findings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""Metrics history: a bounded ring of timestamped scrape samples.
+
+The aggregator (:mod:`kungfu_tpu.monitor.cluster`) used to keep only
+point-in-time text — enough for a human curl, useless for diagnosis:
+"is rank 3 slow *now*" needs "slower than the cluster, for how long".
+:class:`MetricsHistory` retains, per instance (``host:port``), the last
+``window`` parsed snapshots of that worker's exposition, timestamped at
+scrape time.  The kfdoctor detectors (:mod:`kungfu_tpu.monitor.doctor`)
+run over these windows.
+
+Parsing inverts the Prometheus exposition this repo renders
+(:meth:`~kungfu_tpu.monitor.Monitor.render_metrics`): sample lines only,
+label values unescaped (the reference monitor.go serves the same shape).
+Snapshots serialize to JSONL (one snapshot per line) so a history can be
+captured on a cluster and diagnosed offline with ``kft-doctor
+--history`` (docs/monitoring.md "Diagnosis (kfdoctor)").
+"""
+from __future__ import annotations
+
+import collections
+import json
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["parse_metrics", "Snapshot", "MetricsHistory"]
+
+# sample line: `name{labels} value [ts]` | `name value [ts]`
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?( .*)$")
+# one label inside the braces; value body keeps escapes for _unescape
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# (metric name, sorted (label, value) pairs) — same key shape as
+# Monitor._key, so render -> parse -> lookup round-trips
+SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _unescape(value: str) -> str:
+    """Invert _esc: one pass, so an escaped backslash never re-combines
+    with the next character into a spurious escape."""
+    return re.sub(r"\\(.)",
+                  lambda m: "\n" if m.group(1) == "n" else m.group(1),
+                  value)
+
+
+def parse_metrics(text: str) -> Dict[SeriesKey, float]:
+    """Parse an exposition into ``{(name, labels): value}``.
+
+    Comment/metadata lines and unparseable lines are skipped (a torn
+    line from a worker mid-write must not poison the snapshot) — the
+    same tolerance :func:`~kungfu_tpu.monitor.cluster._relabel` applies.
+    """
+    out: Dict[SeriesKey, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, label_body, rest = m.group(1), m.group(2), m.group(3)
+        fields = rest.split()
+        if not fields:
+            continue
+        try:
+            value = float(fields[0])
+        except ValueError:
+            continue
+        labels = tuple(sorted(
+            (k, _unescape(v)) for k, v in _LABEL_RE.findall(label_body or "")))
+        out[(name, labels)] = value
+    return out
+
+
+@dataclass
+class Snapshot:
+    """One scrape of one instance: wall timestamp + parsed samples."""
+    ts: float
+    samples: Dict[SeriesKey, float] = field(default_factory=dict)
+
+    def get(self, metric: str,
+            labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        key = (metric, tuple(sorted((labels or {}).items())))
+        return self.samples.get(key)
+
+
+class MetricsHistory:
+    """Per-instance bounded deque of :class:`Snapshot`.
+
+    Thread-safe: the watcher's debug handler, the periodic doctor scrape
+    and a test can feed/read it concurrently.  Accessors return copies.
+    """
+
+    def __init__(self, window: int = 64):
+        self._window = max(1, int(window))
+        self._lock = threading.Lock()
+        self._per: Dict[str, "collections.deque[Snapshot]"] = {}
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    def append(self, instance: str, samples: Dict[SeriesKey, float],
+               ts: Optional[float] = None) -> None:
+        snap = Snapshot(ts=time.time() if ts is None else float(ts),
+                        samples=dict(samples))
+        with self._lock:
+            ring = self._per.get(instance)
+            if ring is None:
+                ring = self._per[instance] = collections.deque(
+                    maxlen=self._window)
+            ring.append(snap)
+
+    def observe_text(self, instance: str, text: str,
+                     ts: Optional[float] = None) -> None:
+        """Parse one exposition and append it as a snapshot."""
+        self.append(instance, parse_metrics(text), ts=ts)
+
+    # ------------------------------------------------------------ queries
+    def instances(self) -> List[str]:
+        with self._lock:
+            return sorted(self._per)
+
+    def snapshots(self, instance: str) -> List[Snapshot]:
+        with self._lock:
+            return list(self._per.get(instance, ()))
+
+    def latest_ts(self) -> Optional[float]:
+        """Newest snapshot timestamp across all instances (detectors use
+        it to ignore instances that stopped being scraped)."""
+        with self._lock:
+            newest = [ring[-1].ts for ring in self._per.values() if ring]
+        return max(newest) if newest else None
+
+    def series(self, instance: str, metric: str,
+               labels: Optional[Dict[str, str]] = None
+               ) -> List[Tuple[float, float]]:
+        """``(ts, value)`` per snapshot for one series.  ``labels`` is a
+        subset match: a sample qualifies when it carries at least those
+        label pairs (so ``{"quantile": "0.5"}`` finds the p50 line
+        whatever other labels the renderer added).  Snapshots where the
+        subset is ambiguous (several series match) contribute nothing —
+        detectors must name their series precisely."""
+        want = set((labels or {}).items())
+        pts: List[Tuple[float, float]] = []
+        for snap in self.snapshots(instance):
+            hits = [v for (name, lab), v in snap.samples.items()
+                    if name == metric and want.issubset(lab)]
+            if len(hits) == 1:
+                pts.append((snap.ts, hits[0]))
+        return pts
+
+    def label_values(self, instance: str, metric: str,
+                     label: str) -> List[str]:
+        """Distinct values of one label across a metric's samples (e.g.
+        every collective ``name`` seen for an instance)."""
+        vals = set()
+        for snap in self.snapshots(instance):
+            for (name, lab), _v in snap.samples.items():
+                if name == metric:
+                    for k, v in lab:
+                        if k == label:
+                            vals.add(v)
+        return sorted(vals)
+
+    # ------------------------------------------------------- persistence
+    def save(self, path: str) -> None:
+        """JSONL, one snapshot per line, oldest first per instance."""
+        with self._lock:
+            rows = [(inst, snap) for inst, ring in sorted(self._per.items())
+                    for snap in ring]
+        with open(path, "w") as f:
+            for inst, snap in rows:
+                f.write(json.dumps({
+                    "instance": inst, "ts": snap.ts,
+                    "samples": [[name, dict(lab), v]
+                                for (name, lab), v in snap.samples.items()],
+                }) + "\n")
+
+    @classmethod
+    def load(cls, path: str, window: int = 0) -> "MetricsHistory":
+        """Inverse of :meth:`save`; ``window=0`` sizes the ring to hold
+        everything in the file."""
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                samples = {(name, tuple(sorted(lab.items()))): float(v)
+                           for name, lab, v in doc["samples"]}
+                rows.append((doc["instance"], doc["ts"], samples))
+        if window <= 0:
+            per_count: Dict[str, int] = {}
+            for inst, _ts, _s in rows:
+                per_count[inst] = per_count.get(inst, 0) + 1
+            window = max(per_count.values(), default=1)
+        h = cls(window=window)
+        for inst, ts, samples in rows:
+            h.append(inst, samples, ts=ts)
+        return h

@@ -37,8 +37,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import trace as _trace
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
+from ..monitor import get_monitor
 from .cache import (init_paged_pools, lookup_blocks, pool_attend,
                     pool_attend_queries, pool_write_at,
                     pool_write_prompt_batch, pool_write_token)
@@ -64,6 +66,12 @@ class Request:
     # Ignored when temperature == 0 (greedy).
     top_k: int = 0
     top_p: float = 1.0
+    # when the request entered the system (perf_counter clock); the
+    # front-end stamps it at construction, submit() back-fills, and a
+    # preemption re-stamps on requeue — queue-wait observability
+    # (kungfu_tpu_serving_queue_wait_seconds) measures the CURRENT wait,
+    # not wait-plus-discarded-compute
+    arrival_t: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -690,6 +698,12 @@ class DecodeEngine:
                 cfg, block_size, self.G, mesh, tp_axis, prep=prep,
                 pspecs=pspecs)
         self.stats = EngineStats(num_slots)
+        # serving latency observability (docs/monitoring.md): admission
+        # wall clock per in-flight uid (request span = admit -> harvest)
+        # and lifetime denominators for the prefix-cache gauges
+        self._admit_t: Dict[int, float] = {}
+        self._admitted_total = 0
+        self._prompt_tokens_total = 0
 
     # ------------------------------------------------------------- admin
     def validate_shape(self, req: Request) -> None:
@@ -724,6 +738,8 @@ class DecodeEngine:
         if req.uid in in_flight:
             raise ValueError(f"request uid {req.uid} already in flight "
                              f"(uids key both results and sampling)")
+        if req.arrival_t is None:
+            req.arrival_t = time.perf_counter()
         self._queue.append(req)
 
     def _bucket(self, n: int) -> int:
@@ -941,6 +957,7 @@ class DecodeEngine:
                 temps[g] = req.temperature
                 topks[g] = req.top_k
                 topps[g] = req.top_p
+            _t_prefill = time.perf_counter()
             if t_cacheds.any():
                 # at least one cached prefix: the suffix program (reads
                 # the shared blocks through the tables)
@@ -964,6 +981,26 @@ class DecodeEngine:
                     jnp.asarray(topps))
             tok0s = np.asarray(tok0s)
             self.stats.prefills += 1
+            now = time.perf_counter()
+            mon = get_monitor()
+            mon.observe("kungfu_tpu_serving_prefill_seconds",
+                        now - _t_prefill)
+            _trace.event("serving.prefill", category="serving",
+                         dur=now - _t_prefill,
+                         attrs={"batch": len(batch), "bucket": Tb})
+            for req, _slot, _blocks, _tc in batch:
+                self._admitted_total += 1
+                self._prompt_tokens_total += len(req.prompt)
+                if req.arrival_t is not None:
+                    mon.observe("kungfu_tpu_serving_queue_wait_seconds",
+                                now - req.arrival_t)
+                self._admit_t[req.uid] = now
+            mon.set_gauge("kungfu_tpu_serving_prefix_hit_rate",
+                          self.stats.prefix_hits
+                          / max(1, self._admitted_total))
+            mon.set_gauge("kungfu_tpu_serving_prefix_token_reuse",
+                          self.stats.prefix_tokens_reused
+                          / max(1, self._prompt_tokens_total))
             for g, (req, slot, blocks, t_cached) in enumerate(batch):
                 self._admit_split[req.uid] = t_cached
                 self._cache_insert(req, blocks)
@@ -1004,6 +1041,15 @@ class DecodeEngine:
     def _harvest(self, slot: int) -> None:
         run = self._running[slot]
         self._emit(run)
+        t_admit = self._admit_t.pop(run.req.uid, None)
+        if t_admit is not None:
+            # the per-request span (renders as one bar per request in
+            # the merged Chrome trace: admit -> last token)
+            _trace.event("serving.request", category="serving",
+                         dur=time.perf_counter() - t_admit,
+                         attrs={"uid": run.req.uid,
+                                "prompt": len(run.req.prompt),
+                                "tokens": len(run.out)})
         self._emitted.pop(run.req.uid, None)
         self._results[run.req.uid] = run.out
         self._admit_split.pop(run.req.uid, None)
@@ -1025,6 +1071,8 @@ class DecodeEngine:
         if victim is None:
             return False
         run = self._running[victim]
+        run.req.arrival_t = time.perf_counter()  # re-queued: wait restarts
+        self._admit_t.pop(run.req.uid, None)
         self._queue.appendleft(run.req)
         # its generated-so-far tokens are discarded and will be
         # regenerated on replay: don't count them twice
@@ -1115,6 +1163,7 @@ class DecodeEngine:
             draft[slot, 0] = self._tok[slot]
             draft[slot, 1:1 + len(d)] = d
             dlen[slot] = len(d)
+        _t_decode = time.perf_counter()
         preds, self.pools = self._verify(
             self.params, self.pools, jnp.asarray(self._tables),
             jnp.asarray(self._pos), jnp.asarray(draft),
@@ -1122,10 +1171,12 @@ class DecodeEngine:
             jnp.asarray(self._tcount), jnp.asarray(self._temp),
             jnp.asarray(self._topk), jnp.asarray(self._topp))
         preds = np.asarray(preds)                    # [S, Q] — ONE sync
+        _dt_decode = time.perf_counter() - _t_decode
         # a verify dispatch budgets Q positions per slot (occupancy then
         # reads emitted/(Q*slots), comparable with chunk mode's K)
         self.stats.decode_steps += Q
         self.stats.dispatches += 1
+        _tokens_before = self.stats.tokens_out
         for slot in active:
             run = self._running[slot]
             # longest drafted prefix matching the model's own predictions
@@ -1149,7 +1200,16 @@ class DecodeEngine:
                 self._pos[slot] += n_new
                 self._tok[slot] = emitted[-1]
                 self._tcount[slot] += n_new
+        self._observe_decode(_dt_decode,
+                             self.stats.tokens_out - _tokens_before)
         return True
+
+    def _observe_decode(self, dt: float, emitted: int) -> None:
+        """Per-token decode latency: one dispatch's wall time amortized
+        over the tokens it emitted (the p50/p99 a traffic bench reads)."""
+        if emitted > 0:
+            get_monitor().observe("kungfu_tpu_serving_decode_token_seconds",
+                                  dt / emitted)
 
     def step(self) -> bool:
         """One scheduler tick: admit, guarantee memory, ONE device
@@ -1162,6 +1222,7 @@ class DecodeEngine:
         active = [s for s in range(self.S) if self._running[s] is not None]
         if not active:
             return bool(self._queue)
+        _t_decode = time.perf_counter()
         toks, self.pools = self._decode(
             self.params, self.pools, jnp.asarray(self._tables),
             jnp.asarray(self._pos), jnp.asarray(self._tok),
@@ -1169,8 +1230,10 @@ class DecodeEngine:
             jnp.asarray(self._tcount), jnp.asarray(self._temp),
             jnp.asarray(self._topk), jnp.asarray(self._topp))
         toks = np.asarray(toks)                      # [K, S] — ONE sync
+        _dt_decode = time.perf_counter() - _t_decode
         self.stats.decode_steps += self.K
         self.stats.dispatches += 1
+        _tokens_before = self.stats.tokens_out
         for slot in active:
             run = self._running[slot]
             for j in range(self.K):
@@ -1185,6 +1248,8 @@ class DecodeEngine:
                 self._pos[slot] += self.K
                 self._tok[slot] = int(toks[self.K - 1, slot])
                 self._tcount[slot] += self.K
+        self._observe_decode(_dt_decode,
+                             self.stats.tokens_out - _tokens_before)
         return True
 
     @property

@@ -100,6 +100,17 @@ class ServingServer:
                              pending=depth,
                              busy=server.engine.busy)
                     self._reply(200, s)
+                elif self.path.startswith("/metrics"):
+                    # Prometheus exposition: queue-wait / prefill /
+                    # per-token decode summaries + prefix-cache gauges
+                    # the engine feeds (docs/monitoring.md)
+                    from ..monitor import get_monitor
+                    body = get_monitor().render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._reply(404, {"error": "unknown path"})
 
@@ -202,7 +213,8 @@ class ServingServer:
             uid = self._next_uid
             self._next_uid += 1
             req = Request(uid=uid, prompt=prompt, max_new=max_new,
-                          eos=eos, temperature=temperature)
+                          eos=eos, temperature=temperature,
+                          arrival_t=time.perf_counter())
             # validate NOW so the caller gets a 422, not a wedged wait
             # (shape checks only — stateless, so no race with the
             # scheduler thread that owns the engine)

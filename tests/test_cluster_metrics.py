@@ -73,6 +73,67 @@ def test_aggregate_two_live_workers_and_one_dead():
     assert "kungfu_tpu_cluster_workers 3" in body
 
 
+def test_aggregate_mid_scrape_timeout_never_aborts():
+    """A dead-but-accepting target (socket listens, nobody answers)
+    wedges the scrape mid-request; the aggregation must time out into
+    ``worker_up 0`` for THAT instance and still merge the live one."""
+    import socket
+
+    from kungfu_tpu.monitor.history import MetricsHistory
+    live = MetricsServer(_worker_monitor(0)).start()
+    wedge = socket.socket()
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(1)          # accepts, then never reads or replies
+    hist = MetricsHistory()
+    try:
+        targets = [
+            ("127.0.0.1", live.port - MONITOR_PORT_OFFSET),
+            ("127.0.0.1",
+             wedge.getsockname()[1] - MONITOR_PORT_OFFSET)]
+        body = mcluster.aggregate(targets, timeout=0.5, history=hist)
+    finally:
+        live.stop()
+        wedge.close()
+    i_live = f"127.0.0.1:{targets[0][1]}"
+    i_dead = f"127.0.0.1:{targets[1][1]}"
+    assert f'kungfu_tpu_worker_up{{instance="{i_live}"}} 1' in body
+    assert f'kungfu_tpu_worker_up{{instance="{i_dead}"}} 0' in body
+    assert f'instance="{i_live}",target="ici"' in body
+    # only the successful scrape lands in the kfdoctor history
+    assert list(hist.instances()) == [i_live]
+
+
+def test_aggregate_mid_read_death_yields_worker_up_zero():
+    """A worker that sends headers then dies mid-body raises
+    http.client.IncompleteRead (an HTTPException, NOT OSError) — it
+    must degrade to worker_up 0, not abort the aggregation."""
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def serve_short():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(b"HTTP/1.0 200 OK\r\n"
+                     b"Content-Length: 100\r\n\r\nshort")
+        conn.close()
+
+    t = threading.Thread(target=serve_short, daemon=True)
+    t.start()
+    try:
+        port = srv.getsockname()[1] - MONITOR_PORT_OFFSET
+        body = mcluster.aggregate([("127.0.0.1", port)], timeout=2.0)
+    finally:
+        t.join(timeout=5)
+        srv.close()
+    assert (f'kungfu_tpu_worker_up{{instance="127.0.0.1:{port}"}} 0'
+            in body)
+    assert "kungfu_tpu_cluster_workers 1" in body
+
+
 # ------------------------------------------- the watcher's debug endpoint
 class _AliveProc:
     def poll(self):

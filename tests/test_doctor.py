@@ -1,0 +1,366 @@
+"""kfdoctor: the diagnosis plane (kungfu_tpu.monitor.doctor/.history).
+
+Detectors over synthetic scrape histories: the straggler must be NAMED
+(instance and rank), a healthy cluster must stay silent, one slow
+window must not page anyone, and the export side (finding gauges,
+/findings endpoint, the kft-doctor CLI) must round-trip the findings.
+"""
+import json
+import math
+import sys
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.monitor import MONITOR_PORT_OFFSET, MetricsServer, Monitor
+from kungfu_tpu.monitor.doctor import (Doctor, Finding, PeerLatencyProber,
+                                       detect_control_plane,
+                                       detect_interference,
+                                       detect_stragglers, render_report)
+from kungfu_tpu.monitor.history import MetricsHistory, parse_metrics
+
+
+def _step_expo(p50: float) -> str:
+    return (f'kungfu_tpu_step_seconds{{quantile="0.5"}} {p50}\n'
+            f"kungfu_tpu_step_seconds_sum {p50 * 3}\n"
+            f"kungfu_tpu_step_seconds_count 3\n")
+
+
+def _coll_expo(p50: float, name: str = "allreduce") -> str:
+    return (f'kungfu_tpu_collective_seconds'
+            f'{{name="{name}",quantile="0.5"}} {p50}\n')
+
+
+def _feed(hist, rounds):
+    """rounds: list of {instance: p50} dicts, oldest first."""
+    for r in rounds:
+        for inst, p50 in r.items():
+            hist.observe_text(inst, _step_expo(p50))
+
+
+# ---------------------------------------------------------------- parsing
+def test_parse_metrics_skips_meta_and_torn_lines():
+    text = ("# HELP kungfu_tpu_step_seconds help\n"
+            "# TYPE kungfu_tpu_step_seconds summary\n"
+            'kungfu_tpu_step_seconds{quantile="0.5"} 0.25\n'
+            "kungfu_tpu_step_seconds_count 3\n"
+            "torn_line_without_value\n"
+            "not a metric at all {{{{\n")
+    samples = parse_metrics(text)
+    assert samples[("kungfu_tpu_step_seconds",
+                    (("quantile", "0.5"),))] == 0.25
+    assert samples[("kungfu_tpu_step_seconds_count", ())] == 3.0
+    assert len(samples) == 2
+
+
+def test_parse_metrics_unescapes_label_values():
+    text = 'm{name="a\\"b\\\\c\\nd"} 1\n'
+    ((_, labels),) = parse_metrics(text).keys()
+    assert dict(labels)["name"] == 'a"b\\c\nd'
+
+
+# ---------------------------------------------------------------- history
+def test_history_ring_is_bounded_per_instance():
+    h = MetricsHistory(window=3)
+    for i in range(5):
+        h.observe_text("w0", _step_expo(float(i)), ts=float(i))
+    snaps = h.snapshots("w0")
+    assert len(snaps) == 3
+    assert [s.ts for s in snaps] == [2.0, 3.0, 4.0]
+
+
+def test_history_series_subset_match_and_ambiguity():
+    h = MetricsHistory()
+    h.observe_text("w0", _coll_expo(0.1, "a") + _coll_expo(0.2, "b"))
+    # precise subset: unambiguous, one point
+    assert [v for _t, v in h.series(
+        "w0", "kungfu_tpu_collective_seconds",
+        {"name": "a", "quantile": "0.5"})] == [0.1]
+    # ambiguous subset (two names match): the snapshot contributes nothing
+    assert h.series("w0", "kungfu_tpu_collective_seconds",
+                    {"quantile": "0.5"}) == []
+    assert h.label_values("w0", "kungfu_tpu_collective_seconds",
+                          "name") == ["a", "b"]
+
+
+def test_history_jsonl_round_trip(tmp_path):
+    h = MetricsHistory(window=8)
+    h.observe_text("w0", 'm{k="v\\"q"} 1\n', ts=10.0)
+    h.observe_text("w0", 'm{k="v\\"q"} 2\n', ts=11.0)
+    h.observe_text("w1", "m 3\n", ts=12.0)
+    p = tmp_path / "hist.jsonl"
+    h.save(str(p))
+    h2 = MetricsHistory.load(str(p))
+    assert h2.instances() == ["w0", "w1"]
+    assert h2.series("w0", "m", {"k": 'v"q'}) == [(10.0, 1.0), (11.0, 2.0)]
+    assert h2.latest_ts() == 12.0
+
+
+# -------------------------------------------------------------- straggler
+def test_straggler_named_with_rank_and_critical():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": 0.1, "h1:2": 0.1, "h2:3": 1.0}] * 3)
+    ranks = {"h0:1": 0, "h1:2": 1, "h2:3": 2}
+    fs = detect_stragglers(h, ranks=ranks, version=7)
+    assert len(fs) == 1
+    f = fs[0]
+    assert (f.kind, f.instance, f.rank) == ("straggler", "h2:3", 2)
+    assert f.severity == "critical"          # 10x >> 2*skew
+    assert f.version == 7
+    assert f.evidence["skew_ratio"] == pytest.approx(10.0, rel=0.01)
+
+
+def test_straggler_clean_cluster_is_silent():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": 0.1, "h1:2": 0.11, "h2:3": 0.09}] * 4)
+    assert detect_stragglers(h) == []
+
+
+def test_straggler_needs_persistence_not_one_bad_window():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": 0.1, "h1:2": 0.1},
+              {"h0:1": 0.1, "h1:2": 0.1},
+              {"h0:1": 0.1, "h1:2": 1.0}])   # only the LAST window slow
+    assert detect_stragglers(h) == []
+
+
+def test_straggler_two_workers_median_is_the_fast_one():
+    """n=2 lower-median degenerates to min: the straggler cannot drag
+    its own baseline up and hide."""
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": 0.1, "h1:2": 0.5}] * 3)
+    fs = detect_stragglers(h)
+    assert [f.instance for f in fs] == ["h1:2"]
+
+
+def test_straggler_single_instance_has_no_cluster():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": 9.9}] * 5)
+    assert detect_stragglers(h) == []
+
+
+def test_straggler_ignores_stale_ghost_instance():
+    h = MetricsHistory()
+    for i in range(3):
+        h.observe_text("ghost:9", _step_expo(1.0), ts=float(i))
+    for i in range(3):
+        ts = 1000.0 + i
+        h.observe_text("h0:1", _step_expo(0.1), ts=ts)
+        h.observe_text("h1:2", _step_expo(0.1), ts=ts)
+    assert detect_stragglers(h, stale_s=60.0) == []
+
+
+# ----------------------------------------------------------- interference
+def test_interference_regression_vs_rolling_baseline():
+    h = MetricsHistory()
+    for p50 in (0.1, 0.1, 0.1, 0.5, 0.5, 0.5):
+        h.observe_text("h0:1", _coll_expo(p50))
+    fs = detect_interference(h)
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.kind == "interference"
+    assert f.evidence["collective"] == "allreduce"
+    assert f.evidence["regress_ratio"] == pytest.approx(5.0, rel=0.01)
+
+
+def test_interference_stable_latency_is_silent():
+    h = MetricsHistory()
+    for _ in range(8):
+        h.observe_text("h0:1", _coll_expo(0.1))
+    assert detect_interference(h) == []
+
+
+# ---------------------------------------------------------- control plane
+def test_control_plane_lease_outage_and_miss_growth():
+    h = MetricsHistory()
+    base = ('kungfu_tpu_lease_age_seconds{peer="127.0.0.1:31100"} 42.5\n'
+            'kungfu_tpu_rpc_outage_seconds{server="http://cs:1"} 9.0\n')
+    for misses in (0, 1, 3, 6):
+        h.observe_text(
+            "runner",
+            base + f'kungfu_tpu_heartbeat_misses_total'
+                   f'{{peer="127.0.0.1:31101"}} {misses}\n')
+    fs = detect_control_plane(h, ranks={"127.0.0.1:31100": 0,
+                                        "127.0.0.1:31101": 1})
+    by_signal = {f.evidence["signal"]: f for f in fs}
+    assert set(by_signal) == {"lease-age", "rpc-outage",
+                              "heartbeat-misses"}
+    assert by_signal["lease-age"].severity == "critical"
+    assert by_signal["lease-age"].rank == 0
+    assert by_signal["rpc-outage"].instance == "http://cs:1"
+    assert by_signal["heartbeat-misses"].rank == 1
+    assert by_signal["heartbeat-misses"].evidence["missed"] == 6.0
+
+
+def test_control_plane_quiet_metrics_no_findings():
+    h = MetricsHistory()
+    for _ in range(3):
+        h.observe_text(
+            "runner",
+            'kungfu_tpu_lease_age_seconds{peer="p"} 0.5\n'
+            'kungfu_tpu_heartbeat_misses_total{peer="p"} 1\n')
+    assert detect_control_plane(h) == []
+
+
+# ------------------------------------------------------- Doctor + export
+def test_doctor_gauges_raise_and_clear_on_transitions():
+    mon = Monitor()
+    doc = Doctor(monitor=mon)
+    for _ in range(3):
+        doc.observe("h0:1", _step_expo(0.1))
+        doc.observe("h1:2", _step_expo(1.0))
+    fs = doc.diagnose(ranks={"h0:1": 0, "h1:2": 1})
+    assert [f.rank for f in fs] == [1]
+    body = mon.render_metrics()
+    assert ('kungfu_tpu_finding_active{kind="straggler",rank="1"} 1'
+            in body)
+    # recovery: three healthy windows -> the gauge drops to 0, not gone
+    for _ in range(3):
+        doc.observe("h0:1", _step_expo(0.1))
+        doc.observe("h1:2", _step_expo(0.1))
+    assert doc.diagnose(ranks={"h0:1": 0, "h1:2": 1}) == []
+    body = mon.render_metrics()
+    assert ('kungfu_tpu_finding_active{kind="straggler",rank="1"} 0'
+            in body)
+
+
+def test_finding_dict_round_trip_ignores_unknown_keys():
+    f = Finding(kind="straggler", severity="warn", instance="h:1",
+                rank=3, windows=3, evidence={"x": 1}, action="act",
+                version=9)
+    d = f.to_dict()
+    d["extra_future_field"] = "ignored"
+    assert Finding.from_dict(d) == f
+    assert f.key() == ("straggler", "3")
+
+
+def test_render_report_healthy_and_with_findings():
+    assert "healthy" in render_report([])
+    f = Finding(kind="straggler", severity="critical", instance="h:1",
+                rank=0, windows=3, evidence={"skew_ratio": 4.0},
+                action="inspect the host", version=2)
+    rep = render_report([f])
+    assert "rank 0 (h:1)" in rep and "inspect the host" in rep
+    assert "membership version: 2" in rep
+
+
+# ------------------------------------------------- /findings end-to-end
+def test_watcher_findings_endpoint_names_slow_instance():
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import Watcher, _start_debug_server
+    from kungfu_tpu.plan import PeerID
+
+    class _AliveProc:
+        def poll(self):
+            return None
+
+    servers = []
+    for i in (0, 1):
+        mon = Monitor()
+        for _ in range(6):
+            mon.observe("kungfu_tpu_step_seconds",
+                        1.0 if i == 1 else 0.1)
+        servers.append(MetricsServer(mon).start())
+    dbg = None
+    try:
+        job = Job(prog=sys.executable, args=["-c", "pass"])
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 1))
+        w.current = {
+            PeerID("127.0.0.1", s.port - MONITOR_PORT_OFFSET, i):
+                _AliveProc()
+            for i, s in enumerate(servers)}
+        dbg = _start_debug_server(w, 0)
+        url = f"http://127.0.0.1:{dbg.port}/findings"
+        for _ in range(4):       # each GET is one scrape window
+            body = urllib.request.urlopen(
+                url, timeout=10).read().decode()
+        doc = json.loads(body)
+    finally:
+        if dbg is not None:
+            dbg.stop()
+        for s in servers:
+            s.stop()
+    slow = f"127.0.0.1:{servers[1].port - MONITOR_PORT_OFFSET}"
+    stragglers = [f for f in doc["findings"]
+                  if f["kind"] == "straggler"]
+    assert stragglers and all(f["instance"] == slow for f in stragglers)
+
+
+# ------------------------------------------------------------------ CLI
+def _mk_history_file(tmp_path, slow=True):
+    h = MetricsHistory(window=8)
+    for _ in range(4):
+        h.observe_text("h0:1", _step_expo(0.1))
+        h.observe_text("h1:2", _step_expo(1.0 if slow else 0.1))
+    p = tmp_path / "hist.jsonl"
+    h.save(str(p))
+    return str(p)
+
+
+def test_cli_history_report_and_json(tmp_path, capsys):
+    from kungfu_tpu.monitor import doctor as D
+    path = _mk_history_file(tmp_path)
+    assert D.main(["--history", path]) == 0
+    assert "straggler" in capsys.readouterr().out
+    assert D.main(["--history", path, "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["instance"] for r in rows
+            if r["kind"] == "straggler"] == ["h1:2"]
+    # --fail-on-critical gates: the 10x skew is critical -> exit 1
+    assert D.main(["--history", path, "--fail-on-critical"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_history_healthy_cluster(tmp_path, capsys):
+    path = _mk_history_file(tmp_path, slow=False)
+    from kungfu_tpu.monitor import doctor as D
+    assert D.main(["--history", path, "--fail-on-critical"]) == 0
+    assert "healthy" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ peer probes
+def test_peer_prober_live_and_dead_targets():
+    live = MetricsServer(Monitor()).start()
+    mon = Monitor()
+    try:
+        targets = [("127.0.0.1", live.port - MONITOR_PORT_OFFSET),
+                   ("127.0.0.1", 1)]      # nothing listens on 10001
+        prober = PeerLatencyProber(lambda: targets, monitor=mon,
+                                   attempt_timeout=1.0)
+        prober.probe_once()
+    finally:
+        live.stop()
+    body = mon.render_metrics()
+    peer = f"127.0.0.1:{live.port - MONITOR_PORT_OFFSET}"
+    assert (f'kungfu_tpu_peer_latency_seconds_count{{peer="{peer}"}} 1'
+            in body)
+    assert ('kungfu_tpu_peer_probe_failures_total{peer="127.0.0.1:1"} 1'
+            in body)
+    assert prober.probes == 1 and prober.failures == 1
+
+
+def test_peer_prober_from_env_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("KFT_PEER_PROBE_S", raising=False)
+    assert PeerLatencyProber.from_env(lambda: []) is None
+
+
+def test_peer_prober_thread_starts_and_stops(monkeypatch):
+    monkeypatch.setenv("KFT_PEER_PROBE_S", "0.05")
+    prober = PeerLatencyProber.from_env(lambda: [])
+    assert prober is not None
+    try:
+        assert prober._thread.is_alive()
+    finally:
+        prober.stop()
+    assert not prober._thread.is_alive()
+
+
+def test_env_knobs_resolve_at_construction(monkeypatch):
+    monkeypatch.setenv("KFT_DOCTOR_SKEW", "2.5")
+    monkeypatch.setenv("KFT_DOCTOR_WINDOWS", "5")
+    monkeypatch.setenv("KFT_DOCTOR_REGRESS", "banana")   # malformed
+    doc = Doctor(monitor=Monitor())
+    assert doc.skew == 2.5
+    assert doc.min_windows == 5
+    assert doc.regress == 2.0                            # fell back
+    assert math.isfinite(doc.stale_s)

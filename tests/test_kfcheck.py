@@ -232,6 +232,23 @@ def test_silent_except_positive_scoped_dirs(tmp_path):
     assert rules_fired(fs) == set()
 
 
+def test_silent_except_covers_kfdoctor_modules(tmp_path):
+    """The kfdoctor diagnosis plane (monitor/doctor.py, history.py) is
+    inside the silent-except scope — a doctor that eats its own errors
+    is worse than no doctor."""
+    src = """
+        def diagnose(history):
+            try:
+                detect(history)
+            except Exception:
+                pass
+    """
+    for rel in ("kungfu_tpu/monitor/doctor.py",
+                "kungfu_tpu/monitor/history.py"):
+        fs = run_on(tmp_path, src, relpath=rel)
+        assert rules_fired(fs) == {"silent-except"}, rel
+
+
 def test_silent_except_bare_and_negative(tmp_path):
     fs = run_on(tmp_path, """
         def a(url):

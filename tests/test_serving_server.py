@@ -79,6 +79,26 @@ def test_stats_endpoint(served):
     assert "tokens_out" in s and "pending" in s and "busy" in s
 
 
+def test_metrics_endpoint_exposes_serving_latency_quantiles(served):
+    """/metrics next to /stats: after a real generation, queue-wait /
+    prefill / per-token decode summaries render p50+p99 quantiles and
+    the prefix-cache gauges are present (docs/monitoring.md)."""
+    _, srv = served
+    _post(srv, {"prompt": [1, 2, 3, 4], "max_new": 4})
+    with urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/metrics", timeout=30) as r:
+        body = r.read().decode()
+    for metric in ("kungfu_tpu_serving_queue_wait_seconds",
+                   "kungfu_tpu_serving_prefill_seconds",
+                   "kungfu_tpu_serving_decode_token_seconds"):
+        assert f"# TYPE {metric} summary" in body, metric
+        assert f'{metric}{{quantile="0.5"}}' in body, metric
+        assert f'{metric}{{quantile="0.99"}}' in body, metric
+        assert f"{metric}_count" in body, metric
+    assert "# TYPE kungfu_tpu_serving_prefix_hit_rate gauge" in body
+    assert "kungfu_tpu_serving_prefix_token_reuse" in body
+
+
 def test_bad_requests_get_4xx_not_a_wedge(served):
     _, srv = served
     with pytest.raises(urllib.error.HTTPError) as e:

@@ -145,6 +145,86 @@ class TestMonitor:
         assert 'kungfu_tpu_egress_bytes_total{target="ici"} 1' in body
         assert "kungfu_tpu_provider_errors_total 1" in body
 
+    # ------------------------------------------- Summary edge cases
+    def test_summary_empty_quantiles_are_nan_and_render_safe(self):
+        import math
+        s = Summary()
+        assert math.isnan(s.quantile(0.5))
+        lines = s.render("empty_seconds")
+        # no quantile lines for an empty window, but sum/count render
+        assert not any("quantile" in l for l in lines)
+        assert "empty_seconds_sum 0" in lines
+        assert "empty_seconds_count 0" in lines
+
+    def test_summary_single_observation_all_quantiles_collapse(self):
+        s = Summary()
+        s.observe(0.25)
+        for q in Summary.QUANTILES:
+            assert s.quantile(q) == 0.25
+        lines = s.render("one_seconds")
+        assert 'one_seconds{quantile="0.99"} 0.25' in lines
+        assert "one_seconds_count 1" in lines
+
+    def test_summary_window_eviction_keeps_lifetime_sum_count(self):
+        """sum/count are lifetime totals; quantiles cover only the
+        sliding window — eviction must not corrupt either."""
+        s = Summary(window=4)
+        for v in range(1, 11):          # 1..10; window holds 7..10
+            s.observe(float(v))
+        assert s.count == 10
+        assert s.sum == pytest.approx(55.0)
+        assert s.quantile(0.0) == 7.0   # evicted samples really gone
+        assert s.quantile(0.99) == 10.0
+
+    def test_summary_label_escaping_round_trip(self):
+        """A hostile label value must survive render -> parse intact
+        (the kfdoctor history re-reads what the monitor writes)."""
+        from kungfu_tpu.monitor.history import parse_metrics
+        mon = Monitor()
+        nasty = 'he"llo\\world\nline2'
+        mon.observe("kungfu_tpu_collective_seconds", 0.5,
+                    labels={"name": nasty})
+        samples = parse_metrics(mon.render_metrics())
+        hits = [(k, v) for k, v in samples.items()
+                if k[0] == "kungfu_tpu_collective_seconds_count"]
+        assert len(hits) == 1
+        (_, labels), count = hits[0]
+        assert dict(labels)["name"] == nasty
+        assert count == 1.0
+
+    # ------------------------------------- label-cardinality cap
+    def test_labelset_cap_drops_new_series_keeps_existing(
+            self, monkeypatch, capsys):
+        monkeypatch.setenv("KFT_METRIC_MAX_LABELSETS", "2")
+        mon = Monitor()
+        for i in range(5):
+            mon.set_gauge("g_metric", float(i), labels={"uid": str(i)})
+        mon.inc("c_metric", labels={"uid": "a"})
+        mon.inc("c_metric", labels={"uid": "b"})
+        mon.inc("c_metric", labels={"uid": "c"})     # over the cap
+        mon.observe("s_metric", 1.0, labels={"uid": "x"})
+        mon.observe("s_metric", 1.0, labels={"uid": "y"})
+        mon.observe("s_metric", 1.0, labels={"uid": "z"})
+        # existing series still update past the cap
+        mon.set_gauge("g_metric", 9.0, labels={"uid": "0"})
+        mon.inc("c_metric", labels={"uid": "a"})
+        body = mon.render_metrics()
+        assert 'g_metric{uid="0"} 9' in body
+        assert 'g_metric{uid="1"} 1' in body
+        assert 'uid="2"' not in body and 'uid="4"' not in body
+        assert 'c_metric{uid="a"} 2' in body
+        assert 'c_metric{uid="c"}' not in body
+        assert 's_metric_count{uid="z"}' not in body
+        # one warning per metric, not per dropped sample
+        err = capsys.readouterr().err
+        assert err.count("g_metric hit the 2 label-set cap") == 1
+
+    def test_labelset_cap_malformed_env_falls_back(self, monkeypatch):
+        from kungfu_tpu.monitor import DEFAULT_MAX_LABELSETS
+        monkeypatch.setenv("KFT_METRIC_MAX_LABELSETS", "banana")
+        mon = Monitor()
+        assert mon._max_labelsets == DEFAULT_MAX_LABELSETS
+
 
 class TestNativeProviderLifecycle:
     """The native metrics provider path (native._maybe_start_metrics /

@@ -43,9 +43,11 @@ say() { printf '\n==== %s ====\n' "$*"; }
 say "0/3 kfcheck static analysis"
 python -m tools.kfcheck || exit 1
 
-# metrics/trace smoke: a real /metrics endpoint scraped over HTTP plus
-# the kftrace merger over a 2-worker fixture (~2 s; docs/monitoring.md)
-say "0b/3 metrics + trace smoke"
+# metrics/trace/doctor smoke (`make doctor-smoke`): a real /metrics
+# endpoint scraped over HTTP, the kftrace merger over a 2-worker
+# fixture, a watcher /findings endpoint attributing a step-time skew,
+# and the kft-doctor CLI over a saved history (~5 s; docs/monitoring.md)
+say "0b/3 metrics + trace + doctor smoke"
 python tools/metrics_trace_smoke.py || exit 1
 
 # kfsnap micro-bench smoke: the async zero-copy commit path must hold
@@ -111,6 +113,15 @@ else
   python -m kungfu_tpu.chaos.runner \
       --scenario config-server-crash-restart-mid-resize \
       --replay-check || fail=1
+
+  # kfdoctor proof: delay ONE rank at every fence; the doctor sampler
+  # scraping live worker /metrics must raise a straggler finding naming
+  # exactly that rank — and its clean twin must stay silent (the
+  # false-positive guard).  Same data-plane self-skip as above.
+  say "2e/3 kfchaos straggler-doctor attribution (+ clean twin)"
+  python -m kungfu_tpu.chaos.runner --scenario straggler-doctor || fail=1
+  python -m kungfu_tpu.chaos.runner \
+      --scenario straggler-doctor-clean || fail=1
 fi
 
 say "3/3 dryrun_multichip(8)"

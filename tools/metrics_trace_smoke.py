@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Metrics + trace smoke for CI (tools/ci.sh, fast path).
 
-Three cheap end-to-end checks, no pytest, no multi-process plane:
+Five cheap end-to-end checks, no pytest, no multi-process plane:
 
 1. /metrics — start a real :class:`~kungfu_tpu.monitor.MetricsServer`,
    feed counters, a summary, and a gauge, scrape it over HTTP, and
@@ -13,6 +13,13 @@ Three cheap end-to-end checks, no pytest, no multi-process plane:
 3. merger — run the ``tools/kftrace_merge.py`` CLI on that 2-worker
    fixture and validate the resulting Chrome-trace JSON: both pids
    present, spans aligned onto one monotonic timeline.
+4. /findings — a watcher debug server fronting one fast and one slow
+   worker must, after enough scrapes to fill the doctor's windows,
+   report a straggler Finding naming the slow instance (kfdoctor
+   end-to-end over real HTTP; ``make doctor-smoke``).
+5. kft-doctor CLI — run ``python -m kungfu_tpu.monitor.doctor
+   --history`` over a saved fixture history and assert the straggler
+   shows up in both the text report and ``--json`` output.
 
 Exit 0 on success, 1 with a message on any failure.
 """
@@ -100,11 +107,97 @@ def check_merge() -> None:
     assert max(ts) - min(ts) < 1e6, "anchor alignment failed"
 
 
+def check_findings() -> None:
+    """kfdoctor over the wire: two live workers with a 10x step-time
+    skew; the watcher's /findings endpoint must attribute it."""
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import Watcher, _start_debug_server
+    from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                    Monitor)
+    from kungfu_tpu.plan import PeerID
+
+    class _AliveProc:
+        def poll(self):
+            return None
+
+    servers = []
+    for i in (0, 1):
+        mon = Monitor()
+        for _ in range(8):
+            mon.observe("kungfu_tpu_step_seconds",
+                        1.0 if i == 1 else 0.1)
+        servers.append(MetricsServer(mon).start())
+    dbg = None
+    try:
+        job = Job(prog=sys.executable, args=["-c", "pass"])
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 1))
+        w.current = {
+            PeerID("127.0.0.1", s.port - MONITOR_PORT_OFFSET, i):
+                _AliveProc()
+            for i, s in enumerate(servers)}
+        dbg = _start_debug_server(w, 0)
+        url = f"http://127.0.0.1:{dbg.port}/findings"
+        # each GET is one scrape window; the straggler detector needs
+        # several consecutive skewed windows before it will speak
+        for _ in range(4):
+            body = urllib.request.urlopen(url, timeout=10).read().decode()
+        doc = json.loads(body)
+    finally:
+        if dbg is not None:
+            dbg.stop()
+        for s in servers:
+            s.stop()
+    slow = f"127.0.0.1:{servers[1].port - MONITOR_PORT_OFFSET}"
+    stragglers = [f for f in doc["findings"] if f["kind"] == "straggler"]
+    assert stragglers, f"no straggler finding in /findings: {doc}"
+    assert all(f["instance"] == slow for f in stragglers), \
+        f"straggler misattributed (slow={slow}): {stragglers}"
+
+
+def check_doctor_cli() -> None:
+    """kft-doctor offline mode: diagnose a saved history fixture."""
+    from kungfu_tpu.monitor.history import MetricsHistory
+
+    def expo(p50: float) -> str:
+        return (f'kungfu_tpu_step_seconds{{quantile="0.5"}} {p50}\n'
+                f"kungfu_tpu_step_seconds_sum {p50 * 3}\n"
+                f"kungfu_tpu_step_seconds_count 3\n")
+
+    hist = MetricsHistory(window=16)
+    for _ in range(4):
+        hist.observe_text("h0:1", expo(0.1))
+        hist.observe_text("h1:2", expo(0.1))
+        hist.observe_text("h2:3", expo(1.0))
+    tmp = tempfile.mkdtemp(prefix="kfdoctor-smoke-")
+    path = os.path.join(tmp, "history.jsonl")
+    hist.save(path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.monitor.doctor",
+         "--history", path],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "straggler" in proc.stdout, \
+        f"kft-doctor missed the straggler:\n{proc.stdout}{proc.stderr}"
+    proc = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.monitor.doctor",
+         "--history", path, "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    findings = json.loads(proc.stdout)
+    hits = [f for f in findings if f["kind"] == "straggler"]
+    assert hits and all(f["instance"] == "h2:3" for f in hits), \
+        f"unexpected --json findings: {findings}"
+
+
 def main() -> int:
     check_metrics()
     print("metrics-smoke: /metrics OK")
     check_merge()
     print("metrics-smoke: kftrace merge OK")
+    check_findings()
+    print("metrics-smoke: /findings straggler attribution OK")
+    check_doctor_cli()
+    print("metrics-smoke: kft-doctor CLI OK")
     return 0
 
 
