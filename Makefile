@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke clean
+.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -25,6 +25,13 @@ chaos-smoke: native
 # fixture (docs/monitoring.md "Diagnosis (kfdoctor)").
 doctor-smoke:
 	python tools/metrics_trace_smoke.py
+
+# kfprof smoke: the device-time attribution plane on CPU — step-phase
+# breakdown sums to wall time, /profile round-trips a capture, the
+# report table and BENCH-compatible JSON block render
+# (docs/monitoring.md "Profiling (kfprof)").
+prof-smoke:
+	python tools/kfprof_report.py --smoke
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

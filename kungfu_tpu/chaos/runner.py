@@ -368,6 +368,32 @@ def scenarios() -> Dict[str, Scenario]:
             timeout_s=420.0,
             doctor_expect={"absent_kind": "straggler"}),
         Scenario(
+            name="slow-compute-doctor",
+            desc="rank 1's compute window stalls 0.3s every step from "
+                 "step 8 (after a clean baseline accumulated): kfprof's "
+                 "roofline fraction collapses against its own history "
+                 "and kfdoctor must raise a perf finding whose kind "
+                 "names the dominant phase — compute-bound, rank 1",
+            plan=Plan(seed=None).add("elastic.step.compute", "delay",
+                                     rank=1, step=list(range(8, 30)),
+                                     count=22, delay_s=0.3),
+            nprocs=3,
+            target_steps=20,
+            timeout_s=420.0,
+            doctor_expect={"kind": "compute-bound", "rank": 1}),
+        Scenario(
+            name="slow-compute-doctor-clean",
+            desc="the same 3-proc workload with NO faults: a "
+                 "compute-bound perf finding here is a false positive "
+                 "(CPU runs sit far below the TPU roofline the whole "
+                 "time — only a drop against the run's own baseline "
+                 "may fire)",
+            plan=Plan(seed=None),
+            nprocs=3,
+            target_steps=20,
+            timeout_s=420.0,
+            doctor_expect={"absent_kind": "compute-bound"}),
+        Scenario(
             name="double-resize",
             desc="two proposals land back-to-back (3->2 and ->3 in one "
                  "step): the digest consensus must converge on exactly "

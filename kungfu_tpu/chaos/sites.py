@@ -21,6 +21,11 @@ SITES: Dict[str, str] = {
         "start of every step, before the version-fence allreduce — a "
         "delay here models a straggling peer at the fence; a kill, a "
         "mid-step preemption"),
+    "elastic.step.compute": (
+        "inside the timed compute window, after the batch device_put "
+        "and before the jitted step dispatch — a delay here models a "
+        "slow device (thermal throttle, co-tenant) and must surface as "
+        "a kfprof compute-bound perf finding"),
     "elastic.commit.begin": (
         "entry of _commit, before any state is snapshotted — a kill "
         "here loses nothing (the previous commit stands)"),

@@ -216,6 +216,10 @@ class DistributedElasticTrainer:
             body, mesh=self.mesh,
             in_specs=(P(), P(), P("dp")), out_specs=(P(), P(), P())))
         self._batch_sharding = NamedSharding(self.mesh, P("dp"))
+        # kfprof: the flops/HBM gauges follow the CURRENT program — each
+        # (re)build re-arms the one-shot cost analysis, so elastic
+        # resizes re-publish (monitor/profiler.py)
+        self._cost_published = False
 
     def _fetch_version(self) -> int:
         if not self.we.config_server:
@@ -468,6 +472,8 @@ class DistributedElasticTrainer:
         try:
             _t0 = _time.perf_counter()
             batch = jax.device_put(global_batch, self._batch_sharding)
+            _chaos_point("elastic.step.compute", rank=self.peer.rank,
+                         step=self.step_count, version=self.version)
             params, opt, loss = self._step(self._params, self._opt, batch)
             lossv = float(np.asarray(loss))  # blocks until the step ran
             self._last_step_s = _time.perf_counter() - _t0
@@ -497,9 +503,14 @@ class DistributedElasticTrainer:
             # compile-inflated first step would underestimate the
             # cadence by the compile/step ratio
             try:
+                _t_commit = _time.perf_counter()
                 self._measure_commit()
+                _commit_s = _time.perf_counter() - _t_commit
             except native.NativeError as e:
                 return self._recover(global_batch, cause=e)
+            self._publish_step_phases(
+                _time.perf_counter() - _t_entry, _fence_wait,
+                _commit_s, batch)
             return lossv
         if self._auto_snap and self.step_count >= 2:
             budget = _snapshot_budget()
@@ -531,9 +542,14 @@ class DistributedElasticTrainer:
                 # after step 1): measure one collective commit together
                 # now and derive at the next step
                 try:
+                    _t_commit = _time.perf_counter()
                     self._measure_commit()
+                    _commit_s = _time.perf_counter() - _t_commit
                 except native.NativeError as e:
                     return self._recover(global_batch, cause=e)
+                self._publish_step_phases(
+                    _time.perf_counter() - _t_entry, _fence_wait,
+                    _commit_s, batch)
                 return lossv
             self.snapshot_every = cadence
             self._auto_snap = False
@@ -543,16 +559,47 @@ class DistributedElasticTrainer:
                       f" (commit {self._auto_commit_s:.2f}s vs step "
                       f"{step_s:.3f}s, budget {budget:.0%})",
                       file=_sys.stderr)
+        _commit_s = 0.0
         if self.step_count % self.snapshot_every == 0:
             try:
+                _t_commit = _time.perf_counter()
                 self._commit()
+                _commit_s = _time.perf_counter() - _t_commit
             except native.NativeError as e:
                 # sharded commits ride the host plane (shard-replica
                 # exchange); a peer death there is a membership event
                 # like any other — an INCOMPLETE commit is never
                 # recorded, so recovery restarts from the previous one
                 return self._recover(global_batch, cause=e)
+        self._publish_step_phases(_time.perf_counter() - _t_entry,
+                                  _fence_wait, _commit_s, batch)
         return lossv
+
+    def _publish_step_phases(self, wall_s, fence_wait, commit_s,
+                             batch) -> None:
+        """kfprof device-time attribution for the step that just ran
+        (monitor/profiler.py): the measured compute (dispatch->sync
+        around the jitted call), collective (version-fence wait) and
+        transfer (kfsnap commit dispatch) splits, with host as the
+        remainder; plus the one-shot compiled-cost gauges after each
+        (re)build and the per-step roofline fraction."""
+        from ..monitor import profiler as _prof
+        phases = getattr(self, "_phases", None)
+        if phases is None:
+            phases = self._phases = _prof.StepPhases(loop="train")
+        phases.add("compute", self._last_step_s or 0.0)
+        phases.add("collective", fence_wait)
+        phases.add("transfer", commit_s)
+        phases.publish(wall_s, rank=self.peer.rank, step=self.step_count,
+                       version=self.version)
+        if not getattr(self, "_cost_published", True):
+            # after the flag flips the cost is settled until the next
+            # _build; set first so a failing analysis is not retried
+            # every step
+            self._cost_published = True
+            _prof.publish_compiled_cost(self._step, self._params,
+                                        self._opt, batch)
+        _prof.publish_roofline(self._last_step_s or 0.0)
 
     @property
     def size(self) -> int:

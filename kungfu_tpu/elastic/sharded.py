@@ -636,6 +636,9 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         # make_fsdp_step's meta: (unravel, size, state specs)
         self._step = make_step((self._unravel, self._vec_size, specs))
         self._batch_sharding = NamedSharding(mesh, P(FSDP_AXIS))
+        # kfprof: re-arm the one-shot cost gauges for the new program
+        # (this _build fully overrides the replicated parent's)
+        self._cost_published = False
 
     # ----------------------------------------------------------- lifecycle
     def _rebuild_at(self, peer) -> None:

@@ -238,8 +238,10 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
     plus ``/cluster_metrics`` — every live worker's /metrics endpoint
     scraped and merged with per-worker instance labels — and
     ``/findings`` — the kfdoctor diagnosis (each hit scrapes one more
-    snapshot into the history window and re-runs the detectors)
-    (kungfu_tpu.monitor.cluster, monitor/doctor.py; docs/monitoring.md).
+    snapshot into the history window and re-runs the detectors) — and
+    ``/profile?duration_s=N`` — a kfprof device-trace capture fanned to
+    every live worker (kungfu_tpu.monitor.{cluster,doctor,profiler};
+    docs/monitoring.md).
     """
     import json as _json
     from http.server import BaseHTTPRequestHandler
@@ -273,6 +275,24 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
                         targets, history=doctor.history).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/profile"):
+                    # kfprof cluster capture: fan one overlapping
+                    # device-trace request to every live worker's
+                    # metrics endpoint and merge (monitor/profiler.py;
+                    # docs/monitoring.md "Profiling (kfprof)")
+                    from ..monitor import profiler as _profiler
+                    dur = _profiler._parse_duration(self.path)
+                    with w._lock:
+                        targets = [(p.host, p.port) for p in w.current]
+                    doc = _profiler.profile_cluster(targets, dur)
+                    doc["version"] = w.version
+                    body = _json.dumps(doc, indent=2).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

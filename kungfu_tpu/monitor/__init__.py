@@ -21,6 +21,7 @@ every live worker's endpoint into ``/cluster_metrics``
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
@@ -89,6 +90,21 @@ _HELP = {
     "kungfu_tpu_serving_prefix_token_reuse":
         "Serving: fraction of prompt tokens served from the prefix "
         "cache instead of prefilled (lifetime).",
+    "kungfu_tpu_step_phase_seconds":
+        "kfprof: step wall time split into compute/collective/transfer/"
+        "host phases, per loop (monitor/profiler.py).",
+    "kungfu_tpu_step_flops":
+        "kfprof: XLA cost-analysis FLOPs of the compiled step "
+        "(re-published after every elastic resize).",
+    "kungfu_tpu_step_hbm_bytes":
+        "kfprof: XLA cost-analysis bytes accessed (HBM traffic) of the "
+        "compiled step.",
+    "kungfu_tpu_roofline_fraction":
+        "kfprof: achieved fraction of the measured ROOFLINE.json "
+        "ceiling, per bound (mxu/hbm/best).",
+    "kungfu_tpu_profile_failures_total":
+        "kfprof: device-trace captures and cost analyses that failed "
+        "or found the profiler busy, per op.",
 }
 
 # satellite guard: a buggy caller labeling by request id would grow the
@@ -508,7 +524,15 @@ def grad_bytes(params) -> int:
 
 
 class MetricsServer:
-    """HTTP /metrics endpoint on a background thread."""
+    """HTTP /metrics endpoint on a background thread.
+
+    Also serves ``/profile?duration_s=N`` (kfprof, monitor/profiler.py):
+    every worker already runs this server when monitoring is enabled
+    (native._maybe_start_metrics), so the on-demand device-trace capture
+    needs no extra listener.  The reply is always 200 with an ``ok``
+    field — a busy/failed profiler is an answer, not an HTTP error (the
+    rpc client raises on error statuses, which would hide the reason
+    from the cluster fan-out)."""
 
     def __init__(self, monitor: Monitor, host: str = "127.0.0.1",
                  port: int = 0):
@@ -519,14 +543,23 @@ class MetricsServer:
                 def log_message(self, fmt, *args):
                     pass
 
+                def _send(self, body: bytes, ctype: str) -> None:
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
                 def do_GET(self):
                     if self.path.startswith("/metrics"):
-                        body = mon.render_metrics().encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "text/plain")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._send(mon.render_metrics().encode(),
+                                   "text/plain")
+                    elif self.path.startswith("/profile"):
+                        from . import profiler as _profiler
+                        doc = _profiler.handle_profile_request(
+                            self.path, monitor=mon)
+                        self._send(json.dumps(doc).encode(),
+                                   "application/json")
                     else:
                         self.send_response(404)
                         self.end_headers()

@@ -25,7 +25,8 @@ from typing import Iterable, List, Optional, Tuple
 
 from . import MONITOR_PORT_OFFSET, _esc
 
-__all__ = ["scrape", "merge_metrics", "aggregate", "MONITOR_PORT_OFFSET"]
+__all__ = ["scrape", "merge_metrics", "aggregate", "phase_shares",
+           "MONITOR_PORT_OFFSET"]
 
 # `name{labels} value` | `name value` (+ optional timestamp); group 1 =
 # metric name, 2 = existing label body (no braces), 3 = rest
@@ -79,6 +80,36 @@ def merge_metrics(per_worker: Iterable[Tuple[str, str]]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# kfprof phase attribution out of a worker's raw exposition:
+# kungfu_tpu_step_phase_seconds_sum{phase="...",loop="..."} <v>
+_PHASE_SUM_RE = re.compile(
+    r'^kungfu_tpu_step_phase_seconds_sum\{([^}]*)\} ([0-9eE.+-]+)$')
+_PHASE_LABEL_RE = re.compile(r'phase="([^"]*)"')
+
+
+def phase_shares(text: str) -> "dict":
+    """Normalized kfprof phase shares out of one worker's /metrics text
+    (summing the ``step_phase_seconds_sum`` accumulators across loops).
+    Empty dict when the worker publishes no attribution yet."""
+    totals: dict = {}
+    for line in text.splitlines():
+        m = _PHASE_SUM_RE.match(line.strip())
+        if not m:
+            continue
+        lm = _PHASE_LABEL_RE.search(m.group(1))
+        if not lm:
+            continue
+        try:
+            totals[lm.group(1)] = (totals.get(lm.group(1), 0.0)
+                                   + float(m.group(2)))
+        except ValueError:
+            continue
+    grand = sum(totals.values())
+    if grand <= 0:
+        return {}
+    return {p: v / grand for p, v in sorted(totals.items())}
+
+
 def aggregate(targets: Iterable[Tuple[str, int]],
               timeout: float = 2.0,
               history: Optional["object"] = None) -> str:
@@ -95,6 +126,7 @@ def aggregate(targets: Iterable[Tuple[str, int]],
     successful scrape is appended to (the kfdoctor window ring)."""
     scraped: List[Tuple[str, str]] = []
     ups: List[Tuple[str, int]] = []
+    shares: List[Tuple[str, "dict"]] = []
     for host, port in targets:
         instance = f"{host}:{port}"
         try:
@@ -102,6 +134,9 @@ def aggregate(targets: Iterable[Tuple[str, int]],
                           timeout=timeout)
             scraped.append((instance, text))
             ups.append((instance, 1))
+            sh = phase_shares(text)
+            if sh:
+                shares.append((instance, sh))
             if history is not None:
                 history.observe_text(instance, text)
         except (OSError, ValueError, http.client.HTTPException) as e:
@@ -120,4 +155,18 @@ def aggregate(targets: Iterable[Tuple[str, int]],
                     "this launcher at aggregation time.")
     up_lines.append("# TYPE kungfu_tpu_cluster_workers gauge")
     up_lines.append(f"kungfu_tpu_cluster_workers {workers}")
+    if shares:
+        # kfprof attribution meta: each worker's lifetime phase shares,
+        # pre-digested so `kft-doctor --url` / kfprof_report render the
+        # breakdown from this one scrape instead of a second pass
+        up_lines.append("# HELP kungfu_tpu_step_phase_share each "
+                        "worker's kfprof step-time share per phase "
+                        "(lifetime fractions, sum to 1).")
+        up_lines.append("# TYPE kungfu_tpu_step_phase_share gauge")
+        for instance, sh in shares:
+            for phase, frac in sh.items():
+                up_lines.append(
+                    f'kungfu_tpu_step_phase_share{{'
+                    f'instance="{_esc(instance)}",'
+                    f'phase="{_esc(phase)}"}} {frac:.6f}')
     return body + "\n".join(up_lines) + "\n"

@@ -18,7 +18,13 @@ interprets them.  This module is that layer:
     collective name (the paper's interference signal);
   * **control-plane** — lease-age spikes, growing heartbeat misses, or
     rpc outages in the *launcher's* own metrics, attributed to the peer
-    or server they name.
+    or server they name;
+  * **perf** (kfprof, monitor/profiler.py) — an instance whose
+    ``roofline_fraction`` sits below ``KFT_DOCTOR_ROOFLINE`` AND has
+    dropped ``KFT_DOCTOR_ROOFLINE_DROP``x against its own baseline for
+    ``KFT_DOCTOR_WINDOWS`` windows; the Finding's ``kind`` names the
+    dominant step phase (compute-bound / collective-bound /
+    input-bound / host-bound) with the phase shares as evidence.
 
 - :class:`Doctor` wraps history + detectors + export: findings are
   kftrace-traced on raise/clear, exported as
@@ -51,7 +57,7 @@ from .history import MetricsHistory
 
 __all__ = ["Finding", "Doctor", "PeerLatencyProber", "render_report",
            "detect_stragglers", "detect_interference",
-           "detect_control_plane", "RUNNER_INSTANCE"]
+           "detect_control_plane", "detect_perf", "RUNNER_INSTANCE"]
 
 # the launcher's own metrics live in the history under this pseudo
 # instance (lease ages, rpc outage gauges — the control-plane signals)
@@ -90,7 +96,7 @@ class Finding:
     ``version`` is the elastic membership version the diagnosis was made
     under, when the caller knows it — rank numbering is only meaningful
     relative to a membership."""
-    kind: str                      # straggler | interference | control-plane
+    kind: str   # straggler | interference | control-plane | *-bound (perf)
     severity: str                  # warn | critical
     instance: str                  # host:port (or config-server url)
     rank: Optional[int]
@@ -290,6 +296,88 @@ def detect_control_plane(history: MetricsHistory, *,
     return findings
 
 
+def _phase_p50s(history: MetricsHistory, inst: str) -> Dict[str, float]:
+    """Latest per-phase step-time p50 for an instance, trying the train
+    loop first, then serve (the ``loop`` label disambiguates the
+    summaries — series() needs a unique match per snapshot)."""
+    from .profiler import PHASES, STEP_PHASE_METRIC
+    for loop in ("train", "serve"):
+        out: Dict[str, float] = {}
+        for phase in PHASES:
+            pts = history.series(inst, STEP_PHASE_METRIC,
+                                 {"loop": loop, "phase": phase,
+                                  "quantile": "0.5"})
+            if pts:
+                out[phase] = pts[-1][1]
+        if out:
+            return out
+    return {}
+
+
+def detect_perf(history: MetricsHistory, *,
+                roofline: float = 0.05, drop: float = 2.0,
+                min_windows: int = 3, stale_s: float = 60.0,
+                ranks: Optional[Dict[str, int]] = None,
+                version: Optional[int] = None) -> List[Finding]:
+    """kfprof roofline collapse, attributed to the dominant step phase.
+
+    An instance whose ``kungfu_tpu_roofline_fraction{bound="best"}`` sat
+    below ``roofline`` for each of the last ``min_windows`` windows AND
+    dropped ``drop``x against its own earlier baseline gets a Finding
+    whose kind names where the step time went (compute-bound /
+    collective-bound / input-bound / host-bound, from the kfprof phase
+    split).  The drop guard is deliberate: an absolute threshold alone
+    would fire forever on platforms whose ceiling the workload was never
+    going to reach (a CPU smoke run is permanently "below 5%") — only a
+    regression against the instance's own history is diagnosable."""
+    from .profiler import PHASE_KIND, ROOFLINE_METRIC
+    findings: List[Finding] = []
+    for inst in _fresh_instances(history, stale_s):
+        pts = history.series(inst, ROOFLINE_METRIC, {"bound": "best"})
+        if len(pts) < 2 * min_windows:
+            continue
+        baseline_vals = [v for _ts, v in pts[:-min_windows]]
+        recent_vals = [v for _ts, v in pts[-min_windows:]]
+        baseline = _lower_median(baseline_vals)
+        recent = sum(recent_vals) / len(recent_vals)
+        if baseline <= 0:
+            continue
+        if not all(v < roofline for v in recent_vals):
+            continue
+        if recent * drop >= baseline:
+            continue
+        phases = _phase_p50s(history, inst)
+        if not phases:
+            continue
+        total = sum(phases.values())
+        if total <= 0:
+            continue
+        shares = {p: v / total for p, v in phases.items()}
+        dominant = max(shares, key=lambda p: shares[p])
+        ratio = baseline / recent if recent > 0 else float("inf")
+        evidence: Dict[str, object] = {
+            "roofline_fraction": round(recent, 6),
+            "baseline_fraction": round(baseline, 6),
+            "threshold": roofline,
+            "drop_ratio": round(min(ratio, 1e9), 3),
+        }
+        for p, s in sorted(shares.items()):
+            evidence[f"share_{p}"] = round(s, 4)
+        findings.append(Finding(
+            kind=PHASE_KIND[dominant],
+            severity=SEV_CRITICAL if ratio > 2 * drop else SEV_WARN,
+            instance=inst,
+            rank=(ranks or {}).get(inst),
+            windows=min_windows,
+            evidence=evidence,
+            action="capture a device trace (/profile?duration_s=5, "
+                   "tools/kfprof_report.py) and inspect the dominant "
+                   f"phase ({dominant}); for collective/input-bound "
+                   "steps consider a strategy or input-pipeline change",
+            version=version, detected_ts=time.time()))
+    return findings
+
+
 class Doctor:
     """History + detector suite + export.
 
@@ -311,6 +399,8 @@ class Doctor:
     KFT_DOCTOR_OUTAGE_S    5.0      control-plane: rpc outage alarm
     KFT_DOCTOR_MISSES      3        control-plane: heartbeat-miss growth
     KFT_DOCTOR_STALE_S     60.0     ignore instances not scraped lately
+    KFT_DOCTOR_ROOFLINE    0.05     perf: roofline-fraction floor
+    KFT_DOCTOR_ROOFLINE_DROP  2.0   perf: drop vs own baseline required
     =====================  =======  =====================================
     """
 
@@ -327,6 +417,8 @@ class Doctor:
         self.outage_s = _env_float("KFT_DOCTOR_OUTAGE_S", 5.0)
         self.miss_delta = _env_float("KFT_DOCTOR_MISSES", 3.0)
         self.stale_s = _env_float("KFT_DOCTOR_STALE_S", 60.0)
+        self.roofline = _env_float("KFT_DOCTOR_ROOFLINE", 0.05)
+        self.roofline_drop = _env_float("KFT_DOCTOR_ROOFLINE_DROP", 2.0)
         self._active: Dict[Tuple[str, str], Finding] = {}
         self.last: List[Finding] = []
 
@@ -351,7 +443,12 @@ class Doctor:
                                    outage_s=self.outage_s,
                                    miss_delta=self.miss_delta,
                                    min_windows=self.min_windows,
-                                   ranks=ranks, version=version))
+                                   ranks=ranks, version=version)
+            + detect_perf(self.history, roofline=self.roofline,
+                          drop=self.roofline_drop,
+                          min_windows=self.min_windows,
+                          stale_s=self.stale_s,
+                          ranks=ranks, version=version))
         self._export(findings)
         self.last = findings
         return findings

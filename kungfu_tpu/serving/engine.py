@@ -704,6 +704,10 @@ class DecodeEngine:
         self._admit_t: Dict[int, float] = {}
         self._admitted_total = 0
         self._prompt_tokens_total = 0
+        # kfprof step attribution for the decode loop: compute = prefill
+        # + decode dispatch->sync, host = scheduler remainder
+        from ..monitor.profiler import StepPhases
+        self._prof_phases = StepPhases(loop="serve")
 
     # ------------------------------------------------------------- admin
     def validate_shape(self, req: Request) -> None:
@@ -985,6 +989,7 @@ class DecodeEngine:
             mon = get_monitor()
             mon.observe("kungfu_tpu_serving_prefill_seconds",
                         now - _t_prefill)
+            self._prof_phases.add("compute", now - _t_prefill)
             _trace.event("serving.prefill", category="serving",
                          dur=now - _t_prefill,
                          attrs={"batch": len(batch), "bucket": Tb})
@@ -1136,6 +1141,7 @@ class DecodeEngine:
         are EXACTLY the sequential argmax streams (lossless); sampled
         slots draft nothing and behave as 1-token steps with the usual
         key discipline."""
+        _t_tick = time.perf_counter()
         self._admit()
         # draft BEFORE ensuring blocks: each slot's block horizon is its
         # accepted-prefix-reachable positions (dlen + 1)
@@ -1202,6 +1208,8 @@ class DecodeEngine:
                 self._tcount[slot] += n_new
         self._observe_decode(_dt_decode,
                              self.stats.tokens_out - _tokens_before)
+        self._prof_phases.add("compute", _dt_decode)
+        self._prof_phases.publish(time.perf_counter() - _t_tick)
         return True
 
     def _observe_decode(self, dt: float, emitted: int) -> None:
@@ -1217,6 +1225,7 @@ class DecodeEngine:
         Returns False when idle."""
         if self.spec:
             return self._step_speculative()
+        _t_tick = time.perf_counter()
         self._admit()
         self._ensure_blocks()
         active = [s for s in range(self.S) if self._running[s] is not None]
@@ -1250,6 +1259,8 @@ class DecodeEngine:
                 self._tcount[slot] += self.K
         self._observe_decode(_dt_decode,
                              self.stats.tokens_out - _tokens_before)
+        self._prof_phases.add("compute", _dt_decode)
+        self._prof_phases.publish(time.perf_counter() - _t_tick)
         return True
 
     @property

@@ -55,3 +55,30 @@ def config_flag_supported(flag: str) -> bool:
     flag raises instead of ignoring it."""
     import jax
     return flag in jax.config.values
+
+
+def compiled_cost_analysis(compiled) -> "dict | None":
+    """XLA cost analysis of an AOT-compiled step, normalized across jax
+    versions (the kfprof flops/HBM gauges, monitor/profiler.py).
+
+    ``Compiled.cost_analysis()`` returns a plain dict on current jax, a
+    one-element **list** of dicts on 0.4.x, and does not exist (or
+    raises ``NotImplementedError``) on older jaxlibs / backends without
+    a cost model.  Callers get one flat ``{"flops": ..., "bytes
+    accessed": ..., ...}`` dict, or None when this build cannot say —
+    absence of the gauges, never a crash (tests/test_jax_compat.py)."""
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        cost = fn()
+    except Exception:
+        # backends without a cost model raise from deep inside xla
+        # (NotImplementedError, XlaRuntimeError, ...): "unknown" is an
+        # expected answer here, not a failure to surface
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None
+    return dict(cost)

@@ -117,21 +117,76 @@ def report() -> str:
     return "\n".join(lines)
 
 
-def start_capture(logdir: str) -> None:
-    """Begin an XLA device trace (view in XProf/TensorBoard)."""
-    import jax
-    jax.profiler.start_trace(logdir)
+# one device capture at a time: jax.profiler raises RuntimeError on a
+# second start_trace, and a failed start used to leak that exception to
+# whoever asked for a profile (the /profile endpoint must answer "busy",
+# not die).  The guard holds the active logdir; failures are COUNTED on
+# the monitor (kungfu_tpu_profile_failures_total) so they stay visible
+# without taking down the caller.
+_capture_lock = threading.Lock()
+_capture_dir: Optional[str] = None
 
 
-def stop_capture() -> None:
+def _count_capture_failure(op: str) -> None:
+    from ..monitor import get_monitor
+    get_monitor().inc("kungfu_tpu_profile_failures_total",
+                      labels={"op": op})
+
+
+def capturing() -> Optional[str]:
+    """The active capture's logdir, or None."""
+    with _capture_lock:
+        return _capture_dir
+
+
+def start_capture(logdir: str) -> Optional[str]:
+    """Begin an XLA device trace (view in XProf/TensorBoard).
+
+    Idempotent and exception-safe: returns the logdir on success, None
+    when a capture is already running or jax.profiler refused (counted
+    via Monitor, never raised — a profile request must degrade to "no
+    capture", not crash the serving thread)."""
+    global _capture_dir
     import jax
-    jax.profiler.stop_trace()
+    with _capture_lock:
+        if _capture_dir is not None:
+            _count_capture_failure("start-busy")
+            return None
+        try:
+            jax.profiler.start_trace(logdir)
+        except Exception:
+            _count_capture_failure("start")
+            return None
+        _capture_dir = logdir
+        return logdir
+
+
+def stop_capture() -> Optional[str]:
+    """End the active capture; returns its logdir, or None when nothing
+    was running (idempotent — a double stop is a no-op, not a
+    RuntimeError out of jax.profiler)."""
+    global _capture_dir
+    import jax
+    with _capture_lock:
+        if _capture_dir is None:
+            return None
+        logdir, _capture_dir = _capture_dir, None
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            _count_capture_failure("stop")
+            return None
+        return logdir
 
 
 @contextlib.contextmanager
 def capture(logdir: str):
-    start_capture(logdir)
+    """Capture for the duration of the block; yields the logdir (None
+    when another capture already owns the profiler — this block then
+    must NOT stop it on exit)."""
+    started = start_capture(logdir)
     try:
-        yield
+        yield started
     finally:
-        stop_capture()
+        if started is not None:
+            stop_capture()

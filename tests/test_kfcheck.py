@@ -249,6 +249,21 @@ def test_silent_except_covers_kfdoctor_modules(tmp_path):
         assert rules_fired(fs) == {"silent-except"}, rel
 
 
+def test_silent_except_covers_kfprof(tmp_path):
+    """The kfprof attribution plane (monitor/profiler.py) is inside the
+    silent-except scope — a profiler that eats a failed capture would
+    report 'all healthy' precisely when the capture path broke."""
+    src = """
+        def handle_profile_request(path):
+            try:
+                start_capture(path)
+            except Exception:
+                pass
+    """
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/monitor/profiler.py")
+    assert rules_fired(fs) == {"silent-except"}
+
+
 def test_silent_except_bare_and_negative(tmp_path):
     fs = run_on(tmp_path, """
         def a(url):

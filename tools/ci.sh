@@ -56,6 +56,14 @@ python tools/metrics_trace_smoke.py || exit 1
 say "0c/3 kfsnap snapshot micro-bench"
 python tools/bench_snapshot.py --smoke || exit 1
 
+# kfprof smoke (`make prof-smoke`): the device-time attribution plane
+# on CPU — published phases must sum to wall time within 10%, a
+# /profile capture must round-trip artifacts, and the breakdown table +
+# BENCH-compatible JSON block must render (~15 s; docs/monitoring.md
+# "Profiling (kfprof)")
+say "0d/3 kfprof report smoke"
+python tools/kfprof_report.py --smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

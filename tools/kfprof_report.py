@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""kfprof report: render a cluster's device-time attribution.
+
+Three sources (docs/monitoring.md "Profiling (kfprof)"):
+
+  --url URL    a running watcher's debug address — one GET of
+               /cluster_metrics yields every worker's phase breakdown,
+               compiled-cost gauges and roofline fraction
+  --dir DIR    a capture tree (``KFT_TRACE_DIR/prof`` or the logdirs a
+               /profile response named) — reads the ``kfprof_meta.json``
+               attribution snapshots the workers wrote next to their
+               XLA trace artifacts
+  --smoke      self-contained CPU check for CI (ci.sh step 0d,
+               ``make prof-smoke``): runs a jitted workload through the
+               whole kfprof plane, asserts the published phases sum to
+               the measured wall time within 10%, round-trips
+               /profile against a local MetricsServer, renders the
+               table through the same code path as --url, and emits a
+               validated BENCH-compatible JSON block
+
+The report shows, per instance: seconds and share per phase
+(compute / collective / transfer / host), the step's XLA cost
+(flops, HBM bytes), and the achieved fraction of the ROOFLINE.json
+ceilings; plus the BENCH_r* trajectory for context.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kungfu_tpu.monitor.history import parse_metrics          # noqa: E402
+from kungfu_tpu.monitor.profiler import (                     # noqa: E402
+    FLOPS_METRIC, HBM_METRIC, PHASES, ROOFLINE_METRIC,
+    STEP_PHASE_METRIC)
+
+
+# ------------------------------------------------------------- collect
+def records_from_cluster_text(text: str) -> Dict[str, dict]:
+    """Per-instance attribution out of a /cluster_metrics exposition
+    (every sample carries an ``instance`` label there)."""
+    recs: Dict[str, dict] = {}
+
+    def rec(inst: str) -> dict:
+        return recs.setdefault(inst, {"phases": {}, "flops": None,
+                                      "hbm_bytes": None, "roofline": None})
+
+    for (name, labels), value in parse_metrics(text).items():
+        lab = dict(labels)
+        inst = lab.get("instance", "local")
+        if name == STEP_PHASE_METRIC + "_sum" and "phase" in lab:
+            ph = rec(inst)["phases"]
+            ph[lab["phase"]] = ph.get(lab["phase"], 0.0) + value
+        elif name == FLOPS_METRIC:
+            rec(inst)["flops"] = value
+        elif name == HBM_METRIC:
+            rec(inst)["hbm_bytes"] = value
+        elif name == ROOFLINE_METRIC and lab.get("bound") == "best":
+            rec(inst)["roofline"] = value
+    return {i: r for i, r in recs.items() if r["phases"]}
+
+
+def records_from_dir(root: str) -> Dict[str, dict]:
+    """Attribution out of the ``kfprof_meta.json`` snapshots a capture
+    wrote (one per worker logdir)."""
+    recs: Dict[str, dict] = {}
+    pattern = os.path.join(root, "**", "kfprof_meta.json")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"kfprof: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        phases: Dict[str, float] = {}
+        for _loop, ph in (meta.get("phases") or {}).items():
+            for p, v in ph.items():
+                phases[p] = phases.get(p, 0.0) + float(v)
+        if not phases:
+            continue
+        cost = meta.get("cost") or {}
+        roof = (meta.get("roofline") or {}).get("best")
+        recs[os.path.relpath(os.path.dirname(path), root)] = {
+            "phases": phases,
+            "flops": cost.get("flops"),
+            "hbm_bytes": cost.get("hbm_bytes"),
+            "roofline": roof,
+        }
+    return recs
+
+
+# -------------------------------------------------------------- render
+def _fmt_eng(v: Optional[float]) -> str:
+    if v is None or v <= 0:
+        return "-"
+    for unit, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if v >= scale:
+            return f"{v / scale:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def render_report(recs: Dict[str, dict]) -> str:
+    if not recs:
+        return ("kfprof: no step-phase attribution found — have workers "
+                "taken a step with monitoring enabled?\n")
+    head = (f"{'instance':<24} " +
+            " ".join(f"{p:>10} {'%':>5}" for p in PHASES) +
+            f" {'flops':>8} {'hbm':>8} {'roofline':>8}")
+    lines = [head, "-" * len(head)]
+    for inst, r in sorted(recs.items()):
+        total = sum(r["phases"].values()) or 1.0
+        cells = []
+        for p in PHASES:
+            v = r["phases"].get(p, 0.0)
+            cells.append(f"{v:>10.3f} {100 * v / total:>4.0f}%")
+        roof = r.get("roofline")
+        roof_cell = f"{roof * 100:>7.2f}%" if roof is not None \
+            else f"{'-':>8}"
+        lines.append(
+            f"{inst:<24} " + " ".join(cells) +
+            f" {_fmt_eng(r.get('flops')):>8}"
+            f" {_fmt_eng(r.get('hbm_bytes')):>8} {roof_cell}")
+    return "\n".join(lines) + "\n"
+
+
+def bench_block(recs: Dict[str, dict]) -> dict:
+    """A BENCH_r*-compatible JSON block (metric/value/unit/vs_baseline)
+    so the perf trajectory has device-time attribution to carry."""
+    roofs = [r["roofline"] for r in recs.values()
+             if r.get("roofline") is not None]
+    shares: Dict[str, float] = {}
+    for r in recs.values():
+        total = sum(r["phases"].values()) or 1.0
+        for p in PHASES:
+            share = r["phases"].get(p, 0.0) / total
+            shares[p] = shares.get(p, 0.0) + share / len(recs)
+    return {
+        "metric": "kfprof_roofline_fraction_best",
+        "value": round(sum(roofs) / len(roofs), 6) if roofs else None,
+        "unit": "fraction",
+        "vs_baseline": None,
+        "phase_shares": {p: round(s, 4) for p, s in sorted(shares.items())},
+        "workers": len(recs),
+    }
+
+
+def trajectory(repo: str = _REPO) -> List[str]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                tail = f.read()
+            # the measured block is the last JSON object line in `tail`
+            doc = json.loads(tail)
+            blk = doc.get("tail", "")
+            line = next((ln for ln in reversed(blk.splitlines())
+                         if ln.startswith("{")), None)
+            if line:
+                b = json.loads(line)
+                out.append(f"  {os.path.basename(path)}: "
+                           f"{b.get('metric')}={b.get('value')} "
+                           f"{b.get('unit', '')}")
+        except (OSError, ValueError, StopIteration):
+            continue
+    return out
+
+
+# --------------------------------------------------------------- smoke
+def smoke() -> int:
+    """CPU CI check: drive the full kfprof plane in-process."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                    get_monitor)
+    from kungfu_tpu.monitor import cluster as _cluster
+    from kungfu_tpu.monitor import profiler as prof
+
+    td = tempfile.mkdtemp(prefix="kfprof-smoke-")
+    roof_path = os.path.join(td, "ROOFLINE.json")
+    with open(roof_path, "w") as f:
+        json.dump({"results": [
+            {"op": "matmul_smoke_bf16", "tflops": 0.5},
+            {"op": "hbm_copy_smoke", "gib_per_s": 10.0}]}, f)
+    old_roof = os.environ.get(prof.ENV_ROOFLINE)
+    old_trace = os.environ.get("KFT_TRACE_DIR")
+    os.environ[prof.ENV_ROOFLINE] = roof_path
+    os.environ["KFT_TRACE_DIR"] = td
+    try:
+        fn = jax.jit(lambda x: x @ x)
+        x = jnp.ones((256, 256), jnp.float32)
+        fn(x).block_until_ready()            # compile outside the timing
+        cost = prof.publish_compiled_cost(fn, x)
+        print(f"kfprof smoke: cost={cost}")
+        sp = prof.StepPhases(loop="train")
+        wall_total = attributed = 0.0
+        dt = 0.0
+        for step in range(8):
+            t_wall = time.perf_counter()
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            dt = time.perf_counter() - t0
+            sp.add("compute", dt)
+            time.sleep(0.002)                # deliberate host-phase tail
+            wall = time.perf_counter() - t_wall
+            ph = sp.publish(wall, rank=0, step=step)
+            wall_total += wall
+            attributed += sum(ph.values())
+        roof = prof.publish_roofline(dt)
+        print(f"kfprof smoke: roofline={roof}")
+        # acceptance: published phases sum to wall time within 10%
+        if abs(attributed - wall_total) > 0.10 * wall_total:
+            print(f"kfprof smoke: FAIL phase sum {attributed:.4f}s vs "
+                  f"wall {wall_total:.4f}s (>10% off)", file=sys.stderr)
+            return 1
+        # /profile round-trip against a real MetricsServer, with a live
+        # jit workload so the capture has device events to record
+        srv = MetricsServer(get_monitor(), port=0).start()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                fn(x).block_until_ready()
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            doc = prof.profile_cluster(
+                [("127.0.0.1", srv.port - MONITOR_PORT_OFFSET)], 0.4)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        if not doc["ok"] or not doc["artifacts"]:
+            print(f"kfprof smoke: FAIL /profile round-trip: {doc}",
+                  file=sys.stderr)
+            srv.stop()
+            return 1
+        print(f"kfprof smoke: capture ok, "
+              f"{len(doc['artifacts'])} artifact(s) under "
+              f"{os.path.join(td, 'prof')}")
+        # the table renders through the same path --url uses, including
+        # the cluster-side phase-share meta (monitor/cluster.py)
+        text = _cluster.aggregate(
+            [("127.0.0.1", srv.port - MONITOR_PORT_OFFSET)])
+        srv.stop()
+        if "kungfu_tpu_step_phase_share" not in text:
+            print("kfprof smoke: FAIL cluster meta lacks "
+                  "step_phase_share", file=sys.stderr)
+            return 1
+        recs = records_from_cluster_text(text)
+        sys.stdout.write(render_report(recs))
+        dir_recs = records_from_dir(os.path.join(td, "prof"))
+        if not dir_recs:
+            print("kfprof smoke: FAIL --dir path found no "
+                  "kfprof_meta.json", file=sys.stderr)
+            return 1
+        blk = bench_block(recs)
+        encoded = json.dumps(blk)
+        decoded = json.loads(encoded)        # BENCH block must validate
+        for key in ("metric", "value", "unit", "vs_baseline"):
+            if key not in decoded:
+                print(f"kfprof smoke: FAIL bench block missing {key}",
+                      file=sys.stderr)
+                return 1
+        print(encoded)
+        print("kfprof smoke: OK")
+        return 0
+    finally:
+        for env, old in ((prof.ENV_ROOFLINE, old_roof),
+                         ("KFT_TRACE_DIR", old_trace)):
+            if old is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = old
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kfprof-report",
+        description="render a cluster's kfprof device-time attribution "
+                    "(docs/monitoring.md)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="watcher debug address; "
+                                   "/cluster_metrics is appended")
+    src.add_argument("--dir", help="capture tree holding "
+                                   "kfprof_meta.json snapshots")
+    src.add_argument("--smoke", action="store_true",
+                     help="self-contained CPU CI check")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the BENCH-compatible JSON block only")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if args.url:
+        import urllib.request
+        url = args.url.rstrip("/") + "/cluster_metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                text = r.read().decode()
+        except (OSError, ValueError) as e:
+            print(f"kfprof: cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+        recs = records_from_cluster_text(text)
+    else:
+        recs = records_from_dir(args.dir)
+    if args.json:
+        print(json.dumps(bench_block(recs), indent=2))
+        return 0
+    sys.stdout.write(render_report(recs))
+    if recs:
+        print(json.dumps(bench_block(recs)))
+    traj = trajectory()
+    if traj:
+        print("bench trajectory:")
+        print("\n".join(traj))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

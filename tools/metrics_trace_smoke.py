@@ -37,12 +37,19 @@ sys.path.insert(0, REPO)
 
 def check_metrics() -> None:
     from kungfu_tpu.monitor import MetricsServer, Monitor
+    from kungfu_tpu.monitor.profiler import StepPhases
     mon = Monitor()
     mon.egress(12345, "dcn")
     mon.ingress(999, 'ici"quoted')          # exercises label escaping
     for v in (0.01, 0.02, 0.03):
         mon.observe("kungfu_tpu_step_seconds", v)
     mon.set_gauge("kungfu_tpu_grad_noise_scale", 3.5)
+    # the kfprof series ride the same server (monitor/profiler.py)
+    sp = StepPhases(loop="train", monitor=mon)
+    sp.add("compute", 0.02)
+    sp.publish(0.03)
+    mon.set_gauge("kungfu_tpu_roofline_fraction", 0.42,
+                  labels={"bound": "best"})
     srv = MetricsServer(mon).start()
     try:
         body = urllib.request.urlopen(
@@ -59,7 +66,13 @@ def check_metrics() -> None:
             'kungfu_tpu_step_seconds{quantile="0.5"}',
             "kungfu_tpu_step_seconds_count 3",
             "# TYPE kungfu_tpu_grad_noise_scale gauge",
-            "kungfu_tpu_grad_noise_scale 3.5"):
+            "kungfu_tpu_grad_noise_scale 3.5",
+            "# TYPE kungfu_tpu_step_phase_seconds summary",
+            'phase="compute"',
+            'phase="host"',
+            "kungfu_tpu_step_phase_seconds_sum",
+            "# TYPE kungfu_tpu_roofline_fraction gauge",
+            'kungfu_tpu_roofline_fraction{bound="best"} 0.42'):
         assert needle in body, f"missing {needle!r} in /metrics:\n{body}"
 
 
