@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke clean
+.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -18,6 +18,19 @@ chaos-smoke: native
 	python -m kungfu_tpu.chaos.runner --scenario smoke
 	python -m kungfu_tpu.chaos.runner \
 	    --scenario config-server-crash-restart-mid-resize --replay-check
+
+# kfsim smoke: a 20-fake-worker rolling preemption wave under the REAL
+# watcher + config server — no jax, no data plane, so it can NEVER
+# self-skip (docs/chaos.md "Simulation tier (kfsim)").  < 60 s.
+sim-smoke:
+	python -m kungfu_tpu.chaos.runner --scenario sim-smoke
+
+# kfsim fuzz soak: seeded random_plan sweeps at 50 fake workers; rerun
+# a red seed bit-for-bit with `make sim-soak SEEDS=<n>`.
+SEEDS ?= 1 2 3
+sim-soak:
+	python -m kungfu_tpu.chaos.runner --scenario none \
+	    $(foreach s,$(SEEDS),--sim-seed $(s))
 
 # kfdoctor smoke: metrics/trace plumbing plus the diagnosis plane —
 # a watcher /findings endpoint must attribute a 10x step-time skew to

@@ -10,16 +10,30 @@ Where the reference runs a Go socket runtime under TF/Torch ops, this
 framework runs `jax.lax` collectives inside jitted, shard_mapped training
 steps — the communication schedule is compiled, not interpreted.
 """
-from .utils.jax_compat import ensure_compat as _ensure_jax_compat
+from __future__ import annotations
 
-_ensure_jax_compat()  # alias moved jax surfaces (jax.shard_map on 0.4.x)
+import os as _os
 
-from . import comm, plan
-from .comm import Session
+# kfsim lite mode: the fake trainers of kungfu_tpu/sim/ run hundreds of
+# control-plane-only processes on one box and must not pay the jax import
+# (~1 s CPU each, serialised on a small machine).  With KFT_SIM_LITE=1
+# only the host-plane surface (plan/, elastic config client, launcher,
+# monitor, store, chaos) is importable; Session/training stay out.
+_SIM_LITE = _os.environ.get("KFT_SIM_LITE") == "1"
+
+if not _SIM_LITE:
+    from .utils.jax_compat import ensure_compat as _ensure_jax_compat
+
+    _ensure_jax_compat()  # alias moved jax surfaces (jax.shard_map on 0.4.x)
+
+    from . import comm, plan
+    from .comm import Session
+    from .training import (broadcast_variables, build_train_step,
+                           build_train_step_with_state, init_opt_state, lane,
+                           lane_mean, replicate)
+else:
+    from . import plan
 from .plan import Cluster, HostList, PeerID, PeerList, Strategy
-from .training import (broadcast_variables, build_train_step,
-                       build_train_step_with_state, init_opt_state, lane,
-                       lane_mean, replicate)
 
 __version__ = "0.1.0"
 

@@ -154,20 +154,69 @@ assert float(jax.jit(jnp.sum)(x)) == 2.0  # spans both processes
 def data_plane_supported() -> bool:
     """True when this jax build can run a GLOBAL computation spanning
     two OS processes on the CPU backend — the substrate of every
-    scenario in the matrix (and of the multi-process trainer tests,
-    which share this probe via tests/testutil.py).  Older jaxlib CPU
-    backends reject it with "Multiprocess computations aren't
-    implemented"; there the runner SKIPS instead of failing.  Probed
-    once per process with two throwaway subprocesses; override with
-    ``KFT_TESTS_DATA_PLANE=0/1`` to skip the probe."""
+    real-tier scenario in the matrix (and of the multi-process trainer
+    tests, which share this probe via tests/testutil.py).  Older jaxlib
+    CPU backends reject it with "Multiprocess computations aren't
+    implemented"; there the runner SKIPS instead of failing.
+
+    The verdict is a property of the jaxlib build, not of the process:
+    it is cached on disk keyed by jaxlib version (under ``$TMPDIR``, or
+    ``KFT_TESTS_CACHE_DIR``), so only the FIRST pytest/CI process on a
+    box ever pays the two probe subprocesses and their 120 s ceiling.
+    ``KFT_TESTS_DATA_PLANE=0/1`` overrides everything;
+    ``KFT_TESTS_DATA_PLANE_CACHE=0`` disables the disk cache."""
     global _DATA_PLANE
     if _DATA_PLANE is None:
         force = os.environ.get("KFT_TESTS_DATA_PLANE", "")
         if force:
             _DATA_PLANE = force.lower() not in ("0", "false", "no")
         else:
-            _DATA_PLANE = _probe_data_plane()
+            path = _probe_cache_path()
+            cached = _read_probe_cache(path) if path else None
+            if cached is not None:
+                _DATA_PLANE = cached
+            else:
+                _DATA_PLANE = _probe_data_plane()
+                if path:
+                    _write_probe_cache(path, _DATA_PLANE)
     return _DATA_PLANE
+
+
+def _probe_cache_path() -> Optional[str]:
+    """Disk-cache location for the probe verdict, keyed by jaxlib
+    version (importing ``jaxlib.version`` alone initialises no
+    backends).  None disables caching: jaxlib absent, or
+    ``KFT_TESTS_DATA_PLANE_CACHE=0``."""
+    import importlib.util
+    if os.environ.get("KFT_TESTS_DATA_PLANE_CACHE",
+                      "").lower() in ("0", "false", "no"):
+        return None
+    if importlib.util.find_spec("jaxlib") is None:
+        return None
+    from jaxlib import version as _jv
+    key = getattr(_jv, "__version__", "unknown")
+    root = os.environ.get("KFT_TESTS_CACHE_DIR") or tempfile.gettempdir()
+    return os.path.join(root, f"kft-data-plane-{key}.json")
+
+
+def _read_probe_cache(path: str) -> Optional[bool]:
+    verdict = None
+    with contextlib.suppress(OSError, ValueError):
+        with open(path) as f:
+            d = json.load(f)
+        if isinstance(d, dict) and isinstance(d.get("supported"), bool):
+            verdict = d["supported"]
+    return verdict
+
+
+def _write_probe_cache(path: str, supported: bool) -> None:
+    # atomic publish; a write failure just means the next process
+    # probes again (the cache is an optimisation, never load-bearing)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with contextlib.suppress(OSError):
+        with open(tmp, "w") as f:
+            json.dump({"supported": supported}, f)
+        os.replace(tmp, path)
 
 
 def _probe_data_plane() -> bool:
@@ -259,6 +308,21 @@ class Scenario:
     # false-positive guard for the clean twin).  Enabling this exports
     # KFT_CONFIG_ENABLE_MONITORING=1 so workers serve /metrics.
     doctor_expect: Optional[Dict[str, object]] = None
+    # ---- kfsim (docs/chaos.md "Simulation tier"): tier="sim" runs the
+    # scenario over fake trainers (kungfu_tpu/sim/) under the real
+    # watcher — no jax, no data plane, scales to 100+ processes
+    tier: str = "real"
+    sim_seed: int = 0            # wsum fingerprint + step-time jitter
+    sim_step_s: float = 0.05     # scripted base step time
+    sim_slow_ranks: Sequence[int] = ()   # scripted stragglers ...
+    sim_slow_factor: float = 8.0         # ... and how much slower
+    sim_heartbeat_s: float = 0.5   # lease renewal cadence (workers)
+    sim_lease_ttl_s: float = 6.0   # watcher escalation age (runner)
+    sim_drain_s: float = 120.0     # final-consensus poll budget
+    # scenario-level proof floors (0 = unchecked, both tiers): at least
+    # this many journal fires / distinct observed config versions
+    min_fired: int = 0
+    min_config_versions: int = 0
 
 
 def scenarios() -> Dict[str, Scenario]:
@@ -408,6 +472,9 @@ def scenarios() -> Dict[str, Scenario]:
     out["smoke"] = dataclasses.replace(
         m[0], name="smoke", target_steps=12,
         desc="tier-1 smoke: " + m[0].desc)
+    # the sim tier (lazy import: sim.scenarios imports this module)
+    from ..sim.scenarios import sim_scenarios
+    out.update(sim_scenarios())
     return out
 
 
@@ -423,6 +490,9 @@ class ScenarioResult:
     # every kfchaos failure ships its own timeline (merge them with
     # `python tools/kftrace_merge.py <out_dir>`)
     trace_files: List[str] = dataclasses.field(default_factory=list)
+    # the parent/control port this run actually bound (OS-assigned when
+    # Scenario.parent_port is None — pinned by the concurrent-run test)
+    parent_port: Optional[int] = None
 
     @property
     def ok(self) -> bool:
@@ -685,9 +755,67 @@ class _DoctorSampler(threading.Thread):
                       f, indent=2)
 
 
+def doctor_violations(doctor_expect: Dict[str, object],
+                      found: List[dict]) -> List[str]:
+    """Check a scenario's ``doctor_expect`` contract against the
+    findings a :class:`_DoctorSampler` accumulated (shared by the real
+    and sim runners)."""
+    violations: List[str] = []
+    exp_kind = doctor_expect.get("kind")
+    absent = doctor_expect.get("absent_kind")
+    if exp_kind is not None:
+        exp_rank = doctor_expect.get("rank")
+        hits = [d for d in found if d.get("kind") == exp_kind]
+        if not any(d.get("rank") == exp_rank for d in hits):
+            violations.append(
+                f"doctor: expected a {exp_kind!r} finding naming "
+                f"rank {exp_rank}; saw ranks "
+                f"{sorted(str(d.get('rank')) for d in hits)}")
+        wrong = [d for d in hits if d.get("rank") != exp_rank]
+        if wrong:
+            violations.append(
+                f"doctor: {exp_kind!r} misattributed to rank(s) "
+                f"{sorted(str(d.get('rank')) for d in wrong)} "
+                f"(only rank {exp_rank} was delayed)")
+    if absent is not None:
+        spurious = [d for d in found if d.get("kind") == absent]
+        if spurious:
+            violations.append(
+                f"doctor: spurious {absent!r} finding(s) on a "
+                f"clean run: ranks "
+                f"{sorted(str(d.get('rank')) for d in spurious)}")
+    return violations
+
+
+def floor_violations(sc: Scenario, fired: List[dict],
+                     events: List[dict]) -> List[str]:
+    """Scenario-level proof floors: a chaos scenario that fired nothing
+    (or never moved the membership) proved nothing — a silent pass here
+    is a harness regression, not a healthy cluster."""
+    violations: List[str] = []
+    if sc.min_fired and len(fired) < sc.min_fired:
+        violations.append(
+            f"only {len(fired)} fault(s) fired "
+            f"(scenario requires >= {sc.min_fired})")
+    if sc.min_config_versions:
+        seen = {e.get("version") for e in events
+                if e.get("kind") == "config"}
+        if len(seen) < sc.min_config_versions:
+            violations.append(
+                f"only {len(seen)} distinct config version(s) observed "
+                f"{sorted(v for v in seen if v is not None)} (scenario "
+                f"requires >= {sc.min_config_versions})")
+    return violations
+
+
 def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                  verbose: bool = True) -> ScenarioResult:
-    """Execute one scenario end-to-end and check every invariant."""
+    """Execute one scenario end-to-end and check every invariant.
+    ``tier="sim"`` scenarios route to the kfsim runner (fake trainers
+    under the real watcher — no jax, no data plane)."""
+    if sc.tier == "sim":
+        from ..sim.runner import run_sim_scenario
+        return run_sim_scenario(sc, out_root=out_root, verbose=verbose)
     from ..elastic import ConfigServer, put_config
     from ..launcher.job import Job
     from ..launcher.watch import watch_run
@@ -809,34 +937,15 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
                 f"mode this scenario demonstrates did not reproduce")
     if sc.doctor_expect:
         found = list(sampler.seen.values()) if sampler is not None else []
-        exp_kind = sc.doctor_expect.get("kind")
-        absent = sc.doctor_expect.get("absent_kind")
-        if exp_kind is not None:
-            exp_rank = sc.doctor_expect.get("rank")
-            hits = [d for d in found if d.get("kind") == exp_kind]
-            if not any(d.get("rank") == exp_rank for d in hits):
-                violations.append(
-                    f"doctor: expected a {exp_kind!r} finding naming "
-                    f"rank {exp_rank}; saw ranks "
-                    f"{sorted(str(d.get('rank')) for d in hits)}")
-            wrong = [d for d in hits if d.get("rank") != exp_rank]
-            if wrong:
-                violations.append(
-                    f"doctor: {exp_kind!r} misattributed to rank(s) "
-                    f"{sorted(str(d.get('rank')) for d in wrong)} "
-                    f"(only rank {exp_rank} was delayed)")
-        if absent is not None:
-            spurious = [d for d in found if d.get("kind") == absent]
-            if spurious:
-                violations.append(
-                    f"doctor: spurious {absent!r} finding(s) on a "
-                    f"clean run: ranks "
-                    f"{sorted(str(d.get('rank')) for d in spurious)}")
+        violations += doctor_violations(sc.doctor_expect, found)
+    fired = _collect_fired(log_prefix)
+    violations += floor_violations(sc, fired, events)
     trace_files = sorted(glob.glob(os.path.join(out_dir,
                                                 "kftrace*.jsonl")))
     res = ScenarioResult(scenario=sc.name, rc=rc, violations=violations,
-                         events=events, fired=_collect_fired(log_prefix),
-                         out_dir=out_dir, trace_files=trace_files)
+                         events=events, fired=fired,
+                         out_dir=out_dir, trace_files=trace_files,
+                         parent_port=parent_port)
     if verbose:
         status = "PASS" if res.ok else "FAIL"
         print(f"kfchaos: scenario {sc.name}: {status} "
@@ -872,7 +981,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="deterministic fault-injection scenarios for the "
                     "elastic control plane")
     p.add_argument("--scenario", default="smoke",
-                   help="scenario name, 'all', or 'smoke' (default)")
+                   help="scenario name, 'all', 'smoke' (default), or "
+                        "'none' (only the --seed/--sim-seed extras)")
     p.add_argument("--out", default=None,
                    help="directory to keep artifacts under (default: "
                         "a fresh tempdir)")
@@ -884,25 +994,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--seed", type=int, default=None,
                    help="additionally run a random_plan fuzz scenario "
                         "with this seed (no resize schedule)")
+    p.add_argument("--sim-seed", type=int, action="append", default=[],
+                   help="additionally run a SIM-tier fuzz sweep with "
+                        "this seed (repeatable; `make sim-soak`)")
+    p.add_argument("--sim-procs", type=int, default=50,
+                   help="fleet size for --sim-seed sweeps (default 50)")
     args = p.parse_args(argv)
 
     matrix = scenarios()
     if args.list:
         for name, sc in matrix.items():
-            print(f"{name:28s} {sc.desc}")
-        return 0
-    from .. import native
-    if not native.available():
-        print("kfchaos: SKIP (native comm library unavailable)",
-              flush=True)
-        return 0
-    if not data_plane_supported():
-        print("kfchaos: SKIP (this jax build cannot run multiprocess "
-              "CPU computations; scenarios need the real data plane)",
-              flush=True)
+            tag = " [sim]" if sc.tier == "sim" else ""
+            print(f"{name:28s}{tag} {sc.desc}")
         return 0
     if args.scenario == "all":
         picked = [sc for name, sc in matrix.items() if name != "smoke"]
+    elif args.scenario == "none":
+        picked = []
     else:
         if args.scenario not in matrix:
             p.error(f"unknown scenario {args.scenario!r} "
@@ -918,6 +1026,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     "elastic.commit.exchange",
                                     "config.fetch"],
                              actions=("exception", "delay", "drop-rpc"))))
+    for seed in args.sim_seed:
+        from ..sim.scenarios import sim_fuzz_scenario
+        picked.append(sim_fuzz_scenario(seed, nprocs=args.sim_procs))
+    # Gate only the REAL tier on native + the multiprocess data plane;
+    # sim scenarios run everywhere, unconditionally (their entire point)
+    real = [sc for sc in picked if sc.tier != "sim"]
+    if real:
+        from .. import native
+        blocked = None
+        if not native.available():
+            blocked = "native comm library unavailable"
+        elif not data_plane_supported():
+            blocked = ("this jax build cannot run multiprocess CPU "
+                       "computations; real-tier scenarios need the "
+                       "data plane")
+        if blocked:
+            print(f"kfchaos: SKIP {len(real)} real-tier scenario(s) "
+                  f"({blocked})", flush=True)
+            picked = [sc for sc in picked if sc.tier == "sim"]
+            if not picked:
+                return 0
     if args.out:
         os.makedirs(args.out, exist_ok=True)
     ok = True

@@ -91,6 +91,11 @@ SITES: Dict[str, str] = {
         "worker liveness lease renewal, before the POST /heartbeat — "
         "drop-rpc here ages the worker's lease WITHOUT hanging the "
         "worker, driving the watcher's expired-lease escalation"),
+    "sim.state.fetch": (
+        "kfsim fake trainer (sim/trainer.py), before probing peer "
+        "/state endpoints for committed synthetic state — a drop-rpc "
+        "or exception here models a joiner that cannot reach any "
+        "donor and must found from zero"),
     # ------------------------------------------------ launcher / watcher
     "launcher.watch.update": (
         "watcher applying a Stage{version, cluster} diff, before any "

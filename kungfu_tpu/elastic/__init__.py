@@ -1,19 +1,32 @@
 """Elastic cluster membership (config server, resize protocol, policies)."""
-from . import snapshot, state
-from .config_server import ConfigServer, fetch_config, put_config
-from .snapshot import AsyncCommitter
-from .dataset import ElasticDataShard
-from .policy import (BasePolicy, PolicyContext, PolicyRunner,
-                     ScheduledResizePolicy)
-from .schedule import Stage, StepSchedule
-from .trainer import ElasticTrainer
-from .multiproc import DistributedElasticTrainer
-from .sharded import ShardedElasticTrainer
+import os as _os
 
-__all__ = [
-    "snapshot", "state", "AsyncCommitter",
-    "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
-    "DistributedElasticTrainer", "ShardedElasticTrainer",
-    "BasePolicy", "PolicyContext", "PolicyRunner", "ScheduledResizePolicy",
-    "Stage", "StepSchedule", "ElasticDataShard",
-]
+from . import state
+from .config_server import ConfigServer, fetch_config, put_config
+from .schedule import Stage, StepSchedule
+
+if _os.environ.get("KFT_SIM_LITE") != "1":
+    # The trainer stack imports jax at module top; kfsim fake trainers
+    # (KFT_SIM_LITE=1) only need the host-plane surface above.
+    from . import snapshot
+    from .snapshot import AsyncCommitter
+    from .dataset import ElasticDataShard
+    from .policy import (BasePolicy, PolicyContext, PolicyRunner,
+                         ScheduledResizePolicy)
+    from .trainer import ElasticTrainer
+    from .multiproc import DistributedElasticTrainer
+    from .sharded import ShardedElasticTrainer
+
+    __all__ = [
+        "snapshot", "state", "AsyncCommitter",
+        "ConfigServer", "fetch_config", "put_config", "ElasticTrainer",
+        "DistributedElasticTrainer", "ShardedElasticTrainer",
+        "BasePolicy", "PolicyContext", "PolicyRunner",
+        "ScheduledResizePolicy",
+        "Stage", "StepSchedule", "ElasticDataShard",
+    ]
+else:
+    __all__ = [
+        "state", "ConfigServer", "fetch_config", "put_config",
+        "Stage", "StepSchedule",
+    ]

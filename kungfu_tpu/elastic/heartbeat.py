@@ -29,7 +29,12 @@ Env dials (documented in docs/elastic.md):
 """
 from __future__ import annotations
 
+import contextlib
+import http.client
+import json
+import socket
 import threading
+import urllib.parse
 from typing import Optional
 
 from ..chaos import point as _chaos_point
@@ -50,9 +55,12 @@ class HeartbeatSender:
         self.interval_s = max(0.1, float(interval_s))
         self.misses = 0
         self.sent = 0
+        self.post_timeout_s = 2.0
         self._last = -float("inf")
         self._mono = time.monotonic
         self._pending: Optional[dict] = None
+        self._deadline: Optional[float] = None
+        self._conn: Optional[http.client.HTTPConnection] = None
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -77,8 +85,40 @@ class HeartbeatSender:
         return True
 
     # ----------------------------------------------------------- sender side
+    def _post(self, payload: dict) -> None:
+        """One lease-renewal POST, connection owned by the sender (NOT
+        routed through utils.rpc): owning the socket lets ``stop()``
+        force-close an in-flight attempt, so a beat against a dead or
+        wedged server can never make the join overshoot its budget.
+        Single attempt by design (a missed beat IS the signal)."""
+        from .config_server import _health_url
+        timeout = self.post_timeout_s
+        deadline = self._deadline
+        if deadline is not None:
+            # stopping: clamp the attempt to the remaining join budget
+            timeout = max(0.05, min(timeout, deadline - self._mono()))
+        u = urllib.parse.urlsplit(_health_url(self.url, "/heartbeat"))
+        body = json.dumps({"peer": self.peer, **payload}).encode()
+        conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                          timeout=timeout)
+        with self._lock:
+            self._conn = conn
+        try:
+            try:
+                conn.request("POST", u.path or "/heartbeat", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+            except http.client.HTTPException as e:
+                raise OSError(f"heartbeat: {e!r}") from e
+            if resp.status >= 400:
+                raise OSError(f"heartbeat HTTP {resp.status}")
+        finally:
+            with self._lock:
+                self._conn = None
+            conn.close()
+
     def _run(self) -> None:
-        from .config_server import post_heartbeat
         while True:
             self._wake.wait()
             self._wake.clear()
@@ -94,9 +134,11 @@ class HeartbeatSender:
                 _chaos_point("heartbeat.miss", rank=payload["rank"],
                              step=payload["step"],
                              version=payload["version"])
-                post_heartbeat(self.url, self.peer, **payload)
+                self._post(payload)
                 self.sent += 1
             except (OSError, ValueError) as e:
+                if self._stop:
+                    return  # stop() yanked the in-flight socket
                 # a missed beat is the signal, not an error to fight:
                 # count it (and say so once per outage-ish burst)
                 self.misses += 1
@@ -110,9 +152,23 @@ class HeartbeatSender:
                                   labels={"peer": self.peer})
 
     def stop(self, join_timeout: float = 2.0) -> None:
+        deadline = self._mono() + max(0.0, join_timeout)
+        self._deadline = deadline  # clamps attempts that start after this
         self._stop = True
         self._wake.set()
-        self._thread.join(timeout=join_timeout)
+        # A beat already in flight against a dead/wedged server would
+        # otherwise hold the sender for its full post timeout; shutting
+        # the socket down wakes the blocked read immediately.
+        with self._lock:
+            conn = self._conn
+        if conn is not None:
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                with contextlib.suppress(OSError):
+                    sock.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                conn.close()
+        self._thread.join(timeout=max(0.0, deadline - self._mono()))
 
     # -------------------------------------------------------------- factory
     @classmethod

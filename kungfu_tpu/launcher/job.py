@@ -44,6 +44,11 @@ class Job:
     config_server: Optional[str] = None
     log_dir: Optional[str] = None
     num_local_devices: Optional[int] = None  # per-worker device count
+    # extra env vars for every worker this job spawns — per-JOB, so two
+    # jobs in one process (concurrent sim fleets, pytest alongside a
+    # manual run) cannot bleed settings into each other the way a
+    # process-global os.environ mutation would
+    extra_env: Optional[Dict[str, str]] = None
 
     def new_proc(self, self_peer: PeerID, cluster: Cluster, version: int,
                  parent: PeerID, chip_id: Optional[int] = None) -> Proc:
@@ -54,6 +59,8 @@ class Job:
             parent=parent,
             chip_ids=[chip_id] if chip_id is not None else None,
             num_local_devices=self.num_local_devices)
+        if self.extra_env:
+            env = {**env, **self.extra_env}
         rank = cluster.workers.rank(self_peer)
         name = f"{rank}/{len(cluster.workers)}/{version}"
         return Proc(name=name, args=[self.prog] + list(self.args), env=env,

@@ -55,6 +55,12 @@ class Watcher:
         self.preempted: List[PeerID] = []
         self._last_cluster: Optional[Cluster] = None
         self._done: set = set()  # peers that exited cleanly this version
+        # peers reaped as preempted whose exclusion CAS has not landed
+        # yet: retry_pending must NOT respawn them — a respawn races the
+        # watcher's own shrink proposal, and the late exclusion then
+        # removes a healthy worker after survivors began finalizing
+        # (observed as split final membership in 100-worker sim sweeps)
+        self._condemned: set = set()
         # applied Stage history for the debug endpoint (reference: the
         # runner's -debug-port dump, handler.go:117-122)
         self.history: List[Dict] = []
@@ -78,6 +84,10 @@ class Watcher:
                 if chip is not None and self.pool:
                     self.pool.put(chip)
             self._done.clear()  # new membership version: everyone works again
+            # exclusions that landed leave want; keep condemning only
+            # peers still awaiting theirs (a later grow that re-adds an
+            # excluded host:port is a NEW worker and must spawn)
+            self._condemned &= want
             for peer in sorted(want - have):
                 self._spawn(peer, cluster, version)
             self.version = version
@@ -115,7 +125,8 @@ class Watcher:
             if self._last_cluster is None:
                 return
             want = set(self.local_workers(self._last_cluster))
-            for peer in sorted(want - set(self.current) - self._done):
+            for peer in sorted(want - set(self.current) - self._done
+                               - self._condemned):
                 self._spawn(peer, self._last_cluster, self.version)
 
     def all_local_done(self) -> bool:
@@ -146,6 +157,7 @@ class Watcher:
                     self._done.add(peer)
                 elif self.preempt_recover and code in _PREEMPT_CODES:
                     self.preempted.append(peer)
+                    self._condemned.add(peer)
                 elif self.failed is None:
                     self.failed = code
 
@@ -342,7 +354,8 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
               pool: Optional[ChipPool] = None,
               stop_when_empty: bool = True,
               debug_port: int = 0,
-              preempt_recover: bool = True) -> int:
+              preempt_recover: bool = True,
+              lease_ttl_s: Optional[float] = None) -> int:
     """Run the elastic watch loop until the *global* cluster drains or a
     local worker fails (reference: watch.go:106-135 WatchRun).
 
@@ -448,13 +461,16 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     # preemption death takes.  0 (the default) = observe-only: gauges
     # and /health stay live, no escalation (long XLA compiles between
     # steps make an unconditional default unsafe; docs/elastic.md).
-    try:
-        lease_ttl = float(os.environ.get("KFT_LEASE_TTL_S", "0") or 0)
-    except ValueError:
-        print(f"kft-run: ignoring malformed KFT_LEASE_TTL_S="
-              f"{os.environ.get('KFT_LEASE_TTL_S')!r}; leases "
-              f"observe-only", file=_sys.stderr, flush=True)
-        lease_ttl = 0.0
+    if lease_ttl_s is not None:  # explicit beats env: a caller running
+        lease_ttl = lease_ttl_s  # several watch loops in one process
+    else:                        # cannot share one global knob
+        try:
+            lease_ttl = float(os.environ.get("KFT_LEASE_TTL_S", "0") or 0)
+        except ValueError:
+            print(f"kft-run: ignoring malformed KFT_LEASE_TTL_S="
+                  f"{os.environ.get('KFT_LEASE_TTL_S')!r}; leases "
+                  f"observe-only", file=_sys.stderr, flush=True)
+            lease_ttl = 0.0
     escalated: set = set()   # peers already proposed, per version
     escalated_version = -1
 

@@ -1,0 +1,49 @@
+"""kfsim: cluster-in-a-box — the control plane at 100 workers, no jax.
+
+The chaos matrix's real tier spawns ≤4 actual trainers and needs a jax
+build that can run the multiprocess CPU data plane; on images without
+it the whole matrix self-skips.  kfsim closes that gap with a **fake
+trainer** (:mod:`kungfu_tpu.sim.trainer`) that speaks the REAL host
+plane — config-server GET/PUT/CAS through :mod:`kungfu_tpu.utils.rpc`,
+real ``POST /heartbeat`` leases, real :class:`~kungfu_tpu.store.
+VersionedStore` saves keyed by membership version, a real ``/metrics``
+endpoint with scripted step-time distributions — while the "training"
+itself is a deterministic seeded arithmetic loop.  A
+:class:`~kungfu_tpu.sim.runner.SimClusterRunner` spawns N of them under
+the production :func:`~kungfu_tpu.launcher.watch.watch_run` watcher, so
+preemption reaping, ``propose_exclusion`` shrinks, lease escalation and
+doctor scrapes are all the real code paths, at scales (100+ processes
+on one box) the real tier can never reach.
+
+Fake trainers run with ``KFT_SIM_LITE=1``, which prunes the package
+``__init__`` imports down to the jax-free host-plane surface — a
+worker costs ~0.2 s of import CPU instead of ~1 s, which is what makes
+100-process sweeps viable on a small machine.
+
+What sim proves and what it cannot is tabulated in docs/chaos.md
+("Simulation tier (kfsim)").
+"""
+from __future__ import annotations
+
+__all__ = ["sim_wsum", "step_increment"]
+
+
+def step_increment(seed: int, t: int) -> float:
+    """The synthetic "weight update" of sim step ``t`` (1-based): a
+    seeded, strictly-positive harmonic term.  Pure function of
+    ``(seed, t)`` and summed in step order, so every rank's running
+    ``wsum`` is bit-identical and a lost, replayed, or reordered step
+    shifts the fingerprint."""
+    return 1.0 / (t + 7.0 + (seed % 1000) * 1e-3)
+
+
+def sim_wsum(seed: int, n_steps: int) -> float:
+    """The trajectory oracle: the exact ``wsum`` a fault-free sim run
+    reaches after ``n_steps`` steps (what the real tier's numpy-adam
+    :func:`~kungfu_tpu.chaos.runner.oracle_wsum` is to real training).
+    Feeds ``invariants.run_all(oracle_wsum=...)`` — nonzero for any
+    ``n_steps > 0``, so ``check_no_fresh_start`` stays meaningful."""
+    w = 0.0
+    for t in range(1, n_steps + 1):
+        w += step_increment(seed, t)
+    return w

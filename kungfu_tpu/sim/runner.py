@@ -1,0 +1,233 @@
+"""SimClusterRunner: N fake trainers under the production watcher.
+
+The runner process is the REAL control plane end of the scenario: an
+in-process :class:`~kungfu_tpu.elastic.ConfigServer`, the real
+:func:`~kungfu_tpu.launcher.watch.watch_run` loop (reaping, pending
+retries, ``propose_exclusion`` shrinks, lease escalation when
+``KFT_LEASE_TTL_S`` is set), the kfdoctor sampler for
+``doctor_expect`` scenarios, and the same event/journal collection +
+:mod:`~kungfu_tpu.chaos.invariants` sweep the real tier uses.  Only
+the worker payload differs: :mod:`kungfu_tpu.sim.trainer` processes
+spawned with ``KFT_SIM_LITE=1`` (no jax import), which is what makes
+100-process fleets practical on one small box.
+
+Scenario timeouts are enforced HERE (a watchdog SIGKILLs the fleet and
+fails the run) because a sim fleet wedged in drain consensus would
+otherwise hang the harness.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import types
+from typing import List, Optional
+
+from . import sim_wsum
+from ..chaos import invariants
+from ..chaos.runner import (Scenario, ScenarioResult,
+                            _collect_events, _collect_fired,
+                            _CrashRestartOrchestrator, _DoctorSampler,
+                            _free_port,
+                            doctor_violations, floor_violations)
+
+# The spawned payload: sets lite mode BEFORE any kungfu_tpu import (a
+# belt to the env var's braces), then runs the fake trainer.  The
+# tempdir-unique script path doubles as the no-orphans pid marker.
+SIM_WORKER = (
+    "import os, sys\n"
+    "os.environ.setdefault('KFT_SIM_LITE', '1')\n"
+    "from kungfu_tpu.sim.trainer import main\n"
+    "sys.exit(main())\n"
+)
+
+# Worker base port chosen so that BOTH the worker range and the metrics
+# range (port + MONITOR_PORT_OFFSET) sit below the kernel's default
+# ephemeral floor (net.ipv4.ip_local_port_range starts at 32768): a
+# 100-process fleet makes thousands of outgoing heartbeat/config
+# connections, and any of them could otherwise squat a metrics port as
+# its ephemeral source port (observed as EADDRINUSE at n=100).
+SIM_BASE_PORT = 21100
+
+# Concurrent runs in one process (pytest running two scenarios in
+# threads) each need a disjoint worker range, or their metrics servers
+# fight over port+offset and their /state adoption probes cross fleets.
+# A cursor hands out [base, base+nprocs) slices, wrapping before the
+# metrics range would cross the ephemeral floor.  Cross-PROCESS
+# concurrency is covered separately: the fake trainer degrades to
+# serving no /metrics when its bind loses a race.
+_BASE_LOCK = threading.Lock()
+_BASE_CURSOR = [SIM_BASE_PORT]
+
+
+def _alloc_base_port(nprocs: int) -> int:
+    from ..monitor import MONITOR_PORT_OFFSET
+    with _BASE_LOCK:
+        base = _BASE_CURSOR[0]
+        if base + nprocs + MONITOR_PORT_OFFSET >= 32768:
+            base = SIM_BASE_PORT
+        _BASE_CURSOR[0] = base + nprocs
+        return base
+
+
+class SimClusterRunner:
+    """Run one ``tier="sim"`` scenario end-to-end."""
+
+    def __init__(self, sc: Scenario, out_root: Optional[str] = None,
+                 verbose: bool = True):
+        if sc.tier != "sim":
+            raise ValueError(f"scenario {sc.name!r} is tier="
+                             f"{sc.tier!r}, not 'sim'")
+        self.sc = sc
+        self.out_root = out_root
+        self.verbose = verbose
+        self.timed_out = False
+
+    # ----------------------------------------------------------- watchdog
+    def _kill_fleet(self, out_dir: str) -> None:
+        self.timed_out = True
+        for pidfile in glob.glob(os.path.join(out_dir, "pid.*")):
+            with contextlib.suppress(OSError, ValueError):
+                with open(pidfile) as f:
+                    os.kill(int(f.read().strip()), signal.SIGKILL)
+
+    # --------------------------------------------------------------- run
+    def run(self) -> ScenarioResult:
+        from ..elastic import ConfigServer, put_config
+        from ..launcher.job import Job
+        from ..launcher.watch import watch_run
+        from ..plan import Cluster, HostList, PeerID
+
+        sc = self.sc
+        out_dir = tempfile.mkdtemp(prefix=f"kfsim-{sc.name}-",
+                                   dir=self.out_root)
+        script = os.path.join(out_dir, "sim_worker.py")
+        with open(script, "w") as f:
+            f.write(SIM_WORKER)
+        plan_path = os.path.join(out_dir, "plan.json")
+        sc.plan.save(plan_path)
+        log_prefix = os.path.join(out_dir, "chaos-log")
+        target = sc.target_steps * sc.batch
+
+        env = {
+            "KFT_SIM_LITE": "1",
+            "KFT_CHAOS_PLAN": plan_path,
+            "KFT_CHAOS_LOG": log_prefix,
+            "KFT_CHAOS_OUT": out_dir,
+            "KFT_CHAOS_B": str(sc.batch),
+            "KFT_CHAOS_TARGET": str(target),
+            "KFT_CHAOS_PROPOSE": json.dumps(
+                [list(p) for p in sc.propose]),
+            "KFT_CHAOS_SNAP": str(sc.snapshot_every),
+            "KFT_SIM_SEED": str(sc.sim_seed),
+            "KFT_SIM_STEP_S": str(sc.sim_step_s),
+            "KFT_SIM_SLOW_RANKS": ",".join(
+                str(r) for r in sc.sim_slow_ranks),
+            "KFT_SIM_SLOW_FACTOR": str(sc.sim_slow_factor),
+            "KFT_SIM_DRAIN_S": str(sc.sim_drain_s),
+            # workers pump leases at this cadence; the TTL side goes to
+            # watch_run directly (lease_ttl_s), not through env
+            "KFT_HEARTBEAT_S": str(sc.sim_heartbeat_s),
+        }
+        if self.verbose:
+            print(f"kfsim: scenario {sc.name}: {sc.nprocs} fake "
+                  f"workers, target {target} samples, "
+                  f"{len(sc.plan.faults)} fault(s), out {out_dir}",
+                  flush=True)
+        cluster = Cluster.from_hostlist(
+            HostList.parse(f"127.0.0.1:{sc.nprocs}"), sc.nprocs,
+            base_port=_alloc_base_port(sc.nprocs))
+        parent_port = sc.parent_port if sc.parent_port else _free_port()
+        srv = ConfigServer().start()
+        url = srv.url
+        # sample the server's (epoch, version) stream into the event
+        # log — feeds check_version_monotonic_across_epochs and the
+        # min_config_versions floor (no restarts scheduled: the shim
+        # only carries the URL)
+        observer = _CrashRestartOrchestrator(
+            sc, types.SimpleNamespace(url=url), out_dir)
+        sampler = None
+        watchdog = threading.Timer(sc.timeout_s,
+                                   self._kill_fleet, args=(out_dir,))
+        watchdog.daemon = True
+        try:
+            put_config(url, cluster)
+            observer.start()
+            if sc.doctor_expect is not None:
+                sampler = _DoctorSampler(cluster, out_dir)
+                sampler.start()
+            watchdog.start()
+            # worker settings ride the Job (NOT os.environ): two
+            # concurrent runs in one process must not bleed plans,
+            # out-dirs, or cadences into each other's spawns
+            job = Job(prog=sys.executable, args=[script],
+                      config_server=url, extra_env=env)
+            rc = watch_run(job, "127.0.0.1",
+                           PeerID("127.0.0.1", parent_port),
+                           cluster, url, poll_interval=0.2,
+                           preempt_recover=True,
+                           lease_ttl_s=sc.sim_lease_ttl_s)
+        finally:
+            watchdog.cancel()
+            if sampler is not None:
+                sampler.stop()
+            observer.stop()
+            srv.stop()
+            from ..utils import rpc as _rpc
+            _rpc.reset(url)
+
+        events = _collect_events(out_dir)
+        pids = [int(open(p).read().strip())
+                for p in glob.glob(os.path.join(out_dir, "pid.*"))]
+        violations: List[str] = []
+        if self.timed_out:
+            violations.append(
+                f"scenario timeout after {sc.timeout_s}s (fleet "
+                f"SIGKILLed by the watchdog)")
+        elif rc != 0:
+            violations.append(f"job exited rc={rc} (expected 0)")
+        violations += invariants.run_all(
+            events, pids=pids,
+            oracle_wsum=lambda samples: sim_wsum(
+                sc.sim_seed, samples // sc.batch),
+            pid_marker=script)
+        if sc.expect_violation:
+            import re as _re
+            matched = [v for v in violations
+                       if _re.search(sc.expect_violation, v)]
+            violations = [v for v in violations if v not in matched]
+            if not matched:
+                violations.append(
+                    f"expected a violation matching "
+                    f"{sc.expect_violation!r}; none tripped")
+        if sc.doctor_expect:
+            found = (list(sampler.seen.values())
+                     if sampler is not None else [])
+            violations += doctor_violations(sc.doctor_expect, found)
+        fired = _collect_fired(log_prefix)
+        violations += floor_violations(sc, fired, events)
+        res = ScenarioResult(scenario=sc.name, rc=rc,
+                             violations=violations, events=events,
+                             fired=fired, out_dir=out_dir,
+                             parent_port=parent_port)
+        if self.verbose:
+            status = "PASS" if res.ok else "FAIL"
+            finals = sum(1 for e in events if e.get("kind") == "final")
+            print(f"kfsim: scenario {sc.name}: {status} "
+                  f"({len(fired)} fault(s) fired, {len(events)} "
+                  f"events, {finals} final(s))", flush=True)
+            for v in violations:
+                print(f"kfsim:   violation: {v}", flush=True)
+        return res
+
+
+def run_sim_scenario(sc: Scenario, out_root: Optional[str] = None,
+                     verbose: bool = True) -> ScenarioResult:
+    """Functional entry point (what
+    :func:`kungfu_tpu.chaos.runner.run_scenario` dispatches to)."""
+    return SimClusterRunner(sc, out_root=out_root, verbose=verbose).run()

@@ -1,0 +1,219 @@
+"""The sim-tier scenario matrix: the shapes the real tier can never
+express — 100-worker preemption waves, cascading lease expiries,
+doctor attribution at fleet scale, spot-trace replays, seeded fuzz
+sweeps — all on one box, no data plane.
+
+Every entry here is an ordinary :class:`~kungfu_tpu.chaos.runner.
+Scenario` with ``tier="sim"``; :func:`kungfu_tpu.chaos.runner.
+scenarios` merges this matrix into the CLI's, so
+``python -m kungfu_tpu.chaos.runner --scenario sim-smoke`` just works
+(and never self-skips: the sim tier needs no jax data plane).
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+from ..chaos.plan import Plan, random_plan
+from ..chaos.runner import Scenario
+
+# A replayed spot-preemption burst (shape lifted from public spot-VM
+# reclaim traces: single early reclaims, then a correlated burst, then
+# stragglers): (step_fence, ranks killed at that fence).
+SPOT_TRACE: Sequence[Tuple[int, Tuple[int, ...]]] = (
+    (2, (3,)),
+    (4, (11, 12, 13)),
+    (6, (7,)),
+    (9, (21, 22)),
+)
+
+
+def _wave_plan(waves: Sequence[Tuple[int, Sequence[int]]]) -> Plan:
+    """Compile (step, ranks) waves into SIGKILL faults at the sim step
+    fence, each wave GATED ON the membership version the previous
+    wave's exclusion produces (wave i fires only at version > i).
+
+    Three sim realities shape the matchers: late spawns adopt
+    committed peer state and skip early fences entirely (an exact-step
+    match would mostly miss); a starved box can reap temporally-spread
+    deaths into one batched CAS (collapsing "waves" into one version
+    bump); and faults are armed per-PROCESS, so after a shrink the new
+    holder of a victim rank carries its own live copy of the fault.
+    Step RANGES make each kill land at the victim's first fence
+    at-or-after the wave step; version WINDOWS [i+1, i+2] both make
+    wave i+1 wait until wave i's shrink is live (the plan provably
+    rolls: kill -> exclusion -> new version -> next wave) and CLOSE
+    each wave once the cluster moves on — an open-ended window would
+    let every rank keep killing its successive holders until the
+    fleet annihilates."""
+    plan = Plan(seed=None)
+    for i, (step, ranks) in enumerate(waves):
+        for r in ranks:
+            plan.add("elastic.step.fence", "kill", rank=r,
+                     step=list(range(step, 400)),
+                     version=[i + 1, i + 2])
+    return plan
+
+
+def sim_fuzz_scenario(seed: int, nprocs: int = 50) -> Scenario:
+    """A ``random_plan(seed)`` fuzz sweep at fleet scale: kills land as
+    preemptions, injected exceptions exit preemption-class (the watcher
+    absorbs both as shrinks), drop-rpc exercises the poll/lease miss
+    paths.  Same seed => same plan; rerun a red sweep by seed alone."""
+    return Scenario(
+        name=f"sim-fuzz-{seed}",
+        desc=f"kfsim fuzz: random_plan(seed={seed}) over {nprocs} fake "
+             f"workers (kill/exception/delay/drop-rpc on the host-plane "
+             f"sites); every elastic contract still asserted",
+        plan=random_plan(seed, n_faults=6,
+                         sites=("elastic.step.fence",
+                                "elastic.step.compute",
+                                "config.fetch", "heartbeat.miss",
+                                "sim.state.fetch"),
+                         ranks=tuple(range(min(nprocs, 16))),
+                         steps=tuple(range(1, 10)),
+                         actions=("kill", "exception", "delay",
+                                  "drop-rpc")),
+        tier="sim",
+        nprocs=nprocs,
+        target_steps=10,
+        sim_step_s=0.08,
+        sim_lease_ttl_s=20.0,
+        sim_drain_s=180.0,
+        timeout_s=420.0)
+
+
+def sim_scenarios() -> Dict[str, Scenario]:
+    m = [
+        Scenario(
+            name="sim-smoke",
+            desc="20 fake workers, two rolling preemption waves (2 "
+                 "kills at fence 3, 2 more at fence 7): the watcher "
+                 "must reap, CAS-shrink, and every survivor must "
+                 "converge on one final membership — no data plane, "
+                 "runs everywhere",
+            plan=_wave_plan([(3, (5, 12)), (7, (8, 15))]),
+            tier="sim",
+            nprocs=20,
+            target_steps=12,
+            sim_step_s=0.05,
+            min_fired=2,
+            min_config_versions=2,
+            timeout_s=150.0),
+        Scenario(
+            name="sim-preemption-wave-100",
+            desc="100 fake workers, rolling preemption waves (5 kills "
+                 "each at fences 3/6/9 across the rank space): "
+                 "progress-monotonic, no-fresh-start, single-winner "
+                 "and version-monotonic checked over the full sim "
+                 "event stream",
+            plan=_wave_plan([(3, range(5, 10)),
+                             (6, range(40, 45)),
+                             (9, range(80, 85))]),
+            tier="sim",
+            nprocs=100,
+            # the training window must outlast the 100-process spawn
+            # storm (~10s on one starved core): a worker that spawns
+            # after the frontier reaches target adopts straight into
+            # drain and crosses no fence a wave could kill it at
+            target_steps=60,
+            sim_step_s=0.4,
+            # 100 heartbeat threads on one starved box age leases far
+            # past wall-clock intent; keep escalation out of THIS
+            # scenario (sim-lease-cascade owns that path) so every
+            # shrink is a wave kill
+            sim_lease_ttl_s=30.0,
+            sim_drain_s=420.0,
+            min_fired=10,
+            min_config_versions=4,
+            timeout_s=600.0),
+        Scenario(
+            name="sim-lease-cascade",
+            desc="config-server partition, worker side: heartbeats "
+                 "from ranks 4/9/14 are dropped from fences 2/6/10 on "
+                 "(drop-rpc, unlimited) — their leases age past "
+                 "KFT_LEASE_TTL_S and the watcher must escalate each "
+                 "into a propose_exclusion shrink, in cascade; "
+                 "survivors' drain consensus depends on those "
+                 "exclusions landing",
+            # drop onsets staggered so the three lease expiries land
+            # ~0.6s apart (>> the 0.2s watcher poll: distinct shrinks,
+            # not one batched CAS) and ALL inside the training window —
+            # 24 steps x 0.2s = 4.8s vs expiries at ~2.9/3.5/4.1s; if
+            # training ends first, drain consensus can complete before
+            # the cascade and the version floor reads a false red
+            plan=(Plan(seed=None)
+                  .add("heartbeat.miss", "drop-rpc", rank=4,
+                       step=list(range(2, 400)), count=-1)
+                  .add("heartbeat.miss", "drop-rpc", rank=9,
+                       step=list(range(5, 400)), count=-1)
+                  .add("heartbeat.miss", "drop-rpc", rank=14,
+                       step=list(range(8, 400)), count=-1)),
+            tier="sim",
+            nprocs=20,
+            target_steps=24,
+            sim_step_s=0.2,
+            sim_heartbeat_s=0.3,
+            sim_lease_ttl_s=2.5,
+            min_fired=3,
+            min_config_versions=3,
+            timeout_s=300.0),
+        Scenario(
+            name="sim-straggler-doctor-100",
+            desc="100 fake workers, rank 77 scripted 8x slower: the "
+                 "kfdoctor sampler scraping all 100 live /metrics "
+                 "endpoints must attribute a straggler finding to rank "
+                 "77 and no other — attribution proven at a scale the "
+                 "real tier cannot spawn",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=100,
+            # rank 77 spawns ~10s into the spawn storm and ADOPTS the
+            # frontier's committed state; the fleet must still be
+            # mid-training then, and must keep training long enough
+            # for several slow steps to land in the doctor's history
+            # windows — a short run would let rank 77 adopt straight
+            # into drain and emit no straggler signal at all
+            target_steps=60,
+            sim_step_s=0.25,
+            sim_slow_ranks=(77,),
+            sim_slow_factor=4.0,
+            # rank 77 must stay IN the cluster long enough to be
+            # attributed (and starved leases must not shrink anyone)
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=420.0,
+            doctor_expect={"kind": "straggler", "rank": 77},
+            timeout_s=600.0),
+        Scenario(
+            name="sim-spot-trace",
+            desc="30 fake workers under a replayed spot-preemption "
+                 "trace (single reclaims, a correlated 3-worker burst, "
+                 "stragglers): the elastic contracts must hold through "
+                 "the realistic arrival pattern",
+            plan=_wave_plan(SPOT_TRACE),
+            tier="sim",
+            nprocs=30,
+            target_steps=20,
+            sim_step_s=0.15,
+            sim_lease_ttl_s=15.0,
+            sim_drain_s=180.0,
+            min_fired=4,
+            min_config_versions=3,
+            timeout_s=300.0),
+        Scenario(
+            name="sim-grow-join",
+            desc="12 fake workers grow to 16 via rank 0's real "
+                 "fetch+CAS put at fence 4: joiners must adopt "
+                 "committed synthetic state from a peer's /state "
+                 "(sync events with samples>0 — the no-fresh-start "
+                 "and sync-from-committed paths), then all 16 finals "
+                 "converge",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=12,
+            propose=((4, 16),),
+            target_steps=14,
+            sim_step_s=0.1,
+            min_config_versions=2,
+            timeout_s=240.0),
+    ]
+    return {s.name: s for s in m}

@@ -1,0 +1,446 @@
+"""The kfsim fake trainer process (``python -m kungfu_tpu.sim.trainer``).
+
+One OS process per fake worker, spawned by the production watcher.  It
+speaks the REAL host plane:
+
+- config-server GET/PUT/CAS through :mod:`kungfu_tpu.utils.rpc`
+  (:func:`~kungfu_tpu.elastic.config_server.fetch_config` /
+  :func:`~kungfu_tpu.elastic.config_server.put_config` with If-Match);
+- liveness leases through the real
+  :class:`~kungfu_tpu.elastic.heartbeat.HeartbeatSender` (step-pumped
+  ``POST /heartbeat``);
+- synthetic state saved to a real
+  :class:`~kungfu_tpu.store.VersionedStore` keyed by membership
+  version, re-loaded at drain so a store regression trips the
+  ``wsum`` invariants;
+- a real ``/metrics`` endpoint (worker port + ``MONITOR_PORT_OFFSET``)
+  with scripted step-time/phase distributions the doctor scrapes, plus
+  ``/state`` — the committed synthetic state a joiner adopts.
+
+The "training" itself is :func:`kungfu_tpu.sim.step_increment`
+arithmetic: every rank accumulates the identical seeded ``wsum``
+fingerprint, so the chaos invariants (progress-monotonic,
+no-fresh-start, sync-from-committed, single-winner, trajectory oracle)
+apply unchanged to the sim event stream.
+
+Termination protocol (single-winner without a data plane): a worker
+that reaches the sample target enters DRAIN — it keeps renewing its
+lease at its final step and polls ``/config`` + ``/health`` until every
+worker of the CURRENT membership shows a lease step >= the target.
+Faults only fire at step fences below the target, so once that
+predicate holds the membership can no longer change, and every
+survivor's ``final`` event converges on the same (version, size).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import step_increment
+from ..chaos import point as _chaos_point
+from ..elastic.config_server import fetch_config, fetch_health, put_config
+from ..elastic.heartbeat import HeartbeatSender
+from ..launcher import env as E
+from ..monitor import MONITOR_PORT_OFFSET, Monitor
+from ..plan.cluster import Cluster
+from ..plan.hostspec import HostList
+from ..store import VersionedStore
+from ..utils import rpc as _rpc
+from ..utils.http import BackgroundHTTPServer
+
+_STATE_KEY = "sim-state"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        print(f"kfsim: ignoring malformed {name}={raw!r}; "
+              f"using {default}", file=sys.stderr)
+        return default
+
+
+def _env_int_set(name: str) -> set:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return set()
+    try:
+        return {int(x) for x in raw.split(",") if x.strip()}
+    except ValueError:
+        print(f"kfsim: ignoring malformed {name}={raw!r}",
+              file=sys.stderr)
+        return set()
+
+
+def _metrics_handler(trainer: "FakeTrainer"):
+    def factory(_srv):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = trainer.monitor.render_metrics().encode()
+                elif self.path.startswith("/state"):
+                    body = json.dumps(trainer.committed_state()).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+        return Handler
+    return factory
+
+
+class FakeTrainer:
+    """One fake worker: real host plane, synthetic training loop."""
+
+    def __init__(self, we: "E.WorkerEnv"):
+        if we.self_spec is None or not we.config_server:
+            raise RuntimeError("kfsim trainer needs the launcher env "
+                               "ABI (KFT_SELF_SPEC + KFT_CONFIG_SERVER)")
+        self.we = we
+        self.host = we.self_spec.host
+        self.port = we.self_spec.port
+        self.url = we.config_server
+        self.version = we.cluster_version
+        self.workers = list(we.peers)
+        self.init_rank = we.rank()
+        self.rank = self.init_rank
+
+        self.out_dir = os.environ["KFT_CHAOS_OUT"]
+        self.batch = int(os.environ.get("KFT_CHAOS_B", "8"))
+        self.target = int(os.environ["KFT_CHAOS_TARGET"])
+        self.target_step = max(1, self.target // self.batch)
+        self.propose: List[Tuple[int, int]] = [
+            tuple(p) for p in
+            json.loads(os.environ.get("KFT_CHAOS_PROPOSE", "[]"))]
+        snap = os.environ.get("KFT_CHAOS_SNAP", "1")
+        self.snapshot_every = 1 if snap == "auto" else max(1, int(snap))
+
+        self.seed = int(os.environ.get("KFT_SIM_SEED", "0"))
+        self.step_s = _env_float("KFT_SIM_STEP_S", 0.05)
+        self.poll_s = _env_float("KFT_SIM_POLL_S", 0.25)
+        self.drain_s = _env_float("KFT_SIM_DRAIN_S", 90.0)
+        slow = _env_int_set("KFT_SIM_SLOW_RANKS")
+        self.slow_factor = (_env_float("KFT_SIM_SLOW_FACTOR", 8.0)
+                            if self.init_rank in slow else 1.0)
+        # scripted per-worker jitter: deterministic per (seed, port)
+        self._jitter = random.Random((self.seed << 17) ^ self.port)
+
+        self.samples = 0
+        self.step = 0
+        self.w = 0.0
+        self._committed: Optional[dict] = None
+        self._proposed: set = set()
+        self._last_poll = -float("inf")
+
+        self.store = VersionedStore(window=4)
+        self.monitor = Monitor()
+        self.stream = f"{self.port}.{os.getpid()}"
+        self._ev_path = os.path.join(self.out_dir,
+                                     f"events.{self.stream}.jsonl")
+        with open(os.path.join(self.out_dir, f"pid.{self.stream}"),
+                  "w") as f:
+            f.write(str(os.getpid()))
+        self.hb = HeartbeatSender.from_env(we)
+        # the sim contract: /metrics + /state are served when the port
+        # can be bound (the doctor scrapes the fleet; joiners adopt
+        # committed state).  An outgoing connection from ANY fleet
+        # process may transiently squat port+offset as its ephemeral
+        # source port, so a bind failure must degrade (no /metrics for
+        # this worker) rather than kill the trainer — exiting here
+        # reads as a preemption and shrinks the cluster for no reason.
+        self.server = None
+        for attempt in range(5):
+            try:
+                self.server = BackgroundHTTPServer(
+                    _metrics_handler(self), self.host,
+                    self.port + MONITOR_PORT_OFFSET).start()
+                break
+            except OSError as e:
+                print(f"kfsim: metrics bind "
+                      f"{self.port + MONITOR_PORT_OFFSET} failed "
+                      f"({e}); retry {attempt + 1}/5", file=sys.stderr)
+                time.sleep(0.2)
+        if self.server is None:
+            print(f"kfsim: serving no /metrics on rank {self.rank} "
+                  f"(port {self.port + MONITOR_PORT_OFFSET} still in "
+                  f"use)", file=sys.stderr)
+
+    # ----------------------------------------------------------- events
+    def emit(self, kind: str, **kw) -> None:
+        kw.update(kind=kind, stream=self.stream)
+        with open(self._ev_path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    # ------------------------------------------------------------ state
+    def committed_state(self) -> dict:
+        c = self._committed
+        if c is None:
+            return {"samples": 0, "step": 0, "w": 0.0,
+                    "version": self.version, "seed": self.seed}
+        return dict(c)
+
+    def _commit(self) -> None:
+        _chaos_point("store.save", rank=self.rank, step=self.step,
+                     version=self.version)
+        self.store.save(self.version, _STATE_KEY,
+                        np.array([self.samples, self.step, self.w],
+                                 np.float64))
+        self.emit("commit", samples=self.samples, step=self.step)
+        self._committed = {"samples": self.samples, "step": self.step,
+                           "w": self.w, "version": self.version,
+                           "seed": self.seed}
+
+    def _adopt_peer_state(self) -> None:
+        """Joiner bootstrap: fetch the best committed synthetic state
+        from peers' ``/state`` endpoints (the sim analogue of the real
+        tier's collective state resync).  Nothing reachable => fresh
+        start at zero, which is correct for the founding cohort."""
+        _chaos_point("sim.state.fetch", rank=self.rank, step=self.step,
+                     version=self.version)
+        best: Optional[dict] = None
+        probed = 0
+        for p in self.workers:
+            if p.host == self.host and p.port == self.port:
+                continue
+            if probed >= 8:
+                break
+            probed += 1
+            try:
+                raw = _rpc.call(
+                    f"http://{p.host}:{p.port + MONITOR_PORT_OFFSET}"
+                    f"/state", attempt_timeout=0.5)
+                d = json.loads(raw.decode())
+            except (OSError, ValueError):
+                continue  # peer not up yet / dying: fresh start is fine
+            if (isinstance(d, dict) and d.get("seed") == self.seed
+                    and int(d.get("samples", 0)) > 0
+                    and (best is None
+                         or int(d["samples"]) > best["samples"])):
+                best = {"samples": int(d["samples"]),
+                        "step": int(d["step"]), "w": float(d["w"])}
+        if best is not None:
+            self.samples = best["samples"]
+            self.step = best["step"]
+            self.w = best["w"]
+            self.emit("sync", step=self.step, samples=self.samples,
+                      size=len(self.workers), version=self.version,
+                      wsum=self.w)
+
+    # ----------------------------------------------------------- resize
+    def _apply_config(self, version: int, cluster) -> bool:
+        """Adopt a new membership; returns False when this worker was
+        excluded (caller must detach)."""
+        workers = list(cluster.workers)
+        rank = None
+        for i, p in enumerate(workers):
+            if p.host == self.host and p.port == self.port:
+                rank = i
+                break
+        if rank is None:
+            return False
+        self.version = version
+        self.workers = workers
+        self.rank = rank
+        # survivors re-key their committed state under the new
+        # membership version (the real tier re-commits after rebuild)
+        c = self._committed
+        if c is not None:
+            self.store.save(self.version, _STATE_KEY,
+                            np.array([c["samples"], c["step"], c["w"]],
+                                     np.float64))
+            self._committed = dict(c, version=self.version)
+        c = self.committed_state()
+        self.emit("sync", step=c["step"], samples=c["samples"],
+                  size=len(workers), version=version, wsum=c["w"])
+        return True
+
+    def _poll_config(self, force: bool = False) -> bool:
+        """Refresh (version, cluster); returns False on exclusion."""
+        now = time.monotonic()
+        if not force and now - self._last_poll < self.poll_s:
+            return True
+        self._last_poll = now
+        try:
+            version, cluster = fetch_config(self.url, timeout=2.0)
+        except (OSError, ValueError):
+            # config-server outage: keep training on the last-known
+            # membership (the watcher owns escalation)
+            self.monitor.inc("kungfu_tpu_sim_config_misses_total")
+            return True
+        if version != self.version:
+            return self._apply_config(version, cluster)
+        return True
+
+    def _maybe_propose(self) -> None:
+        """Rank 0 drives the scenario's resize schedule through the
+        real CAS path: fetch, rebuild the worker list, PUT If-Match."""
+        if self.rank != 0:
+            return
+        for st, sz in self.propose:
+            if self.step < st or (st, sz) in self._proposed:
+                continue
+            self._proposed.add((st, sz))
+            try:
+                version, cluster = fetch_config(self.url, timeout=2.0)
+                cur = list(cluster.workers)
+                if sz <= len(cur):
+                    new_workers = cur[:sz]
+                else:
+                    # keep joiners in the fleet's own port range (the
+                    # runner picks a base below the kernel's ephemeral
+                    # floor; DEFAULT_WORKER_PORT would not be)
+                    grown = Cluster.from_hostlist(
+                        HostList.parse(f"{self.host}:{sz}"), sz,
+                        base_port=min(p.port for p in cur))
+                    new_workers = cur + [
+                        p for p in grown.workers
+                        if not any(q.host == p.host and q.port == p.port
+                                   for q in cur)][:sz - len(cur)]
+                from ..plan.peer import PeerList
+                new = Cluster(cluster.runners, PeerList(new_workers))
+                put_config(self.url, new, if_version=version)
+            except (OSError, ValueError) as e:
+                # a lost CAS race or an outage: drop the proposal (the
+                # scenario asserts on the config stream, not on us)
+                self.emit("propose_failed", step=self.step,
+                          error=repr(e))
+
+    # ------------------------------------------------------------- loop
+    def _step_time(self) -> float:
+        base = self.step_s * self.slow_factor
+        return base * self._jitter.uniform(0.85, 1.15)
+
+    def _beat(self) -> None:
+        if self.hb is not None:
+            self.hb.beat(rank=self.rank, step=self.step,
+                         version=self.version)
+
+    def run(self) -> int:
+        self.emit("start", rank=self.rank, size=len(self.workers),
+                  version=self.version, step=self.step,
+                  samples=self.samples)
+        self._adopt_peer_state()
+        while self.samples < self.target:
+            if not self._poll_config():
+                return self._detach()
+            _chaos_point("elastic.step.fence", rank=self.rank,
+                         step=self.step + 1, version=self.version)
+            self._beat()
+            t0 = time.monotonic()
+            dt = self._step_time()
+            time.sleep(dt)
+            _chaos_point("elastic.step.compute", rank=self.rank,
+                         step=self.step + 1, version=self.version)
+            self.step += 1
+            self.samples += self.batch
+            self.w += step_increment(self.seed, self.step)
+            wall = time.monotonic() - t0
+            self.monitor.observe("kungfu_tpu_step_seconds", wall)
+            # scripted phase split: a fixed device-less "roofline"
+            for phase, share in (("compute", 0.65), ("allreduce", 0.25),
+                                 ("other", 0.10)):
+                self.monitor.observe("kungfu_tpu_step_phase_seconds",
+                                     wall * share,
+                                     labels={"phase": phase})
+            self.emit("step", rank=self.rank, size=len(self.workers),
+                      version=self.version, step=self.step,
+                      samples=self.samples)
+            if self.step % self.snapshot_every == 0:
+                self._commit()
+            self._maybe_propose()
+        return self._drain()
+
+    # ------------------------------------------------------------ drain
+    def _drain(self) -> int:
+        """Hold the lease at the final step until the whole current
+        membership is at target, then emit the converged ``final``."""
+        if self._committed is None or self._committed["step"] < self.step:
+            self._commit()
+        deadline = time.monotonic() + self.drain_s
+        # a draining fleet is a thundering herd: every worker fires TWO
+        # requests per iteration at one server, so the cadence must
+        # scale with fleet size (and desynchronise) or a 100-worker
+        # drain saturates the starved box and convergence crawls
+        pause = max(self.poll_s, 0.015 * len(self.workers))
+        while time.monotonic() < deadline:
+            self._beat()
+            if not self._poll_config(force=True):
+                return self._detach()
+            try:
+                health = fetch_health(self.url, timeout=2.0)
+            except (OSError, ValueError):
+                time.sleep(pause)
+                continue
+            leases = health.get("leases", {})
+            need = [f"{p.host}:{p.port}" for p in self.workers]
+            done = all(
+                isinstance(leases.get(k), dict)
+                and (leases[k].get("step") or 0) >= self.target_step
+                for k in need)
+            if done:
+                return self._finalize()
+            time.sleep(pause * self._jitter.uniform(0.8, 1.3))
+        self.emit("drain_timeout", step=self.step, samples=self.samples,
+                  version=self.version)
+        return self._finalize()
+
+    def _finalize(self) -> int:
+        # round-trip the committed fingerprint through the real store:
+        # a keying/GC bug there surfaces as a wsum invariant violation
+        version, arr = self.store.get_latest(_STATE_KEY)
+        _chaos_point("store.load", rank=self.rank, step=self.step,
+                     version=version)
+        self.emit("final", rank=self.rank, size=len(self.workers),
+                  version=self.version, step=int(arr[1]),
+                  samples=int(arr[0]), wsum=float(arr[2]))
+        self._shutdown()
+        return 0
+
+    def _detach(self) -> int:
+        self.emit("detached", step=self.step, samples=self.samples)
+        self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        if self.hb is not None:
+            self.hb.stop(join_timeout=1.0)
+        if self.server is not None:
+            self.server.stop()
+
+
+def main() -> int:
+    try:
+        trainer = FakeTrainer(E.from_env())
+    except (OSError, RuntimeError, ValueError, KeyError) as e:
+        # mirror the real worker template: a fake trainer that cannot
+        # even join exits preemption-class so the watcher absorbs it
+        # as a shrink instead of failing the scenario
+        print(f"kfsim: trainer failed to start: {e!r}", file=sys.stderr)
+        return 143
+    try:
+        return trainer.run()
+    except Exception as e:  # fuzz "exception" faults land here
+        trainer.emit("crashed", step=trainer.step,
+                     samples=trainer.samples, error=repr(e))
+        return 143
+
+
+if __name__ == "__main__":
+    sys.exit(main())

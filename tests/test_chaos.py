@@ -442,3 +442,98 @@ def test_scenario_replay_determinism(tmp_path):
     """The same plan file replays to the identical fault sequence."""
     assert runner.replay_check(runner.scenarios()["smoke"],
                                out_root=str(tmp_path))
+
+
+# --------------------------------------------- data-plane probe cache
+class TestProbeCache:
+    """data_plane_supported(): the verdict is a property of the jaxlib
+    build, cached on disk keyed by its version so only the first
+    process on a box pays the two probe subprocesses."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(runner, "_DATA_PLANE", None)
+        monkeypatch.setenv("KFT_TESTS_CACHE_DIR", str(tmp_path))
+        monkeypatch.delenv("KFT_TESTS_DATA_PLANE", raising=False)
+        monkeypatch.delenv("KFT_TESTS_DATA_PLANE_CACHE", raising=False)
+        self.tmp = tmp_path
+        self.calls = []
+        monkeypatch.setattr(
+            runner, "_probe_data_plane",
+            lambda: self.calls.append(1) or True)
+        yield
+
+    def _cache_files(self):
+        return list(self.tmp.glob("kft-data-plane-*.json"))
+
+    def test_probe_writes_cache_then_shortcircuits(self):
+        assert runner.data_plane_supported() is True
+        assert len(self.calls) == 1
+        files = self._cache_files()
+        assert len(files) == 1
+        assert json.loads(files[0].read_text()) == {"supported": True}
+        # a FRESH process (memo cleared) must trust the disk verdict
+        runner._DATA_PLANE = None
+        assert runner.data_plane_supported() is True
+        assert len(self.calls) == 1, "cached verdict re-probed"
+
+    def test_env_override_beats_cache_and_probe(self, monkeypatch):
+        path = runner._probe_cache_path()
+        with open(path, "w") as f:
+            json.dump({"supported": True}, f)
+        monkeypatch.setenv("KFT_TESTS_DATA_PLANE", "0")
+        assert runner.data_plane_supported() is False
+        assert self.calls == []
+
+    def test_corrupt_cache_reprobes_and_heals(self):
+        path = runner._probe_cache_path()
+        with open(path, "w") as f:
+            f.write("not json{")
+        assert runner.data_plane_supported() is True
+        assert len(self.calls) == 1
+        assert json.loads(open(path).read()) == {"supported": True}
+
+    def test_cache_disabled_probes_every_process(self, monkeypatch):
+        monkeypatch.setenv("KFT_TESTS_DATA_PLANE_CACHE", "0")
+        assert runner._probe_cache_path() is None
+        assert runner.data_plane_supported() is True
+        runner._DATA_PLANE = None
+        assert runner.data_plane_supported() is True
+        assert len(self.calls) == 2
+        assert self._cache_files() == []
+
+
+# ------------------------------------- concurrent ephemeral parent ports
+def test_concurrent_runs_get_distinct_ephemeral_parent_ports(tmp_path):
+    """Scenario.parent_port=None means every run binds an OS-assigned
+    port — pinned by TWO runner invocations in flight at once in ONE
+    process (a pytest shard alongside `make sim-smoke` is the real-world
+    shape).  Sim-tier fleets keep it light: no data plane needed."""
+    import threading
+
+    from kungfu_tpu.chaos.runner import Scenario
+    from kungfu_tpu.sim.runner import run_sim_scenario
+
+    def mk(name):
+        return Scenario(
+            name=name, desc="concurrency pin", plan=Plan(seed=None),
+            tier="sim", nprocs=3, target_steps=4, sim_step_s=0.02,
+            timeout_s=120.0)
+
+    results = {}
+
+    def go(name):
+        results[name] = run_sim_scenario(
+            mk(name), out_root=str(tmp_path), verbose=False)
+
+    threads = [threading.Thread(target=go, args=(n,))
+               for n in ("conc-a", "conc-b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert set(results) == {"conc-a", "conc-b"}
+    for res in results.values():
+        assert res.ok, res.violations
+        assert res.parent_port is not None
+    assert results["conc-a"].parent_port != results["conc-b"].parent_port

@@ -264,6 +264,22 @@ def test_silent_except_covers_kfprof(tmp_path):
     assert rules_fired(fs) == {"silent-except"}
 
 
+def test_silent_except_covers_kfsim(tmp_path):
+    """The kfsim fake-trainer plane (kungfu_tpu/sim/) is inside the
+    silent-except scope — it speaks the real control plane, and a fake
+    trainer that eats a config/heartbeat error would green-wash exactly
+    the chaos scenarios built to redden it."""
+    src = """
+        def poll(url):
+            try:
+                fetch_config(url)
+            except Exception:
+                pass
+    """
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/sim/mod.py")
+    assert rules_fired(fs) == {"silent-except"}
+
+
 def test_silent_except_bare_and_negative(tmp_path):
     fs = run_on(tmp_path, """
         def a(url):

@@ -426,6 +426,34 @@ class TestLivenessLeases:
         finally:
             hb.stop()
 
+    def test_stop_bounded_by_wedged_inflight_beat(self):
+        """Regression: stop() must not wait out a beat wedged against a
+        dead-but-accepting server.  The listener here accepts the TCP
+        connection (backlog) but never reads or responds, so the POST
+        blocks in recv; stop() must yank the in-flight socket and join
+        within ~join_timeout, not post_timeout_s + join_timeout."""
+        import socket
+        wedge = socket.socket()
+        wedge.bind(("127.0.0.1", 0))
+        wedge.listen(1)
+        port = wedge.getsockname()[1]
+        hb = HeartbeatSender(f"http://127.0.0.1:{port}/config",
+                             "h1:31100", interval_s=0.05)
+        hb.post_timeout_s = 30.0  # the bug: stop used to wait this out
+        try:
+            hb.beat(rank=0, step=1, version=1)
+            deadline = time.monotonic() + 10
+            while hb._conn is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert hb._conn is not None, "beat never reached the socket"
+            t0 = time.monotonic()
+            hb.stop(join_timeout=0.5)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 2.0, f"stop() took {elapsed:.2f}s"
+            assert not hb._thread.is_alive()
+        finally:
+            wedge.close()
+
     def test_from_env_disabled_cases(self, monkeypatch):
         from kungfu_tpu.launcher import env as E
         monkeypatch.setenv("KFT_HEARTBEAT_S", "0")

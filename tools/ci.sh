@@ -64,6 +64,14 @@ python tools/bench_snapshot.py --smoke || exit 1
 say "0d/3 kfprof report smoke"
 python tools/kfprof_report.py --smoke || exit 1
 
+# kfsim smoke (`make sim-smoke`): a 20-fake-worker rolling preemption
+# wave under the REAL watcher + config server + invariant sweep — the
+# control-plane chaos tier.  Runs the lite (no-jax) worker, so unlike
+# 2c-2e it has NO data-plane gate and must never self-skip: a red here
+# is a red on every image (~10 s; docs/chaos.md "Simulation tier")
+say "0e/3 kfsim control-plane smoke"
+python -m kungfu_tpu.chaos.runner --scenario sim-smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

@@ -293,7 +293,7 @@ class SilentExcept(Rule):
     # utils/rpc.py is control-plane code living under utils (the
     # kfguard rpc client): scoped by file, not by widening all of utils
     path_filter = (r"(^|/)(elastic|launcher|comm|chaos|store|trace"
-                   r"|monitor)(/|$)|(^|/)utils/rpc\.py$")
+                   r"|monitor|sim)(/|$)|(^|/)utils/rpc\.py$")
 
     BROAD = {"Exception", "BaseException"}
 
