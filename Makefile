@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
+.PHONY: check check-fast lint knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -8,6 +8,13 @@
 # see docs/static-analysis.md.
 lint:
 	python -m tools.kfcheck
+	python tools/gen_knob_docs.py --check
+
+# Regenerate docs/knobs.md from the typed registry
+# (kungfu_tpu/utils/knobs.py).  CI fails when the committed file is
+# stale (`tools/gen_knob_docs.py --check`, part of `make lint`).
+knobs-docs:
+	python tools/gen_knob_docs.py
 
 # kfchaos tier-1 scenarios: SIGKILL a rank inside the collective commit,
 # then SIGKILL+restart the WAL-backed config server mid-resize (kfguard;

@@ -14,12 +14,14 @@ from __future__ import annotations
 
 import os as _os
 
+from .utils import knobs as _knobs
+
 # kfsim lite mode: the fake trainers of kungfu_tpu/sim/ run hundreds of
 # control-plane-only processes on one box and must not pay the jax import
 # (~1 s CPU each, serialised on a small machine).  With KFT_SIM_LITE=1
 # only the host-plane surface (plan/, elastic config client, launcher,
 # monitor, store, chaos) is importable; Session/training stay out.
-_SIM_LITE = _os.environ.get("KFT_SIM_LITE") == "1"
+_SIM_LITE = bool(_knobs.get("KFT_SIM_LITE"))
 
 if not _SIM_LITE:
     from .utils.jax_compat import ensure_compat as _ensure_jax_compat

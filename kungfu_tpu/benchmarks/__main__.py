@@ -32,6 +32,7 @@ import sys
 
 import numpy as np
 
+from ..utils import knobs
 from . import Gi, measure, show_rate, show_size
 
 _MODEL_KEYS = {
@@ -173,7 +174,7 @@ def main(argv=None):
             "model": args.model,
             "fuse": args.fuse,
             "strategy": (args.strategy if args.method == "NATIVE"
-                         else os.getenv("KFT_ALLREDUCE_STRATEGY")),
+                         else knobs.raw("KFT_ALLREDUCE_STRATEGY")),
         }
         log_detailed_result(v.mean(), 1.96 * v.std(), attrs)
 

@@ -144,7 +144,8 @@ def main(argv=None):
                          "(e.g. P2P_BENCH.json)")
     args = ap.parse_args(argv)
 
-    if os.environ.get("KFT_SELF_SPEC"):
+    from ..utils import knobs
+    if knobs.raw("KFT_SELF_SPEC"):
         _worker(args)
         return 0
 

@@ -40,6 +40,7 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
+from ..utils import knobs
 from .__main__ import log_detailed_result
 
 
@@ -248,7 +249,7 @@ def _worker_main(args) -> int:
         step()
     dt = (time.perf_counter() - t0) / args.steps
 
-    out = os.environ.get("KFT_SCALING_OUT")
+    out = knobs.raw("KFT_SCALING_OUT")
     if out:
         rank = p.rank if p is not None else 0
         with open(os.path.join(out, f"t.{rank}"), "w") as f:

@@ -143,10 +143,11 @@ def _arm_from_env() -> None:
     the env var AFTER importing kungfu_tpu stays unarmed (deliberate:
     the scenario runner exports the plan for its *worker children*
     without chaos firing in the runner itself)."""
-    path = os.environ.get("KFT_CHAOS_PLAN", "")
+    from ..utils import knobs
+    path = knobs.raw("KFT_CHAOS_PLAN")
     if not path:
         return
-    log = os.environ.get("KFT_CHAOS_LOG", "")
+    log = knobs.raw("KFT_CHAOS_LOG") or ""
     arm(Plan.load(path),
         log_path=f"{log}.{os.getpid()}" if log else None)
 

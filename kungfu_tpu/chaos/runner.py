@@ -37,6 +37,7 @@ import tempfile
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..utils import knobs
 from . import invariants
 from .plan import Fault, Plan
 
@@ -54,12 +55,13 @@ import optax
 
 from kungfu_tpu.elastic.sharded import ShardedElasticTrainer
 from kungfu_tpu.launcher import env as E
+from kungfu_tpu.utils import knobs
 
-out_dir = os.environ["KFT_CHAOS_OUT"]
-B = int(os.environ.get("KFT_CHAOS_B", "8"))
-TARGET = int(os.environ["KFT_CHAOS_TARGET"])
-PROPOSE = json.loads(os.environ.get("KFT_CHAOS_PROPOSE", "[]"))
-SNAP = os.environ.get("KFT_CHAOS_SNAP", "1")
+out_dir = knobs.get("KFT_CHAOS_OUT")
+B = knobs.get("KFT_CHAOS_B")
+TARGET = knobs.get("KFT_CHAOS_TARGET")
+PROPOSE = knobs.get("KFT_CHAOS_PROPOSE")
+SNAP = knobs.get("KFT_CHAOS_SNAP")
 SNAP = "auto" if SNAP == "auto" else int(SNAP)
 we = E.from_env()
 stream = f"{we.self_spec.port}.{os.getpid()}"
@@ -88,9 +90,8 @@ try:
                                {"w": np.zeros((16, 4), np.float32),
                                 "b": np.zeros((4,), np.float32)},
                                snapshot_every=SNAP,
-                               recover_timeout=float(
-                                   os.environ.get("KFT_CHAOS_RECOVER_S",
-                                                  "60")))
+                               recover_timeout=knobs.get(
+                                   "KFT_CHAOS_RECOVER_S"))
 except Exception as e:
     # a joiner whose first collective was torn up by an injected death
     # exits with a preemption-class code: the watcher absorbs it as a
@@ -167,9 +168,9 @@ def data_plane_supported() -> bool:
     ``KFT_TESTS_DATA_PLANE_CACHE=0`` disables the disk cache."""
     global _DATA_PLANE
     if _DATA_PLANE is None:
-        force = os.environ.get("KFT_TESTS_DATA_PLANE", "")
-        if force:
-            _DATA_PLANE = force.lower() not in ("0", "false", "no")
+        force = knobs.get("KFT_TESTS_DATA_PLANE")  # tri-state
+        if force is not None:
+            _DATA_PLANE = force
         else:
             path = _probe_cache_path()
             cached = _read_probe_cache(path) if path else None
@@ -188,14 +189,13 @@ def _probe_cache_path() -> Optional[str]:
     backends).  None disables caching: jaxlib absent, or
     ``KFT_TESTS_DATA_PLANE_CACHE=0``."""
     import importlib.util
-    if os.environ.get("KFT_TESTS_DATA_PLANE_CACHE",
-                      "").lower() in ("0", "false", "no"):
+    if not knobs.get("KFT_TESTS_DATA_PLANE_CACHE"):
         return None
     if importlib.util.find_spec("jaxlib") is None:
         return None
     from jaxlib import version as _jv
     key = getattr(_jv, "__version__", "unknown")
-    root = os.environ.get("KFT_TESTS_CACHE_DIR") or tempfile.gettempdir()
+    root = knobs.raw("KFT_TESTS_CACHE_DIR") or tempfile.gettempdir()
     return os.path.join(root, f"kft-data-plane-{key}.json")
 
 
@@ -576,6 +576,7 @@ class _SubprocessConfigServer:
         import subprocess
         import time
         env = {k: v for k, v in os.environ.items()
+               # prefix filter, not a knob  # kfcheck: disable=knob-registry
                if not k.startswith(("KFT_CHAOS", "KFT_TRACE"))}
         env["JAX_PLATFORMS"] = "cpu"
         self.proc = subprocess.Popen(self._cmd(), env=env,
@@ -734,7 +735,10 @@ class _DoctorSampler(threading.Thread):
                              monitor=Monitor())
         self.path = os.path.join(out_dir, "findings.json")
         self.stop_event = threading.Event()
-        # first to_dict() per Finding.key(): scenario-level evidence
+        # first to_dict() per Finding.key(): scenario-level evidence.
+        # The lock covers the stop() read racing a last diagnose() when
+        # the join below times out.
+        self._seen_lock = threading.Lock()
         self.seen: Dict[Tuple[str, str], dict] = {}
 
     def run(self) -> None:
@@ -743,16 +747,18 @@ class _DoctorSampler(threading.Thread):
             _mcluster.aggregate(self.targets, timeout=1.0,
                                 history=self.doctor.history)
             for f in self.doctor.diagnose(ranks=self.ranks):
-                self.seen.setdefault(f.key(), f.to_dict())
+                with self._seen_lock:
+                    self.seen.setdefault(f.key(), f.to_dict())
             self.stop_event.wait(0.4)
 
     def stop(self) -> None:
         self.stop_event.set()
         self.join(timeout=10)
+        with self._seen_lock:
+            found = sorted(self.seen.values(),
+                           key=lambda d: (d["kind"], str(d["rank"])))
         with open(self.path, "w") as f:
-            json.dump(sorted(self.seen.values(),
-                             key=lambda d: (d["kind"], str(d["rank"]))),
-                      f, indent=2)
+            json.dump(found, f, indent=2)
 
 
 def doctor_violations(doctor_expect: Dict[str, object],

@@ -34,6 +34,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .utils import knobs
+
 _COORD_PORT_OFFSET = 1000
 
 # (version, coordinator, num_processes, process_id) of the live runtime,
@@ -63,7 +65,7 @@ def coordinator_address(peers: Sequence, version: int) -> str:
     20k consecutive versions (the fencing window — beyond it the address
     space wraps).  ``KFT_COORDINATOR`` overrides version 0 only (a static
     address cannot follow elastic membership)."""
-    env = os.environ.get("KFT_COORDINATOR")
+    env = knobs.raw("KFT_COORDINATOR")
     if env and version == 0:
         return env
     host, port = _norm_peers(peers)[0]
@@ -136,10 +138,8 @@ def initialize(peers: Sequence, rank: int, cluster_version: int = 0,
         num_processes=n,
         process_id=rank,
         local_device_ids=local_device_ids,
-        heartbeat_timeout_seconds=int(
-            os.environ.get("KFT_DATA_PLANE_HEARTBEAT_S", "10")),
-        shutdown_timeout_seconds=int(
-            os.environ.get("KFT_DATA_PLANE_SHUTDOWN_S", "5")))
+        heartbeat_timeout_seconds=knobs.get("KFT_DATA_PLANE_HEARTBEAT_S"),
+        shutdown_timeout_seconds=knobs.get("KFT_DATA_PLANE_SHUTDOWN_S"))
     import inspect as _inspect
     supported = _inspect.signature(jax.distributed.initialize).parameters
     # elastic-tuned heartbeat/shutdown timeouts exist only on jax builds
@@ -201,8 +201,8 @@ def shutdown_ordered(grace_s: float = 3.0) -> None:
         except Exception:
             pass
 
-    timeout = (int(os.environ.get("KFT_DATA_PLANE_SHUTDOWN_S", "5"))
-               + int(os.environ.get("KFT_DATA_PLANE_HEARTBEAT_S", "10")))
+    timeout = (knobs.get("KFT_DATA_PLANE_SHUTDOWN_S")
+               + knobs.get("KFT_DATA_PLANE_HEARTBEAT_S"))
     t = threading.Thread(target=_barrier, daemon=True)
     t.start()
     t.join(timeout=timeout)

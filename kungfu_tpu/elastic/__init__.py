@@ -1,11 +1,10 @@
 """Elastic cluster membership (config server, resize protocol, policies)."""
-import os as _os
-
+from ..utils import knobs as _knobs
 from . import state
 from .config_server import ConfigServer, fetch_config, put_config
 from .schedule import Stage, StepSchedule
 
-if _os.environ.get("KFT_SIM_LITE") != "1":
+if not _knobs.get("KFT_SIM_LITE"):
     # The trainer stack imports jax at module top; kfsim fake trainers
     # (KFT_SIM_LITE=1) only need the host-plane surface above.
     from . import snapshot

@@ -175,17 +175,10 @@ class HeartbeatSender:
     def from_env(cls, we) -> Optional["HeartbeatSender"]:
         """Build from the launcher env ABI (None when there is no
         config server, no self spec, or KFT_HEARTBEAT_S=0)."""
-        import os
-        import sys
+        from ..utils import knobs
         if not getattr(we, "config_server", None) or we.self_spec is None:
             return None
-        raw = os.environ.get("KFT_HEARTBEAT_S", "")
-        try:
-            interval = float(raw) if raw else 2.0
-        except ValueError:
-            print(f"kft: ignoring malformed KFT_HEARTBEAT_S={raw!r}; "
-                  f"using 2.0", file=sys.stderr)
-            interval = 2.0
+        interval = knobs.get("KFT_HEARTBEAT_S")
         if interval <= 0:
             return None
         peer = f"{we.self_spec.host}:{we.self_spec.port}"

@@ -39,20 +39,11 @@ from .snapshot import AsyncCommitter
 
 
 def _snapshot_budget(default: float = 0.05) -> float:
-    """KFT_SNAPSHOT_BUDGET as a float, warn-and-fallback on malformed
-    values (the KFT_BASE_PORT idiom, plan/hostspec.py) — a typo in an
-    env var must degrade the cadence derivation, not crash the trainer
-    mid-step."""
-    import os
-    import sys
-    raw = os.environ.get("KFT_SNAPSHOT_BUDGET", "")
-    try:
-        budget = float(raw) if raw else default
-    except ValueError:
-        print(f"kft: ignoring malformed KFT_SNAPSHOT_BUDGET={raw!r}; "
-              f"using {default}", file=sys.stderr)
-        return default
-    return max(budget, 1e-6)
+    """KFT_SNAPSHOT_BUDGET as a float — a typo in an env var must
+    degrade the cadence derivation (registry warn-and-fallback), not
+    crash the trainer mid-step."""
+    from ..utils import knobs
+    return max(knobs.get("KFT_SNAPSHOT_BUDGET", default=default), 1e-6)
 
 
 class DistributedElasticTrainer:

@@ -70,13 +70,8 @@ def chunk_threshold_bytes(default_mb: float = DEFAULT_CHUNK_MB) -> int:
     """``KFT_SNAP_CHUNK_MB`` as bytes, warn-and-fallback on malformed
     values (the KFT_SNAPSHOT_BUDGET idiom): store leaves larger than
     this as chunk views instead of single monolithic blobs."""
-    raw = os.environ.get("KFT_SNAP_CHUNK_MB", "")
-    try:
-        mb = float(raw) if raw else float(default_mb)
-    except ValueError:
-        print(f"kft: ignoring malformed KFT_SNAP_CHUNK_MB={raw!r}; "
-              f"using {default_mb}", file=sys.stderr)
-        mb = float(default_mb)
+    from ..utils import knobs
+    mb = knobs.get("KFT_SNAP_CHUNK_MB", default=float(default_mb))
     return max(1, int(mb * (1 << 20)))
 
 

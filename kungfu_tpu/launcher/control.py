@@ -33,13 +33,14 @@ from typing import Callable, Iterable, Optional
 
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID
+from ..utils import knobs
 
 CONTROL_TOKEN_ENV = "KFT_CONTROL_TOKEN"
 CONTROL_BIND_ENV = "KFT_CONTROL_BIND"
 
 
 def _env_token() -> Optional[str]:
-    return os.environ.get(CONTROL_TOKEN_ENV) or None
+    return knobs.raw(CONTROL_TOKEN_ENV)
 
 
 def _resolve_token(token: Optional[str]) -> Optional[str]:
@@ -53,7 +54,7 @@ def ensure_control_token() -> str:
     this process's env if the operator didn't set it.  Every launch path
     (local watch mode, kft-distribute fan-out) calls this so the token
     derivation lives in exactly one place."""
-    tok = os.environ.get(CONTROL_TOKEN_ENV)
+    tok = knobs.raw(CONTROL_TOKEN_ENV)
     if not tok:
         import secrets
         tok = secrets.token_hex(16)
@@ -97,7 +98,7 @@ class ControlServer:
         # deliberately open (tests, trusted single-host setups)
         self._token = _resolve_token(token)
         if host is None:
-            host = os.environ.get(CONTROL_BIND_ENV, "0.0.0.0")
+            host = knobs.get(CONTROL_BIND_ENV, default="0.0.0.0")
         self._srv = _TCP((host, port), _Handler)
         self._srv.control = self  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]

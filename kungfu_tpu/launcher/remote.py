@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from ..plan.hostspec import HostList
 from ..plan.peer import PeerID
 from ..plan.topology import Strategy
+from ..utils import knobs
 from . import env as E
 from .proc import Proc, run_all
 
@@ -26,7 +27,7 @@ SSH_ENV = "KFT_SSH"
 
 
 def _ssh_argv(host: str, user: str, remote_cmd: str) -> List[str]:
-    ssh = os.environ.get(SSH_ENV, "ssh")
+    ssh = knobs.get(SSH_ENV)
     target = f"{user}@{host}" if user else host
     return shlex.split(ssh) + [target, remote_cmd]
 

@@ -21,6 +21,7 @@ from ..chaos import point as _chaos_point
 from ..plan.cluster import Cluster
 from ..plan.peer import PeerID, PeerList
 from ..elastic.config_server import fetch_config, fetch_health, put_config
+from ..utils import knobs
 from ..utils import rpc as _rpc
 from .job import ChipPool, Job
 from .proc import Proc
@@ -343,7 +344,7 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
     # loopback like every other embedded server (the reference's debug
     # endpoint is likewise an operator-local tool); set KFT_DEBUG_BIND to
     # widen deliberately
-    bind = os.environ.get("KFT_DEBUG_BIND", "127.0.0.1")
+    bind = knobs.get("KFT_DEBUG_BIND")
     srv = BackgroundHTTPServer(factory, host=bind, port=port).start()
     srv.doctor = doctor  # reachable for tests and the watch loop
     return srv
@@ -410,14 +411,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     # finding gauges and traces exist without anyone curling /findings);
     # KFT_PEER_PROBE_S > 0 starts the host-plane peer-latency prober.
     from ..monitor.doctor import Doctor, PeerLatencyProber
-    try:
-        doctor_scrape_s = float(
-            os.environ.get("KFT_DOCTOR_SCRAPE_S", "0") or 0)
-    except ValueError:
-        print(f"kft-run: ignoring malformed KFT_DOCTOR_SCRAPE_S="
-              f"{os.environ.get('KFT_DOCTOR_SCRAPE_S')!r}; doctor "
-              f"scraping disabled", file=_sys.stderr, flush=True)
-        doctor_scrape_s = 0.0
+    doctor_scrape_s = knobs.get("KFT_DOCTOR_SCRAPE_S")
     doctor = Doctor() if (doctor_scrape_s > 0 or debug_port) else None
     doctor_last = -float("inf")
     prober = PeerLatencyProber.from_env(lambda: _doctor_targets(w)[0])
@@ -464,13 +458,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     if lease_ttl_s is not None:  # explicit beats env: a caller running
         lease_ttl = lease_ttl_s  # several watch loops in one process
     else:                        # cannot share one global knob
-        try:
-            lease_ttl = float(os.environ.get("KFT_LEASE_TTL_S", "0") or 0)
-        except ValueError:
-            print(f"kft-run: ignoring malformed KFT_LEASE_TTL_S="
-                  f"{os.environ.get('KFT_LEASE_TTL_S')!r}; leases "
-                  f"observe-only", file=_sys.stderr, flush=True)
-            lease_ttl = 0.0
+        lease_ttl = knobs.get("KFT_LEASE_TTL_S")
     escalated: set = set()   # peers already proposed, per version
     escalated_version = -1
 

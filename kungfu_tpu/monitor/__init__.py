@@ -29,6 +29,7 @@ import time
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..utils import knobs
 from ..utils.http import BackgroundHTTPServer
 
 MONITOR_PORT_OFFSET = 10000  # reference: monitor starts at worker port+10000
@@ -105,6 +106,10 @@ _HELP = {
     "kungfu_tpu_profile_failures_total":
         "kfprof: device-trace captures and cost analyses that failed "
         "or found the profiler busy, per op.",
+    "kungfu_tpu_sim_config_misses_total":
+        "kfsim: fake-trainer polls of the config server that failed "
+        "(sim/trainer.py; models control-plane flakiness seen by a "
+        "worker).",
 }
 
 # satellite guard: a buggy caller labeling by request id would grow the
@@ -275,14 +280,8 @@ class Monitor:
         self._gauges: Dict[tuple, float] = {}
         self._counters: Dict[tuple, float] = {}
         self._lock = threading.Lock()
-        raw = os.environ.get("KFT_METRIC_MAX_LABELSETS", "")
-        try:
-            self._max_labelsets = int(raw) if raw else DEFAULT_MAX_LABELSETS
-        except ValueError:
-            print(f"kft: ignoring malformed KFT_METRIC_MAX_LABELSETS="
-                  f"{raw!r}; using {DEFAULT_MAX_LABELSETS}",
-                  file=sys.stderr)
-            self._max_labelsets = DEFAULT_MAX_LABELSETS
+        self._max_labelsets = knobs.get("KFT_METRIC_MAX_LABELSETS",
+                                        default=DEFAULT_MAX_LABELSETS)
         self._labelsets: Dict[str, int] = {}   # metric -> distinct keys
         self._cap_warned: set = set()
 

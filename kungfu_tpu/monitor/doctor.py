@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..utils import knobs
 from . import MONITOR_PORT_OFFSET, Monitor, get_monitor
 from .history import MetricsHistory
 
@@ -65,18 +66,6 @@ RUNNER_INSTANCE = "runner"
 
 SEV_WARN = "warn"
 SEV_CRITICAL = "critical"
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        print(f"kft-doctor: ignoring malformed {name}={raw!r}; "
-              f"using {default}", file=sys.stderr)
-        return default
 
 
 def _lower_median(values: List[float]) -> float:
@@ -410,15 +399,15 @@ class Doctor:
         self.history = history if history is not None \
             else MetricsHistory(window=window)
         self._mon = monitor
-        self.skew = _env_float("KFT_DOCTOR_SKEW", 1.5)
-        self.min_windows = max(1, int(_env_float("KFT_DOCTOR_WINDOWS", 3)))
-        self.regress = _env_float("KFT_DOCTOR_REGRESS", 2.0)
-        self.lease_age_s = _env_float("KFT_DOCTOR_LEASE_S", 10.0)
-        self.outage_s = _env_float("KFT_DOCTOR_OUTAGE_S", 5.0)
-        self.miss_delta = _env_float("KFT_DOCTOR_MISSES", 3.0)
-        self.stale_s = _env_float("KFT_DOCTOR_STALE_S", 60.0)
-        self.roofline = _env_float("KFT_DOCTOR_ROOFLINE", 0.05)
-        self.roofline_drop = _env_float("KFT_DOCTOR_ROOFLINE_DROP", 2.0)
+        self.skew = knobs.get("KFT_DOCTOR_SKEW")
+        self.min_windows = max(1, knobs.get("KFT_DOCTOR_WINDOWS"))
+        self.regress = knobs.get("KFT_DOCTOR_REGRESS")
+        self.lease_age_s = knobs.get("KFT_DOCTOR_LEASE_S")
+        self.outage_s = knobs.get("KFT_DOCTOR_OUTAGE_S")
+        self.miss_delta = knobs.get("KFT_DOCTOR_MISSES")
+        self.stale_s = knobs.get("KFT_DOCTOR_STALE_S")
+        self.roofline = knobs.get("KFT_DOCTOR_ROOFLINE")
+        self.roofline_drop = knobs.get("KFT_DOCTOR_ROOFLINE_DROP")
         self._active: Dict[Tuple[str, str], Finding] = {}
         self.last: List[Finding] = []
 
@@ -551,7 +540,7 @@ class PeerLatencyProber:
     @classmethod
     def from_env(cls, targets_fn) -> Optional["PeerLatencyProber"]:
         """KFT_PEER_PROBE_S > 0 enables probing at that interval."""
-        interval = _env_float("KFT_PEER_PROBE_S", 0.0)
+        interval = knobs.get("KFT_PEER_PROBE_S")
         if interval <= 0:
             return None
         return cls(targets_fn, interval_s=interval).start()

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import MONITOR_PORT_OFFSET, Monitor, get_monitor
 from .. import trace as _kftrace
+from ..utils import knobs
 
 __all__ = [
     "PHASES", "PHASE_KIND", "StepPhases", "publish_compiled_cost",
@@ -149,7 +150,7 @@ def publish_compiled_cost(fn, *args, monitor: Optional[Monitor] = None,
     Returns ``{"flops": ..., "hbm_bytes": ...}`` or None when this jax
     cannot cost the program (old jaxlib, no cost model) or
     ``KFT_PROF_COST=0`` opted out of the extra AOT compile."""
-    if os.environ.get(ENV_COST, "1") in ("0", "false", "False"):
+    if not knobs.get(ENV_COST):
         return None
     mon = monitor if monitor is not None else get_monitor()
     from ..utils import jax_compat
@@ -200,7 +201,7 @@ def load_ceilings(path: Optional[str] = None) -> Optional[Ceilings]:
     and thereafter stays quiet — when the file is absent or carries no
     matmul/hbm rows: a box that never ran the roofline bench simply has
     no roofline gauges."""
-    path = path or os.environ.get(ENV_ROOFLINE, "") or "ROOFLINE.json"
+    path = path or knobs.raw(ENV_ROOFLINE) or "ROOFLINE.json"
     if path in _ceilings_cache:
         return _ceilings_cache[path]
     ceil: Optional[Ceilings] = None
@@ -297,7 +298,7 @@ def handle_profile_request(path: str,
 
     from ..utils import trace as _utrace
     duration_s = _parse_duration(path)
-    root = os.environ.get(_kftrace.ENV_DIR, "") or tempfile.gettempdir()
+    root = knobs.raw(_kftrace.ENV_DIR) or tempfile.gettempdir()
     with _capture_seq_lock:
         _capture_seq += 1
         seq = _capture_seq

@@ -20,6 +20,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils import knobs
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -48,8 +50,8 @@ _lib_lock = threading.Lock()
 
 
 def lib_path() -> str:
-    return os.environ.get("KFT_NATIVE_LIB",
-                          os.path.join(_NATIVE_DIR, _LIB_NAME))
+    return knobs.raw("KFT_NATIVE_LIB") or os.path.join(_NATIVE_DIR,
+                                                       _LIB_NAME)
 
 
 def build(force: bool = False) -> str:
@@ -174,7 +176,7 @@ class NativePeer:
     def start(self) -> "NativePeer":
         _check(self._lib.kft_peer_start(self._h), "start")
         self._started = True
-        if _env_true("KFT_CONFIG_ENABLE_STALL_DETECTION"):
+        if knobs.get("KFT_CONFIG_ENABLE_STALL_DETECTION"):
             self.set_stall_threshold(30.0)
         return self
 
@@ -697,7 +699,7 @@ def default_peer() -> Optional[NativePeer]:
     # cover partners that poll their resize loop slowly; set
     # KFT_CONFIG_STARTUP_BARRIER=0 to opt out (the next collective then
     # performs the rendezvous instead).
-    if os.environ.get("KFT_CONFIG_STARTUP_BARRIER", "1") != "0":
+    if knobs.get("KFT_CONFIG_STARTUP_BARRIER"):
         last = None
         for _ in range(3):
             try:
@@ -714,10 +716,6 @@ def default_peer() -> Optional[NativePeer]:
     return _default_peer
 
 
-def _env_true(key: str) -> bool:
-    return os.environ.get(key, "") in ("1", "true", "True")
-
-
 def _maybe_start_metrics(p: NativePeer, worker_port: int) -> None:
     """When KFT_CONFIG_ENABLE_MONITORING is set, serve /metrics at worker
     port + 10000 including the native runtime's per-peer egress counters
@@ -725,7 +723,7 @@ def _maybe_start_metrics(p: NativePeer, worker_port: int) -> None:
     endpoint monitor.go:58-104)."""
     from .. import monitor as M
     from ..launcher import env as E
-    if not _env_true(E.ENABLE_MONITORING):
+    if not knobs.get(E.ENABLE_MONITORING):
         return
 
     def native_lines():

@@ -61,11 +61,9 @@ def _mask_skip() -> bool:
     experiments — in a FRESH process: the flag is read at trace time
     and compiled kernels are cached, so flipping it mid-process has no
     effect."""
-    import os
-    env = os.environ.get("KFT_FLASH_MASK_SKIP")
-    if env is not None:
-        return env.strip().lower() not in ("", "0", "false", "off", "no")
-    return True
+    from ..utils import knobs
+    env = knobs.get("KFT_FLASH_MASK_SKIP")
+    return True if env is None else env
 
 
 def _causal_tile_classes(iq, ik, block_q, block_k):
@@ -128,10 +126,8 @@ def _prescale_q() -> bool:
     changed to match.  Default off; ``KFT_FLASH_PRESCALE_Q=1``
     enables — in a FRESH process (trace-time flag, like
     ``KFT_FLASH_MASK_SKIP``)."""
-    import os
-    env = os.environ.get("KFT_FLASH_PRESCALE_Q")
-    return (env is not None
-            and env.strip().lower() not in ("", "0", "false", "off", "no"))
+    from ..utils import knobs
+    return bool(knobs.get("KFT_FLASH_PRESCALE_Q"))
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal, scale, block_q,
@@ -543,10 +539,10 @@ def _big_tile_ok() -> bool:
     every other generation falls back to 1024 until measured (a too-big
     default would turn a working config into a compile failure —
     ADVICE r3).  ``KFT_FLASH_BIG_TILE=1/0`` overrides either way."""
-    import os
-    env = os.environ.get("KFT_FLASH_BIG_TILE")
+    from ..utils import knobs
+    env = knobs.get("KFT_FLASH_BIG_TILE")
     if env is not None:
-        return env.strip().lower() not in ("", "0", "false", "off", "no")
+        return env
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:

@@ -11,9 +11,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable, List
 
+from ..utils import knobs
 from .peer import PeerID, PeerList
-
-import os as _os
 
 
 def _base_port() -> int:
@@ -24,14 +23,8 @@ def _base_port() -> int:
     importing kungfu_tpu (children inherit the env); a cluster's OWN
     base is always derived from its workers (``port - slot``) so
     clusters built under a different base stay self-consistent."""
-    raw = _os.environ.get("KFT_BASE_PORT", "")
-    try:
-        base = int(raw) if raw else 31100
-    except ValueError:
-        import sys
-        print(f"kungfu_tpu: ignoring malformed KFT_BASE_PORT={raw!r}",
-              file=sys.stderr)
-        return 31100
+    raw = knobs.raw("KFT_BASE_PORT")
+    base = knobs.get("KFT_BASE_PORT")
     # the runner port sits at base-100 and the monitor window at
     # base+10000; out-of-range bases would fail much later with an
     # opaque bind error

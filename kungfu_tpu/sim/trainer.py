@@ -52,32 +52,11 @@ from ..monitor import MONITOR_PORT_OFFSET, Monitor
 from ..plan.cluster import Cluster
 from ..plan.hostspec import HostList
 from ..store import VersionedStore
+from ..utils import knobs
 from ..utils import rpc as _rpc
 from ..utils.http import BackgroundHTTPServer
 
 _STATE_KEY = "sim-state"
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        print(f"kfsim: ignoring malformed {name}={raw!r}; "
-              f"using {default}", file=sys.stderr)
-        return default
-
-
-def _env_int_set(name: str) -> set:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return set()
-    try:
-        return {int(x) for x in raw.split(",") if x.strip()}
-    except ValueError:
-        print(f"kfsim: ignoring malformed {name}={raw!r}",
-              file=sys.stderr)
-        return set()
 
 
 def _metrics_handler(trainer: "FakeTrainer"):
@@ -120,22 +99,21 @@ class FakeTrainer:
         self.init_rank = we.rank()
         self.rank = self.init_rank
 
-        self.out_dir = os.environ["KFT_CHAOS_OUT"]
-        self.batch = int(os.environ.get("KFT_CHAOS_B", "8"))
-        self.target = int(os.environ["KFT_CHAOS_TARGET"])
+        self.out_dir = knobs.get("KFT_CHAOS_OUT")
+        self.batch = knobs.get("KFT_CHAOS_B")
+        self.target = knobs.get("KFT_CHAOS_TARGET")
         self.target_step = max(1, self.target // self.batch)
         self.propose: List[Tuple[int, int]] = [
-            tuple(p) for p in
-            json.loads(os.environ.get("KFT_CHAOS_PROPOSE", "[]"))]
-        snap = os.environ.get("KFT_CHAOS_SNAP", "1")
+            tuple(p) for p in knobs.get("KFT_CHAOS_PROPOSE")]
+        snap = knobs.get("KFT_CHAOS_SNAP")
         self.snapshot_every = 1 if snap == "auto" else max(1, int(snap))
 
-        self.seed = int(os.environ.get("KFT_SIM_SEED", "0"))
-        self.step_s = _env_float("KFT_SIM_STEP_S", 0.05)
-        self.poll_s = _env_float("KFT_SIM_POLL_S", 0.25)
-        self.drain_s = _env_float("KFT_SIM_DRAIN_S", 90.0)
-        slow = _env_int_set("KFT_SIM_SLOW_RANKS")
-        self.slow_factor = (_env_float("KFT_SIM_SLOW_FACTOR", 8.0)
+        self.seed = knobs.get("KFT_SIM_SEED")
+        self.step_s = knobs.get("KFT_SIM_STEP_S")
+        self.poll_s = knobs.get("KFT_SIM_POLL_S")
+        self.drain_s = knobs.get("KFT_SIM_DRAIN_S")
+        slow = knobs.get("KFT_SIM_SLOW_RANKS")
+        self.slow_factor = (knobs.get("KFT_SIM_SLOW_FACTOR")
                             if self.init_rank in slow else 1.0)
         # scripted per-worker jitter: deterministic per (seed, port)
         self._jitter = random.Random((self.seed << 17) ^ self.port)

@@ -40,6 +40,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils import knobs
+
 __all__ = [
     "Recorder", "arm", "disarm", "armed", "event", "span", "tail",
     "dump", "recorder",
@@ -56,8 +58,8 @@ def _env_rank() -> Optional[int]:
     """This worker's rank from the launcher env ABI, parsed without
     importing :mod:`kungfu_tpu.launcher` (tracing must stay importable
     from every layer, including the ones launcher.env imports)."""
-    spec = os.environ.get("KFT_SELF_SPEC", "")
-    peers = os.environ.get("KFT_INIT_PEERS", "")
+    spec = knobs.raw("KFT_SELF_SPEC") or ""
+    peers = knobs.raw("KFT_INIT_PEERS") or ""
     if not spec or not peers:
         return None
     try:
@@ -242,14 +244,7 @@ def arm(sink_dir: Optional[str] = None, capacity: Optional[int] = None,
     """Install a recorder for this process and return it."""
     global _rec
     if capacity is None:
-        raw = os.environ.get(ENV_RING, "")
-        try:
-            capacity = int(raw) if raw else DEFAULT_RING
-        except ValueError:
-            import sys
-            print(f"kft: ignoring malformed {ENV_RING}={raw!r}; "
-                  f"using {DEFAULT_RING}", file=sys.stderr)
-            capacity = DEFAULT_RING
+        capacity = knobs.get(ENV_RING)
     _rec = Recorder(sink_dir=sink_dir, capacity=capacity, rank=rank)
     return _rec
 
@@ -286,8 +281,8 @@ def _arm_from_env() -> None:
     """Read KFT_TRACE / KFT_TRACE_DIR exactly once, at import (the
     kfchaos idiom: launcher workers inherit the env; a process setting
     it after import stays disarmed unless it calls :func:`arm`)."""
-    sink = os.environ.get(ENV_DIR, "")
-    on = os.environ.get(ENV_ENABLE, "") in ("1", "true", "True")
+    sink = knobs.raw(ENV_DIR) or ""
+    on = bool(knobs.get(ENV_ENABLE))
     if not sink and not on:
         return
     arm(sink_dir=sink or None)

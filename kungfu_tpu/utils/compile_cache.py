@@ -24,6 +24,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from . import knobs
+
 CACHE_ENV = "KFT_COMPILE_CACHE"
 _DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
                             "kungfu_tpu", "xla")
@@ -72,7 +74,7 @@ def enable_compile_cache(path: Optional[str] = None,
     of respawned workers.  A ``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS``
     env var takes precedence over the default, but an EXPLICIT
     ``min_compile_time_secs`` argument wins over both."""
-    env = os.environ.get(CACHE_ENV, "").strip().lower()
+    env = (knobs.raw(CACHE_ENV) or "").strip().lower()
     if env in ("0", "off", "none", "disable"):
         return None
     import jax
@@ -80,7 +82,7 @@ def enable_compile_cache(path: Optional[str] = None,
     # jax.config) — this helper provides a default, never an override
     existing = (jax.config.jax_compilation_cache_dir
                 or os.environ.get("JAX_COMPILATION_CACHE_DIR"))
-    if path is None and CACHE_ENV not in os.environ and existing:
+    if path is None and not knobs.is_set(CACHE_ENV) and existing:
         return existing
     # Default the cache to accelerator backends only.  XLA:CPU AOT blobs
     # record pseudo machine features (+prefer-no-scatter/gather) that the
@@ -90,7 +92,7 @@ def enable_compile_cache(path: Optional[str] = None,
     # recompile costs seconds and the loader is quiet) the cache stays
     # on by default; on CPU it needs an explicit opt-in via the argument
     # or KFT_COMPILE_CACHE.
-    explicit = path is not None or CACHE_ENV in os.environ
+    explicit = path is not None or knobs.is_set(CACHE_ENV)
     if not explicit and jax.default_backend() == "cpu":
         # one-line notice so CPU deployments that previously benefited
         # from cached recompiles know caching is now opt-in here
@@ -99,7 +101,7 @@ def enable_compile_cache(path: Optional[str] = None,
             "compile cache: off by default on CPU (set KFT_COMPILE_CACHE "
             "or pass path= to opt in)")
         return None
-    base_dir = path or os.environ.get(CACHE_ENV) or _DEFAULT_DIR
+    base_dir = path or knobs.raw(CACHE_ENV) or _DEFAULT_DIR
     cache_dir = os.path.join(base_dir, "host-" + _host_fingerprint())
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_enable_compilation_cache", True)

@@ -1,0 +1,423 @@
+"""Typed registry of every ``KFT_*`` environment knob.
+
+One place that knows each knob's name, type, default, and meaning.
+Callers read knobs through :func:`get` instead of ``os.environ`` so
+
+- a malformed value warns and falls back to the default (the
+  ``KFT_BASE_PORT`` idiom from plan/hostspec.py) instead of crashing a
+  worker mid-resize with a bare ``ValueError``;
+- lookups happen at *call time* against an explicit mapping (default
+  ``os.environ``), so per-job env overrides (``Job.extra_env``,
+  launcher/job.py) and test fixtures see their own values — nothing is
+  latched at import;
+- ``docs/knobs.md`` is generated from this table (``make knobs-docs``)
+  and the kfcheck ``knob-registry`` pass flags any raw
+  ``os.environ["KFT_*"]`` read or unregistered name, so the docs and
+  the code cannot drift apart.
+
+This module is intentionally stdlib-only with no intra-package imports:
+it must be importable before jax (``kungfu_tpu/__init__`` under
+``KFT_SIM_LITE``) and loadable standalone by tools/gen_knob_docs.py.
+
+Types: ``str`` | ``int`` | ``float`` | ``bool`` | ``json`` | ``intset``.
+Bool parsing: ``"" / 0 / false / off / no`` (any case) are false,
+anything else set is true; a ``bool`` knob with default ``None`` is
+tri-state (unset means "caller decides", e.g. the flash-attention
+autotune overrides).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json as _json
+import os
+import sys
+from typing import Dict, List, Mapping, Optional
+
+__all__ = ["Knob", "KNOBS", "get", "raw", "is_set", "generate_docs"]
+
+_FALSEY = ("", "0", "false", "off", "no")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str            # str | int | float | bool | json | intset
+    default: object
+    doc: str
+    group: str
+    required: bool = False   # unset raises KeyError (no sane default)
+    test_only: bool = False  # fixture for the test suite; docs skip it
+    native: bool = False     # read by native/src C++ (env_* helpers)
+
+
+KNOBS: Dict[str, Knob] = {}
+_GROUPS: List[str] = []  # declaration order, for docs
+
+
+def _def(name: str, type: str, default: object, doc: str, *,
+         group: str, required: bool = False, test_only: bool = False,
+         native: bool = False) -> None:
+    if name in KNOBS:
+        raise ValueError(f"duplicate knob {name}")
+    if group not in _GROUPS:
+        _GROUPS.append(group)
+    KNOBS[name] = Knob(name=name, type=type, default=default, doc=doc,
+                       group=group, required=required,
+                       test_only=test_only, native=native)
+
+
+def _parse(knob: Knob, text: str) -> object:
+    if knob.type == "str":
+        return text
+    if knob.type == "bool":
+        return text.strip().lower() not in _FALSEY
+    if knob.type == "int":
+        return int(text)
+    if knob.type == "float":
+        return float(text)
+    if knob.type == "json":
+        return _json.loads(text)
+    if knob.type == "intset":
+        return {int(x) for x in text.split(",") if x.strip()}
+    raise AssertionError(f"unknown knob type {knob.type!r}")
+
+
+_UNSET = object()
+
+
+def raw(name: str, env: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    """The unparsed string value, or None when unset/empty.
+
+    Reads ``env`` (default: ``os.environ``) at call time.
+    """
+    KNOBS[name]  # KeyError on unregistered names: register it first
+    source = os.environ if env is None else env
+    value = source.get(name)
+    return value if value else None
+
+
+def is_set(name: str, env: Optional[Mapping[str, str]] = None) -> bool:
+    """True when the knob is present in the environment (even if empty —
+    some knobs, e.g. KFT_COMPILE_CACHE, treat bare presence as intent)."""
+    KNOBS[name]
+    source = os.environ if env is None else env
+    return name in source
+
+
+def get(name: str, env: Optional[Mapping[str, str]] = None,
+        default: object = _UNSET) -> object:
+    """The knob's typed value from ``env`` (default: ``os.environ``).
+
+    Unset/empty returns the registered default (or ``default=`` when
+    given); a malformed value warns on stderr and falls back the same
+    way. ``required`` knobs raise KeyError when unset — they have no
+    sane default and the caller's contract is "launcher always sets it".
+    """
+    knob = KNOBS[name]
+    text = raw(name, env)
+    fallback = knob.default if default is _UNSET else default
+    if text is None:
+        if knob.required:
+            raise KeyError(f"{name} is required but unset ({knob.doc})")
+        return fallback
+    try:
+        return _parse(knob, text)
+    except (ValueError, TypeError, _json.JSONDecodeError):
+        if knob.required:
+            raise ValueError(f"{name}={text!r} is malformed and the knob "
+                             f"has no default ({knob.doc})")
+        print(f"kft: ignoring malformed {name}={text!r}; "
+              f"using {fallback!r}", file=sys.stderr)
+        return fallback
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Grouped for docs/knobs.md; defaults mirror each call
+# site's historical behaviour exactly.
+# ---------------------------------------------------------------------------
+
+_ABI = "Worker env ABI (set by the launcher)"
+_def("KFT_SELF_SPEC", "str", None,
+     "This worker's `host:port:slot` identity. Unset means singleton "
+     "(non-elastic) mode.", group=_ABI)
+_def("KFT_INIT_PEERS", "str", None,
+     "Comma list of worker `host:port:slot` specs at spawn time; rank = "
+     "index of KFT_SELF_SPEC in this list.", group=_ABI)
+_def("KFT_RUNNER_LIST", "str", None,
+     "Comma list of runner (launcher) endpoints.", group=_ABI)
+_def("KFT_INIT_CLUSTER_VERSION", "int", 0,
+     "Membership version the worker was spawned under (fencing token "
+     "for stale-worker detection).", group=_ABI)
+_def("KFT_ALLREDUCE_STRATEGY", "str", None,
+     "Collective topology strategy (AUTO/RING/TREE/...).", group=_ABI)
+_def("KFT_CONFIG_SERVER", "str", None,
+     "Config-server base URL for elastic membership.", group=_ABI)
+_def("KFT_PARENT_ID", "str", None,
+     "Spawning runner's peer id.", group=_ABI)
+_def("KFT_NUM_LOCAL_DEVICES", "int", None,
+     "Per-worker local device count override.", group=_ABI)
+_def("KFT_VISIBLE_CHIPS", "str", None,
+     "Comma list of local accelerator chip indices assigned by the "
+     "launcher's ChipPool.", group=_ABI)
+_def("KFT_COORDINATOR", "str", None,
+     "jax.distributed coordinator address override (honoured for "
+     "cluster version 0 only).", group=_ABI)
+_def("KFT_CONTROL_TOKEN", "str", None,
+     "Shared secret authenticating control-plane pushes between the "
+     "launcher and workers.", group=_ABI)
+_def("KFT_CONTROL_BIND", "str", None,
+     "Bind address for the runner control server (default all "
+     "interfaces).", group=_ABI)
+
+_CFG = "Runtime config toggles"
+_def("KFT_CONFIG_ENABLE_MONITORING", "bool", False,
+     "Serve Prometheus /metrics from each worker.", group=_CFG)
+_def("KFT_CONFIG_ENABLE_STALL_DETECTION", "bool", False,
+     "Arm the native collective stall detector.", group=_CFG)
+_def("KFT_CONFIG_ENABLE_TRACE", "bool", False,
+     "Gate the lightweight `utils.trace` scopes.", group=_CFG)
+_def("KFT_CONFIG_MONITORING_PERIOD_MS", "int", None,
+     "Native monitoring sample period in ms (passed through to "
+     "workers).", group=_CFG)
+_def("KFT_CONFIG_LOG_LEVEL", "str", None,
+     "Log level passed through to workers.", group=_CFG)
+_def("KFT_CONFIG_STARTUP_BARRIER", "bool", True,
+     "Run a host-plane barrier at peer startup; 0 opts out (the first "
+     "collective then provides the sync).", group=_CFG)
+_def("KFT_SIM_LITE", "bool", False,
+     "Prune jax imports from the package: host-plane-only processes "
+     "(kfsim fake trainers) import in milliseconds.", group=_CFG)
+
+_LAUNCH = "Launcher & control plane"
+_def("KFT_BASE_PORT", "int", 31100,
+     "Base of the default worker-port window (range [1124, 55000]); "
+     "each parallel launch needs a distinct base.", group=_LAUNCH)
+_def("KFT_SSH", "str", "ssh",
+     "ssh binary used to start remote runners (tests swap in a stub).",
+     group=_LAUNCH)
+_def("KFT_DEBUG_BIND", "str", "127.0.0.1",
+     "Bind address for the launcher's local debug/metrics HTTP "
+     "endpoint.", group=_LAUNCH)
+_def("KFT_LEASE_TTL_S", "float", 0.0,
+     "Watcher-side liveness lease expiry age in seconds (0 disables "
+     "lease escalation).", group=_LAUNCH)
+_def("KFT_DOCTOR_SCRAPE_S", "float", 0.0,
+     "Launcher-side doctor scrape interval; > 0 starts the kfdoctor "
+     "sampler.", group=_LAUNCH)
+_def("KFT_PEER_PROBE_S", "float", 0.0,
+     "Host-plane peer latency probe interval; > 0 enables the prober.",
+     group=_LAUNCH)
+
+_NATIVE = "Native transport (read by native/src C++)"
+_def("KFT_RECV_TIMEOUT_S", "float", 120.0,
+     "Blocking-recv timeout on the host data plane.", group=_NATIVE,
+     native=True)
+_def("KFT_CONN_RETRIES", "int", 150,
+     "Connection attempts before a peer dial fails.", group=_NATIVE,
+     native=True)
+_def("KFT_CONN_RETRY_MS", "int", 200,
+     "Delay between connection attempts.", group=_NATIVE, native=True)
+_def("KFT_SHM_MB", "int", 32,
+     "Per-connection same-host shared-memory ring size; 0 disables the "
+     "shm lane.", group=_NATIVE, native=True)
+_def("KFT_BIND_ALL", "bool", False,
+     "Bind the native listener on all interfaces instead of the spec "
+     "host.", group=_NATIVE, native=True)
+_def("KFT_CONFIG_USE_UNIX", "bool", True,
+     "Use unix-domain sockets for same-host peers.", group=_NATIVE,
+     native=True)
+_def("KFT_NATIVE_LIB", "str", None,
+     "Path override for libkft_comm.so (default: the copy built next "
+     "to the package).", group=_NATIVE)
+
+_DATA = "Data plane (jax.distributed)"
+_def("KFT_DATA_PLANE_HEARTBEAT_S", "int", 10,
+     "jax.distributed client heartbeat interval.", group=_DATA)
+_def("KFT_DATA_PLANE_SHUTDOWN_S", "int", 5,
+     "jax.distributed shutdown timeout; teardown waits heartbeat + "
+     "this before abandoning the coordinator.", group=_DATA)
+
+_ELASTIC = "Elastic training, snapshots & rpc"
+_def("KFT_HEARTBEAT_S", "float", 2.0,
+     "Worker liveness-lease renewal interval; 0 disables the sender.",
+     group=_ELASTIC)
+_def("KFT_SNAPSHOT_BUDGET", "float", 0.05,
+     "Async snapshot publish budget as a fraction of step time.",
+     group=_ELASTIC)
+_def("KFT_SNAP_CHUNK_MB", "float", 64.0,
+     "Store leaves larger than this are chunked into zero-copy views.",
+     group=_ELASTIC)
+_def("KFT_COMPILE_CACHE", "str", None,
+     "Compiled-executable cache directory; `0/off/none/disable` "
+     "disables, bare presence opts in on CPU.", group=_ELASTIC)
+_def("KFT_RPC_BREAKER_FAILS", "float", 3.0,
+     "Consecutive transport failures before the rpc circuit breaker "
+     "opens.", group=_ELASTIC)
+_def("KFT_RPC_BREAKER_COOLDOWN_S", "float", 1.0,
+     "Breaker cooldown before a half-open probe is let through.",
+     group=_ELASTIC)
+
+_TRACE = "Tracing, metrics & profiling"
+_def("KFT_TRACE", "bool", False,
+     "Arm the kftrace flight-recorder ring at import.", group=_TRACE)
+_def("KFT_TRACE_DIR", "str", None,
+     "Directory for per-worker JSONL trace streams (implies the ring); "
+     "also the root for profiler captures.", group=_TRACE)
+_def("KFT_TRACE_RING", "int", 4096,
+     "Flight-recorder ring capacity in events.", group=_TRACE)
+_def("KFT_METRIC_MAX_LABELSETS", "int", 256,
+     "Per-metric labelset cardinality cap; new labelsets beyond it are "
+     "dropped with a warning.", group=_TRACE)
+_def("KFT_ROOFLINE", "str", None,
+     "Path to measured roofline ceilings (default ./ROOFLINE.json).",
+     group=_TRACE)
+_def("KFT_PROF_COST", "bool", True,
+     "Run the AOT cost-analysis compile for compiled-cost gauges; 0 "
+     "skips it.", group=_TRACE)
+
+_DOCTOR = "Doctor thresholds (kfdoctor)"
+_def("KFT_DOCTOR_SKEW", "float", 1.5,
+     "Straggler: rank step-p50 over cluster median.", group=_DOCTOR)
+_def("KFT_DOCTOR_WINDOWS", "int", 3,
+     "Consecutive evidence windows required for a finding.",
+     group=_DOCTOR)
+_def("KFT_DOCTOR_REGRESS", "float", 2.0,
+     "Interference: recent p50 over own rolling baseline.",
+     group=_DOCTOR)
+_def("KFT_DOCTOR_LEASE_S", "float", 10.0,
+     "Control plane: lease age alarm threshold.", group=_DOCTOR)
+_def("KFT_DOCTOR_OUTAGE_S", "float", 5.0,
+     "Control plane: rpc outage alarm threshold.", group=_DOCTOR)
+_def("KFT_DOCTOR_MISSES", "float", 3.0,
+     "Control plane: heartbeat-miss growth alarm.", group=_DOCTOR)
+_def("KFT_DOCTOR_STALE_S", "float", 60.0,
+     "Ignore instances not scraped within this window.", group=_DOCTOR)
+_def("KFT_DOCTOR_ROOFLINE", "float", 0.05,
+     "Perf: roofline-fraction floor.", group=_DOCTOR)
+_def("KFT_DOCTOR_ROOFLINE_DROP", "float", 2.0,
+     "Perf: required drop vs own baseline.", group=_DOCTOR)
+
+_OPS = "Kernels (ops)"
+_def("KFT_FLASH_MASK_SKIP", "bool", None,
+     "Flash attention: skip fully-masked KV tiles. Tri-state — unset "
+     "lets the autotune probe decide.", group=_OPS)
+_def("KFT_FLASH_PRESCALE_Q", "bool", False,
+     "Flash attention: pre-scale Q once instead of per-tile.",
+     group=_OPS)
+_def("KFT_FLASH_BIG_TILE", "bool", None,
+     "Flash attention: force the large KV tile on/off. Tri-state — "
+     "unset lets the device probe decide.", group=_OPS)
+
+_CHAOS = "Chaos (kfchaos)"
+_def("KFT_CHAOS_PLAN", "str", None,
+     "Fault-plan JSON path, armed once at import.", group=_CHAOS)
+_def("KFT_CHAOS_LOG", "str", None,
+     "Journal path prefix; fires append to `<prefix>.<pid>`.",
+     group=_CHAOS)
+_def("KFT_CHAOS_OUT", "str", None, required=True,
+     doc="Scenario output directory for the chaos/sim worker "
+     "(progress journal, state dumps). The scenario runner always "
+     "sets it.", group=_CHAOS)
+_def("KFT_CHAOS_B", "int", 8,
+     "Per-step global batch size of the chaos/sim worker.",
+     group=_CHAOS)
+_def("KFT_CHAOS_TARGET", "int", None, required=True,
+     doc="Total sample target the chaos/sim worker trains to. The "
+     "scenario runner always sets it.", group=_CHAOS)
+_def("KFT_CHAOS_PROPOSE", "json", [],
+     "JSON list of `[step, new_size]` resize proposals the worker "
+     "submits.", group=_CHAOS)
+_def("KFT_CHAOS_SNAP", "str", "1",
+     "Snapshot cadence in steps, or `auto` for the budget-tuned "
+     "cadence.", group=_CHAOS)
+_def("KFT_CHAOS_RECOVER_S", "float", 60.0,
+     "Recovery deadline the chaos worker allows a torn collective "
+     "before giving up.", group=_CHAOS)
+
+_SIM = "Simulation (kfsim)"
+_def("KFT_SIM_SEED", "int", 0,
+     "Deterministic per-fleet jitter seed.", group=_SIM)
+_def("KFT_SIM_STEP_S", "float", 0.05,
+     "Synthetic step duration.", group=_SIM)
+_def("KFT_SIM_POLL_S", "float", 0.25,
+     "Config-server poll interval of the fake trainer.", group=_SIM)
+_def("KFT_SIM_DRAIN_S", "float", 90.0,
+     "Drain deadline the fake trainer allows a pending resize.",
+     group=_SIM)
+_def("KFT_SIM_SLOW_RANKS", "intset", frozenset(),
+     "Comma list of ranks scripted as stragglers.", group=_SIM)
+_def("KFT_SIM_SLOW_FACTOR", "float", 8.0,
+     "Step-time multiplier applied to the scripted stragglers.",
+     group=_SIM)
+
+_BENCH = "Benchmarks"
+_def("KFT_SCALING_OUT", "str", None,
+     "Output directory for the scaling benchmark's per-size runs.",
+     group=_BENCH)
+
+_TESTS = "Test fixtures"
+_def("KFT_TESTS_DATA_PLANE", "bool", None, test_only=True,
+     doc="Force the data-plane capability probe on/off (tri-state; "
+     "unset probes).", group=_TESTS)
+_def("KFT_TESTS_DATA_PLANE_CACHE", "bool", True, test_only=True,
+     doc="Cache the data-plane probe result on disk.", group=_TESTS)
+_def("KFT_TESTS_CACHE_DIR", "str", None, test_only=True,
+     doc="Directory for the probe cache (default tmpdir).",
+     group=_TESTS)
+_def("KFT_PERF_ENFORCE", "bool", False, test_only=True,
+     doc="Make perf-sensitive tests fail (instead of skip) on timing "
+     "regressions.", group=_TESTS)
+_def("KFT_SLOW_TESTS", "bool", False, test_only=True,
+     doc="Run the `slow` pytest tier.", group=_TESTS)
+
+
+def generate_docs() -> str:
+    """Render docs/knobs.md from the registry (see tools/gen_knob_docs.py).
+
+    Deterministic: groups in declaration order, knobs sorted by name
+    within each group; ``test_only`` knobs are skipped.
+    """
+    lines = [
+        "# Environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. Regenerate with"
+        " `make knobs-docs`; the table lives in"
+        " kungfu_tpu/utils/knobs.py. -->",
+        "",
+        "Every `KFT_*` knob routes through the typed registry in",
+        "[`kungfu_tpu/utils/knobs.py`](../kungfu_tpu/utils/knobs.py):"
+        " malformed values",
+        "warn on stderr and fall back to the default; lookups are"
+        " call-time, so",
+        "per-job overrides (`Job.extra_env`) behave. The kfcheck"
+        " `knob-registry`",
+        "pass keeps this file honest (docs/static-analysis.md).",
+        "",
+    ]
+    for group in _GROUPS:
+        rows = [k for k in sorted(KNOBS.values(), key=lambda k: k.name)
+                if k.group == group and not k.test_only]
+        if not rows:
+            continue
+        lines += [f"## {group}", "",
+                  "| Knob | Type | Default | Meaning |",
+                  "|---|---|---|---|"]
+        for k in rows:
+            if k.required:
+                default = "*(required)*"
+            elif k.default is None:
+                default = "unset"
+            elif isinstance(k.default, frozenset):
+                default = "empty"
+            else:
+                default = f"`{k.default}`"
+            doc = k.doc
+            if k.native:
+                doc += " *(read by the native C++ transport.)*"
+            lines.append(f"| `{k.name}` | {k.type} | {default} | {doc} |")
+        lines.append("")
+    hidden = sorted(k.name for k in KNOBS.values() if k.test_only)
+    lines += [f"*{len(hidden)} test-only fixtures "
+              f"({', '.join(f'`{n}`' for n in hidden)}) are registered "
+              "but not operator-facing; see the registry source.*", ""]
+    return "\n".join(lines)

@@ -48,6 +48,8 @@ import threading
 import time
 import urllib.error
 import urllib.request
+
+from . import knobs
 from typing import Callable, Dict, Optional, Tuple
 
 __all__ = [
@@ -78,20 +80,6 @@ class RPCCircuitOpen(OSError):
 class RPCStaleRead(OSError):
     """A config response regressed the version counter within one server
     epoch — a reborn/stale server must not be trusted as current."""
-
-
-def _env_float(name: str, default: float) -> float:
-    import os
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        import sys
-        print(f"kft: ignoring malformed {name}={raw!r}; using {default}",
-              file=sys.stderr)
-        return default
 
 
 def _netloc(url: str) -> str:
@@ -134,9 +122,9 @@ class CircuitBreaker:
     def __init__(self, threshold: Optional[int] = None,
                  cooldown: Optional[float] = None):
         self.threshold = int(threshold if threshold is not None
-                             else _env_float("KFT_RPC_BREAKER_FAILS", 3))
+                             else knobs.get("KFT_RPC_BREAKER_FAILS"))
         self.cooldown = (cooldown if cooldown is not None
-                         else _env_float("KFT_RPC_BREAKER_COOLDOWN_S", 1.0))
+                         else knobs.get("KFT_RPC_BREAKER_COOLDOWN_S"))
         self._fails = 0
         self._open_until = 0.0
         self._probing = False

@@ -29,6 +29,7 @@ import time
 from typing import Deque, Dict, List, Optional, Tuple
 
 from .. import trace as _kftrace
+from . import knobs
 
 ENABLE_ENV = "KFT_CONFIG_ENABLE_TRACE"
 
@@ -44,7 +45,7 @@ _events: Deque[Tuple[float, str]] = collections.deque(maxlen=EVENTS_LIMIT)
 
 
 def enabled() -> bool:
-    return os.environ.get(ENABLE_ENV, "") in ("1", "true", "True")
+    return bool(knobs.get(ENABLE_ENV))
 
 
 @contextlib.contextmanager

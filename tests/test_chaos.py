@@ -537,3 +537,35 @@ def test_concurrent_runs_get_distinct_ephemeral_parent_ports(tmp_path):
         assert res.ok, res.violations
         assert res.parent_port is not None
     assert results["conc-a"].parent_port != results["conc-b"].parent_port
+
+
+# --------------------------------------------------- site catalogue pin
+def test_catalogue_sites_arm_and_fire():
+    """Every sites.py entry not already exercised by the scenario matrix
+    must be armable and actually fire (kfcheck's chaos-coverage pass
+    requires each site to appear in >= 1 plan — the explicit literals
+    below are that reference, and the arm->point->inject round trip
+    keeps the pin honest rather than a vacuous loop over SITES)."""
+    plan = (Plan()
+            .add("elastic.commit.record", "exception")
+            .add("elastic.resize.begin", "exception")
+            .add("elastic.pre_teardown.begin", "exception")
+            .add("elastic.teardown.begin", "exception")
+            .add("elastic.rebuild.begin", "exception")
+            .add("elastic.rebuild.before_commit", "exception")
+            .add("elastic.sync_state.begin", "exception")
+            .add("config.wal.append", "exception")
+            .add("config.restart", "exception")
+            .add("rpc.attempt", "exception")
+            .add("sim.state.fetch", "exception")
+            .add("launcher.watch.update", "exception")
+            .add("launcher.watch.spawn", "exception")
+            .add("launcher.watch.kill", "exception"))
+    assert len({f.site for f in plan.faults}) == len(plan.faults)
+    chaos.arm(plan)
+    for fault in plan.faults:
+        with pytest.raises(ChaosInjected):
+            chaos.point(fault.site)
+    assert len(chaos.fired()) == len(plan.faults)
+    # each fault's fire budget (count=1) is now spent: no re-raise
+    chaos.point(plan.faults[0].site)

@@ -478,3 +478,492 @@ def test_shipped_baseline_entries_all_justified():
         (REPO / "tools" / "kfcheck" / "baseline.json").read_text())
     for e in data["entries"]:
         assert e["why"].strip() and "TODO" not in e["why"], e
+
+
+# ================================================== whole-program passes
+from tools.kfcheck.engine import Module  # noqa: E402
+from tools.kfcheck.facts import (FactCache, analyze,  # noqa: E402
+                                 collect_facts, scan_native)
+from tools.kfcheck.wprogram import (ALL_PASSES, edit_distance,  # noqa: E402
+                                    run_passes)
+
+PASS_NAMES = {p.name for p in ALL_PASSES}
+
+
+def run_program(tmp_path, files):
+    """Write a synthetic tree and run only the whole-program passes."""
+    for rel, src in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(textwrap.dedent(src))
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    facts.update(scan_native(tmp_path))
+    return run_passes(facts)
+
+
+MINI_REGISTRY = """
+    def _def(name, type, default, doc="", **kw):
+        pass
+    _def("KFT_GOOD_KNOB", "int", 1, "a registered knob")
+"""
+
+
+# --------------------------------------------------------- lock-discipline
+def test_lock_discipline_positive(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._run)
+                self._results = {}
+
+            def _run(self):
+                self._results["k"] = 1
+
+            def snapshot(self):
+                return dict(self._results)
+    """})
+    assert rules_fired(fs) == {"lock-discipline"}
+    assert "_results" in fs[0].message and fs[0].symbol == "Worker.snapshot"
+
+
+def test_lock_discipline_negative_locked_both_sides(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=self._run)
+                self._results = {}
+
+            def _run(self):
+                with self._lock:
+                    self._results["k"] = 1
+
+            def snapshot(self):
+                with self._lock:
+                    return dict(self._results)
+    """})
+    assert fs == []
+
+
+def test_lock_discipline_exemptions(tmp_path):
+    # thread-safe containers (Queue), __init__ accesses, the _locked
+    # method-name convention, and flag writes of constants do not fire
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._q = queue.Queue()
+                self._thread = threading.Thread(target=self._run)
+                self._done = False
+                self._err = None
+
+            def _run(self):
+                self._q.put(1)
+                self._done = True
+                with self._cv:
+                    self._err = compute()
+
+            def _peek_locked(self):
+                return self._err
+
+            def drain(self):
+                if self._done:
+                    return self._q.get()
+                with self._cv:
+                    return self._peek_locked()
+    """})
+    assert fs == []
+
+
+def test_lock_discipline_thread_subclass_run(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class Sampler(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self.seen = {}
+
+            def run(self):
+                self.seen.setdefault("a", 1)
+
+            def report(self):
+                return list(self.seen.values())
+    """})
+    assert rules_fired(fs) == {"lock-discipline"}
+
+
+# ----------------------------------------------------------- knob-registry
+def test_knob_registry_flags_raw_read_and_unregistered(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "kungfu_tpu/mod.py": """
+            import os
+            A = os.environ.get("KFT_GOOD_KNOB")
+            B = os.environ["KFT_MYSTERY_KNOB"]
+        """})
+    assert rules_fired(fs) == {"knob-registry"}
+    msgs = "\n".join(f.message for f in fs)
+    # registered-but-raw read AND unregistered name both fire
+    assert "raw environment read of `KFT_GOOD_KNOB`" in msgs
+    assert "raw environment read of `KFT_MYSTERY_KNOB`" in msgs
+    assert "`KFT_MYSTERY_KNOB` is not registered" in msgs
+
+
+def test_knob_registry_resolves_module_constants(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "kungfu_tpu/mod.py": """
+            import os
+            ENV = "KFT_GOOD_KNOB"
+            value = os.getenv(ENV)
+        """})
+    assert any("raw environment read of `KFT_GOOD_KNOB`" in f.message
+               for f in fs)
+
+
+def test_knob_registry_negative_and_tests_exemption(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "kungfu_tpu/mod.py": """
+            from .utils import knobs
+            value = knobs.get("KFT_GOOD_KNOB")
+        """,
+        # tests may read env directly — only unregistered names flag
+        "tests/test_mod.py": """
+            import os
+            os.environ.get("KFT_GOOD_KNOB")
+        """})
+    assert fs == []
+
+
+def test_knob_registry_covers_native_reads(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "native/src/peer.cc": """\
+            static double t = env_double("KFT_NATIVE_ONLY_KNOB", 1.0);
+        """})
+    assert rules_fired(fs) == {"knob-registry"}
+    assert "native=True" in fs[0].message
+
+
+def test_deleting_a_registry_entry_fails_ci(tmp_path):
+    """Acceptance gate: drop one migrated knob's _def from the REAL
+    registry and the real call site turns into a finding (CI step 0
+    runs this checker, so this is the red build)."""
+    reg = (REPO / "kungfu_tpu" / "utils" / "knobs.py").read_text()
+    assert '"KFT_HEARTBEAT_S"' in reg, "fixture went stale"
+    # renaming the registered string IS deleting the KFT_HEARTBEAT_S
+    # entry, without having to excise a multi-line _def() call
+    files = {
+        "kungfu_tpu/utils/knobs.py": reg.replace(
+            '"KFT_HEARTBEAT_S"', '"KFT_HEARTBEAT_ZZ"'),
+        "kungfu_tpu/elastic/heartbeat.py":
+            (REPO / "kungfu_tpu" / "elastic" / "heartbeat.py").read_text(),
+    }
+    for rel, src in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(src)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    fs = run_passes(facts)
+    assert any(f.rule == "knob-registry" and "KFT_HEARTBEAT_S" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+# ----------------------------------------------------- metrics-consistency
+METRICS_OK = {
+    "kungfu_tpu/monitor/__init__.py": """
+        _HELP = {
+            "kungfu_tpu_step_seconds": "Step wall time.",
+        }
+
+        class Monitor:
+            def observe(self, metric, value):
+                pass
+
+        def publish(m):
+            m.observe("kungfu_tpu_step_seconds", 1.0)
+    """,
+    "kungfu_tpu/monitor/doctor.py": """
+        def diagnose(history, inst):
+            return history.series(inst, "kungfu_tpu_step_seconds")
+    """,
+}
+
+
+def test_metrics_consistency_negative(tmp_path):
+    assert run_program(tmp_path, METRICS_OK) == []
+
+
+def test_metrics_consumed_but_never_published(tmp_path):
+    files = dict(METRICS_OK)
+    files["kungfu_tpu/monitor/doctor.py"] = """
+        def diagnose(history, inst):
+            return history.series(inst, "kungfu_tpu_phantom_seconds")
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"metrics-consistency"}
+    assert "kungfu_tpu_phantom_seconds" in fs[0].message
+    assert "never" in fs[0].message or "publishes it" in fs[0].message
+
+
+def test_metrics_published_without_help(tmp_path):
+    files = dict(METRICS_OK)
+    files["kungfu_tpu/serving.py"] = """
+        def emit(m):
+            m.set_gauge("kungfu_tpu_undocumented_gauge", 2.0)
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"metrics-consistency"}
+    assert "without HELP" in fs[0].message
+
+
+def test_metrics_near_miss_spelling(tmp_path):
+    files = dict(METRICS_OK)
+    # established name appears twice (publish + HELP); the typo once,
+    # in a non-consumer file so only the near-miss check can catch it
+    files["kungfu_tpu/extra.py"] = """
+        NAME = "kungfu_tpu_step_second"
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"metrics-consistency"}
+    assert "probable misspelling" in fs[0].message
+
+
+def test_metrics_summary_suffixes_normalize(tmp_path):
+    files = dict(METRICS_OK)
+    files["kungfu_tpu/monitor/cluster.py"] = """
+        import re
+        PAT = re.compile(r"^kungfu_tpu_step_seconds_sum")
+    """
+    assert run_program(tmp_path, files) == []
+
+
+def test_misspelled_doctor_metric_fails_ci(tmp_path):
+    """Acceptance gate: misspell one doctor-consumed metric name in the
+    REAL sources and CI step 0 goes red."""
+    mon = (REPO / "kungfu_tpu" / "monitor" / "__init__.py").read_text()
+    doc = (REPO / "kungfu_tpu" / "monitor" / "doctor.py").read_text()
+    assert '"kungfu_tpu_step_seconds"' in doc, "fixture went stale"
+    doc = doc.replace('"kungfu_tpu_step_seconds"',
+                      '"kungfu_tpu_step_secondz"', 1)
+    files = {"kungfu_tpu/monitor/__init__.py": mon,
+             "kungfu_tpu/monitor/doctor.py": doc}
+    for rel, src in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(src)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    fs = run_passes(facts)
+    assert any(f.rule == "metrics-consistency"
+               and "kungfu_tpu_step_secondz" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+# ----------------------------------------------------------- chaos-coverage
+CHAOS_OK = {
+    "kungfu_tpu/chaos/sites.py": """
+        SITES = {
+            "layer.op.phase": "where and what",
+        }
+    """,
+    "kungfu_tpu/elastic/core.py": """
+        from . import chaos
+
+        def step():
+            chaos.point("layer.op.phase", rank=0)
+    """,
+    "tests/test_sites.py": """
+        def test_fault():
+            plan = Plan().add("layer.op.phase", "exception")
+    """,
+}
+
+
+def test_chaos_coverage_negative(tmp_path):
+    assert run_program(tmp_path, CHAOS_OK) == []
+
+
+def test_chaos_point_not_registered(tmp_path):
+    files = dict(CHAOS_OK)
+    files["kungfu_tpu/elastic/core.py"] = """
+        from . import chaos
+
+        def step():
+            chaos.point("layer.op.phase", rank=0)
+            chaos.point("rogue.site.name")
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"chaos-coverage"}
+    assert "rogue.site.name" in fs[0].message
+    assert "not registered" in fs[0].message
+
+
+def test_chaos_dead_catalogue_entry_and_untested_site(tmp_path):
+    files = dict(CHAOS_OK)
+    files["kungfu_tpu/chaos/sites.py"] = """
+        SITES = {
+            "layer.op.phase": "covered",
+            "layer.op.dead": "registered but never fired",
+            "layer.op.untested": "fired but never referenced",
+        }
+    """
+    files["kungfu_tpu/elastic/core.py"] = """
+        from . import chaos
+
+        def step():
+            chaos.point("layer.op.phase", rank=0)
+            chaos.point("layer.op.untested")
+    """
+    fs = run_program(tmp_path, files)
+    msgs = "\n".join(f.message for f in fs)
+    assert "`layer.op.dead` is registered but no chaos.point" in msgs
+    assert "`layer.op.untested` has a live chaos.point but no" in msgs
+
+
+def test_chaos_plan_ref_to_unknown_site(tmp_path):
+    files = dict(CHAOS_OK)
+    files["tests/test_sites.py"] = """
+        def test_fault():
+            plan = Plan().add("layer.op.phase", "exception")
+            bad = Plan().add("layer.op.typo", "kill")
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"chaos-coverage"}
+    assert "unknown site `layer.op.typo`" in fs[0].message
+
+
+# ------------------------------------------------- suppression / baseline
+def test_program_pass_suppression_comment(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "kungfu_tpu/mod.py": """
+            import os
+            # kfcheck: disable=knob-registry
+            A = os.environ.get("KFT_GOOD_KNOB")
+        """})
+    assert fs == []
+
+
+def test_program_findings_use_baseline_machinery(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/utils/knobs.py": MINI_REGISTRY,
+        "kungfu_tpu/mod.py": """
+            import os
+            A = os.environ.get("KFT_GOOD_KNOB")
+        """})
+    assert len(fs) == 1
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(Baseline.render(fs, {fs[0].key(): "migration WIP"}))
+    new, old, stale = Baseline.load(bl_path).split(fs)
+    assert (len(new), len(old), len(stale)) == (0, 1, 0)
+
+
+# ----------------------------------------------------------- facts cache
+def test_fact_cache_hit_and_invalidation(tmp_path):
+    fp = tmp_path / "m.py"
+    fp.write_text("import os\nA = os.environ.get('KFT_X_KNOB')\n")
+    cache_path = tmp_path / ".cache.json"
+    cache = FactCache(cache_path)
+    mod = Module("m.py", fp.read_text())
+    facts = collect_facts(mod)
+    cache.put("m.py", fp.stat(), facts)
+    cache.save()
+    # hit: same mtime/size round-trips through JSON
+    reloaded = FactCache(cache_path)
+    assert reloaded.get("m.py", fp.stat()) == json.loads(
+        json.dumps(facts))
+    # miss: content change invalidates
+    fp.write_text("import os\nA = os.environ.get('KFT_Y_KNOB')  # xx\n")
+    assert reloaded.get("m.py", fp.stat()) is None
+
+
+def test_analyze_uses_cache_for_context_files(tmp_path):
+    ctx = tmp_path / "tools" / "helper.py"
+    ctx.parent.mkdir(parents=True)
+    ctx.write_text("X = 'KFT_CACHED_KNOB'\n")
+    cache_path = tmp_path / ".cache.json"
+    kw = dict(use_cache=True, cache_path=cache_path)
+    _, facts1, _ = analyze([], [tmp_path / "tools"], [], tmp_path, **kw)
+    # poison the cached entry; an (unchanged) second run must serve it
+    data = json.loads(cache_path.read_text())
+    entry = data["files"]["tools/helper.py"]
+    entry["facts"]["knob_literals"][0]["name"] = "KFT_FROM_CACHE"
+    cache_path.write_text(json.dumps(data))
+    _, facts2, _ = analyze([], [tmp_path / "tools"], [], tmp_path, **kw)
+    assert facts2["tools/helper.py"]["knob_literals"][0]["name"] == \
+        "KFT_FROM_CACHE"
+
+
+def test_edit_distance():
+    assert edit_distance("abc", "abc", 2) == 0
+    assert edit_distance("abc", "abd", 2) == 1
+    assert edit_distance("abc", "bd", 2) == 2
+    assert edit_distance("abcdef", "uvwxyz", 2) > 2
+
+
+# ------------------------------------------------------ clean-tree pins
+def _repo_program_findings():
+    _, facts, errors = analyze(
+        [Path("kungfu_tpu")], [Path("tools"), Path("tests")], [],
+        REPO, use_cache=False)
+    assert not errors, errors
+    facts.update(scan_native(REPO))
+    return run_passes(facts)
+
+
+@pytest.fixture(scope="module")
+def repo_program_findings():
+    return _repo_program_findings()
+
+
+@pytest.mark.parametrize("pass_name", sorted(PASS_NAMES))
+def test_shipped_tree_clean_per_pass(repo_program_findings, pass_name):
+    """Per-pass pin: on today's tree every pass is clean modulo the
+    justified baseline."""
+    from tools.kfcheck.__main__ import DEFAULT_BASELINE
+    bl = Baseline.load(DEFAULT_BASELINE)
+    mine = [f for f in repo_program_findings if f.rule == pass_name]
+    new, _, _ = bl.split(mine)
+    assert new == [], [f.render() for f in new]
+
+
+def test_cli_json_output():
+    r = _cli(["--json"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert set(payload) == {"findings", "stale", "errors"}
+    for f in payload["findings"]:
+        assert f["baselined"] is True  # clean tree: only baselined ones
+
+
+def test_cli_list_rules_covers_passes():
+    r = _cli(["--list-rules"])
+    for name in PASS_NAMES:
+        assert name in r.stdout
+    assert "whole-program pass" in r.stdout
+
+
+def test_cli_program_mode_on_synthetic_tree(tmp_path):
+    (tmp_path / "kungfu_tpu").mkdir(parents=True)
+    (tmp_path / "kungfu_tpu" / "mod.py").write_text(
+        'import os\nA = os.environ.get("KFT_ORPHAN_KNOB")\n')
+    r = _cli(["--program", "--root", str(tmp_path), "--no-baseline",
+              "--no-cache", str(tmp_path)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "knob-registry" in r.stdout

@@ -48,6 +48,8 @@ def test_rrun_gives_each_worker_an_identity(fake_ssh, tmp_path):
             "from kungfu_tpu.launcher import env as E; "
             "we = E.from_env(); "
             "print('IDENT', we.rank(), we.size(), we.cluster_version)")
+    # test-local handoff to the child program above, not a library knob
+    # kfcheck: disable=knob-registry
     os.environ["KFT_REPO"] = REPO
     try:
         rc = main(["-np", "2", "-H", "127.0.0.1:2", "-logdir", str(logdir),

@@ -42,6 +42,10 @@ say() { printf '\n==== %s ====\n' "$*"; }
 
 say "0/3 kfcheck static analysis"
 python -m tools.kfcheck || exit 1
+# docs/knobs.md is generated from the typed registry
+# (kungfu_tpu/utils/knobs.py); a stale commit means someone edited one
+# without the other — `make knobs-docs` regenerates
+python tools/gen_knob_docs.py --check || exit 1
 
 # metrics/trace/doctor smoke (`make doctor-smoke`): a real /metrics
 # endpoint scraped over HTTP, the kftrace merger over a 2-worker

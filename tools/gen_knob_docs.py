@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Generate docs/knobs.md from the typed knob registry.
+
+The registry (kungfu_tpu/utils/knobs.py) is the single source of truth
+for every ``KFT_*`` env knob; this renders its table to markdown so the
+operator docs cannot drift from the code.  CI runs ``--check`` (ci.sh
+step 0) and fails when the committed file is stale.
+
+Usage:
+    python tools/gen_knob_docs.py            # rewrite docs/knobs.md
+    python tools/gen_knob_docs.py --check    # exit 1 when stale
+    python tools/gen_knob_docs.py --stdout   # print to stdout
+
+The registry module is loaded standalone (importlib from its file path)
+so this tool needs neither jax nor the kungfu_tpu package import.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+REGISTRY = REPO / "kungfu_tpu" / "utils" / "knobs.py"
+TARGET = REPO / "docs" / "knobs.md"
+
+
+def load_registry():
+    spec = importlib.util.spec_from_file_location("_kft_knobs", REGISTRY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_kft_knobs"] = mod  # dataclasses looks itself up here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="exit 1 when docs/knobs.md is stale")
+    mode.add_argument("--stdout", action="store_true",
+                      help="print the generated markdown")
+    args = ap.parse_args(argv)
+
+    text = load_registry().generate_docs()
+    if args.stdout:
+        sys.stdout.write(text)
+        return 0
+    if args.check:
+        current = TARGET.read_text() if TARGET.exists() else ""
+        if current != text:
+            print(f"{TARGET.relative_to(REPO)} is stale — run "
+                  "`make knobs-docs` and commit the result",
+                  file=sys.stderr)
+            return 1
+        print(f"{TARGET.relative_to(REPO)} is up to date "
+              f"({len(load_registry().KNOBS)} knobs)")
+        return 0
+    TARGET.write_text(text)
+    print(f"wrote {TARGET.relative_to(REPO)} "
+          f"({len(load_registry().KNOBS)} knobs)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,8 +1,16 @@
 """kfcheck CLI.
 
-    python -m tools.kfcheck                    # check kungfu_tpu/ vs baseline
-    python -m tools.kfcheck path/to/file.py    # check specific paths
+    python -m tools.kfcheck                    # full program analysis:
+                                               # per-file rules on
+                                               # kungfu_tpu/ + the four
+                                               # whole-program passes
+                                               # over kungfu_tpu, tools,
+                                               # tests and native/src
+    python -m tools.kfcheck path/to/file.py    # per-file rules only
+    python -m tools.kfcheck --program DIR      # rules + passes treating
+                                               # DIR as the whole program
     python -m tools.kfcheck --write-baseline   # regenerate the baseline
+    python -m tools.kfcheck --json             # machine-readable output
     python -m tools.kfcheck --list-rules
 
 Exit codes: 0 clean (or fully baselined), 1 findings, 2 internal/usage.
@@ -10,12 +18,15 @@ Exit codes: 0 clean (or fully baselined), 1 findings, 2 internal/usage.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 from pathlib import Path
 
 from .engine import Baseline, check_paths
+from .facts import analyze, scan_native
 from .rules import ALL_RULES
+from .wprogram import ALL_PASSES, run_passes
 
 REPO = Path(__file__).resolve().parent.parent.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -24,7 +35,9 @@ DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kfcheck")
     p.add_argument("paths", nargs="*", default=None,
-                   help="files/dirs to check (default: kungfu_tpu/)")
+                   help="files/dirs to check (default: the whole repo — "
+                        "rules on kungfu_tpu/, program passes over "
+                        "kungfu_tpu/ + tools/ + tests/ + native/src)")
     p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                    help="baseline JSON (grandfathered findings)")
     p.add_argument("--no-baseline", action="store_true",
@@ -32,6 +45,18 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from current findings, "
                         "keeping existing justifications")
+    p.add_argument("--program", action="store_true",
+                   help="run the whole-program passes too, treating the "
+                        "given paths as the entire program (default "
+                        "no-paths mode implies this over the repo)")
+    p.add_argument("--root", default=str(REPO),
+                   help="repo root paths are made relative to (program "
+                        "mode on synthetic trees)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON on stdout")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the per-file fact cache "
+                        "(tools/kfcheck/.cache.json)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the OK summary line")
@@ -41,10 +66,29 @@ def main(argv=None) -> int:
         for r in ALL_RULES:
             scope = f"  [scope: {r.path_filter}]" if r.path_filter else ""
             print(f"{r.name}: {r.doc}{scope}")
+        for ps in ALL_PASSES:
+            print(f"{ps.name}: {ps.doc}  [whole-program pass]")
         return 0
 
-    paths = [Path(x) for x in (args.paths or ["kungfu_tpu"])]
-    findings, errors = check_paths(paths, ALL_RULES, REPO)
+    root = Path(args.root).resolve()
+    if args.paths:
+        primary = [Path(x) for x in args.paths]
+        context = []
+        run_program = args.program
+    else:
+        primary = [Path("kungfu_tpu")]
+        context = [Path("tools"), Path("tests")]
+        run_program = True
+
+    if run_program:
+        findings, facts, errors = analyze(
+            primary, context, ALL_RULES, root,
+            use_cache=not args.no_cache)
+        facts.update(scan_native(root))
+        findings = findings + run_passes(facts)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    else:
+        findings, errors = check_paths(primary, ALL_RULES, root)
     for e in errors:
         print(f"kfcheck: ERROR {e}", file=sys.stderr)
 
@@ -66,6 +110,18 @@ def main(argv=None) -> int:
             return 2
         new, old_findings, stale = bl.split(findings)
 
+    if args.as_json:
+        payload = {
+            "findings": [dict(dataclasses.asdict(f), baselined=False)
+                         for f in new]
+            + [dict(dataclasses.asdict(f), baselined=True)
+               for f in old_findings],
+            "stale": stale,
+            "errors": errors,
+        }
+        print(json.dumps(payload, indent=2))
+        return 1 if new else (2 if errors else 0)
+
     for f in new:
         print(f.render())
     for e in stale:
@@ -75,9 +131,9 @@ def main(argv=None) -> int:
     if new:
         print(f"\nkfcheck: {len(new)} finding(s) "
               f"({len(old_findings)} baselined, "
-              f"{len(ALL_RULES)} rules). Fix, add a `# kfcheck: "
-              f"disable=<rule>` with a reason, or baseline with a "
-              f"justification in {args.baseline}.")
+              f"{len(ALL_RULES)} rules + {len(ALL_PASSES)} passes). "
+              f"Fix, add a `# kfcheck: disable=<rule>` with a reason, "
+              f"or baseline with a justification in {args.baseline}.")
         return 1
     if errors:
         return 2

@@ -1,0 +1,328 @@
+"""kfcheck phase 2: whole-program passes over the joined fact model.
+
+Each pass sees :class:`ProgramModel` — every file's facts keyed by
+repo-relative path (see :mod:`tools.kfcheck.facts`) — and yields
+ordinary :class:`~tools.kfcheck.engine.Finding` objects, so the
+existing suppression (``# kfcheck: disable=<pass>``) and baseline
+machinery applies unchanged.  Rule-name = pass-name for all of a
+pass's findings; the message distinguishes the sub-check.
+
+The four passes (docs/static-analysis.md has examples + failure modes):
+
+  lock-discipline      attribute mutated on a thread body but touched
+                       elsewhere without the object's lock
+  knob-registry        every KFT_* env var must live in the typed
+                       registry and be read through it
+  metrics-consistency  consumed metric names must be published,
+                       published names must carry HELP text, and
+                       one-off near-miss spellings are flagged
+  chaos-coverage       chaos.point sites <-> sites.py catalogue <->
+                       scenario/plan/test references must close
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, Iterator, List, Tuple
+
+from .engine import Finding
+from .facts import lockish
+
+
+class ProgramModel:
+    """facts_by_path plus the finding/suppression plumbing passes need."""
+
+    def __init__(self, files: Dict[str, dict]):
+        self.files = files
+
+    def finding(self, rule: str, path: str, rec: dict,
+                message: str) -> Finding:
+        return Finding(rule=rule, path=path, line=rec["line"],
+                       symbol=rec["symbol"], message=message,
+                       snippet=rec["snippet"])
+
+    def is_suppressed(self, path: str, rule: str, line: int) -> bool:
+        rules = self.files.get(path, {}).get("suppressed", {}) \
+            .get(str(line), ())
+        return rule in rules or "all" in rules
+
+
+class ProgramPass:
+    name: str = ""
+    doc: str = ""
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------- lock-discipline
+class LockDiscipline(ProgramPass):
+    name = "lock-discipline"
+    doc = ("attribute mutated inside a threading.Thread body (target= "
+           "method or Thread-subclass run) and also accessed elsewhere "
+           "in the class outside any `with self._lock:` — a data race "
+           "the GIL does not excuse for compound mutations")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        for path, facts in sorted(pm.files.items()):
+            for cls in facts.get("classes", ()):
+                bodies = set(cls["thread_targets"])
+                if cls["is_thread_subclass"]:
+                    bodies.add("run")
+                if not bodies:
+                    continue
+                exempt = set(cls["exempt_attrs"])
+                accesses = cls["accesses"]
+                mutated = sorted({
+                    a["attr"] for a in accesses
+                    if a["method"] in bodies and a["kind"] == "mut"
+                    and a["attr"] not in exempt
+                    and not lockish(a["attr"])})
+                for attr in mutated:
+                    # a `_locked` method-name suffix is the repo's
+                    # caller-holds-the-lock convention
+                    unguarded = [
+                        a for a in accesses
+                        if a["attr"] == attr and not a["locked"]
+                        and a["method"] not in bodies
+                        and a["method"] != "__init__"
+                        and not a["method"].endswith("_locked")]
+                    if not unguarded:
+                        continue
+                    a = unguarded[0]
+                    body = sorted(bodies & {
+                        x["method"] for x in accesses
+                        if x["attr"] == attr and x["kind"] == "mut"})
+                    yield pm.finding(
+                        self.name, path, a,
+                        f"`self.{attr}` is mutated on `{cls['name']}`'s "
+                        f"thread body (`{'`/`'.join(body)}`) but "
+                        f"accessed here in `{a['method']}` without "
+                        f"holding a lock — guard both sides with the "
+                        f"object's lock or make the handoff a "
+                        f"queue/Event")
+
+
+# ----------------------------------------------------------- knob-registry
+class KnobRegistry(ProgramPass):
+    name = "knob-registry"
+    doc = ("every KFT_* env var must have a typed entry in "
+           "kungfu_tpu/utils/knobs.py (docs/knobs.md is generated from "
+           "it) and, outside tests, be read through knobs.get/raw/"
+           "is_set — never through raw os.environ")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        registry: set = set()
+        reg_paths: set = set()
+        for path, f in pm.files.items():
+            if f.get("knob_defs"):
+                registry.update(f["knob_defs"])
+                reg_paths.add(path)
+        for path, f in sorted(pm.files.items()):
+            if path in reg_paths:
+                continue  # the registry itself reads os.environ
+            in_tests = path.startswith("tests/") or "/tests/" in path
+            if not in_tests and not path.startswith("native/"):
+                for r in f.get("env_reads", ()):
+                    nm = r.get("name") or ""
+                    if nm.startswith("KFT_"):
+                        yield pm.finding(
+                            self.name, path, r,
+                            f"raw environment read of `{nm}` — route "
+                            f"it through the typed registry "
+                            f"(kungfu_tpu.utils.knobs.get/raw/is_set) "
+                            f"so type, default and docs stay in one "
+                            f"place")
+            seen: set = set()
+            for r in f.get("knob_literals", ()):
+                nm = r["name"]
+                # names ending "_" are prefixes (env passthrough
+                # filters), not knobs
+                if nm.endswith("_") or nm in registry or nm in seen:
+                    continue
+                seen.add(nm)
+                hint = "_def(..., native=True)" \
+                    if path.startswith("native/") else "_def(...)"
+                yield pm.finding(
+                    self.name, path, r,
+                    f"`{nm}` is not registered in "
+                    f"kungfu_tpu/utils/knobs.py — add a {hint} entry "
+                    f"(docs/knobs.md regenerates via `make knobs-docs`)")
+
+
+# ----------------------------------------------------- metrics-consistency
+def edit_distance(a: str, b: str, cap: int) -> int:
+    """Levenshtein with an early-out once every path exceeds ``cap``."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, start=1):
+        cur = [i]
+        best = i
+        for j, cb in enumerate(b, start=1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1,
+                           prev[j - 1] + (ca != cb)))
+            best = min(best, cur[-1])
+        if best > cap:
+            return cap + 1
+        prev = cur
+    return prev[-1]
+
+
+class MetricsConsistency(ProgramPass):
+    name = "metrics-consistency"
+    doc = ("every kungfu_tpu_* metric name the doctor/history/cluster/"
+           "report tools consume must be published somewhere, every "
+           "published name must carry HELP text, and a name that "
+           "occurs once within edit distance 2 of an established name "
+           "is a probable misspelling")
+
+    # files whose business is reading other components' metrics: any
+    # metric literal there counts as consumed even outside a series()
+    # call (regex parsing, threshold tables, smoke asserts)
+    CONSUMERS = re.compile(
+        r"^kungfu_tpu/monitor/(doctor|history|cluster)\.py$"
+        r"|^tools/(kfprof_report|metrics_trace_smoke)\.py$")
+    SUFFIXES = ("_sum", "_count", "_bucket")
+
+    def _norm(self, name: str) -> str:
+        for s in self.SUFFIXES:
+            if name.endswith(s):
+                return name[:-len(s)]
+        return name
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        published: set = set()
+        helped: set = set()
+        counts: Counter = Counter()
+        first_site: Dict[str, Tuple[str, dict]] = {}
+        pub_site: Dict[str, Tuple[str, dict]] = {}
+        consumes: List[Tuple[str, dict, str]] = []
+        for path, f in sorted(pm.files.items()):
+            is_consumer = bool(self.CONSUMERS.match(path))
+            for r in f.get("metric_names", ()):
+                nm, ctx = r["name"], r["context"]
+                counts[nm] += 1
+                first_site.setdefault(nm, (path, r))
+                if ctx in ("publish", "help"):
+                    # a # HELP line only exists on an exposition the
+                    # component actually serves, so help => published
+                    published.add(nm)
+                    pub_site.setdefault(nm, (path, r))
+                if ctx == "help":
+                    helped.add(nm)
+                if ctx == "consume" or (is_consumer and ctx == "other"):
+                    consumes.append((path, r, nm))
+
+        pub_norm = {self._norm(n) for n in published}
+        seen: set = set()
+        for path, r, nm in consumes:
+            if self._norm(nm) in pub_norm or (path, nm) in seen:
+                continue
+            seen.add((path, nm))
+            yield pm.finding(
+                self.name, path, r,
+                f"metric `{nm}` is consumed here but no component "
+                f"publishes it — the detector/report reads zeros "
+                f"forever; fix the name or publish the family")
+
+        helped_norm = {self._norm(n) for n in helped}
+        for nm in sorted(published):
+            if self._norm(nm) in helped_norm:
+                continue
+            path, r = pub_site[nm]
+            yield pm.finding(
+                self.name, path, r,
+                f"metric `{nm}` is published without HELP/TYPE text — "
+                f"add it to _HELP in kungfu_tpu/monitor/__init__.py "
+                f"(real Prometheus scrapers need # TYPE to ingest)")
+
+        names = sorted(counts)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                if self._norm(a) == self._norm(b):
+                    continue
+                rare, common = (a, b) if counts[a] <= counts[b] else (b, a)
+                if counts[rare] != 1 or counts[common] < 2:
+                    continue
+                d = edit_distance(rare, common, 2)
+                if d > 2:
+                    continue
+                path, r = first_site[rare]
+                yield pm.finding(
+                    self.name, path, r,
+                    f"`{rare}` occurs once and is edit-distance {d} "
+                    f"from `{common}` ({counts[common]} uses) — "
+                    f"probable misspelling")
+
+
+# ----------------------------------------------------------- chaos-coverage
+class ChaosCoverage(ProgramPass):
+    name = "chaos-coverage"
+    doc = ("chaos.point call sites, the sites.py catalogue, and "
+           "scenario/plan/test references must close over each other: "
+           "no unregistered points, no dead catalogue entries, no "
+           "untested sites, no plans naming unknown sites")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        sites: Dict[str, Tuple[str, dict]] = {}
+        points: Dict[str, List[Tuple[str, dict]]] = {}
+        refs: Counter = Counter()
+        all_refs: List[Tuple[str, dict, str]] = []
+        for path, f in sorted(pm.files.items()):
+            for r in f.get("chaos_site_defs", ()):
+                sites.setdefault(r["name"], (path, r))
+            for r in f.get("chaos_points", ()):
+                points.setdefault(r["name"], []).append((path, r))
+            for r in f.get("chaos_site_refs", ()):
+                refs[r["name"]] += 1
+                all_refs.append((path, r, r["name"]))
+        if not sites:
+            return  # tree has no chaos catalogue: nothing to close over
+        for nm in sorted(points):
+            if nm not in sites:
+                path, r = points[nm][0]
+                yield pm.finding(
+                    self.name, path, r,
+                    f"chaos.point site `{nm}` is not registered in "
+                    f"chaos/sites.py — arm-time validation will reject "
+                    f"every plan that targets it")
+        for nm in sorted(sites):
+            path, r = sites[nm]
+            if nm not in points:
+                yield pm.finding(
+                    self.name, path, r,
+                    f"site `{nm}` is registered but no chaos.point(...) "
+                    f"in the tree fires it — dead catalogue entry "
+                    f"(remove it or thread the point through)")
+            elif refs[nm] == 0:
+                yield pm.finding(
+                    self.name, path, r,
+                    f"site `{nm}` has a live chaos.point but no "
+                    f"scenario, plan or test references it — the "
+                    f"injection site is untested")
+        seen: set = set()
+        for path, r, nm in all_refs:
+            if nm in sites or (path, nm) in seen:
+                continue
+            seen.add((path, nm))
+            yield pm.finding(
+                self.name, path, r,
+                f"fault plan references unknown site `{nm}` — the "
+                f"fault can never fire; register the site or fix the "
+                f"name")
+
+
+ALL_PASSES = [LockDiscipline(), KnobRegistry(), MetricsConsistency(),
+              ChaosCoverage()]
+
+
+def run_passes(facts_by_path: Dict[str, dict],
+               passes=None) -> List[Finding]:
+    pm = ProgramModel(facts_by_path)
+    out: List[Finding] = []
+    for p in (passes if passes is not None else ALL_PASSES):
+        for f in p.check(pm):
+            if not pm.is_suppressed(f.path, f.rule, f.line):
+                out.append(f)
+    return out

@@ -194,8 +194,9 @@ def smoke() -> int:
         json.dump({"results": [
             {"op": "matmul_smoke_bf16", "tflops": 0.5},
             {"op": "hbm_copy_smoke", "gib_per_s": 10.0}]}, f)
-    old_roof = os.environ.get(prof.ENV_ROOFLINE)
-    old_trace = os.environ.get("KFT_TRACE_DIR")
+    from kungfu_tpu.utils import knobs
+    old_roof = knobs.raw(prof.ENV_ROOFLINE)
+    old_trace = knobs.raw("KFT_TRACE_DIR")
     os.environ[prof.ENV_ROOFLINE] = roof_path
     os.environ["KFT_TRACE_DIR"] = td
     try:
