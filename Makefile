@@ -1,13 +1,22 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
-# control-plane excepts, ...).  Fails on any non-baselined finding;
+# control-plane excepts, plus the whole-program passes incl. the
+# phase-3 dataflow family: use-after-donate, sharding-mismatch,
+# host-roundtrip-traced).  Fails on any non-baselined finding;
 # see docs/static-analysis.md.
 lint:
 	python -m tools.kfcheck
+	python tools/gen_knob_docs.py --check
+
+# Same checker, scoped: per-file rules on git-changed files only; the
+# whole-program passes still cover the full tree from the fact cache
+# (sub-second once warm).
+lint-fast:
+	python -m tools.kfcheck --fast
 	python tools/gen_knob_docs.py --check
 
 # Regenerate docs/knobs.md from the typed registry

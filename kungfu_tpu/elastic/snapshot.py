@@ -83,9 +83,13 @@ def _leaf_nbytes(leaf) -> int:
 class PendingSnapshot:
     """A dispatched-but-not-joined device->host snapshot.
 
-    Holds references to the device arrays (they must stay alive until
-    the join — jax arrays are immutable and the trainers never donate
-    their state buffers, so the values cannot change under us).
+    Holds references to the device arrays, so they must stay alive —
+    and *valid* — until the join.  Since the elastic trainers donate
+    their step inputs (``donate=True``), a pending snapshot over step
+    state must be joined before the donated buffers are re-entered
+    into a step: snapshot the *returned* tree, use the synchronous
+    ``snapshot()``, or ``AsyncCommitter.drain()`` first.  The kfcheck
+    ``use-after-donate`` pass enforces this ordering repo-wide.
     ``join()`` materialises the host tree; ``join_s`` / ``nbytes`` then
     describe the transfer for metrics.
     """

@@ -119,8 +119,13 @@ class ElasticTrainer:
         if n not in self._step_cache:
             build = (build_train_step_with_state if self.has_model_state
                      else build_train_step)
+            # donation is safe here by construction: step() rebinds every
+            # donated root in the call statement itself, and resize/snapshot
+            # read self.params only via the synchronous kfsnap path.  The
+            # kfcheck use-after-donate pass gates this — any new post-call
+            # read of a donated buffer turns CI step 0 red.
             self._step_cache[n] = build(self.loss_fn, self.optimizer,
-                                        self.mesh, donate=False)
+                                        self.mesh, donate=True)
         self._step = self._step_cache[n]
         self.n = n
 

@@ -155,7 +155,11 @@ def publish_compiled_cost(fn, *args, monitor: Optional[Monitor] = None,
     mon = monitor if monitor is not None else get_monitor()
     from ..utils import jax_compat
     try:
-        compiled = fn.lower(*args, **kwargs).compile()
+        # lower a non-donating twin when the step donates: the aliased
+        # program under-counts bytes accessed, and the throwaway AOT
+        # compile would warn about donated buffers it never runs
+        compiled = jax_compat.lower_for_cost_analysis(
+            fn, *args, **kwargs).compile()
     except Exception as e:
         # a step that RUNS but cannot be AOT-costed (donated buffers,
         # exotic shardings, ...) must not lose the training loop

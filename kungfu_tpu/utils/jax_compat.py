@@ -57,6 +57,39 @@ def config_flag_supported(flag: str) -> bool:
     return flag in jax.config.values
 
 
+def lower_for_cost_analysis(fn, *args, **kwargs):
+    """AOT-lower ``fn(*args, **kwargs)`` for cost analysis, stripping
+    buffer donation (publish_compiled_cost, monitor/profiler.py).
+
+    A donating step compiles to a program whose donated inputs alias
+    its outputs, so ``cost_analysis()`` under-counts "bytes accessed" —
+    and the throwaway AOT compile emits donation warnings (or, on some
+    jaxlib builds, refuses) for buffers that are never actually
+    executed.  When the lowering declares donated arguments (probed
+    through ``Lowered.args_info``, present since 0.4.x; absent means
+    not donating), re-jit the wrapped function with donation off and
+    lower that twin instead.  Falls back to the original lowering when
+    the twin cannot be built (no ``__wrapped__``, e.g. a fake in
+    tests), so the gauges never regress for non-donating callers."""
+    import jax
+    lowered = fn.lower(*args, **kwargs)
+    try:
+        infos = jax.tree_util.tree_leaves(
+            lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+        donating = any(getattr(i, "donated", False) for i in infos)
+    except Exception:
+        donating = False
+    if not donating:
+        return lowered
+    inner = getattr(fn, "__wrapped__", None)
+    if inner is None:
+        return lowered
+    try:
+        return jax.jit(inner).lower(*args, **kwargs)
+    except Exception:
+        return lowered
+
+
 def compiled_cost_analysis(compiled) -> "dict | None":
     """XLA cost analysis of an AOT-compiled step, normalized across jax
     versions (the kfprof flops/HBM gauges, monitor/profiler.py).

@@ -111,6 +111,38 @@ def test_cost_analysis_real_jit():
         assert float(cost.get("flops", 0.0)) > 0
 
 
+def test_cost_analysis_survives_donation():
+    """A donated step (elastic/trainer.py ships donate=True) must still
+    yield cost gauges: lower_for_cost_analysis strips donation by
+    lowering a non-donated twin, and the twin's lowering declares no
+    donated arguments."""
+    import jax.numpy as jnp
+    from kungfu_tpu.utils.jax_compat import (compiled_cost_analysis,
+                                             lower_for_cost_analysis)
+    fn = jax.jit(lambda x, y: (x @ y, x + y), donate_argnums=(0, 1))
+    x = jnp.ones((16, 16), jnp.float32)
+    lowered = lower_for_cost_analysis(fn, x, x)
+    infos = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda a: hasattr(a, "donated"))
+    assert not any(getattr(i, "donated", False) for i in infos)
+    cost = compiled_cost_analysis(lowered.compile())
+    if cost is not None:
+        assert float(cost.get("flops", 0.0)) > 0
+
+
+def test_lower_for_cost_analysis_fake_fallback():
+    """Objects without args_info/__wrapped__ (the test fakes, old jax)
+    must route through fn.lower unchanged."""
+    from kungfu_tpu.utils.jax_compat import lower_for_cost_analysis
+
+    class Fake:
+        def lower(self, *a, **k):
+            return self
+
+    f = Fake()
+    assert lower_for_cost_analysis(f) is f
+
+
 def test_cost_gauges_absent_when_shim_says_none(monkeypatch):
     """publish_compiled_cost on a costless build: no gauges, no crash
     (the old-jaxlib acceptance path)."""

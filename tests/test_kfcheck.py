@@ -138,7 +138,10 @@ def test_host_sync_positive(tmp_path):
                 jax.device_get(loss)
     """)
     assert rules_fired(fs) == {"host-sync-in-hot-path"}
-    assert len(fs) == 2  # float(loss) + device_get
+    # device_get only: implicit float()/int() syncs moved to the
+    # host-roundtrip-traced dataflow pass, which proves them from the
+    # jit binding instead of guessing from the variable name
+    assert len(fs) == 1
 
 
 def test_host_sync_block_until_ready(tmp_path):
@@ -872,6 +875,341 @@ def test_program_findings_use_baseline_machinery(tmp_path):
     bl_path.write_text(Baseline.render(fs, {fs[0].key(): "migration WIP"}))
     new, old, stale = Baseline.load(bl_path).split(fs)
     assert (len(new), len(old), len(stale)) == (0, 1, 0)
+
+
+# ------------------------------------------------- dataflow: use-after-donate
+DONATING_TRAINER = """
+    import jax
+
+    class Trainer:
+        def __init__(self, body):
+            self._step = jax.jit(body, donate_argnums=(0, 1))
+
+        def train(self, params, opt, batches):
+            for b in batches:
+                new_p, new_opt, loss = self._step(params, opt, b)
+                print(params)
+                params, opt = new_p, new_opt
+            return params
+"""
+
+
+def test_use_after_donate_positive(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/t.py": DONATING_TRAINER})
+    assert rules_fired(fs) == {"use-after-donate"}
+    assert len(fs) == 1
+    assert "`params`" in fs[0].message and "donated position 0" \
+        in fs[0].message
+    assert fs[0].snippet.strip() == "print(params)"
+
+
+def test_use_after_donate_negative_rebound_in_statement(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/t.py": """
+        import jax
+
+        class Trainer:
+            def __init__(self, body):
+                self._step = jax.jit(body, donate_argnums=(0, 1))
+
+            def train(self, params, opt, batches):
+                for b in batches:
+                    params, opt, loss = self._step(params, opt, b)
+                    print(loss)
+                return params
+    """})
+    assert fs == []
+
+
+def test_use_after_donate_suppression(tmp_path):
+    src = DONATING_TRAINER.replace(
+        "print(params)",
+        "print(params)  # kfcheck: disable=use-after-donate")
+    fs = run_program(tmp_path, {"kungfu_tpu/t.py": src})
+    assert fs == []
+
+
+def test_use_after_donate_never_rebound_attr(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/t.py": """
+        import jax
+
+        class Trainer:
+            def __init__(self, body):
+                self._step = jax.jit(body, donate_argnums=(0,))
+
+            def step(self, batch):
+                loss = self._step(self.params, batch)
+                return loss
+    """})
+    assert rules_fired(fs) == {"use-after-donate"}
+    assert "never rebound" in fs[0].message
+
+
+def test_use_after_donate_outside_kungfu_tpu_exempt(tmp_path):
+    # tests/benches may re-read donated inputs to assert CPU semantics
+    fs = run_program(tmp_path, {"tools/bench_x.py": DONATING_TRAINER})
+    assert fs == []
+
+
+def test_use_after_donate_gated_factory_closure(tmp_path):
+    """The repo idiom end to end: a module-level factory whose closure
+    calls a conditionally-donated jit, consumed cross-file through a
+    self-attr binding; the donate=True call site makes a post-call read
+    a finding, the donate=False twin stays quiet."""
+    factory = """
+        import jax
+
+        def build_step(loss_fn, opt, mesh, donate=False):
+            def body(p, s, b):
+                return p, s, b
+            jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+            jitted = jax.jit(body, **jit_kwargs)
+
+            def step(p, s, b):
+                p2, s2, out = jitted(p, s, b)
+                return p2, s2, out
+            return step
+    """
+    trainer = """
+        from .train import build_step
+
+        class Trainer:
+            def _install(self, n):
+                self._step = build_step(self.loss, self.opt, self.mesh,
+                                        donate={flag})
+
+            def step(self, p, s, batch):
+                p2, s2, loss = self._step(p, s, batch)
+                return p2, s2, p
+    """
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/train.py": factory,
+        "kungfu_tpu/tr.py": trainer.format(flag="True")})
+    assert "use-after-donate" in rules_fired(fs)
+    assert any("via factory `build_step`" in f.message for f in fs)
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/train.py": factory,
+        "kungfu_tpu/tr.py": trainer.format(flag="False")})
+    assert fs == []
+
+
+def test_use_after_donate_kfsnap_async_dispatch(tmp_path):
+    """The temporal hazard: an async snapshot holds device refs while a
+    later donated step invalidates them; drain() before the step clears
+    it."""
+    src = """
+        import jax
+
+        class MP:
+            def __init__(self, body, committer):
+                self._step = jax.jit(body, donate_argnums=(0, 1))
+                self._committer = committer
+
+            def _commit(self, publish):
+                self._committer.initiate((self._params, self._opt),
+                                         publish)
+
+            def step(self, batch):
+                {drain}self._params, self._opt, loss = self._step(
+                    self._params, self._opt, batch)
+                return loss
+    """
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/mp.py": src.format(drain="")})
+    assert rules_fired(fs) == {"use-after-donate"}
+    assert "async snapshot dispatch" in fs[0].message
+    assert "initiate" in fs[0].snippet
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/mp.py": src.format(
+            drain="self._committer.drain()\n                ")})
+    assert fs == []
+
+
+def test_use_after_donate_real_training_read_fails_ci(tmp_path):
+    """Acceptance gate: inject a post-call read of a donated arg into
+    the REAL build_train_step closure and the checker (CI step 0) goes
+    red."""
+    src = (REPO / "kungfu_tpu" / "training.py").read_text()
+    marker = "        return p, s, losses\n"
+    assert marker in src, "fixture went stale"
+    files = {"kungfu_tpu/training.py": src.replace(
+        marker,
+        "        _dbg = stacked_params\n" + marker, 1)}
+    for rel, text in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(text)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    fs = run_passes(facts)
+    assert any(f.rule == "use-after-donate" and "stacked_params"
+               in f.message for f in fs), [f.render() for f in fs]
+
+
+# ------------------------------------------------ dataflow: sharding-mismatch
+def test_sharding_mismatch_positive_and_negative(tmp_path):
+    factory = """
+        import jax
+
+        def build_step(loss_fn, opt, mesh, donate=False):
+            def body(p, s, b):
+                return p, s, b
+            jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
+            jitted = jax.jit(body, **jit_kwargs)
+
+            def step(p, s, b):
+                p, s, out = jitted(p, s, b)
+                return p, s, out
+            return step
+    """
+    trainer = """
+        from .train import build_step
+
+        class Trainer:
+            def _install(self, n):
+                self.mesh = flat_mesh(n=n)
+                self.params = restack(self._host, n, {layout})
+                self._step = build_step(self.loss, self.opt, self.mesh,
+                                        donate=True)
+
+            def step(self, batch):
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, batch)
+                return loss
+    """
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/train.py": factory,
+        "kungfu_tpu/tr.py": trainer.format(layout="other_mesh(n)")})
+    assert rules_fired(fs) == {"sharding-mismatch"}
+    assert "`self.params`" in fs[0].message and "other_mesh" \
+        in fs[0].message
+    # laid out against the same mesh the step was built with: quiet
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/train.py": factory,
+        "kungfu_tpu/tr.py": trainer.format(layout="self.mesh")})
+    assert fs == []
+
+
+def test_sharding_mismatch_suppression(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/t.py": """
+        import jax
+
+        class T:
+            def _install(self, n):
+                # kfcheck: disable=sharding-mismatch
+                self.params = restack(self._host, n, other_mesh(n))
+                self._step = jax.jit(body, donate_argnums=(0,))
+
+            def step(self, b):
+                self.params, loss = self._step(self.params, b)
+                return loss
+    """})
+    assert fs == []
+
+
+def test_sharding_mismatch_real_elastic_relayout_fails_ci(tmp_path):
+    """Acceptance gate: re-lay out the REAL elastic trainer's donated
+    params against a different mesh than the step was built with and
+    the checker goes red."""
+    tr = (REPO / "kungfu_tpu" / "elastic" / "trainer.py").read_text()
+    marker = "self.params = _restack(self._host_params, n, self.mesh)"
+    assert marker in tr, "fixture went stale"
+    files = {
+        "kungfu_tpu/elastic/trainer.py": tr.replace(
+            marker,
+            "self.params = _restack(self._host_params, n, "
+            "flat_mesh(n=n))", 1),
+        "kungfu_tpu/training.py":
+            (REPO / "kungfu_tpu" / "training.py").read_text(),
+    }
+    for rel, text in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(text)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    fs = run_passes(facts)
+    assert any(f.rule == "sharding-mismatch" and "self.params"
+               in f.message for f in fs), [f.render() for f in fs]
+
+
+# -------------------------------------------- dataflow: host-roundtrip-traced
+def test_host_roundtrip_sync_in_hot_loop(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/e.py": """
+        import jax
+
+        class Engine:
+            def __init__(self, body):
+                self._decode = jax.jit(body)
+
+            def serve(self, reqs):
+                out = []
+                for r in reqs:
+                    toks = self._decode(r)
+                    out.append(float(toks))
+                return out
+    """})
+    assert rules_fired(fs) == {"host-roundtrip-traced"}
+    assert "inside a loop of `serve`" in fs[0].message
+
+
+def test_host_roundtrip_negative_single_sync_rebind(tmp_path):
+    # the engine.py idiom: ONE deliberate np.asarray sync rebinds the
+    # name to a host array; the loop then reads free numpy memory
+    fs = run_program(tmp_path, {"kungfu_tpu/e.py": """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def __init__(self, body):
+                self._decode = jax.jit(body)
+
+            def serve(self, reqs):
+                toks = self._decode(reqs)
+                toks = np.asarray(toks)
+                out = []
+                for j in range(4):
+                    out.append(int(toks[j]))
+                return out
+    """})
+    assert fs == []
+
+
+def test_host_roundtrip_feedback(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/e.py": """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def __init__(self, body):
+                self._decode = jax.jit(body)
+
+            def serve(self, batch):
+                toks = self._decode(batch)
+                host = np.asarray(toks)
+                out = self._decode(host)
+                return out
+    """})
+    assert rules_fired(fs) == {"host-roundtrip-traced"}
+    assert "fed back" in fs[0].message
+
+
+def test_host_roundtrip_cold_frame_exempt(tmp_path):
+    # a sync inside a loop of a cold (non-hot-path) frame is fine
+    fs = run_program(tmp_path, {"kungfu_tpu/e.py": """
+        import jax
+
+        class Engine:
+            def __init__(self, body):
+                self._decode = jax.jit(body)
+
+            def warmup(self, reqs):
+                for r in reqs:
+                    toks = self._decode(r)
+                    print(float(toks))
+    """})
+    assert fs == []
 
 
 # ----------------------------------------------------------- facts cache

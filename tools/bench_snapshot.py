@@ -147,6 +147,42 @@ def run(total_mb: float, iters: int = 3) -> dict:
     return doc
 
 
+def donate_probe() -> dict:
+    """The donate rung's safety contract as an executable assertion: a
+    donated step's *returned* tree must snapshot and round-trip exactly,
+    and a donated input the backend actually invalidated must raise on
+    read.  A reintroduced post-call read of a donated buffer therefore
+    fails CI twice — statically in the kfcheck ``use-after-donate`` pass
+    and dynamically here (on backends that honour donation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu.elastic import snapshot as kfsnap
+
+    step = jax.jit(lambda p, s: (p + 1.0, s * 2.0), donate_argnums=(0, 1))
+    p0 = jnp.arange(1024, dtype=jnp.float32)
+    s0 = jnp.ones((1024,), jnp.float32)
+    expect_p = np.asarray(p0) + 1.0   # pre-call reads are fine
+    expect_s = np.asarray(s0) * 2.0
+    p1, s1 = step(p0, s0)
+    # snapshot the RETURNED tree — the ordering kfcheck enforces
+    host = kfsnap.snapshot({"p": p1, "s": s1})
+    assert np.array_equal(host["p"], expect_p), "donated step corrupted p"
+    assert np.array_equal(host["s"], expect_s), "donated step corrupted s"
+    invalidated = bool(getattr(p0, "is_deleted", lambda: False)())
+    if invalidated:
+        try:
+            np.asarray(p0)
+        except Exception:
+            pass
+        else:
+            raise AssertionError(
+                "backend invalidated the donated input but reading it "
+                "did not raise — use-after-donate would return garbage")
+    return {"donated_input_invalidated": invalidated,
+            "returned_tree_roundtrip": True}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=float, default=256.0,
@@ -160,6 +196,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     doc = run(args.mb, iters=args.iters)
+    doc["donate"] = donate_probe()
     print(json.dumps(doc, indent=2))
     if args.smoke:
         sp = doc["speedup_commit"]
@@ -173,7 +210,8 @@ def main(argv=None) -> int:
                 doc["sync"]["snapshot_s"] + 0.05), (
             "kfsnap snapshot regressed vs the blocking per-leaf path")
         print(f"kfsnap smoke OK: commit {sp}x legacy, "
-              f"restore bit-identical")
+              f"restore bit-identical, donated returned-tree snapshot "
+              f"round-trips")
         return 0
     with open(args.out, "w") as f:
         json.dump(doc, f, indent=2)

@@ -41,7 +41,15 @@ fail=0
 say() { printf '\n==== %s ====\n' "$*"; }
 
 say "0/3 kfcheck static analysis"
-python -m tools.kfcheck || exit 1
+# --fast scopes the per-file rules to git-changed files; the
+# whole-program passes (lock/knob/metrics/chaos + the phase-3 dataflow
+# family: use-after-donate, sharding-mismatch, host-roundtrip-traced)
+# always cover the full tree via the fact cache
+if [ "$FAST" = 1 ]; then
+  python -m tools.kfcheck --fast || exit 1
+else
+  python -m tools.kfcheck || exit 1
+fi
 # docs/knobs.md is generated from the typed registry
 # (kungfu_tpu/utils/knobs.py); a stale commit means someone edited one
 # without the other — `make knobs-docs` regenerates

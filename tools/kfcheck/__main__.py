@@ -2,11 +2,17 @@
 
     python -m tools.kfcheck                    # full program analysis:
                                                # per-file rules on
-                                               # kungfu_tpu/ + the four
+                                               # kungfu_tpu/ + the
                                                # whole-program passes
-                                               # over kungfu_tpu, tools,
+                                               # (incl. the phase-3
+                                               # dataflow family) over
+                                               # kungfu_tpu, tools,
                                                # tests and native/src
     python -m tools.kfcheck path/to/file.py    # per-file rules only
+    python -m tools.kfcheck --fast             # rules only on git-changed
+                                               # files; passes still cover
+                                               # the full tree via the
+                                               # warm fact cache
     python -m tools.kfcheck --program DIR      # rules + passes treating
                                                # DIR as the whole program
     python -m tools.kfcheck --write-baseline   # regenerate the baseline
@@ -30,6 +36,25 @@ from .wprogram import ALL_PASSES, run_passes
 
 REPO = Path(__file__).resolve().parent.parent.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _git_changed(root: Path) -> list:
+    """Repo-relative .py files changed vs HEAD (staged, unstaged, and
+    untracked).  Empty on any git failure — --fast then degrades to
+    passes-only, never to a silent skip of the passes."""
+    import subprocess
+    names: set = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if out.returncode != 0:
+            return []
+        names.update(out.stdout.split())
+    return sorted(n for n in names if n.endswith(".py"))
 
 
 def main(argv=None) -> int:
@@ -57,6 +82,12 @@ def main(argv=None) -> int:
     p.add_argument("--no-cache", action="store_true",
                    help="bypass the per-file fact cache "
                         "(tools/kfcheck/.cache.json)")
+    p.add_argument("--fast", "--changed", action="store_true",
+                   dest="fast",
+                   help="per-file rules only on git-changed files; the "
+                        "whole-program passes (dataflow included) still "
+                        "cover the full tree, served from the warm fact "
+                        "cache")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the OK summary line")
@@ -75,6 +106,14 @@ def main(argv=None) -> int:
         primary = [Path(x) for x in args.paths]
         context = []
         run_program = args.program
+    elif args.fast:
+        # rules scope to what changed; facts (and so the passes) still
+        # span the whole tree — unchanged files come out of the cache
+        changed = _git_changed(root)
+        primary = [Path(c) for c in changed
+                   if (root / c).exists() and c.startswith("kungfu_tpu/")]
+        context = [Path("kungfu_tpu"), Path("tools"), Path("tests")]
+        run_program = True
     else:
         primary = [Path("kungfu_tpu")]
         context = [Path("tools"), Path("tests")]
@@ -109,6 +148,11 @@ def main(argv=None) -> int:
             print(f"kfcheck: bad baseline: {e}", file=sys.stderr)
             return 2
         new, old_findings, stale = bl.split(findings)
+        if args.fast:
+            # unchanged files were never rule-checked, so their
+            # baselined findings are absent — not fixed; only the full
+            # run may call a baseline entry stale
+            stale = []
 
     if args.as_json:
         payload = {

@@ -1,0 +1,856 @@
+"""kfcheck phase 3: interprocedural dataflow over the jit hot path.
+
+The per-file rules (phase 1) and the joined fact passes (phase 2) see
+names and strings; neither can answer the question that blocks buffer
+donation: *is a value that was passed in a donated position ever read
+after the jitted call returns?*  This module adds exactly that — a
+small def-use model of the step/commit/serve hot paths:
+
+  - every ``jax.jit``/``pjit`` binding with its ``donate_argnums``
+    (literal, or the repo's ``jit_kwargs = {"donate_argnums": T} if
+    donate else {}`` idiom), the mesh it was built against, and the
+    function it wraps;
+  - every *factory* (a function that returns a donated jit, directly or
+    through a closure — ``build_train_step`` returns ``step`` which
+    calls the donated ``jitted``), with donated positions mapped
+    through the closure's parameters;
+  - every call site of a donation-capable binding with the root token
+    of each argument (``self.params``, ``global_batch``), which roots
+    the same statement rebinds, and every later read of an un-rebound
+    root within the frame (exception handlers included — the scan is
+    lexical over the whole function body);
+  - kfsnap async-dispatch sites (``committer.initiate(...)``, escaped
+    ``dispatch(...)``) whose held device references are the *temporal*
+    use-after-donate: the background join reads buffers a later donated
+    step has already invalidated;
+  - per-frame escapes of jit outputs to host (``float``/``np.asarray``/
+    ``device_get``/``block_until_ready``) and host values fed back into
+    a jit — the real device→host(→device) round trips the lexical
+    host-sync rule could only guess at by variable name.
+
+Facts are collected per file into ``facts["dataflow"]`` (JSON-able,
+cached with everything else in ``.cache.json`` — ``_tool_hash`` covers
+this file, so editing the collector invalidates stale facts) and joined
+across files by factory *name* in :func:`build_factory_table` — the
+same "heuristic honesty" contract as facts.py: AST-shaped, not a
+points-to analysis, resolved only through same-file bindings and
+uniquely-named module-level factories.
+
+Three passes ride the standard machinery (suppression comments,
+baseline, ``--list-rules``): ``use-after-donate``,
+``sharding-mismatch`` and ``host-roundtrip-traced``.  They scope their
+findings to ``kungfu_tpu/`` — tests may legitimately re-read a donated
+input to assert CPU semantics; production hot paths may not.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .engine import Finding, Module
+from .rules import call_name, dotted, tail
+
+# bump (with FACTS_SCHEMA) when the record shape changes
+DATAFLOW_SCHEMA = 1
+
+TRACERS = {"jit", "pjit"}
+SHARDERS = {"shard_map", "smap"}
+# host-escape calls: tail names that force a device->host materialize
+ESCAPES = {"float", "int", "asarray", "array", "device_get", "item"}
+# frames whose loops are the hot path for host-roundtrip findings
+HOT_FRAME = re.compile(r"train|serv|decode|fit|run_steps|epoch|step|tick",
+                       re.IGNORECASE)
+MESH_NAME = re.compile(r"^(self\.)?\w*mesh\w*$", re.IGNORECASE)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ---------------------------------------------------------------- tokens
+def _token(node: ast.AST) -> str:
+    """Root token of an expression: ``x`` for names (through
+    subscripts), ``self.x`` for self-attributes, '' otherwise."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        chain = node
+        while isinstance(chain.value, ast.Attribute):
+            chain = chain.value
+        if isinstance(chain.value, ast.Name) and chain.value.id == "self":
+            return "self." + chain.attr
+    return ""
+
+
+def _callee_token(call: ast.Call) -> str:
+    """Token when the call target is *directly* a name or self-attr
+    (``jitted(...)``, ``self._step(...)``) — method calls through an
+    object (``self._committer.initiate(...)``) return ''."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        return "self." + f.attr
+    return ""
+
+
+def _target_tokens(stmt: ast.AST) -> List[str]:
+    """Root tokens of every assignment target (tuples flattened)."""
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    else:
+        return []
+    out: List[str] = []
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            tok = _token(e)
+            if tok:
+                out.append(tok)
+    return out
+
+
+def _int_tuple(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def norm_mesh(tok: Optional[str]) -> str:
+    """Mesh tokens compare syntactically; ``self.mesh`` == ``mesh``."""
+    tok = re.sub(r"\s+", "", tok or "")
+    return tok[5:] if tok.startswith("self.") else tok
+
+
+# ------------------------------------------------------- function walker
+def _own_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Every node whose innermost enclosing function is ``fn`` (nested
+    defs/classes are their own frames and excluded)."""
+    out: List[ast.AST] = []
+
+    def walk(n: ast.AST) -> None:
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, _FN + (ast.ClassDef,)):
+                continue
+            out.append(c)
+            walk(c)
+    walk(fn)
+    return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _param_default(fn: ast.AST, name: str):
+    """The literal default of parameter ``name`` (None if absent or
+    non-literal)."""
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        if p.arg == name and isinstance(d, ast.Constant):
+            return d.value
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == name and isinstance(d, ast.Constant):
+            return d.value
+    return None
+
+
+class _FrameInfo:
+    """One function's locally-resolvable dataflow context."""
+
+    def __init__(self, fn: ast.AST, own: List[ast.AST]):
+        self.fn = fn
+        self.own = own
+        # `jit_kwargs = {"donate_argnums": T} if donate else {}` and the
+        # unconditional dict form
+        self.donate_kwargs: Dict[str, Tuple[List[int], Optional[str]]] = {}
+        # local `sm = shard_map(body, mesh=...)` assigns
+        self.shard_of: Dict[str, Tuple[Optional[str], Optional[str]]] = {}
+        # local `build = (A if cond else B)` factory-name conditionals
+        self.cond_names: Dict[str, List[str]] = {}
+        self.local_defs: Dict[str, ast.AST] = {
+            n.name: n for n in ast.iter_child_nodes(fn)
+            if isinstance(n, _FN)}
+        for n in own:
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1 or \
+                    not isinstance(n.targets[0], ast.Name):
+                continue
+            name, val = n.targets[0].id, n.value
+            for d in ([val.body, val.orelse]
+                      if isinstance(val, ast.IfExp) else [val]):
+                if isinstance(d, ast.Dict):
+                    for k, v in zip(d.keys, d.values):
+                        if isinstance(k, ast.Constant) and \
+                                k.value == "donate_argnums":
+                            gate = None
+                            if isinstance(val, ast.IfExp) and \
+                                    isinstance(val.test, ast.Name):
+                                gate = val.test.id
+                            self.donate_kwargs[name] = (_int_tuple(v), gate)
+            if isinstance(val, ast.Call) and \
+                    tail(call_name(val)) in SHARDERS:
+                mesh = None
+                for kw in val.keywords:
+                    if kw.arg == "mesh":
+                        mesh = ast.unparse(kw.value)
+                inner = val.args[0].id if val.args and \
+                    isinstance(val.args[0], ast.Name) else None
+                self.shard_of[name] = (mesh, inner)
+            if isinstance(val, ast.IfExp) and \
+                    isinstance(val.body, ast.Name) and \
+                    isinstance(val.orelse, ast.Name):
+                self.cond_names[name] = [val.body.id, val.orelse.id]
+
+    def jit_info(self, call: ast.Call) -> Optional[dict]:
+        """Donation/mesh/arity facts for a jit/pjit call, or None."""
+        if tail(call_name(call)) not in TRACERS:
+            return None
+        argnums: List[int] = []
+        mode, gate = "off", None
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                argnums, mode = _int_tuple(kw.value), "always"
+            elif kw.arg is None and isinstance(kw.value, ast.Name) and \
+                    kw.value.id in self.donate_kwargs:
+                argnums, gate = self.donate_kwargs[kw.value.id]
+                mode = "param" if gate else "always"
+        mesh, nparams = None, None
+        if call.args:
+            a0 = call.args[0]
+            if isinstance(a0, ast.Call) and \
+                    tail(call_name(a0)) in SHARDERS:
+                for kw in a0.keywords:
+                    if kw.arg == "mesh":
+                        mesh = ast.unparse(kw.value)
+                if a0.args and isinstance(a0.args[0], ast.Name):
+                    d = self.local_defs.get(a0.args[0].id)
+                    nparams = len(_param_names(d)) if d else None
+            elif isinstance(a0, ast.Name):
+                if a0.id in self.shard_of:
+                    mesh, inner = self.shard_of[a0.id]
+                    d = self.local_defs.get(inner or "")
+                    nparams = len(_param_names(d)) if d else None
+                elif a0.id in self.local_defs:
+                    nparams = len(_param_names(self.local_defs[a0.id]))
+        gate_default = None
+        if gate is not None:
+            gate_default = _param_default(self.fn, gate)
+        return {"argnums": argnums, "mode": mode, "gate": gate,
+                "gate_default": gate_default, "mesh": mesh,
+                "nparams": nparams}
+
+
+# -------------------------------------------------------------- collector
+def _index_functions(tree: ast.Module):
+    """[(fn_node, class_name_or_None, dotted_qualname)], outermost
+    classes attributed so ``self.X`` joins across methods."""
+    out = []
+
+    def visit(node: ast.AST, cls: Optional[str], qual: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, cls or child.name, qual + [child.name])
+            elif isinstance(child, _FN):
+                out.append((child, cls, ".".join(qual + [child.name])))
+                visit(child, cls, qual + [child.name])
+            else:
+                visit(child, cls, qual)
+    visit(tree, None, [])
+    return out
+
+
+def collect_dataflow(mod: Module) -> dict:
+    """One file's dataflow facts (a plain JSON-able dict)."""
+    df: dict = {"factories": [], "bindings": [], "aliases": [],
+                "calls": [], "producers": [], "async_dispatch": [],
+                "escapes": []}
+
+    def rec(node: ast.AST, **extra) -> dict:
+        line = getattr(node, "lineno", 1)
+        d = {"line": line, "symbol": mod.symbol_at(line),
+             "snippet": mod.snippet_at(line)}
+        d.update(extra)
+        return d
+
+    fns = _index_functions(mod.tree)
+    frames = {id(fn): _FrameInfo(fn, _own_nodes(fn)) for fn, _, _ in fns}
+
+    # pass A: bindings, aliases, factories, producers, async dispatch
+    for fn, cls, qual in fns:
+        fr = frames[id(fn)]
+        for n in fr.own:
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) and \
+                    getattr(n, "value", None) is not None:
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                if len(targets) != 1 or \
+                        isinstance(targets[0], (ast.Tuple, ast.List)):
+                    tok = None
+                else:
+                    tok = _token(targets[0])
+                val = n.value
+                if tok and isinstance(val, ast.Call):
+                    ji = fr.jit_info(val)
+                    if ji is not None:
+                        df["bindings"].append(rec(
+                            n, target=tok, kind="jit", cls=cls, fn=qual,
+                            callees=[], args=[], kwargs={}, **ji))
+                    else:
+                        callee = call_name(val)
+                        cands = fr.cond_names.get(callee) \
+                            if "." not in callee else None
+                        df["bindings"].append(rec(
+                            n, target=tok, kind="call", cls=cls, fn=qual,
+                            callees=cands or [tail(callee)],
+                            args=[_token(a) for a in val.args],
+                            kwargs={kw.arg: ast.unparse(kw.value)
+                                    for kw in val.keywords if kw.arg}))
+                elif tok and tok.startswith("self."):
+                    src = _token(val)
+                    if src.startswith("self.") and src != tok:
+                        df["aliases"].append(
+                            {"target": tok, "source": src, "cls": cls})
+                # producer: self-attr laid out against a mesh
+                if tok and tok.startswith("self.") and \
+                        isinstance(val, ast.Call):
+                    mesh = None
+                    for sub in ast.walk(val):
+                        if isinstance(sub, (ast.Name, ast.Attribute)):
+                            nm = dotted(sub)
+                            if nm and MESH_NAME.match(nm):
+                                mesh = nm
+                                break
+                    if mesh:
+                        df["producers"].append(rec(
+                            n, attr=tok, cls=cls, mesh=mesh, fn=qual))
+        # kfsnap async dispatch: initiate(...) always; dispatch(...)
+        # when its PendingSnapshot escapes the frame un-joined
+        joined_ids = set()
+        join_roots = set()
+        for n in fr.own:
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "join":
+                joined_ids.add(id(n.func.value))
+                join_roots.add(_token(n.func.value))
+        for n in fr.own:
+            if not isinstance(n, ast.Call):
+                continue
+            t = tail(call_name(n))
+            if t == "initiate" and n.args:
+                roots = sorted({_token(s)[5:] for s in ast.walk(n.args[0])
+                                if _token(s).startswith("self.")})
+                df["async_dispatch"].append(rec(
+                    n, cls=cls, fn=qual, method=fn.name, roots=roots))
+            elif t == "dispatch" and n.args and id(n) not in joined_ids:
+                held = None
+                for st in fr.own:
+                    if isinstance(st, ast.Assign) and st.value is n:
+                        held = _target_tokens(st)
+                    elif isinstance(st, ast.Return) and st.value is n:
+                        held = ["<returned>"]
+                if held is None or all(h in join_roots for h in held
+                                       if h != "<returned>") and \
+                        held != ["<returned>"]:
+                    continue
+                roots = sorted({_token(s)[5:] for s in ast.walk(n.args[0])
+                                if _token(s).startswith("self.")})
+                df["async_dispatch"].append(rec(
+                    n, cls=cls, fn=qual, method=fn.name, roots=roots))
+        # factory detection: this function returns a donated jit
+        local_jits = {b["target"]: b for b in df["bindings"]
+                      if b["fn"] == qual and b["kind"] == "jit"}
+        for n in fr.own:
+            if not isinstance(n, ast.Return) or n.value is None:
+                continue
+            info = None
+            if isinstance(n.value, ast.Call):
+                info = fr.jit_info(n.value)
+            elif isinstance(n.value, ast.Name):
+                nm = n.value.id
+                if nm in local_jits:
+                    b = local_jits[nm]
+                    info = {k: b[k] for k in ("argnums", "mode", "gate",
+                                              "gate_default", "mesh",
+                                              "nparams")}
+                elif nm in fr.local_defs:
+                    info = _closure_factory(fr, fr.local_defs[nm],
+                                            local_jits)
+            if info is None or info["mode"] == "off":
+                continue
+            params = _param_names(fn)
+            mesh_param = next((i for i, p in enumerate(params)
+                               if p == "mesh" or p.endswith("_mesh")), None)
+            df["factories"].append(rec(
+                n, name=fn.name, cls=cls,
+                mesh_param=mesh_param,
+                mesh_param_name=(params[mesh_param]
+                                 if mesh_param is not None else None),
+                **info))
+
+    # pass B: calls of bound callables + post-call read analysis
+    bound = {}
+    for b in df["bindings"]:
+        bound[(b["cls"], b["target"])] = b
+    alias_src = {(a["cls"], a["target"]): a["source"]
+                 for a in df["aliases"]}
+
+    def _resolve_target(cls: Optional[str], tok: str) -> Optional[str]:
+        seen = set()
+        while (cls, tok) not in bound:
+            nxt = alias_src.get((cls, tok))
+            if nxt is None or nxt in seen:
+                return None
+            seen.add(nxt)
+            tok = nxt
+        return tok
+
+    for fn, cls, qual in fns:
+        fr = frames[id(fn)]
+        # loop line ranges for the escape records
+        loops = [(n.lineno, getattr(n, "end_lineno", n.lineno))
+                 for n in fr.own
+                 if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
+        in_loop = lambda ln: any(lo <= ln <= hi for lo, hi in loops)
+        # token -> sorted (line, kind) events for post-read scans
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for n in fr.own:
+            tok = None
+            if isinstance(n, ast.Name):
+                tok, is_store = n.id, isinstance(n.ctx, ast.Store)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and n.value.id == "self":
+                tok = "self." + n.attr
+                is_store = isinstance(n.ctx, ast.Store)
+            if tok is None:
+                continue
+            (stores if is_store else loads).setdefault(tok, []).append(
+                n.lineno)
+        stmts = [n for n in fr.own
+                 if isinstance(n, (ast.Assign, ast.AnnAssign, ast.Expr,
+                                   ast.Return, ast.AugAssign))]
+        drains = sorted(n.lineno for n in fr.own
+                        if isinstance(n, ast.Call)
+                        and tail(call_name(n)) == "drain")
+        jit_outputs: Dict[str, Tuple[int, str]] = {}
+        host_rooted: Dict[str, Tuple[int, str]] = {}
+        frame_calls: List[Tuple[ast.Call, List[str], str]] = []
+        for n in fr.own:
+            if not isinstance(n, ast.Call):
+                continue
+            ctok = _callee_token(n)
+            if not ctok:
+                continue
+            binding_tok = _resolve_target(
+                cls if ctok.startswith("self.") else None, ctok) or \
+                (_resolve_target(cls, ctok) if cls else None)
+            # local-name bindings live in an enclosing frame: accept a
+            # binding whose frame lexically encloses this one
+            if binding_tok is None and not ctok.startswith("self."):
+                for b in df["bindings"]:
+                    if b["target"] == ctok and b["kind"] != "alias" and \
+                            (qual == b["fn"]
+                             or qual.startswith(b["fn"] + ".")):
+                        binding_tok = ctok
+                        break
+            if binding_tok is None:
+                continue
+            stmt = next((s for s in stmts
+                         if any(sub is n for sub in ast.walk(s))), None)
+            stmt_end = getattr(stmt, "end_lineno", n.lineno) \
+                if stmt is not None else n.lineno
+            rebound = _target_tokens(stmt) if stmt is not None else []
+            args = [_token(a) for a in n.args]
+            post_reads, never_rebound = {}, []
+            for r in set(a for a in args if a):
+                if r in rebound:
+                    continue
+                first_store = next((ln for ln in sorted(stores.get(r, []))
+                                    if ln > stmt_end), None)
+                first_load = next(
+                    (ln for ln in sorted(loads.get(r, []))
+                     if ln > stmt_end
+                     and (first_store is None or ln <= first_store)), None)
+                if first_load is not None:
+                    post_reads[r] = {
+                        "line": first_load,
+                        "symbol": mod.symbol_at(first_load),
+                        "snippet": mod.snippet_at(first_load)}
+                elif r.startswith("self.") and first_store is None:
+                    never_rebound.append(r)
+            df["calls"].append(rec(
+                n, callee=ctok, binding=binding_tok, cls=cls, fn=qual,
+                method=fn.name, nargs=len(n.args), args=args,
+                rebound=rebound, post_reads=post_reads,
+                never_rebound=sorted(never_rebound),
+                drain_before=any(d < n.lineno for d in drains)))
+            if stmt is not None and isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            jit_outputs[e.id] = (stmt_end, binding_tok)
+            frame_calls.append((n, args, binding_tok))
+
+        def device_rooted(tok: str, ln: int) -> Optional[str]:
+            # a jit output stops being a device value once the name is
+            # re-stored (`toks = np.asarray(toks)` is the ONE deliberate
+            # sync; later reads touch the host copy)
+            if tok not in jit_outputs:
+                return None
+            lo, src = jit_outputs[tok]
+            if ln < lo or any(lo < s < ln for s in stores.get(tok, [])):
+                return None
+            return src
+
+        # escapes of jit outputs to host
+        for n in fr.own:
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "block_until_ready":
+                tok = _token(n.func.value)
+                src = device_rooted(tok, n.lineno)
+                if src is not None:
+                    df["escapes"].append(rec(
+                        n, kind="sync", cls=cls, fn=qual, method=fn.name,
+                        source=src, name=tok, in_loop=in_loop(n.lineno)))
+                continue
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            t = tail(call_name(n))
+            if t not in ESCAPES:
+                continue
+            if t in ("float", "int") and "." in call_name(n):
+                continue
+            tok = _token(n.args[0])
+            src = device_rooted(tok, n.lineno)
+            if src is not None:
+                df["escapes"].append(rec(
+                    n, kind="sync", cls=cls, fn=qual, method=fn.name,
+                    source=src, name=tok, in_loop=in_loop(n.lineno)))
+                stmt = next((s for s in stmts
+                             if isinstance(s, ast.Assign)
+                             and any(sub is n for sub in ast.walk(s))),
+                            None)
+                if stmt is not None:
+                    for h in _target_tokens(stmt):
+                        host_rooted[h] = (n.lineno, jit_outputs[tok][1])
+        # feedback: a host-escaped value re-enters a later jitted call
+        for n, args, binding_tok in frame_calls:
+            for a in args:
+                if a in host_rooted and host_rooted[a][0] < n.lineno:
+                    df["escapes"].append(rec(
+                        n, kind="feedback", cls=cls, fn=qual,
+                        method=fn.name, source=host_rooted[a][1],
+                        name=a, in_loop=in_loop(n.lineno)))
+    return df
+
+
+def _closure_factory(fr: _FrameInfo, inner: ast.AST,
+                     local_jits: Dict[str, dict]) -> Optional[dict]:
+    """``return step`` where the inner def calls a local donated jit:
+    map the donated positions through the closure's parameters."""
+    params = _param_names(inner)
+    for n in ast.walk(inner):
+        if not isinstance(n, ast.Call):
+            continue
+        ctok = _callee_token(n)
+        if ctok not in local_jits:
+            continue
+        b = local_jits[ctok]
+        argnums = []
+        for i in b["argnums"]:
+            if i < len(n.args) and isinstance(n.args[i], ast.Name) and \
+                    n.args[i].id in params:
+                argnums.append(params.index(n.args[i].id))
+        return {"argnums": sorted(argnums), "mode": b["mode"],
+                "gate": b["gate"], "gate_default": b["gate_default"],
+                "mesh": b["mesh"], "nparams": len(params)}
+    return None
+
+
+# ------------------------------------------------------------------ join
+def build_factory_table(files: Dict[str, dict]) -> Dict[str, dict]:
+    """Module-level donated-jit factories joined by name.  A name
+    defined twice with different shapes is resolved conservatively
+    (union of donated positions, arity dropped)."""
+    out: Dict[str, dict] = {}
+    for path, f in sorted(files.items()):
+        for fac in (f.get("dataflow") or {}).get("factories", ()):
+            if fac.get("cls"):
+                continue  # methods don't join by bare name
+            prev = out.get(fac["name"])
+            if prev is None:
+                out[fac["name"]] = dict(fac, path=path)
+            else:
+                prev["argnums"] = sorted(set(prev["argnums"])
+                                         | set(fac["argnums"]))
+                if prev.get("nparams") != fac.get("nparams"):
+                    prev["nparams"] = None
+    return out
+
+
+def _truthy(lit: Optional[str]) -> Optional[bool]:
+    if lit in ("True", "1"):
+        return True
+    if lit in ("False", "0", "None"):
+        return False
+    return None
+
+
+def resolve_binding(b: dict, factories: Dict[str, dict],
+                    nargs: Optional[int] = None) -> Optional[dict]:
+    """Donation facts for one binding record, cross-file factories
+    joined in.  ``nargs`` (the call site's positional arity) filters
+    factory candidates whose returned callable has a known arity.
+    Returns None when the binding is not jit-shaped at all."""
+    if b["kind"] == "jit":
+        # "param" counts as donating even when the gate defaults off: the
+        # binding exists to be donation-capable, so a post-call read in
+        # the same frame is a bug on every donate=True caller's path
+        donating = b["mode"] in ("always", "param")
+        return {"donating": donating and bool(b["argnums"]),
+                "argnums": b["argnums"], "mesh": b.get("mesh"),
+                "gated": b["mode"] == "param",
+                "factory": None, "def_line": b["line"]}
+    cands = [factories[c] for c in b.get("callees", ())
+             if c in factories]
+    if nargs is not None:
+        fit = [c for c in cands
+               if c.get("nparams") in (None, nargs)]
+        cands = fit or cands
+    if not cands:
+        return None
+    donating, argnums, mesh, names = False, set(), None, []
+    for c in cands:
+        lit = _truthy(b.get("kwargs", {}).get(c.get("gate") or "donate"))
+        on = lit if lit is not None else (
+            c["mode"] == "always" or c.get("gate_default") is not False)
+        if on:
+            donating = True
+            argnums.update(c["argnums"])
+        names.append(c["name"])
+        mp = c.get("mesh_param")
+        mn = c.get("mesh_param_name")
+        tok = b.get("kwargs", {}).get(mn) if mn else None
+        if tok is None and mp is not None and mp < len(b.get("args", ())):
+            tok = b["args"][mp]
+        mesh = mesh or tok
+    return {"donating": donating, "argnums": sorted(argnums),
+            "mesh": mesh, "gated": True, "factory": "/".join(names),
+            "def_line": b["line"]}
+
+
+class _FileModel:
+    """Resolved bindings of one file, queried by (cls, target)."""
+
+    def __init__(self, df: dict, factories: Dict[str, dict]):
+        self.df = df
+        self.factories = factories
+        self.bindings: Dict[Tuple[Optional[str], str], dict] = {}
+        for b in df.get("bindings", ()):
+            self.bindings[(b["cls"], b["target"])] = b
+        self.aliases = {(a["cls"], a["target"]): a["source"]
+                        for a in df.get("aliases", ())}
+
+    def resolve(self, cls: Optional[str], tok: str,
+                nargs: Optional[int] = None) -> Optional[dict]:
+        seen = set()
+        while (cls, tok) not in self.bindings:
+            nxt = self.aliases.get((cls, tok))
+            if nxt is None or nxt in seen:
+                # local names may bind in an enclosing frame under a
+                # different cls key; fall back to target-only match
+                hits = [b for (c, t), b in self.bindings.items()
+                        if t == tok]
+                if len(hits) == 1:
+                    return resolve_binding(hits[0], self.factories, nargs)
+                return None
+            seen.add(nxt)
+            tok = nxt
+        return resolve_binding(self.bindings[(cls, tok)],
+                               self.factories, nargs)
+
+
+# ------------------------------------------------------------------ passes
+class _DataflowPass:
+    """Shared scoping: dataflow findings apply to kungfu_tpu/ sources
+    (tests may legitimately re-read donated inputs to assert CPU
+    semantics; the production hot path may not)."""
+
+    SCOPE = "kungfu_tpu/"
+
+    def _files(self, pm) -> Iterator[Tuple[str, dict, "_FileModel"]]:
+        factories = build_factory_table(pm.files)
+        for path, f in sorted(pm.files.items()):
+            if not path.startswith(self.SCOPE):
+                continue
+            df = f.get("dataflow") or {}
+            if df.get("calls") or df.get("escapes") or \
+                    df.get("async_dispatch"):
+                yield path, df, _FileModel(df, factories)
+
+
+class UseAfterDonateLogic(_DataflowPass):
+    name = "use-after-donate"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, df, fm in self._files(pm):
+            donated_attr_calls: List[Tuple[dict, List[str]]] = []
+            for call in df.get("calls", ()):
+                r = fm.resolve(call["cls"], call["binding"], call["nargs"])
+                if not r or not r["donating"]:
+                    continue
+                attr_roots: List[str] = []
+                for i in r["argnums"]:
+                    if i >= len(call["args"]):
+                        continue
+                    root = call["args"][i]
+                    if not root or root in call["rebound"]:
+                        if root and root.startswith("self."):
+                            attr_roots.append(root[5:])
+                        continue
+                    via = f" (via factory `{r['factory']}`)" \
+                        if r["factory"] else ""
+                    pr = call["post_reads"].get(root)
+                    if pr is not None:
+                        yield Finding(
+                            rule=self.name, path=path, line=pr["line"],
+                            symbol=pr["symbol"], snippet=pr["snippet"],
+                            message=(
+                                f"`{root}` was passed in donated position "
+                                f"{i} of `{call['callee']}`{via} at line "
+                                f"{call['line']} — its buffer is "
+                                f"invalidated by XLA when the call "
+                                f"returns, and this read hands back "
+                                f"garbage (or raises) on donating "
+                                f"backends; read the *returned* value or "
+                                f"rebind before reading"))
+                    elif root in call["never_rebound"]:
+                        yield Finding(
+                            rule=self.name, path=path, line=call["line"],
+                            symbol=call["symbol"],
+                            snippet=call["snippet"],
+                            message=(
+                                f"`{root}` is donated to "
+                                f"`{call['callee']}`{via} but never "
+                                f"rebound in `{call['method']}` — every "
+                                f"later method of `{call['cls']}` that "
+                                f"touches it reads an invalidated "
+                                f"buffer; rebind it from the call's "
+                                f"return in the same statement"))
+                    if root.startswith("self."):
+                        attr_roots.append(root[5:])
+                if attr_roots and call["cls"]:
+                    donated_attr_calls.append((call, attr_roots))
+            # kfsnap temporal hazard: an async snapshot holds device
+            # references across steps; a later donated step invalidates
+            # them under the background join
+            for call, roots in donated_attr_calls:
+                for ad in df.get("async_dispatch", ()):
+                    if ad["cls"] != call["cls"]:
+                        continue
+                    shared = sorted(set(ad["roots"]) & set(roots))
+                    if not shared or call["drain_before"]:
+                        continue
+                    yield Finding(
+                        rule=self.name, path=path, line=ad["line"],
+                        symbol=ad["symbol"], snippet=ad["snippet"],
+                        message=(
+                            f"async snapshot dispatch holds device "
+                            f"references to `self.{'`/`self.'.join(shared)}` "
+                            f"while `{call['method']}` (line "
+                            f"{call['line']}) donates the same buffers — "
+                            f"the background join reads invalidated "
+                            f"memory one step later; snapshot the "
+                            f"*returned* tree, use the synchronous "
+                            f"snapshot(), or drain() before the donated "
+                            f"step"))
+
+
+class ShardingMismatchLogic(_DataflowPass):
+    name = "sharding-mismatch"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, df, fm in self._files(pm):
+            seen = set()
+            for call in df.get("calls", ()):
+                r = fm.resolve(call["cls"], call["binding"], call["nargs"])
+                if not r or not r["donating"] or not r["mesh"]:
+                    continue
+                step_mesh = norm_mesh(r["mesh"])
+                for i in r["argnums"]:
+                    if i >= len(call["args"]):
+                        continue
+                    root = call["args"][i]
+                    if not root.startswith("self."):
+                        continue
+                    for prod in df.get("producers", ()):
+                        if prod["cls"] != call["cls"] or \
+                                prod["attr"] != root:
+                            continue
+                        prod_mesh = norm_mesh(prod["mesh"])
+                        key = (path, prod["line"], call["line"])
+                        if prod_mesh == step_mesh or key in seen:
+                            continue
+                        seen.add(key)
+                        yield Finding(
+                            rule=self.name, path=path,
+                            line=prod["line"], symbol=prod["symbol"],
+                            snippet=prod["snippet"],
+                            message=(
+                                f"`{root}` is laid out against "
+                                f"`{prod['mesh']}` here but donated to "
+                                f"`{call['callee']}` (line "
+                                f"{call['line']}) which was built "
+                                f"against `{r['mesh']}` — donation "
+                                f"aliases input and output buffers, so "
+                                f"a mesh/sharding mismatch either "
+                                f"defeats the aliasing (silent copy, "
+                                f"donation win gone) or resharded the "
+                                f"donated value; build both against "
+                                f"the same mesh"))
+
+
+class HostRoundtripLogic(_DataflowPass):
+    name = "host-roundtrip-traced"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, df, fm in self._files(pm):
+            for esc in df.get("escapes", ()):
+                r = fm.resolve(esc["cls"], esc["source"])
+                if r is None:
+                    continue
+                if esc["kind"] == "feedback":
+                    yield Finding(
+                        rule=self.name, path=path, line=esc["line"],
+                        symbol=esc["symbol"], snippet=esc["snippet"],
+                        message=(
+                            f"`{esc['name']}` took a device→host round "
+                            f"trip (it was materialized from a "
+                            f"`{esc['source']}` output) and is fed back "
+                            f"into a jitted call here — the host copy "
+                            f"blocks the step and the re-upload pays "
+                            f"H2D again; keep the value on device "
+                            f"between jitted calls"))
+                elif esc["in_loop"] and HOT_FRAME.search(esc["method"]):
+                    yield Finding(
+                        rule=self.name, path=path, line=esc["line"],
+                        symbol=esc["symbol"], snippet=esc["snippet"],
+                        message=(
+                            f"`{esc['name']}` is an output of jitted "
+                            f"`{esc['source']}` and is synced to host "
+                            f"inside a loop of `{esc['method']}` — "
+                            f"every iteration stalls the dispatch "
+                            f"pipeline on a device round trip; hoist "
+                            f"the sync out of the loop or batch it"))

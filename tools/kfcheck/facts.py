@@ -32,11 +32,12 @@ import re
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .dataflow import collect_dataflow
 from .engine import Module, Rule, iter_py_files
 from .rules import call_name, dotted, tail
 
 # bump to invalidate every cached fact when the extraction shape changes
-FACTS_SCHEMA = 1
+FACTS_SCHEMA = 2
 
 DEFAULT_CACHE = Path(__file__).resolve().parent / ".cache.json"
 
@@ -257,6 +258,7 @@ def collect_facts(mod: Module) -> dict:
         "env_reads": [], "knob_literals": [], "knob_defs": [],
         "metric_names": [], "chaos_points": [], "chaos_site_defs": [],
         "chaos_site_refs": [], "classes": [],
+        "dataflow": collect_dataflow(mod),
         "suppressed": {str(k): sorted(v)
                        for k, v in mod.suppressed.items()},
     }
@@ -393,14 +395,17 @@ def scan_native(root: Path) -> Dict[str, dict]:
                         "knob_defs": [], "metric_names": [],
                         "chaos_points": [], "chaos_site_defs": [],
                         "chaos_site_refs": [], "classes": [],
-                        "suppressed": {}}
+                        "dataflow": {}, "suppressed": {}}
     return out
 
 
 # ------------------------------------------------------------------ cache
 def _tool_hash() -> str:
+    # the dataflow collector feeds facts["dataflow"], so its source is
+    # part of the cache key too — stale facts must not mask a finding
     h = hashlib.md5(str(FACTS_SCHEMA).encode())
     h.update(Path(__file__).read_bytes())
+    h.update((Path(__file__).parent / "dataflow.py").read_bytes())
     return h.hexdigest()
 
 

@@ -197,10 +197,12 @@ class TraceImpurity(Rule):
 # -------------------------------------------------- host-sync-in-hot-path
 class HostSyncInHotPath(Rule):
     name = "host-sync-in-hot-path"
-    doc = ("device->host sync inside a training/serving step loop — every "
-           "iteration stalls the XLA pipeline to materialize a host value; "
-           "also flags whole-tree tree_map(np.asarray|jax.device_get, ...) "
-           "on step/commit/resize paths (use kungfu_tpu.elastic.snapshot)")
+    doc = ("explicit device->host sync inside a training/serving step loop "
+           "— every iteration stalls the XLA pipeline to materialize a host "
+           "value; also flags whole-tree tree_map(np.asarray|jax.device_get, "
+           "...) on step/commit/resize paths (use kungfu_tpu.elastic."
+           "snapshot).  Implicit float()/int() syncs are traced by the "
+           "host-roundtrip-traced dataflow pass instead of guessed by name")
 
     HOT_FN = re.compile(r"train|serv|decode|fit|run_steps|epoch",
                         re.IGNORECASE)
@@ -210,13 +212,10 @@ class HostSyncInHotPath(Rule):
                            re.IGNORECASE)
     SYNCS = {"device_get", "block_until_ready"}
     TREE_SYNCS = {"asarray", "device_get"}
-    ARRAYISH = re.compile(r"loss|grad|logit|prob|acc|metric|output",
-                          re.IGNORECASE)
-
-    def _root_name(self, node: ast.AST) -> str:
-        while isinstance(node, (ast.Attribute, ast.Subscript)):
-            node = node.value
-        return node.id if isinstance(node, ast.Name) else ""
+    # NOTE: `float(loss)`-style implicit syncs used to be guessed here by
+    # an ARRAYISH name heuristic; the host-roundtrip-traced dataflow pass
+    # (tools/kfcheck/dataflow.py) now proves or refutes them by tracking
+    # actual jit outputs, so the lexical branch is retired.
 
     def _tree_map_sync(self, call: ast.Call) -> Optional[str]:
         """The dotted sync name when ``call`` is a
@@ -274,14 +273,6 @@ class HostSyncInHotPath(Rule):
                             f"`{nm}()` inside the step loop of "
                             f"`{fn.name}`: forces a device sync every "
                             f"iteration")
-                    elif t in ("float", "int") and "." not in nm \
-                            and sub.args and self.ARRAYISH.search(
-                                self._root_name(sub.args[0]) or "\0"):
-                        yield mod.finding(
-                            self.name, sub,
-                            f"`{t}({ast.unparse(sub.args[0])})` inside "
-                            f"the step loop of `{fn.name}`: implicit "
-                            f"device->host sync; hoist or batch it")
 
 
 # ------------------------------------------------------------ silent-except

@@ -7,17 +7,25 @@ existing suppression (``# kfcheck: disable=<pass>``) and baseline
 machinery applies unchanged.  Rule-name = pass-name for all of a
 pass's findings; the message distinguishes the sub-check.
 
-The four passes (docs/static-analysis.md has examples + failure modes):
+The seven passes (docs/static-analysis.md has examples + failure modes):
 
-  lock-discipline      attribute mutated on a thread body but touched
-                       elsewhere without the object's lock
-  knob-registry        every KFT_* env var must live in the typed
-                       registry and be read through it
-  metrics-consistency  consumed metric names must be published,
-                       published names must carry HELP text, and
-                       one-off near-miss spellings are flagged
-  chaos-coverage       chaos.point sites <-> sites.py catalogue <->
-                       scenario/plan/test references must close
+  lock-discipline        attribute mutated on a thread body but touched
+                         elsewhere without the object's lock
+  knob-registry          every KFT_* env var must live in the typed
+                         registry and be read through it
+  metrics-consistency    consumed metric names must be published,
+                         published names must carry HELP text, and
+                         one-off near-miss spellings are flagged
+  chaos-coverage         chaos.point sites <-> sites.py catalogue <->
+                         scenario/plan/test references must close
+  use-after-donate       a value passed in a donated jit position is
+                         read after the call returns (phase 3,
+                         tools/kfcheck/dataflow.py)
+  sharding-mismatch      a donated self-attr is laid out against a
+                         different mesh than the step was built with
+  host-roundtrip-traced  jit outputs escaping to host in hot loops /
+                         host values fed back into a jit, proven from
+                         def-use chains instead of name heuristics
 """
 from __future__ import annotations
 
@@ -25,6 +33,8 @@ import re
 from collections import Counter
 from typing import Dict, Iterator, List, Tuple
 
+from .dataflow import (HostRoundtripLogic, ShardingMismatchLogic,
+                       UseAfterDonateLogic)
 from .engine import Finding
 from .facts import lockish
 
@@ -313,8 +323,54 @@ class ChaosCoverage(ProgramPass):
                 f"name")
 
 
+# ------------------------------------------------- dataflow (phase 3)
+# The interprocedural def-use model lives in tools/kfcheck/dataflow.py
+# (facts["dataflow"]: jit bindings + donate_argnums, factories, call
+# sites with argument roots and post-call reads, kfsnap dispatch sites,
+# host escapes); these passes join it repo-wide and emit through the
+# standard machinery.  They are what lets elastic/trainer.py ship with
+# donate=True: a post-call read of a donated buffer anywhere on the
+# step/commit/serve path turns CI step 0 red.
+
+class UseAfterDonate(ProgramPass, UseAfterDonateLogic):
+    name = "use-after-donate"
+    doc = ("a value passed in a donated position of a jitted call is "
+           "read after the call returns (on any path — exception "
+           "handlers and the kfsnap async dispatch included): XLA has "
+           "already invalidated the buffer, so donating backends hand "
+           "back garbage or raise")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class ShardingMismatch(ProgramPass, ShardingMismatchLogic):
+    name = "sharding-mismatch"
+    doc = ("a donated input is laid out against a different mesh than "
+           "the jitted step consuming it was built with (incl. across "
+           "the elastic _build/_install rebuild) — the input/output "
+           "buffer aliasing donation promises is silently defeated or "
+           "the value is resharded mid-step")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class HostRoundtrip(ProgramPass, HostRoundtripLogic):
+    name = "host-roundtrip-traced"
+    doc = ("a value proven to be a jitted-call output is synced to "
+           "host inside a hot-frame loop, or a host-materialized value "
+           "is fed back into a jitted call — real device->host(->device) "
+           "round trips traced through dataflow, superseding the "
+           "lexical float(loss) name heuristic")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
 ALL_PASSES = [LockDiscipline(), KnobRegistry(), MetricsConsistency(),
-              ChaosCoverage()]
+              ChaosCoverage(), UseAfterDonate(), ShardingMismatch(),
+              HostRoundtrip()]
 
 
 def run_passes(facts_by_path: Dict[str, dict],
