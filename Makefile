@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -61,6 +61,22 @@ doctor-smoke:
 # (docs/monitoring.md "Profiling (kfprof)").
 prof-smoke:
 	python tools/kfprof_report.py --smoke
+
+# kfload smoke: tiny CPU serving server + 3-rung open-loop Poisson
+# sweep; asserts SERVING_BENCH.json shape, SLO gauges on /metrics, the
+# /requests journal, and the kftrace+kfrequests merge round-trip
+# (docs/serving.md "SLOs, the request journal and kfload").  Run the
+# serving chaos twins with `make slo-smoke`.
+load-smoke:
+	python tools/kfload.py --smoke
+
+# SLO doctor proof: delay every serving admission (serving.admit) — the
+# doctor scraping the live server's /metrics must raise an
+# slo-violation finding naming the instance; the clean twin must stay
+# silent.  Single-process CPU jax, never self-skips.
+slo-smoke:
+	python -m kungfu_tpu.chaos.runner --scenario slo-doctor
+	python -m kungfu_tpu.chaos.runner --scenario slo-doctor-clean
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

@@ -310,7 +310,10 @@ class Scenario:
     doctor_expect: Optional[Dict[str, object]] = None
     # ---- kfsim (docs/chaos.md "Simulation tier"): tier="sim" runs the
     # scenario over fake trainers (kungfu_tpu/sim/) under the real
-    # watcher — no jax, no data plane, scales to 100+ processes
+    # watcher — no jax, no data plane, scales to 100+ processes.
+    # tier="serving" drives a single-process CPU serving server
+    # (chaos/serving.py) — single-host jax, no data plane either, so
+    # both non-real tiers run everywhere unconditionally
     tier: str = "real"
     sim_seed: int = 0            # wsum fingerprint + step-time jitter
     sim_step_s: float = 0.05     # scripted base step time
@@ -467,6 +470,31 @@ def scenarios() -> Dict[str, Scenario]:
             propose=((3, 2), (3, 3)),
             target_steps=20,
             timeout_s=420.0),
+        Scenario(
+            name="slo-doctor",
+            desc="every serving admission stalls 0.6s (serving.admit "
+                 "delay) under a live CPU serving server: the SLO "
+                 "plane's budget-burn gauges must sustain above "
+                 "threshold and kfdoctor must raise an slo-violation "
+                 "finding naming the serving instance — with a "
+                 "queue-dominated phase breakdown (the delay sits "
+                 "between arrival and admission)",
+            plan=Plan(seed=None).add("serving.admit", "delay",
+                                     count=999, delay_s=0.6),
+            tier="serving",
+            timeout_s=300.0,
+            min_fired=3,
+            doctor_expect={"kind": "slo-violation", "rank": 0}),
+        Scenario(
+            name="slo-doctor-clean",
+            desc="the same serving workload with NO faults: an "
+                 "slo-violation finding here is a false positive "
+                 "(warm-up compiles must roll out of the SLO window "
+                 "before they can burn the budget)",
+            plan=Plan(seed=None),
+            tier="serving",
+            timeout_s=300.0,
+            doctor_expect={"absent_kind": "slo-violation"}),
     ]
     out = {s.name: s for s in m}
     out["smoke"] = dataclasses.replace(
@@ -822,6 +850,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
     if sc.tier == "sim":
         from ..sim.runner import run_sim_scenario
         return run_sim_scenario(sc, out_root=out_root, verbose=verbose)
+    if sc.tier == "serving":
+        from .serving import run_serving_scenario
+        return run_serving_scenario(sc, out_root=out_root,
+                                    verbose=verbose)
     from ..elastic import ConfigServer, put_config
     from ..launcher.job import Job
     from ..launcher.watch import watch_run
@@ -1010,7 +1042,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     matrix = scenarios()
     if args.list:
         for name, sc in matrix.items():
-            tag = " [sim]" if sc.tier == "sim" else ""
+            tag = f" [{sc.tier}]" if sc.tier != "real" else ""
             print(f"{name:28s}{tag} {sc.desc}")
         return 0
     if args.scenario == "all":
@@ -1036,8 +1068,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..sim.scenarios import sim_fuzz_scenario
         picked.append(sim_fuzz_scenario(seed, nprocs=args.sim_procs))
     # Gate only the REAL tier on native + the multiprocess data plane;
-    # sim scenarios run everywhere, unconditionally (their entire point)
-    real = [sc for sc in picked if sc.tier != "sim"]
+    # sim AND serving scenarios run everywhere, unconditionally (their
+    # entire point — serving is single-process CPU jax, no data plane)
+    real = [sc for sc in picked if sc.tier == "real"]
     if real:
         from .. import native
         blocked = None
@@ -1050,7 +1083,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if blocked:
             print(f"kfchaos: SKIP {len(real)} real-tier scenario(s) "
                   f"({blocked})", flush=True)
-            picked = [sc for sc in picked if sc.tier == "sim"]
+            picked = [sc for sc in picked if sc.tier != "real"]
             if not picked:
                 return 0
     if args.out:

@@ -104,6 +104,13 @@ SITES: Dict[str, str] = {
         "watcher about to spawn one worker process"),
     "launcher.watch.kill": (
         "watcher about to kill one removed worker"),
+    # ------------------------------------------------ serving engine
+    "serving.admit": (
+        "decode engine admission (serving/engine.py _admit), after a "
+        "prefill batch is picked and before its device dispatch — a "
+        "delay here models a slow admission path and must surface as "
+        "an slo-violation finding (queue-dominated burn); an exception "
+        "models an admission-plane crash"),
     # ------------------------------------------------ model store
     "store.save": (
         "ModelStore.save of a pytree (versioned or flat)"),

@@ -110,6 +110,26 @@ _HELP = {
         "kfsim: fake-trainer polls of the config server that failed "
         "(sim/trainer.py; models control-plane flakiness seen by a "
         "worker).",
+    "kungfu_tpu_serving_preemptions_total":
+        "Serving: slot preemptions back to the queue, per reason "
+        "(engine.py youngest-first victim selection).",
+    "kungfu_tpu_serving_cumulative_wait_seconds":
+        "Serving: a finished request's TOTAL queue wait accumulated "
+        "across every admission (preemption requeues included) — the "
+        "sojourn view the re-stamped current-wait summary cannot show.",
+    "kungfu_tpu_serving_phase_share":
+        "Serving: fraction of window request wall time spent per "
+        "lifecycle phase (queue/prefill/decode; serving/slo.py).",
+    "kungfu_tpu_slo_compliance":
+        "Serving SLO: fraction of requests in the compliance window "
+        "meeting each objective (ttft/tpot/e2e; serving/slo.py).",
+    "kungfu_tpu_slo_budget_burn":
+        "Serving SLO: error-budget burn rate per objective — miss "
+        "fraction over budgeted miss fraction; sustained > "
+        "KFT_DOCTOR_BURN raises an slo-violation finding.",
+    "kungfu_tpu_slo_worst_ms":
+        "Serving SLO: worst observed value (ms) per objective in the "
+        "current compliance window (doctor evidence).",
 }
 
 # satellite guard: a buggy caller labeling by request id would grow the

@@ -19,6 +19,13 @@ interprets them.  This module is that layer:
   * **control-plane** — lease-age spikes, growing heartbeat misses, or
     rpc outages in the *launcher's* own metrics, attributed to the peer
     or server they name;
+  * **slo-violation** (serving, serving/slo.py) — a serving instance
+    whose ``kungfu_tpu_slo_budget_burn{objective}`` gauge stayed above
+    ``KFT_DOCTOR_BURN`` for ``KFT_DOCTOR_WINDOWS`` consecutive scrapes;
+    the evidence carries the window's compliance, worst request, and
+    the dominant lifecycle phase (queue/prefill/decode share), and the
+    action names the matching capacity/profile move — the load signal a
+    multi-replica router acts on;
   * **perf** (kfprof, monitor/profiler.py) — an instance whose
     ``roofline_fraction`` sits below ``KFT_DOCTOR_ROOFLINE`` AND has
     dropped ``KFT_DOCTOR_ROOFLINE_DROP``x against its own baseline for
@@ -58,7 +65,8 @@ from .history import MetricsHistory
 
 __all__ = ["Finding", "Doctor", "PeerLatencyProber", "render_report",
            "detect_stragglers", "detect_interference",
-           "detect_control_plane", "detect_perf", "RUNNER_INSTANCE"]
+           "detect_control_plane", "detect_perf", "detect_slo",
+           "RUNNER_INSTANCE"]
 
 # the launcher's own metrics live in the history under this pseudo
 # instance (lease ages, rpc outage gauges — the control-plane signals)
@@ -367,6 +375,87 @@ def detect_perf(history: MetricsHistory, *,
     return findings
 
 
+# action per dominant lifecycle phase: where the SLO budget went says
+# what to do about it (docs/serving.md "SLOs & error budgets")
+_SLO_ACTIONS = {
+    "queue": "admission-bound: requests burn their budget waiting for "
+             "a slot — add capacity (slots / a replica behind the "
+             "router) or shed load upstream",
+    "prefill": "prefill-bound: check prompt-bucket sizes and the "
+               "prefix-cache hit rate (the prefix gauges on "
+               "/metrics); group more admissions per dispatch",
+    "decode": "decode-bound: capture a profile (/profile?duration_s=5, "
+              "tools/kfprof_report.py); consider a different decode "
+              "chunk or speculative decoding",
+}
+
+
+def detect_slo(history: MetricsHistory, *,
+               burn: float = 2.0, min_windows: int = 3,
+               stale_s: float = 60.0,
+               ranks: Optional[Dict[str, int]] = None,
+               version: Optional[int] = None) -> List[Finding]:
+    """Sustained serving error-budget burn, per objective.
+
+    A serving instance whose ``kungfu_tpu_slo_budget_burn{objective}``
+    sat above ``burn`` in each of the last ``min_windows`` scrapes gets
+    a Finding.  The sustained-burn guard (not a single spike) is the
+    standard error-budget alerting discipline: one slow request inside
+    the percentile budget is paid for; a window-after-window burn > 1
+    means the budget runs out — and burn > ``burn``x means it runs out
+    ``burn``x early.  Evidence cites the worst request in the window
+    and the dominant lifecycle phase (queue/prefill/decode) from the
+    journal's phase-share gauges, so the action can say *where* the
+    latency went."""
+    findings: List[Finding] = []
+    for inst in _fresh_instances(history, stale_s):
+        for obj in sorted(history.label_values(
+                inst, "kungfu_tpu_slo_budget_burn", "objective")):
+            pts = history.series(inst, "kungfu_tpu_slo_budget_burn",
+                                 {"objective": obj})
+            if len(pts) < min_windows:
+                continue
+            recent = [v for _ts, v in pts[-min_windows:]]
+            if not all(v > burn for v in recent):
+                continue
+            mean_burn = sum(recent) / len(recent)
+            comp = history.series(inst, "kungfu_tpu_slo_compliance",
+                                  {"objective": obj})
+            worst = history.series(inst, "kungfu_tpu_slo_worst_ms",
+                                   {"objective": obj})
+            shares: Dict[str, float] = {}
+            for phase in ("queue", "prefill", "decode"):
+                p = history.series(inst, "kungfu_tpu_serving_phase_share",
+                                   {"phase": phase})
+                if p:
+                    shares[phase] = p[-1][1]
+            dominant = (max(shares, key=lambda p: shares[p])
+                        if shares else "queue")
+            evidence: Dict[str, object] = {
+                "objective": obj,
+                "burn": round(mean_burn, 3),
+                "threshold": burn,
+            }
+            if comp:
+                evidence["compliance"] = round(comp[-1][1], 4)
+            if worst:
+                evidence["worst_ms"] = round(worst[-1][1], 1)
+            evidence["dominant_phase"] = dominant
+            for p, s in sorted(shares.items()):
+                evidence[f"share_{p}"] = round(s, 4)
+            findings.append(Finding(
+                kind="slo-violation",
+                severity=(SEV_CRITICAL if mean_burn > 2 * burn
+                          else SEV_WARN),
+                instance=inst,
+                rank=(ranks or {}).get(inst),
+                windows=min_windows,
+                evidence=evidence,
+                action=_SLO_ACTIONS[dominant],
+                version=version, detected_ts=time.time()))
+    return findings
+
+
 class Doctor:
     """History + detector suite + export.
 
@@ -390,6 +479,7 @@ class Doctor:
     KFT_DOCTOR_STALE_S     60.0     ignore instances not scraped lately
     KFT_DOCTOR_ROOFLINE    0.05     perf: roofline-fraction floor
     KFT_DOCTOR_ROOFLINE_DROP  2.0   perf: drop vs own baseline required
+    KFT_DOCTOR_BURN        2.0      slo: sustained error-budget burn
     =====================  =======  =====================================
     """
 
@@ -408,6 +498,7 @@ class Doctor:
         self.stale_s = knobs.get("KFT_DOCTOR_STALE_S")
         self.roofline = knobs.get("KFT_DOCTOR_ROOFLINE")
         self.roofline_drop = knobs.get("KFT_DOCTOR_ROOFLINE_DROP")
+        self.burn = knobs.get("KFT_DOCTOR_BURN")
         self._active: Dict[Tuple[str, str], Finding] = {}
         self.last: List[Finding] = []
 
@@ -437,7 +528,11 @@ class Doctor:
                           drop=self.roofline_drop,
                           min_windows=self.min_windows,
                           stale_s=self.stale_s,
-                          ranks=ranks, version=version))
+                          ranks=ranks, version=version)
+            + detect_slo(self.history, burn=self.burn,
+                         min_windows=self.min_windows,
+                         stale_s=self.stale_s,
+                         ranks=ranks, version=version))
         self._export(findings)
         self.last = findings
         return findings

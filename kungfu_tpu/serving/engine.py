@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import trace as _trace
+from ..chaos import point as _chaos_point
 from ..models import gpt as G
 from ..models.gpt import GPTConfig
 from ..monitor import get_monitor
@@ -72,6 +73,10 @@ class Request:
     # (kungfu_tpu_serving_queue_wait_seconds) measures the CURRENT wait,
     # not wait-plus-discarded-compute
     arrival_t: Optional[float] = None
+    # the ORIGINAL arrival, never re-stamped: total sojourn (e2e SLO,
+    # journal TTFT) stays recoverable across preemption requeues, while
+    # arrival_t above keeps measuring the current wait
+    first_arrival_t: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -704,6 +709,12 @@ class DecodeEngine:
         self._admit_t: Dict[int, float] = {}
         self._admitted_total = 0
         self._prompt_tokens_total = 0
+        # per-request lifecycle journal + SLO plane (serving/slo.py):
+        # arrival/admit/first-token/finish, preemption counts, prefix
+        # reuse — feeds /requests, the kungfu_tpu_slo_* gauges, and the
+        # kfrequests JSONL stream trace/merge.py folds into the timeline
+        from .slo import RequestJournal
+        self.journal = RequestJournal()
         # kfprof step attribution for the decode loop: compute = prefill
         # + decode dispatch->sync, host = scheduler remainder
         from ..monitor.profiler import StepPhases
@@ -744,6 +755,10 @@ class DecodeEngine:
                              f"(uids key both results and sampling)")
         if req.arrival_t is None:
             req.arrival_t = time.perf_counter()
+        if req.first_arrival_t is None:
+            req.first_arrival_t = req.arrival_t
+        self.journal.on_submit(req.uid, req.first_arrival_t,
+                               len(req.prompt))
         self._queue.append(req)
 
     def _bucket(self, n: int) -> int:
@@ -961,6 +976,10 @@ class DecodeEngine:
                 temps[g] = req.temperature
                 topks[g] = req.top_k
                 topps[g] = req.top_p
+            # admission fault site: a chaos "delay" here models a slow
+            # admission path (SLO burn without touching the device
+            # program); "exception" models an admission-plane crash
+            _chaos_point("serving.admit", step=self.stats.prefills)
             _t_prefill = time.perf_counter()
             if t_cacheds.any():
                 # at least one cached prefix: the suffix program (reads
@@ -996,10 +1015,27 @@ class DecodeEngine:
             for req, _slot, _blocks, _tc in batch:
                 self._admitted_total += 1
                 self._prompt_tokens_total += len(req.prompt)
+                wait = (now - req.arrival_t
+                        if req.arrival_t is not None else 0.0)
                 if req.arrival_t is not None:
                     mon.observe("kungfu_tpu_serving_queue_wait_seconds",
-                                now - req.arrival_t)
+                                wait)
                 self._admit_t[req.uid] = now
+                self.journal.on_admit(req.uid, now, slot=_slot,
+                                      prefix_reused=_tc, wait_s=wait)
+                # tok0 came out of this prefill: first token lands now
+                # (set-once in the journal — a preemption replay's
+                # re-prefill does not move it)
+                self.journal.on_first_token(req.uid, now)
+                if _trace.armed():
+                    _trace.event("serving.queue", category="serving",
+                                 dur=wait, attrs={"uid": req.uid,
+                                                  "slot": _slot})
+                    _trace.event("serving.prefill", category="serving",
+                                 dur=now - _t_prefill,
+                                 attrs={"uid": req.uid, "slot": _slot,
+                                        "cached": int(_tc),
+                                        "prompt": len(req.prompt)})
             mon.set_gauge("kungfu_tpu_serving_prefix_hit_rate",
                           self.stats.prefix_hits
                           / max(1, self._admitted_total))
@@ -1046,15 +1082,31 @@ class DecodeEngine:
     def _harvest(self, slot: int) -> None:
         run = self._running[slot]
         self._emit(run)
+        now = time.perf_counter()
         t_admit = self._admit_t.pop(run.req.uid, None)
         if t_admit is not None:
             # the per-request span (renders as one bar per request in
             # the merged Chrome trace: admit -> last token)
             _trace.event("serving.request", category="serving",
-                         dur=time.perf_counter() - t_admit,
+                         dur=now - t_admit,
                          attrs={"uid": run.req.uid,
                                 "prompt": len(run.req.prompt),
                                 "tokens": len(run.out)})
+        rec = self.journal.on_finish(run.req.uid, now,
+                                     output_tokens=len(run.out))
+        if rec is not None:
+            # total queue time across every admission — the re-stamped
+            # arrival_t alone cannot reconstruct this (satellite of the
+            # queue-wait blind spot; docs/serving.md)
+            get_monitor().observe(
+                "kungfu_tpu_serving_cumulative_wait_seconds",
+                rec.queue_wait_s)
+            if _trace.armed():
+                _trace.event("serving.finish", category="serving",
+                             dur=(now - rec.arrival_t),
+                             attrs={"uid": run.req.uid,
+                                    "tokens": len(run.out),
+                                    "preemptions": rec.preemptions})
         self._emitted.pop(run.req.uid, None)
         self._results[run.req.uid] = run.out
         self._admit_split.pop(run.req.uid, None)
@@ -1076,8 +1128,18 @@ class DecodeEngine:
         if victim is None:
             return False
         run = self._running[victim]
-        run.req.arrival_t = time.perf_counter()  # re-queued: wait restarts
+        # re-queued: the CURRENT-wait clock restarts, but
+        # req.first_arrival_t (stamped once in submit) is untouched, so
+        # total sojourn stays recoverable through the journal
+        run.req.arrival_t = time.perf_counter()
         self._admit_t.pop(run.req.uid, None)
+        self.journal.on_preempt(run.req.uid)
+        get_monitor().inc("kungfu_tpu_serving_preemptions_total",
+                          labels={"reason": "kv-pressure"})
+        _trace.event("serving.preempt", category="serving",
+                     attrs={"uid": run.req.uid, "slot": victim,
+                            "reason": "kv-pressure",
+                            "discarded": len(run.out)})
         self._queue.appendleft(run.req)
         # its generated-so-far tokens are discarded and will be
         # regenerated on replay: don't count them twice
@@ -1185,6 +1247,7 @@ class DecodeEngine:
         _tokens_before = self.stats.tokens_out
         for slot in active:
             run = self._running[slot]
+            _n0, _uid = len(run.out), run.req.uid
             # longest drafted prefix matching the model's own predictions
             a = 0
             while a < dlen[slot] and draft[slot, a + 1] == preds[slot, a]:
@@ -1206,6 +1269,11 @@ class DecodeEngine:
                 self._pos[slot] += n_new
                 self._tok[slot] = emitted[-1]
                 self._tcount[slot] += n_new
+            if _trace.armed():
+                _trace.event("serving.decode", category="serving",
+                             dur=_dt_decode,
+                             attrs={"uid": _uid, "slot": slot,
+                                    "tokens": len(run.out) - _n0})
         self._observe_decode(_dt_decode,
                              self.stats.tokens_out - _tokens_before)
         self._prof_phases.add("compute", _dt_decode)
@@ -1245,6 +1313,7 @@ class DecodeEngine:
         _tokens_before = self.stats.tokens_out
         for slot in active:
             run = self._running[slot]
+            _n0, _uid = len(run.out), run.req.uid
             for j in range(self.K):
                 run.out.append(int(toks[j, slot]))
                 self.stats.tokens_out += 1
@@ -1257,6 +1326,11 @@ class DecodeEngine:
                 self._pos[slot] += self.K
                 self._tok[slot] = int(toks[self.K - 1, slot])
                 self._tcount[slot] += self.K
+            if _trace.armed():
+                _trace.event("serving.decode", category="serving",
+                             dur=_dt_decode,
+                             attrs={"uid": _uid, "slot": slot,
+                                    "tokens": len(run.out) - _n0})
         self._observe_decode(_dt_decode,
                              self.stats.tokens_out - _tokens_before)
         self._prof_phases.add("compute", _dt_decode)

@@ -111,6 +111,18 @@ class ServingServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/requests"):
+                    # live request journal: open + recently finished
+                    # lifecycles and the current SLO evaluation
+                    # (serving/slo.py; docs/serving.md).  ?n= caps the
+                    # finished tail.
+                    from urllib.parse import parse_qs, urlsplit
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        n = int(qs.get("n", ["64"])[0])
+                    except ValueError:
+                        n = 64
+                    self._reply(200, server.engine.journal.snapshot(n))
                 else:
                     self._reply(404, {"error": "unknown path"})
 
@@ -285,3 +297,7 @@ class ServingServer:
         if self._sched:
             self._sched.join(timeout=30)   # releases waiters on exit
         self._http.stop()
+        # requests still in flight never finish: close their journal
+        # records as evicted (terminal serving.evict span) so the ring
+        # and the kfrequests stream don't end with dangling lifecycles
+        self.engine.journal.evict_open("server-closed")

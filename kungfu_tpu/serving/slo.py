@@ -1,0 +1,335 @@
+"""Request journal + SLO/error-budget plane for the serving path.
+
+The training plane already has per-step observability (kftrace spans,
+/metrics summaries, kfdoctor findings); this module gives the serving
+path the same treatment at *request* granularity:
+
+- :class:`RequestJournal` — every request's lifecycle (arrival, each
+  admission, first token, finish) recorded in a bounded in-memory ring
+  plus an optional JSONL sink (``kfrequests.<pid>.jsonl`` under
+  ``KFT_TRACE_DIR``, same anchor convention as kftrace streams so
+  ``trace/merge.py`` can place requests on the wall clock).  Served
+  live as ``/requests`` by :class:`~kungfu_tpu.serving.ServingServer`.
+- :class:`SLO` / :func:`load_slos` — the typed objective registry
+  (``KFT_SLO_TTFT_MS`` / ``KFT_SLO_TPOT_MS`` / ``KFT_SLO_E2E_MS`` +
+  target percentile and compliance window).
+- :func:`evaluate` — per-window compliance and error-budget *burn
+  rate* ((1 - compliance) / (1 - percentile): 1.0 means spending the
+  budget exactly as provisioned, sustained > 1 pages), published as
+  ``kungfu_tpu_slo_compliance{objective}`` /
+  ``kungfu_tpu_slo_budget_burn{objective}`` gauges that ``detect_slo``
+  (monitor/doctor.py) and the future multi-replica router consume.
+
+All timestamps are ``time.perf_counter()`` values on the engine
+process's clock; the JSONL anchor record pairs that clock with the
+wall clock for merging.  See docs/serving.md "SLOs, the request
+journal and kfload".
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Mapping, Optional
+
+from ..utils import knobs
+
+__all__ = ["SLO", "RequestRecord", "RequestJournal", "load_slos",
+           "evaluate", "burn_rate", "OBJECTIVES", "PHASES"]
+
+OBJECTIVES = ("ttft", "tpot", "e2e")
+PHASES = ("queue", "prefill", "decode")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One latency objective: ``percentile`` of requests in the
+    compliance window must come in under ``target_ms``."""
+    objective: str        # ttft | tpot | e2e
+    target_ms: float
+    percentile: float
+    window: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def load_slos(env: Optional[Mapping[str, str]] = None) -> List[SLO]:
+    """The enabled objectives from the knob registry (0 disables one)."""
+    pct = float(knobs.get("KFT_SLO_PERCENTILE", env))
+    window = int(knobs.get("KFT_SLO_WINDOW", env))
+    out = []
+    for obj, knob in (("ttft", "KFT_SLO_TTFT_MS"),
+                      ("tpot", "KFT_SLO_TPOT_MS"),
+                      ("e2e", "KFT_SLO_E2E_MS")):
+        target = float(knobs.get(knob, env))
+        if target > 0:
+            out.append(SLO(obj, target, pct, window))
+    return out
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One request's lifecycle.  ``arrival_t`` is the ORIGINAL arrival
+    (it survives preemption re-queues — the engine's ``Request`` keeps
+    a separate re-stamped wait clock for the current-wait summary);
+    ``queue_wait_s`` accumulates across every admission."""
+    uid: int
+    arrival_t: float
+    prompt_tokens: int = 0
+    admit_t: Optional[float] = None        # most recent admission
+    first_token_t: Optional[float] = None  # set once, survives replay
+    finish_t: Optional[float] = None
+    output_tokens: int = 0
+    prefix_reused: int = 0                 # cache-hit depth (tokens)
+    preemptions: int = 0
+    queue_wait_s: float = 0.0              # cumulative across requeues
+    slot: Optional[int] = None
+    outcome: Optional[str] = None          # finish | evict
+
+    # -- derived latencies (ms; None until the phase completes) -------
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return (self.first_token_t - self.arrival_t) * 1e3
+
+    def tpot_ms(self) -> Optional[float]:
+        if (self.finish_t is None or self.first_token_t is None
+                or self.output_tokens < 2):
+            return None
+        return ((self.finish_t - self.first_token_t)
+                / (self.output_tokens - 1)) * 1e3
+
+    def e2e_ms(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return (self.finish_t - self.arrival_t) * 1e3
+
+    def value_ms(self, objective: str) -> Optional[float]:
+        if objective == "ttft":
+            return self.ttft_ms()
+        if objective == "tpot":
+            return self.tpot_ms()
+        if objective == "e2e":
+            return self.e2e_ms()
+        raise ValueError(f"unknown objective {objective!r}")
+
+    def phase_s(self) -> Dict[str, float]:
+        """Wall seconds spent per lifecycle phase (finished requests)."""
+        out = {"queue": self.queue_wait_s, "prefill": 0.0, "decode": 0.0}
+        if self.admit_t is not None and self.first_token_t is not None:
+            out["prefill"] = max(self.first_token_t - self.admit_t, 0.0)
+        if self.first_token_t is not None and self.finish_t is not None:
+            out["decode"] = max(self.finish_t - self.first_token_t, 0.0)
+        return out
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["ttft_ms"] = self.ttft_ms()
+        d["tpot_ms"] = self.tpot_ms()
+        d["e2e_ms"] = self.e2e_ms()
+        return d
+
+
+def burn_rate(compliance: float, percentile: float) -> float:
+    """Error-budget burn: miss fraction over budgeted miss fraction."""
+    budget = max(1.0 - percentile, 1e-9)
+    return max(1.0 - compliance, 0.0) / budget
+
+
+def evaluate(records: List[RequestRecord],
+             slos: List[SLO]) -> Dict[str, dict]:
+    """Per-objective compliance/burn over each SLO's window (the most
+    recent ``window`` finished records).  Pure — unit-testable on
+    synthetic journals with exact window math."""
+    out: Dict[str, dict] = {}
+    for slo in slos:
+        recent = records[-slo.window:]
+        values = [(r, r.value_ms(slo.objective)) for r in recent]
+        values = [(r, v) for r, v in values if v is not None]
+        n = len(values)
+        ok = sum(1 for _, v in values if v <= slo.target_ms)
+        compliance = (ok / n) if n else 1.0
+        out[slo.objective] = {
+            "target_ms": slo.target_ms,
+            "percentile": slo.percentile,
+            "window": slo.window,
+            "n": n,
+            "compliance": compliance,
+            "burn": burn_rate(compliance, slo.percentile),
+            "worst_ms": max((v for _, v in values), default=0.0),
+        }
+    return out
+
+
+class RequestJournal:
+    """Bounded per-request journal: open records by uid, a finished
+    ring, an optional rotating JSONL sink, and the SLO gauges.
+
+    Mutated only by the engine owner thread; ``snapshot()`` is read
+    from HTTP handler threads, so every access takes the lock.
+    """
+
+    def __init__(self, *, ring: Optional[int] = None,
+                 sink_dir: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 slos: Optional[List[SLO]] = None,
+                 env: Optional[Mapping[str, str]] = None):
+        if ring is None:
+            ring = int(knobs.get("KFT_SLO_JOURNAL_RING", env))
+        if sink_dir is None:
+            sink_dir = knobs.raw("KFT_TRACE_DIR", env)
+        if max_bytes is None:
+            max_bytes = int(
+                float(knobs.get("KFT_SLO_JOURNAL_MB", env)) * 1e6)
+        self.slos = load_slos(env) if slos is None else list(slos)
+        self._lock = threading.Lock()
+        self._open: Dict[int, RequestRecord] = {}
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, ring))
+        self._max_bytes = max(int(max_bytes), 4096)
+        self._sink = None
+        self.sink_path: Optional[str] = None
+        if sink_dir:
+            os.makedirs(sink_dir, exist_ok=True)
+            self.sink_path = os.path.join(
+                sink_dir, f"kfrequests.{os.getpid()}.jsonl")
+            self._sink = open(self.sink_path, "a")
+            self._write_anchor()
+
+    # -- sink ---------------------------------------------------------
+    def _write_anchor(self) -> None:
+        self._sink.write(json.dumps(
+            {"kind": "anchor", "wall": time.time(),
+             "mono": time.perf_counter(), "pid": os.getpid()}) + "\n")
+        self._sink.flush()
+
+    def _sink_write(self, record: RequestRecord) -> None:
+        if self._sink is None:
+            return
+        if self._sink.tell() > self._max_bytes:
+            # single-generation rotation, kftrace-style flat files: the
+            # old stream keeps its anchor, the fresh one re-anchors
+            self._sink.close()
+            os.replace(self.sink_path, self.sink_path + ".1")
+            self._sink = open(self.sink_path, "a")
+            self._write_anchor()
+        self._sink.write(json.dumps(record.to_dict()) + "\n")
+        self._sink.flush()
+
+    # -- lifecycle hooks (engine owner thread) ------------------------
+    def on_submit(self, uid: int, arrival_t: float,
+                  prompt_tokens: int) -> None:
+        with self._lock:
+            self._open[uid] = RequestRecord(
+                uid=uid, arrival_t=arrival_t,
+                prompt_tokens=prompt_tokens)
+
+    def on_admit(self, uid: int, t: float, *, slot: int,
+                 prefix_reused: int, wait_s: float) -> None:
+        with self._lock:
+            rec = self._open.get(uid)
+            if rec is None:
+                return
+            rec.admit_t = t
+            rec.slot = slot
+            rec.prefix_reused = prefix_reused
+            rec.queue_wait_s += max(wait_s, 0.0)
+
+    def on_first_token(self, uid: int, t: float) -> None:
+        with self._lock:
+            rec = self._open.get(uid)
+            if rec is not None and rec.first_token_t is None:
+                rec.first_token_t = t
+
+    def on_preempt(self, uid: int) -> None:
+        with self._lock:
+            rec = self._open.get(uid)
+            if rec is not None:
+                rec.preemptions += 1
+                rec.slot = None
+
+    def on_finish(self, uid: int, t: float, *, output_tokens: int,
+                  outcome: str = "finish") -> Optional[RequestRecord]:
+        with self._lock:
+            rec = self._open.pop(uid, None)
+            if rec is None:
+                return None
+            rec.finish_t = t
+            rec.output_tokens = output_tokens
+            rec.outcome = outcome
+            self._ring.append(rec)
+            self._sink_write(rec)
+        self.publish()
+        return rec
+
+    def evict_open(self, reason: str = "shutdown") -> List[RequestRecord]:
+        """Close every in-flight record as evicted (server teardown)."""
+        from .. import trace as _trace
+        now = time.perf_counter()
+        with self._lock:
+            evicted = list(self._open.values())
+            for rec in evicted:
+                rec.finish_t = now
+                rec.outcome = "evict"
+                self._ring.append(rec)
+                self._sink_write(rec)
+            self._open.clear()
+        for rec in evicted:
+            _trace.event("serving.evict", category="serving",
+                         attrs={"uid": rec.uid, "reason": reason})
+        if evicted:
+            self.publish()
+        return evicted
+
+    # -- read side ----------------------------------------------------
+    def finished(self) -> List[RequestRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def snapshot(self, n: int = 64) -> dict:
+        with self._lock:
+            done = list(self._ring)[-max(n, 0):]
+            open_ = list(self._open.values())
+        return {
+            "open": [r.to_dict() for r in open_],
+            "finished": [r.to_dict() for r in done],
+            "slo": evaluate(self.finished(), self.slos),
+        }
+
+    # -- SLO gauges ---------------------------------------------------
+    def publish(self) -> Dict[str, dict]:
+        """Recompute compliance/burn over the window and publish the
+        gauges (plus the phase-share attribution the doctor's evidence
+        cites).  Cheap: the window is a few hundred records."""
+        from ..monitor import get_monitor
+        records = self.finished()
+        stats = evaluate(records, self.slos)
+        mon = get_monitor()
+        for obj, st in stats.items():
+            mon.set_gauge("kungfu_tpu_slo_compliance",
+                          st["compliance"], {"objective": obj})
+            mon.set_gauge("kungfu_tpu_slo_budget_burn",
+                          st["burn"], {"objective": obj})
+            mon.set_gauge("kungfu_tpu_slo_worst_ms",
+                          st["worst_ms"], {"objective": obj})
+        window = max((s.window for s in self.slos), default=64)
+        totals = {p: 0.0 for p in PHASES}
+        for rec in records[-window:]:
+            for phase, s in rec.phase_s().items():
+                totals[phase] += s
+        denom = sum(totals.values())
+        if denom > 0:
+            for phase in PHASES:
+                mon.set_gauge("kungfu_tpu_serving_phase_share",
+                              totals[phase] / denom, {"phase": phase})
+        return stats
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None

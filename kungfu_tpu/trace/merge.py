@@ -28,11 +28,15 @@ import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["load_stream", "merge", "discover", "main"]
+__all__ = ["load_stream", "merge", "discover", "discover_requests",
+           "request_events", "main"]
 
 # sink streams are kftrace.r<rank>.<pid>.jsonl; crash dumps
 # (kftrace-crash.*) replay the same ring and are excluded by default
 STREAM_GLOB = "kftrace.*.jsonl"
+# serving request journals (serving/slo.py), same anchor convention;
+# ".1" rotation generations merge too
+REQUEST_GLOB = "kfrequests.*.jsonl*"
 
 
 def load_stream(path: str) -> Tuple[Optional[dict], List[dict]]:
@@ -71,7 +75,76 @@ def discover(inputs: Sequence[str], include_crash: bool = False
     return out
 
 
-def merge(paths: Sequence[str]) -> dict:
+def discover_requests(inputs: Sequence[str]) -> List[str]:
+    """Request journals (kfrequests.*.jsonl) under the input dirs."""
+    out: List[str] = []
+    for inp in inputs:
+        if os.path.isdir(inp):
+            out.extend(sorted(glob.glob(os.path.join(inp, REQUEST_GLOB))))
+    return out
+
+
+def request_events(path: str, base: float) -> List[dict]:
+    """Chrome events from one request journal: one timeline row per
+    SLOT (pid "serving <pid>", tid = slot), each finished request a
+    span from original arrival to finish with its queue / prefill /
+    decode phases as nested sub-spans (Chrome nests same-tid complete
+    events by containment)."""
+    anchor, records = load_stream(path)
+
+    def wall(ts):
+        if anchor is None:
+            return ts
+        return anchor["wall"] + (ts - anchor["mono"])
+
+    os_pid = (anchor or {}).get("pid", 0)
+    pid = f"serving {os_pid}"
+    out: List[dict] = [{"name": "process_name", "ph": "M",
+                        "pid": pid, "tid": 0,
+                        "args": {"name": f"serving requests "
+                                         f"(pid {os_pid})"}}]
+    slots = set()
+    for rec in records:
+        t0, t1 = rec.get("arrival_t"), rec.get("finish_t")
+        if t0 is None or t1 is None:
+            continue
+        tid = rec.get("slot")
+        tid = -1 if tid is None else int(tid)
+        slots.add(tid)
+
+        def span(name, a, b, extra=None):
+            if a is None or b is None or b < a:
+                return
+            out.append({"name": name, "cat": "serving",
+                        "ph": "X", "pid": pid, "tid": tid,
+                        "ts": (wall(a) - base) * 1e6,
+                        "dur": (b - a) * 1e6,
+                        "args": dict(extra or {})})
+
+        span(f"req {rec.get('uid')}", t0, t1,
+             {"uid": rec.get("uid"),
+              "prompt": rec.get("prompt_tokens"),
+              "tokens": rec.get("output_tokens"),
+              "preemptions": rec.get("preemptions"),
+              "prefix_reused": rec.get("prefix_reused"),
+              "outcome": rec.get("outcome")})
+        admit, tok0 = rec.get("admit_t"), rec.get("first_token_t")
+        # queue: original arrival to (last) admission — preempted
+        # requeues fold into this bar (cumulative wait is in args)
+        span("queue", t0, admit,
+             {"wait_s_total": rec.get("queue_wait_s")})
+        span("prefill", admit, tok0)
+        span("decode", tok0, t1)
+    for tid in sorted(slots):
+        out.append({"name": "thread_name", "ph": "M",
+                    "pid": pid, "tid": tid,
+                    "args": {"name": (f"slot {tid}" if tid >= 0
+                                      else "unadmitted")}})
+    return out
+
+
+def merge(paths: Sequence[str],
+          request_paths: Sequence[str] = ()) -> dict:
     """Chrome-trace dict from per-worker streams (see module doc)."""
     streams = []
     for path in paths:
@@ -79,7 +152,12 @@ def merge(paths: Sequence[str]) -> dict:
         if not events and anchor is None:
             continue
         streams.append((path, anchor, events))
-    if not streams:
+    req_streams = []
+    for path in request_paths:
+        anchor, records = load_stream(path)
+        if records:
+            req_streams.append((path, anchor, records))
+    if not streams and not req_streams:
         raise ValueError("no kftrace events found in inputs")
 
     def wall_of(anchor: Optional[dict], ts: float) -> float:
@@ -88,8 +166,12 @@ def merge(paths: Sequence[str]) -> dict:
             return ts
         return anchor["wall"] + (ts - anchor["mono"])
 
-    base = min(wall_of(a, ev["ts"])
-               for _, a, evs in streams for ev in evs)
+    candidates = [wall_of(a, ev["ts"])
+                  for _, a, evs in streams for ev in evs]
+    candidates += [wall_of(a, rec["arrival_t"])
+                   for _, a, recs in req_streams for rec in recs
+                   if rec.get("arrival_t") is not None]
+    base = min(candidates)
     trace_events: List[dict] = []
     for i, (path, anchor, events) in enumerate(streams):
         os_pid = (anchor or {}).get("pid", i)
@@ -120,6 +202,8 @@ def merge(paths: Sequence[str]) -> dict:
                 out["ph"] = "i"
                 out["s"] = "p"
             trace_events.append(out)
+    for path, _anchor, _records in req_streams:
+        trace_events.extend(request_events(path, base))
     # stable sort so readers (and tests) see one monotonic timeline;
     # metadata events carry no ts and sort first
     trace_events.sort(key=lambda e: e.get("ts", -1.0))
@@ -142,13 +226,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "ring events already present in live streams)")
     args = p.parse_args(argv)
     paths = discover(args.inputs, include_crash=args.include_crash)
-    if not paths:
+    req_paths = discover_requests(args.inputs)
+    if not paths and not req_paths:
         p.error(f"no kftrace streams under {args.inputs}")
-    doc = merge(paths)
+    doc = merge(paths, request_paths=req_paths)
     with open(args.out, "w") as f:
         json.dump(doc, f)
     n = sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
-    print(f"kftrace-merge: {len(paths)} stream(s), {n} events "
+    print(f"kftrace-merge: {len(paths)} stream(s) + "
+          f"{len(req_paths)} request journal(s), {n} events "
           f"-> {args.out}")
     return 0
 

@@ -296,6 +296,9 @@ _def("KFT_DOCTOR_ROOFLINE", "float", 0.05,
      "Perf: roofline-fraction floor.", group=_DOCTOR)
 _def("KFT_DOCTOR_ROOFLINE_DROP", "float", 2.0,
      "Perf: required drop vs own baseline.", group=_DOCTOR)
+_def("KFT_DOCTOR_BURN", "float", 2.0,
+     "SLO: sustained error-budget burn rate that raises an "
+     "slo-violation finding.", group=_DOCTOR)
 
 _OPS = "Kernels (ops)"
 _def("KFT_FLASH_MASK_SKIP", "bool", None,
@@ -354,6 +357,37 @@ _BENCH = "Benchmarks"
 _def("KFT_SCALING_OUT", "str", None,
      "Output directory for the scaling benchmark's per-size runs.",
      group=_BENCH)
+
+_SLO = "Serving SLOs & request journal"
+_def("KFT_SLO_TTFT_MS", "float", 2000.0,
+     "SLO: time-to-first-token target in ms (0 disables the "
+     "objective).", group=_SLO)
+_def("KFT_SLO_TPOT_MS", "float", 200.0,
+     "SLO: per-output-token decode latency target in ms (0 disables "
+     "the objective).", group=_SLO)
+_def("KFT_SLO_E2E_MS", "float", 10000.0,
+     "SLO: end-to-end request latency target in ms, first arrival to "
+     "finish (0 disables the objective).", group=_SLO)
+_def("KFT_SLO_PERCENTILE", "float", 0.95,
+     "Fraction of requests in the compliance window each objective "
+     "must satisfy (the error budget is 1 - this).", group=_SLO)
+_def("KFT_SLO_WINDOW", "int", 64,
+     "Compliance window: number of most recently finished requests "
+     "the SLO gauges are computed over.", group=_SLO)
+_def("KFT_SLO_JOURNAL_RING", "int", 1024,
+     "In-memory request-journal ring capacity (finished requests kept "
+     "for /requests).", group=_SLO)
+_def("KFT_SLO_JOURNAL_MB", "float", 16.0,
+     "Rotate the kfrequests JSONL sink under KFT_TRACE_DIR once it "
+     "exceeds this size (one .1 generation is kept).", group=_SLO)
+
+_LOAD = "Load harness (kfload)"
+_def("KFT_LOAD_TIMEOUT_S", "float", 120.0,
+     "Per-request client timeout of the kfload generators.",
+     group=_LOAD)
+_def("KFT_LOAD_SEED", "int", 0,
+     "Seed for kfload's Poisson arrivals and prompt mixes.",
+     group=_LOAD)
 
 _TESTS = "Test fixtures"
 _def("KFT_TESTS_DATA_PLANE", "bool", None, test_only=True,

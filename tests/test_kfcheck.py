@@ -267,6 +267,28 @@ def test_silent_except_covers_kfprof(tmp_path):
     assert rules_fired(fs) == {"silent-except"}
 
 
+def test_silent_except_covers_slo_plane(tmp_path):
+    """The serving SLO plane (serving/slo.py) and its load harness
+    (tools/kfload.py) are inside the silent-except scope — a swallowed
+    error there silently corrupts the compliance/burn numbers the
+    plane exists to report.  The REST of serving/ stays out of scope
+    (scoped by file, like utils/rpc.py)."""
+    src = """
+        def publish(journal):
+            try:
+                journal.evaluate()
+            except Exception:
+                pass
+    """
+    for rel in ("kungfu_tpu/serving/slo.py", "tools/kfload.py"):
+        fs = run_on(tmp_path, src, relpath=rel)
+        assert rules_fired(fs) == {"silent-except"}, rel
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/serving/engine.py")
+    # the earlier slo.py fixture shares the directory: scope the
+    # assertion to the engine.py file itself
+    assert {f.rule for f in fs if f.path.endswith("engine.py")} == set()
+
+
 def test_silent_except_covers_kfsim(tmp_path):
     """The kfsim fake-trainer plane (kungfu_tpu/sim/) is inside the
     silent-except scope — it speaks the real control plane, and a fake

@@ -1,0 +1,296 @@
+"""SLO plane: request journal, compliance/burn math, detect_slo, and
+the serving front-end's /requests + request-id propagation.
+
+The math tests are exact (synthetic records with hand-picked
+timestamps); the lifecycle tests run the REAL engine/server on CPU so
+request ids are proven to propagate HTTP -> engine -> journal ->
+/requests, and a forced preemption is proven to keep the ORIGINAL
+arrival time (satellite fix: TTFT/e2e include every re-queue).
+"""
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu.models import gpt as G
+from kungfu_tpu.monitor import get_monitor
+from kungfu_tpu.monitor.history import MetricsHistory
+from kungfu_tpu.serving import DecodeEngine, Request, ServingServer
+from kungfu_tpu.serving.slo import (SLO, RequestJournal, RequestRecord,
+                                    burn_rate, evaluate, load_slos)
+
+CFG = G.GPTConfig(vocab_size=89, d_model=16, n_heads=4, n_layers=2,
+                  d_ff=32, max_seq=64, dtype=jnp.float32)
+
+
+def _params(seed=0):
+    return G.init_params(jax.random.PRNGKey(seed), CFG)
+
+
+def _rec(uid, arrival, tok0, finish, tokens=8, admit=None):
+    r = RequestRecord(uid=uid, arrival_t=arrival, prompt_tokens=4)
+    r.admit_t = tok0 if admit is None else admit
+    r.first_token_t = tok0
+    r.finish_t = finish
+    r.output_tokens = tokens
+    r.outcome = "finish"
+    return r
+
+
+# --------------------------------------------------------------- math
+def test_burn_rate_math():
+    assert burn_rate(1.0, 0.9) == 0.0
+    assert burn_rate(0.9, 0.9) == pytest.approx(1.0)   # spend = budget
+    assert burn_rate(0.75, 0.9) == pytest.approx(2.5)
+    assert burn_rate(0.0, 0.9) == pytest.approx(10.0)
+
+
+def test_evaluate_exact_window():
+    """Only the last `window` records count, and the numbers are exact:
+    4-record window, 1 violation -> compliance .75, burn 2.5 @ p90."""
+    slo = SLO("ttft", target_ms=100.0, percentile=0.9, window=4)
+    # two old violators that MUST fall out of the window...
+    recs = [_rec(i, 0.0, 10.0, 11.0) for i in range(2)]       # 10 s ttft
+    # ...then 3 compliant (50 ms) + 1 violating (200 ms)
+    recs += [_rec(2 + i, 0.0, 0.05, 0.06) for i in range(3)]
+    recs += [_rec(9, 0.0, 0.2, 0.21)]
+    st = evaluate(recs, [slo])["ttft"]
+    assert st["n"] == 4
+    assert st["compliance"] == pytest.approx(0.75)
+    assert st["burn"] == pytest.approx(2.5)
+    assert st["worst_ms"] == pytest.approx(200.0)
+    # only the 3 compliant records: window underfills, zero burn
+    st = evaluate(recs[2:-1], [slo])["ttft"]
+    assert st["n"] == 3
+    assert st["compliance"] == 1.0 and st["burn"] == 0.0
+
+
+def test_record_derived_latencies():
+    r = _rec(1, 1.0, 1.5, 2.5, tokens=11)
+    assert r.ttft_ms() == pytest.approx(500.0)
+    assert r.e2e_ms() == pytest.approx(1500.0)
+    assert r.tpot_ms() == pytest.approx(100.0)     # 1 s / 10 intervals
+    r.queue_wait_s = 0.4
+    ph = r.phase_s()
+    assert ph["queue"] == pytest.approx(0.4)
+    assert ph["decode"] == pytest.approx(1.0)
+
+
+def test_load_slos_zero_target_disables(monkeypatch):
+    env = {"KFT_SLO_TTFT_MS": "250", "KFT_SLO_TPOT_MS": "0",
+           "KFT_SLO_E2E_MS": "0", "KFT_SLO_PERCENTILE": "0.5",
+           "KFT_SLO_WINDOW": "7"}
+    slos = load_slos(env)
+    assert [(s.objective, s.target_ms, s.percentile, s.window)
+            for s in slos] == [("ttft", 250.0, 0.5, 7)]
+
+
+# ------------------------------------------------------------ journal
+def test_journal_ring_bound_and_jsonl_rotation(tmp_path):
+    j = RequestJournal(ring=4, sink_dir=str(tmp_path), max_bytes=1,
+                       slos=[SLO("ttft", 100.0, 0.9, 4)])
+    for i in range(40):
+        j.on_submit(i, float(i), 4)
+        j.on_admit(i, i + 0.01, slot=0, prefix_reused=0, wait_s=0.01)
+        j.on_first_token(i, i + 0.02)
+        j.on_finish(i, i + 0.05, output_tokens=4)
+    done = j.finished()
+    assert len(done) == 4                         # ring bound holds
+    assert [r.uid for r in done] == [36, 37, 38, 39]
+    # max_bytes clamps at 4096, 40 records overflow it -> one rotation
+    # generation exists and BOTH streams start with an anchor record
+    rotated = tmp_path / f"{j.sink_path}.1".split("/")[-1]
+    assert rotated.exists(), list(tmp_path.iterdir())
+    for path in (j.sink_path, str(rotated)):
+        first = json.loads(open(path).readline())
+        assert first["kind"] == "anchor" and "wall" in first
+    j.close()
+
+
+def test_journal_evict_open_closes_dangling(tmp_path):
+    j = RequestJournal(ring=8, sink_dir=str(tmp_path),
+                       slos=[SLO("ttft", 100.0, 0.9, 4)])
+    j.on_submit(1, 0.0, 4)
+    j.on_submit(2, 0.0, 4)
+    evicted = j.evict_open("test-teardown")
+    assert {r.uid for r in evicted} == {1, 2}
+    assert all(r.outcome == "evict" for r in j.finished())
+    assert j.snapshot()["open"] == []
+    j.close()
+
+
+# ------------------------------------------- preemption (satellite 1)
+def test_preemption_keeps_original_arrival_and_counts(tmp_path,
+                                                      monkeypatch):
+    """A forced preemption must NOT re-stamp the journal's arrival
+    (TTFT/e2e include the full wait), must count on the record AND the
+    `kungfu_tpu_serving_preemptions_total` counter, and the request
+    still finishes (preempt-then-finish)."""
+    monkeypatch.setenv("KFT_TRACE_DIR", "")
+    params = _params()
+    rng = np.random.RandomState(5)
+    reqs = [Request(uid=i,
+                    prompt=rng.randint(0, CFG.vocab_size, 8).tolist(),
+                    max_new=12)
+            for i in range(3)]
+    # same shape as test_serving's preemption fixture: 9 usable blocks
+    # of 4 cannot hold three full-length sequences
+    eng = DecodeEngine(params, CFG, num_slots=3, block_size=4,
+                       num_blocks=10, prompt_buckets=(8,))
+    res = eng.run(reqs)
+    assert eng.stats.preemptions >= 1
+    assert set(res) == {0, 1, 2}                  # all finished anyway
+    done = {r.uid: r for r in eng.journal.finished()}
+    assert set(done) == {0, 1, 2}
+    preempted = [r for r in done.values() if r.preemptions > 0]
+    assert preempted, "journal recorded no preemption"
+    for r in preempted:
+        # original arrival preserved: the second admission happened
+        # strictly later, and the cumulative wait saw both queues
+        assert r.admit_t > r.arrival_t
+        assert r.queue_wait_s > 0.0
+        assert r.outcome == "finish"
+        # first token is set ONCE: it precedes the final finish even
+        # though the replay re-prefilled after the preemption
+        assert r.first_token_t is not None
+        assert r.first_token_t <= r.finish_t
+    text = get_monitor().render_metrics()
+    assert "kungfu_tpu_serving_preemptions_total" in text
+    assert 'reason="kv-pressure"' in text
+    assert "kungfu_tpu_serving_cumulative_wait_seconds" in text
+
+
+# --------------------------------------------------------- detect_slo
+def _burn_snapshot(burn, compliance=0.2, queue=0.9, decode=0.05):
+    return "\n".join([
+        f'kungfu_tpu_slo_budget_burn{{objective="ttft"}} {burn}',
+        f'kungfu_tpu_slo_compliance{{objective="ttft"}} {compliance}',
+        'kungfu_tpu_slo_worst_ms{objective="ttft"} 900.0',
+        f'kungfu_tpu_serving_phase_share{{phase="queue"}} {queue}',
+        'kungfu_tpu_serving_phase_share{phase="prefill"} 0.05',
+        f'kungfu_tpu_serving_phase_share{{phase="decode"}} {decode}',
+    ]) + "\n"
+
+
+def test_detect_slo_sustained_burn_fires_with_phase_evidence():
+    from kungfu_tpu.monitor.doctor import detect_slo
+    h = MetricsHistory(window=16)
+    for i in range(3):
+        h.observe_text("i0", _burn_snapshot(8.0), ts=100.0 + i)
+    fs = detect_slo(h, burn=2.0, min_windows=3, ranks={"i0": 0})
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.kind == "slo-violation" and f.rank == 0
+    assert f.severity == "critical"               # 8.0 > 2 * threshold
+    assert f.evidence["objective"] == "ttft"
+    assert f.evidence["dominant_phase"] == "queue"
+    assert f.evidence["worst_ms"] == pytest.approx(900.0)
+    assert "admission-bound" in f.action
+
+
+def test_detect_slo_single_spike_stays_silent():
+    """One bad window inside the budget discipline must NOT page —
+    only `min_windows` CONSECUTIVE burning scrapes do."""
+    from kungfu_tpu.monitor.doctor import detect_slo
+    h = MetricsHistory(window=16)
+    h.observe_text("i0", _burn_snapshot(8.0), ts=100.0)
+    h.observe_text("i0", _burn_snapshot(0.0, compliance=1.0), ts=101.0)
+    h.observe_text("i0", _burn_snapshot(8.0), ts=102.0)
+    assert detect_slo(h, burn=2.0, min_windows=3,
+                      ranks={"i0": 0}) == []
+    # and a decode-dominated sustained burn names the decode action
+    h2 = MetricsHistory(window=16)
+    for i in range(3):
+        h2.observe_text("i0", _burn_snapshot(3.0, queue=0.01,
+                                             decode=0.9),
+                        ts=100.0 + i)
+    (f,) = detect_slo(h2, burn=2.0, min_windows=3)
+    assert f.evidence["dominant_phase"] == "decode"
+    assert f.severity == "warn"                   # 3.0 <= 2 * 2.0
+
+
+def test_kft_doctor_cli_reports_slo_violation(tmp_path, capsys):
+    """The acceptance loop offline: a saved history with sustained burn
+    must surface through the real `kft-doctor --history --json` CLI."""
+    from kungfu_tpu.monitor.doctor import main as doctor_main
+    h = MetricsHistory(window=16)
+    for i in range(4):
+        h.observe_text("127.0.0.1:8100", _burn_snapshot(8.0),
+                       ts=100.0 + i)
+    path = str(tmp_path / "history.jsonl")
+    h.save(path)
+    rc = doctor_main(["--history", path, "--json"])
+    assert rc == 0
+    rows = json.loads(capsys.readouterr().out)
+    kinds = {r["kind"] for r in rows}
+    assert "slo-violation" in kinds, rows
+    rc = doctor_main(["--history", path, "--fail-on-critical"])
+    assert rc == 1                                # CI gate flavor
+
+
+# ------------------------------------------- server: /requests + ids
+@pytest.fixture()
+def served(tmp_path, monkeypatch):
+    monkeypatch.setenv("KFT_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("KFT_SLO_WINDOW", "8")
+    eng = DecodeEngine(_params(), CFG, num_slots=2, block_size=4,
+                       num_blocks=16, prompt_buckets=(8,),
+                       decode_chunk=2)
+    srv = ServingServer(eng, port=0).start()
+    yield srv, tmp_path
+    srv.close()
+
+
+def _post(srv, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_requests_endpoint_propagates_request_ids(served):
+    """Two real requests through HTTP: the uids the server replies
+    with are the SAME ids the journal, /requests, and the kfrequests
+    JSONL stream carry — end-to-end request-id propagation."""
+    srv, trace_dir = served
+    r1 = _post(srv, {"prompt": [1, 2, 3, 4], "max_new": 4})
+    r2 = _post(srv, {"prompt": [5, 6, 7], "max_new": 3})
+    uids = {r1["uid"], r2["uid"]}
+    assert len(uids) == 2
+    with urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/requests?n=8",
+            timeout=30) as r:
+        snap = json.loads(r.read())
+    fin = {rec["uid"]: rec for rec in snap["finished"]}
+    assert uids <= set(fin)
+    for uid in uids:
+        rec = fin[uid]
+        assert rec["outcome"] == "finish"
+        assert rec["ttft_ms"] is not None and rec["ttft_ms"] > 0
+        assert rec["e2e_ms"] >= rec["ttft_ms"]
+    assert fin[r1["uid"]]["output_tokens"] == len(r1["tokens"])
+    # the SLO block evaluates over these same requests
+    assert "ttft" in snap["slo"] and snap["slo"]["ttft"]["n"] >= 2
+    # ?n= caps the finished tail (bad values fall back, not 500)
+    with urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/requests?n=1",
+            timeout=30) as r:
+        assert len(json.loads(r.read())["finished"]) == 1
+    # the JSONL sink carries the same uids under KFT_TRACE_DIR
+    streams = list(trace_dir.glob("kfrequests.*.jsonl"))
+    assert len(streams) == 1
+    recs = [json.loads(ln) for ln in
+            streams[0].read_text().splitlines() if ln]
+    assert recs[0]["kind"] == "anchor"
+    assert uids <= {r.get("uid") for r in recs[1:]}
+    # and the SLO gauges are live on /metrics
+    with urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/metrics", timeout=30) as r:
+        body = r.read().decode()
+    assert 'kungfu_tpu_slo_compliance{objective="ttft"}' in body
+    assert 'kungfu_tpu_slo_budget_burn{objective="ttft"}' in body

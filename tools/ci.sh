@@ -84,6 +84,16 @@ python tools/kfprof_report.py --smoke || exit 1
 say "0e/3 kfsim control-plane smoke"
 python -m kungfu_tpu.chaos.runner --scenario sim-smoke || exit 1
 
+# kfload smoke (`make load-smoke`): spawn a tiny CPU serving server,
+# sweep 3 open-loop Poisson rungs with client-side TTFT/TPOT timing,
+# and assert the whole serving observability loop — SERVING_BENCH.json
+# shape, SLO gauges on /metrics, the /requests journal, and a
+# kftrace+kfrequests Chrome-trace merge round-trip.  Single-process
+# CPU jax: no data-plane gate, must never self-skip (~45 s;
+# docs/serving.md "SLOs, the request journal and kfload")
+say "0f/3 kfload serving SLO smoke"
+python tools/kfload.py --smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1
@@ -150,6 +160,15 @@ else
   python -m kungfu_tpu.chaos.runner --scenario straggler-doctor || fail=1
   python -m kungfu_tpu.chaos.runner \
       --scenario straggler-doctor-clean || fail=1
+
+  # SLO doctor proof: delay every serving admission on a LIVE CPU
+  # serving server; the doctor scraping its /metrics must raise an
+  # slo-violation finding naming the instance (queue-dominated burn),
+  # and the clean twin must stay silent.  Serving tier = single-process
+  # CPU jax: no data-plane gate, never self-skips (docs/serving.md).
+  say "2f/3 kfchaos slo-doctor (+ clean twin)"
+  python -m kungfu_tpu.chaos.runner --scenario slo-doctor || fail=1
+  python -m kungfu_tpu.chaos.runner --scenario slo-doctor-clean || fail=1
 fi
 
 say "3/3 dryrun_multichip(8)"

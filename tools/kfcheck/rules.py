@@ -282,9 +282,13 @@ class SilentExcept(Rule):
            "error in control-plane code — peer death and resize failures "
            "vanish instead of driving recovery")
     # utils/rpc.py is control-plane code living under utils (the
-    # kfguard rpc client): scoped by file, not by widening all of utils
+    # kfguard rpc client): scoped by file, not by widening all of
+    # utils; serving/slo.py and tools/kfload.py are the SLO plane and
+    # its load harness — a swallowed error there silently corrupts the
+    # very numbers the plane exists to report
     path_filter = (r"(^|/)(elastic|launcher|comm|chaos|store|trace"
-                   r"|monitor|sim)(/|$)|(^|/)utils/rpc\.py$")
+                   r"|monitor|sim)(/|$)|(^|/)utils/rpc\.py$"
+                   r"|(^|/)serving/slo\.py$|(^|/)tools/kfload\.py$")
 
     BROAD = {"Exception", "BaseException"}
 
