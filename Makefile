@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -77,6 +77,15 @@ load-smoke:
 slo-smoke:
 	python -m kungfu_tpu.chaos.runner --scenario slo-doctor
 	python -m kungfu_tpu.chaos.runner --scenario slo-doctor-clean
+
+# kfnet smoke: the data-movement observability plane on CPU — the
+# per-peer bandwidth matrix out of /cluster_metrics, the
+# state-movement ledger families, and the report CLI's --history
+# round trip (docs/monitoring.md "Transport (kfnet)").  The slowlink
+# doctor proof runs as chaos scenarios: sim-slowlink-doctor-100 /
+# sim-slowlink-doctor-clean.
+net-smoke:
+	python tools/kfnet_report.py --smoke
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

@@ -22,7 +22,13 @@ Two numbers per worker:
   Reported as the fraction of pulls that completed within the step,
   i.e. how much of the exchange the compute actually hides.
 
-Run (spawns workers through the launcher):
+Since kfnet the artifact also carries a per-phase breakdown
+(``schema: p2p-phase-v1``): serialize / wire / deserialize GiB/s for
+the whole-blob pull and for the chunked ``{key}.cN`` tier — the
+committed P2P_BENCH.json baseline transport optimisations must beat.
+
+Run (spawns workers through the launcher; ``tools/bench_p2p.py`` is
+the repo-root wrapper):
 
     python -m kungfu_tpu.benchmarks.p2p -np 4 --size-mb 100 --secs 3
 
@@ -100,10 +106,69 @@ def _worker(args) -> None:
     hid_secs = time.perf_counter() - t0
     hid_rate = hidden_total * model.nbytes / hid_secs / (1 << 30)
 
+    # --- per-phase breakdown (kfnet: P2P_BENCH schema p2p-phase-v1) --
+    # where a pull's time goes, phase by phase: serialize (the
+    # publisher's ascontiguous + kft_save), wire (the socket pull into
+    # a reused destination — the sync loop's rate, re-measured inside
+    # the same iteration), deserialize (the consumer-side copy out of
+    # the pull buffer into the arrays compute reads).  A distinct key
+    # for the serialize loop keeps the re-publish from racing peers
+    # still pulling "model".
+    consumer = np.empty_like(model)
+    ph = {"serialize": 0.0, "wire": 0.0, "deserialize": 0.0}
+    ph_bytes = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        t = time.perf_counter()
+        p.save("phase-probe", model, version=0)
+        ph["serialize"] += time.perf_counter() - t
+        t = time.perf_counter()
+        got = p.request(peer, "model", model, version=0, out=dst)
+        ph["wire"] += time.perf_counter() - t
+        t = time.perf_counter()
+        np.copyto(consumer, got)
+        ph["deserialize"] += time.perf_counter() - t
+        ph_bytes += got.nbytes
+    phase_gib = {k: (ph_bytes / v / (1 << 30) if v > 0 else 0.0)
+                 for k, v in ph.items()}
+
+    # --- chunked-leaf tier (the PR-4 `{key}.cN` shape) ---------------
+    # the same phases when the model moves as bounded chunks: per-chunk
+    # wire pulls + per-chunk reassembly copies, the pattern ModelStore
+    # uses for multi-GB leaves
+    nchunks = 8
+    per = max(1, n_f32 // nchunks)
+    for j in range(nchunks):
+        p.save(f"model.c{j}", model[j * per:(j + 1) * per], version=0)
+    p.barrier(name="p2p-bench-chunks")
+    cdst = np.empty(per, np.float32)
+    cph = {"wire": 0.0, "deserialize": 0.0}
+    c_bytes = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        for j in range(nchunks):
+            tmpl = model[j * per:(j + 1) * per]
+            t = time.perf_counter()
+            got = p.request(peer, f"model.c{j}", tmpl, version=0,
+                            out=cdst[:tmpl.size])
+            cph["wire"] += time.perf_counter() - t
+            t = time.perf_counter()
+            np.copyto(consumer[j * per:j * per + tmpl.size], got)
+            cph["deserialize"] += time.perf_counter() - t
+            c_bytes += got.nbytes
+    chunk_gib = {k: (c_bytes / v / (1 << 30) if v > 0 else 0.0)
+                 for k, v in cph.items()}
+
     p.barrier(name="p2p-bench-end")
     row = np.asarray([sync_gib, hid_rate,
                       hidden_done / max(1, hidden_total),
-                      fresh_gib], np.float64)
+                      fresh_gib,
+                      phase_gib["serialize"], phase_gib["wire"],
+                      phase_gib["deserialize"],
+                      chunk_gib["wire"], chunk_gib["deserialize"]],
+                     np.float64)
     allrows = p.gather(row, root=0, name="p2p-bench-rows")
     if rank == 0:
         shm = p.shm_bytes()
@@ -122,6 +187,23 @@ def _worker(args) -> None:
             "sync_pull_fresh_alloc_gib_s": round(
                 float(allrows[:, 3].mean()), 3),
             "shm_lane_bytes": int(shm),
+            # kfnet per-phase schema: the committed baseline the
+            # transport optimisation work must beat, phase by phase
+            "schema": "p2p-phase-v1",
+            "phases": {
+                "pull": {
+                    "serialize_gib_s": round(
+                        float(allrows[:, 4].mean()), 3),
+                    "wire_gib_s": round(float(allrows[:, 5].mean()), 3),
+                    "deserialize_gib_s": round(
+                        float(allrows[:, 6].mean()), 3),
+                },
+                "pull_chunked": {
+                    "wire_gib_s": round(float(allrows[:, 7].mean()), 3),
+                    "deserialize_gib_s": round(
+                        float(allrows[:, 8].mean()), 3),
+                },
+            },
         }
         print("RESULT " + json.dumps(doc), flush=True)
         if args.out:

@@ -322,6 +322,17 @@ class Scenario:
     sim_heartbeat_s: float = 0.5   # lease renewal cadence (workers)
     sim_lease_ttl_s: float = 6.0   # watcher escalation age (runner)
     sim_drain_s: float = 120.0     # final-consensus poll budget
+    # kfnet chaos surface: synthetic per-peer traffic per step (0 =
+    # off) and which ranks' INGRESS is throttled, by what divisor —
+    # the slowlink-doctor proof scenarios
+    sim_net_bytes: int = 0
+    sim_net_slow_ranks: Sequence[int] = ()
+    sim_net_slow_factor: float = 8.0
+    # rate-gauge window for the fake workers (KFT_NET_RATE_PERIOD_S):
+    # oversubscribed fleets (100 procs on few cores) starve workers for
+    # seconds at a time, and a short window would read a scheduling
+    # stall as a dead link — widen it so only a REAL throttle shows
+    sim_net_rate_period_s: float = 1.0
     # scenario-level proof floors (0 = unchecked, both tiers): at least
     # this many journal fires / distinct observed config versions
     min_fired: int = 0

@@ -150,12 +150,21 @@ class DistributedElasticTrainer:
         step whose update came from a rank that never committed it,
         silently skipping data; rank 0's (state, counters) pair is
         always consistent."""
+        from ..monitor import net as _net
         _chaos_point("elastic.sync_state.begin", rank=self.peer.rank,
                      step=self.step_count, version=self.version)
         with _trace_span("elastic.sync_state", category="elastic",
                          rank=self.peer.rank, step=self.step_count,
-                         version=self.version):
-            self._sync_state_inner()
+                         version=self.version), \
+                _net.Transfer("resize.sync",
+                              direction=("egress" if self.peer.rank == 0
+                                         else "ingress"),
+                              rank=self.peer.rank,
+                              version=self.version) as xf:
+            with xf.phase("wire"):
+                self._sync_state_inner()
+            xf.add(_net.tree_bytes(self._host_params)
+                   + _net.tree_bytes(self._host_opt))
 
     def _sync_state_inner(self) -> None:
         self._host_params = D.broadcast_host_tree(

@@ -348,10 +348,16 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         _chaos_point("elastic.sync_state.begin",
                      rank=None if p is None else p.rank,
                      step=self.step_count, version=self.version)
+        from ..monitor import net as _net
         with _trace_span("elastic.sync_state", category="elastic",
                          rank=None if p is None else p.rank,
-                         step=self.step_count, version=self.version):
-            self._sync_resharded(p, nproc)
+                         step=self.step_count, version=self.version), \
+                _net.Transfer("resize.sync",
+                              rank=None if p is None else p.rank,
+                              version=self.version) as xf:
+            with xf.phase("wire"):
+                self._sync_resharded(p, nproc)
+            xf.add(_net.tree_bytes(self._synced))
 
     def _sync_resharded(self, p, nproc: int) -> None:
         newest = max(self._held_meta) if self._held_meta else _NO_SEQ

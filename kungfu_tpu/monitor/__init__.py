@@ -130,6 +130,31 @@ _HELP = {
     "kungfu_tpu_slo_worst_ms":
         "Serving SLO: worst observed value (ms) per objective in the "
         "current compliance window (doctor evidence).",
+    "kungfu_tpu_egress_bytes_rate":
+        "kfnet: egress bytes/sec per target over the scrape window "
+        "(decays to zero when a target goes idle; ctrl:-prefixed "
+        "targets are control-plane traffic).",
+    "kungfu_tpu_ingress_bytes_rate":
+        "kfnet: ingress bytes/sec per target over the scrape window "
+        "(the pull-bandwidth series detect_slowlink compares across "
+        "workers).",
+    "kungfu_tpu_net_transfer_seconds":
+        "kfnet ledger: wall time of one logical state movement, per op "
+        "(store.save/store.load/p2p.pull/state.adopt/resize.sync).",
+    "kungfu_tpu_net_phase_seconds":
+        "kfnet ledger: per-phase wall time within a transfer "
+        "(serialize/copy/wire/deserialize), per op.",
+    "kungfu_tpu_state_moved_bytes_total":
+        "kfnet ledger: cumulative payload bytes moved by state "
+        "movements (snapshot publish, peer pulls, resize adoption), "
+        "per op.",
+    "kungfu_tpu_state_move_gib_s":
+        "kfnet ledger: effective GiB/s of the last completed state "
+        "movement, per op.",
+    "kungfu_tpu_peer_bandwidth_bytes_s":
+        "Cluster bandwidth matrix: per-link bytes/sec between src and "
+        "dst workers, joined from per-worker rate gauges by "
+        "cluster.aggregate (direction names the measuring side).",
 }
 
 # satellite guard: a buggy caller labeling by request id would grow the
@@ -180,10 +205,11 @@ def allreduce_bytes_on_wire(payload_bytes: int, n: int,
 class RateCounter:
     """Accumulates bytes; reports rate over the sampling window."""
 
-    def __init__(self) -> None:
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock  # injectable for window-semantics tests
         self._lock = threading.Lock()
         self._total = 0
-        self._window_start = time.monotonic()
+        self._window_start = clock()
         self._window_bytes = 0
         self._last_rate = 0.0
         self._rolled = False  # becomes True once the first window closed
@@ -209,9 +235,17 @@ class RateCounter:
         yet, but traffic may well have flowed — a scrape right after
         startup must not report 0.0, so the not-yet-rolled first window
         reports its partial ``window_bytes/dt`` instead.
+
+        A target that stops receiving :meth:`add` must not report the
+        last window's rate forever: within one period the held rate is
+        unchanged (concurrent readers of the same window must agree
+        exactly), but the roll of an EMPTY window pins the rate at 0.0
+        — an idle target reads zero after at most one period.  An
+        active counter is unaffected (its open window has bytes almost
+        immediately).
         """
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             dt = now - self._window_start
             if dt < period:
                 if not self._rolled and dt > 0.0:
@@ -302,6 +336,7 @@ class Monitor:
         self._lock = threading.Lock()
         self._max_labelsets = knobs.get("KFT_METRIC_MAX_LABELSETS",
                                         default=DEFAULT_MAX_LABELSETS)
+        self._rate_period = knobs.get("KFT_NET_RATE_PERIOD_S")
         self._labelsets: Dict[str, int] = {}   # metric -> distinct keys
         self._cap_warned: set = set()
 
@@ -331,6 +366,22 @@ class Monitor:
         with self._lock:
             keys = list(self._egress)
         return {k: self._egress[k].rate() for k in keys}
+
+    def ingress_rates(self) -> Dict[str, float]:
+        with self._lock:
+            keys = list(self._ingress)
+        return {k: self._ingress[k].rate() for k in keys}
+
+    def prune_targets(self, targets: Sequence[str]) -> None:
+        """Drop per-target egress/ingress counters for peers that left
+        the membership (call with old_specs - new_specs at a resize).
+        Without this, /metrics keeps publishing byte totals and a
+        decaying-but-present rate series for workers that no longer
+        exist, and the bandwidth matrix grows a ghost row per resize."""
+        with self._lock:
+            for t in targets:
+                self._egress.pop(t, None)
+                self._ingress.pop(t, None)
 
     # ------------------------------------------------- summaries / gauges
     @staticmethod
@@ -422,6 +473,23 @@ class Monitor:
         for k, c in sorted(ig.items()):
             lines.append(f'kungfu_tpu_ingress_bytes_total'
                          f'{{target="{_esc(k)}"}} {c.total()}')
+        # kfnet: the rate view of the same tables — scrape cadence is
+        # the window cadence, so each scrape advances the RateCounter
+        # windows the slowlink detector compares across workers
+        if eg:
+            lines += _meta_lines("kungfu_tpu_egress_bytes_rate",
+                                 "gauge", seen)
+        for k, c in sorted(eg.items()):
+            lines.append(f'kungfu_tpu_egress_bytes_rate'
+                         f'{{target="{_esc(k)}"}} '
+                         f'{c.rate(self._rate_period):.9g}')
+        if ig:
+            lines += _meta_lines("kungfu_tpu_ingress_bytes_rate",
+                                 "gauge", seen)
+        for k, c in sorted(ig.items()):
+            lines.append(f'kungfu_tpu_ingress_bytes_rate'
+                         f'{{target="{_esc(k)}"}} '
+                         f'{c.rate(self._rate_period):.9g}')
         for (metric, labels), val in sorted(gauges.items()):
             lines += _meta_lines(metric, "gauge", seen)
             lines.append(f"{metric}{_labels_str(dict(labels))} {val:.9g}")

@@ -26,7 +26,7 @@ from typing import Iterable, List, Optional, Tuple
 from . import MONITOR_PORT_OFFSET, _esc
 
 __all__ = ["scrape", "merge_metrics", "aggregate", "phase_shares",
-           "MONITOR_PORT_OFFSET"]
+           "peer_rates", "MONITOR_PORT_OFFSET"]
 
 # `name{labels} value` | `name value` (+ optional timestamp); group 1 =
 # metric name, 2 = existing label body (no braces), 3 = rest
@@ -110,6 +110,31 @@ def phase_shares(text: str) -> "dict":
     return {p: v / grand for p, v in sorted(totals.items())}
 
 
+# kfnet per-target throughput out of a worker's raw exposition:
+# kungfu_tpu_{e,in}gress_bytes_rate{target="..."} <v>
+_RATE_RE = re.compile(
+    r'^kungfu_tpu_(egress|ingress)_bytes_rate'
+    r'\{target="([^"]*)"\} ([0-9eE.+-]+)$')
+
+
+def peer_rates(text: str) -> "dict":
+    """kfnet rate gauges out of one worker's /metrics text:
+    ``{(direction, target): bytes_per_sec}``.  Every target is kept —
+    mesh estimates ("ici"), control-plane servers ("ctrl:host:port")
+    and real peers ("host:port") — so the matrix join can classify by
+    target shape.  Empty dict when the worker publishes no rates yet."""
+    rates: dict = {}
+    for line in text.splitlines():
+        m = _RATE_RE.match(line.strip())
+        if not m:
+            continue
+        try:
+            rates[(m.group(1), m.group(2))] = float(m.group(3))
+        except ValueError:
+            continue
+    return rates
+
+
 def aggregate(targets: Iterable[Tuple[str, int]],
               timeout: float = 2.0,
               history: Optional["object"] = None) -> str:
@@ -127,6 +152,7 @@ def aggregate(targets: Iterable[Tuple[str, int]],
     scraped: List[Tuple[str, str]] = []
     ups: List[Tuple[str, int]] = []
     shares: List[Tuple[str, "dict"]] = []
+    links: List[Tuple[str, str, str, float]] = []  # src, dst, dir, rate
     for host, port in targets:
         instance = f"{host}:{port}"
         try:
@@ -137,6 +163,15 @@ def aggregate(targets: Iterable[Tuple[str, int]],
             sh = phase_shares(text)
             if sh:
                 shares.append((instance, sh))
+            for (direction, tgt), rate in sorted(peer_rates(text).items()):
+                # the measuring side is `instance`: its egress rate is
+                # the link instance->target, its ingress rate the link
+                # target->instance.  Both are kept — a disagreement
+                # between the two measurements of one link IS the
+                # asymmetry evidence detect_slowlink names.
+                src, dst = ((instance, tgt) if direction == "egress"
+                            else (tgt, instance))
+                links.append((src, dst, direction, rate))
             if history is not None:
                 history.observe_text(instance, text)
         except (OSError, ValueError, http.client.HTTPException) as e:
@@ -169,4 +204,17 @@ def aggregate(targets: Iterable[Tuple[str, int]],
                     f'kungfu_tpu_step_phase_share{{'
                     f'instance="{_esc(instance)}",'
                     f'phase="{_esc(phase)}"}} {frac:.6f}')
+    if links:
+        # kfnet bandwidth matrix: every worker's per-target rate gauges
+        # joined into N×N link gauges, pre-digested so one scrape of
+        # /cluster_metrics feeds kfnet_report and detect_slowlink
+        up_lines.append("# HELP kungfu_tpu_peer_bandwidth_bytes_s "
+                        "kfnet bandwidth matrix: per-link bytes/sec "
+                        "(direction = which side measured).")
+        up_lines.append("# TYPE kungfu_tpu_peer_bandwidth_bytes_s gauge")
+        for src, dst, direction, rate in links:
+            up_lines.append(
+                f'kungfu_tpu_peer_bandwidth_bytes_s{{'
+                f'direction="{_esc(direction)}",dst="{_esc(dst)}",'
+                f'src="{_esc(src)}"}} {rate:.9g}')
     return body + "\n".join(up_lines) + "\n"

@@ -31,7 +31,13 @@ interprets them.  This module is that layer:
     dropped ``KFT_DOCTOR_ROOFLINE_DROP``x against its own baseline for
     ``KFT_DOCTOR_WINDOWS`` windows; the Finding's ``kind`` names the
     dominant step phase (compute-bound / collective-bound /
-    input-bound / host-bound) with the phase shares as evidence.
+    input-bound / host-bound) with the phase shares as evidence;
+  * **slowlink** (kfnet, monitor/net.py) — an instance whose per-peer
+    pull bandwidth (the ``kungfu_tpu_ingress_bytes_rate`` gauges over
+    real peer targets) sits below the cluster lower-median by
+    ``KFT_DOCTOR_SLOWLINK``x for ``KFT_DOCTOR_WINDOWS`` scrape
+    windows; the evidence carries the instance's bandwidth-matrix row
+    and egress-vs-ingress asymmetry naming the slow direction.
 
 - :class:`Doctor` wraps history + detectors + export: findings are
   kftrace-traced on raise/clear, exported as
@@ -66,7 +72,7 @@ from .history import MetricsHistory
 __all__ = ["Finding", "Doctor", "PeerLatencyProber", "render_report",
            "detect_stragglers", "detect_interference",
            "detect_control_plane", "detect_perf", "detect_slo",
-           "RUNNER_INSTANCE"]
+           "detect_slowlink", "RUNNER_INSTANCE"]
 
 # the launcher's own metrics live in the history under this pseudo
 # instance (lease ages, rpc outage gauges — the control-plane signals)
@@ -181,6 +187,96 @@ def detect_stragglers(history: MetricsHistory, *,
             action="inspect the host (co-tenancy, thermal throttle, IO); "
                    "if persistent, exclude the rank via propose_exclusion "
                    "or rebalance its shard",
+            version=version, detected_ts=time.time()))
+    return findings
+
+
+def _peer_bw(snap, direction: str) -> Dict[str, float]:
+    """Per-peer data-plane bytes/sec out of one snapshot: the kfnet
+    rate gauges whose target names a real worker (``host:port``) —
+    mesh estimates ("ici", "dcn") and ``ctrl:``-prefixed control-plane
+    servers are overhead, not pull bandwidth."""
+    metric = f"kungfu_tpu_{direction}_bytes_rate"
+    out: Dict[str, float] = {}
+    for (name, lab), v in snap.samples.items():
+        if name != metric:
+            continue
+        tgt = dict(lab).get("target", "")
+        if ":" in tgt and not tgt.startswith("ctrl:"):
+            out[tgt] = out.get(tgt, 0.0) + v
+    return out
+
+
+def detect_slowlink(history: MetricsHistory, *,
+                    factor: float = 4.0, min_bps: float = 1024.0,
+                    min_windows: int = 3, stale_s: float = 60.0,
+                    ranks: Optional[Dict[str, int]] = None,
+                    version: Optional[int] = None) -> List[Finding]:
+    """Per-rank pull-bandwidth skew (kfnet): an instance whose summed
+    per-peer ingress rate sits below the cluster (lower-)median by
+    ``factor``x in each of the last ``min_windows`` scrape windows.
+
+    Stale instances are excluded before comparison (a departed worker's
+    frozen rates must not drag the median).  An idle cluster (median
+    below ``min_bps`` in any window) is inconclusive — no bandwidth, no
+    comparison.  The evidence carries the instance's bandwidth-matrix
+    row (slowest peers first) and an egress-vs-ingress asymmetry check:
+    ``slow_direction="ingress"`` means the push side is healthy, so the
+    fault sits on the pull path, not the whole host."""
+    ingress: Dict[str, List[Dict[str, float]]] = {}
+    egress: Dict[str, List[float]] = {}
+    for inst in _fresh_instances(history, stale_s):
+        snaps = history.snapshots(inst)
+        if len(snaps) < min_windows:
+            continue
+        rows = [_peer_bw(s, "ingress") for s in snaps[-min_windows:]]
+        if not all(rows):
+            continue  # a window with no peer series is inconclusive
+        ingress[inst] = rows
+        egress[inst] = [sum(_peer_bw(s, "egress").values())
+                        for s in snaps[-min_windows:]]
+    if len(ingress) < 2:
+        return []
+    totals = {inst: [sum(r.values()) for r in rows]
+              for inst, rows in ingress.items()}
+    medians = [_lower_median([vals[w] for vals in totals.values()])
+               for w in range(min_windows)]
+    if any(m < min_bps for m in medians):
+        return []
+    eg_medians = [_lower_median([vals[w] for vals in egress.values()])
+                  for w in range(min_windows)]
+    findings: List[Finding] = []
+    for inst, vals in sorted(totals.items()):
+        ratios = [v / m for v, m in zip(vals, medians)]
+        if not all(r < 1.0 / factor for r in ratios):
+            continue
+        eg = egress[inst]
+        eg_slow = all(m > 0 and v < m / factor
+                      for v, m in zip(eg, eg_medians))
+        mean_ratio = sum(ratios) / len(ratios)
+        evidence: Dict[str, object] = {
+            "pull_bw_bps": round(vals[-1], 1),
+            "cluster_median_bps": round(medians[-1], 1),
+            "ratio": round(mean_ratio, 4),
+            "egress_bw_bps": round(eg[-1], 1),
+            "slow_direction": "both" if eg_slow else "ingress",
+        }
+        for tgt, bw in sorted(ingress[inst][-1].items(),
+                              key=lambda kv: kv[1])[:4]:
+            evidence[f"bw_from_{tgt}"] = round(bw, 1)
+        findings.append(Finding(
+            kind="slowlink",
+            severity=(SEV_CRITICAL if mean_ratio < 0.5 / factor
+                      else SEV_WARN),
+            instance=inst,
+            rank=(ranks or {}).get(inst),
+            windows=min_windows,
+            evidence=evidence,
+            action="inspect the host's network path (NIC negotiation, "
+                   "throttling, cross-rack route); if slow_direction is "
+                   "'ingress' the push side is healthy — chase the pull "
+                   "route; persistent: exclude the rank or reroute "
+                   "pulls around it",
             version=version, detected_ts=time.time()))
     return findings
 
@@ -480,6 +576,8 @@ class Doctor:
     KFT_DOCTOR_ROOFLINE    0.05     perf: roofline-fraction floor
     KFT_DOCTOR_ROOFLINE_DROP  2.0   perf: drop vs own baseline required
     KFT_DOCTOR_BURN        2.0      slo: sustained error-budget burn
+    KFT_DOCTOR_SLOWLINK    4.0      slowlink: median / pull-bw required
+    KFT_DOCTOR_SLOWLINK_MIN_BPS  1024.0  slowlink: idle-cluster floor
     =====================  =======  =====================================
     """
 
@@ -499,6 +597,8 @@ class Doctor:
         self.roofline = knobs.get("KFT_DOCTOR_ROOFLINE")
         self.roofline_drop = knobs.get("KFT_DOCTOR_ROOFLINE_DROP")
         self.burn = knobs.get("KFT_DOCTOR_BURN")
+        self.slowlink = knobs.get("KFT_DOCTOR_SLOWLINK")
+        self.slowlink_min_bps = knobs.get("KFT_DOCTOR_SLOWLINK_MIN_BPS")
         self._active: Dict[Tuple[str, str], Finding] = {}
         self.last: List[Finding] = []
 
@@ -532,7 +632,12 @@ class Doctor:
             + detect_slo(self.history, burn=self.burn,
                          min_windows=self.min_windows,
                          stale_s=self.stale_s,
-                         ranks=ranks, version=version))
+                         ranks=ranks, version=version)
+            + detect_slowlink(self.history, factor=self.slowlink,
+                              min_bps=self.slowlink_min_bps,
+                              min_windows=self.min_windows,
+                              stale_s=self.stale_s,
+                              ranks=ranks, version=version))
         self._export(findings)
         self.last = findings
         return findings

@@ -1,0 +1,214 @@
+"""kfnet: the data-movement observability plane.
+
+One call-site idiom feeds three surfaces at once:
+
+* **per-peer byte counters** — the Monitor's egress/ingress tables,
+  rendered as ``kungfu_tpu_{e,in}gress_bytes_total{target=}`` plus the
+  ``_rate`` gauges that :func:`kungfu_tpu.monitor.cluster.aggregate`
+  joins into the N×N bandwidth matrix;
+* **the state-movement ledger** — per-op bytes-moved counters, wall
+  and per-phase duration summaries, and an effective-GiB/s gauge for
+  every snapshot publish / peer pull / resize adoption;
+* **a ``net.transfer`` kftrace span tree** — the outer span carries
+  nbytes + GiB/s + per-phase seconds; each :meth:`Transfer.phase`
+  entry nests a ``net.<phase>`` span (one per chunk for the chunked
+  leaf tier), so a slow pull decomposes into
+  serialize/copy/wire/deserialize on the timeline.
+
+Control-plane traffic (config fetches, heartbeats, watcher probes —
+everything riding :mod:`kungfu_tpu.utils.rpc`) shares the same counter
+tables but its targets carry a ``ctrl:`` prefix: the matrix join, the
+control-vs-data share in ``tools/kfnet_report.py`` and the slowlink
+detector separate overhead from state movement by target shape instead
+of needing a second metric family.  See docs/monitoring.md
+"Transport (kfnet)".
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from . import Monitor, get_monitor
+from ..trace import span as _trace_span
+
+PLANE_DATA = "data"
+PLANE_CONTROL = "control"
+CTRL_PREFIX = "ctrl:"
+
+#: canonical phase names; a Transfer may use any subset, many times each
+PHASES = ("serialize", "copy", "wire", "deserialize")
+
+
+def control_target(netloc: str) -> str:
+    """Counter-table key for a control-plane server (idempotent)."""
+    if netloc.startswith(CTRL_PREFIX):
+        return netloc
+    return CTRL_PREFIX + netloc
+
+
+def is_peer_target(target: str) -> bool:
+    """True for targets naming a concrete peer (``host:port``) — the
+    rows the bandwidth matrix and ``detect_slowlink`` consider.  Mesh
+    axis estimates ("ici", "dcn") and ``ctrl:``-prefixed control-plane
+    servers are excluded."""
+    return ":" in target and not target.startswith(CTRL_PREFIX)
+
+
+def tree_bytes(tree) -> int:
+    """Total nbytes across a host pytree's array leaves (ledger sizing;
+    metadata only, never syncs a device)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+def account(direction: str, nbytes: int, *, peer: str,
+            plane: str = PLANE_DATA,
+            monitor: Optional[Monitor] = None) -> None:
+    """Point accounting for one already-timed wire leg.
+
+    Cheap enough for the rpc hot path: two dict operations on the
+    Monitor, no I/O, no locks beyond the counter's own.
+    """
+    mon = monitor if monitor is not None else get_monitor()
+    target = control_target(peer) if plane == PLANE_CONTROL else peer
+    if direction == "egress":
+        mon.egress(int(nbytes), target=target)
+    else:
+        mon.ingress(int(nbytes), target=target)
+
+
+def record_transfer(op: str, *, nbytes: int, wall: float,
+                    direction: str = "ingress",
+                    peer: Optional[str] = None,
+                    plane: str = PLANE_DATA,
+                    phases: Optional[Dict[str, float]] = None,
+                    monitor: Optional[Monitor] = None) -> None:
+    """Ledger entry for one completed state movement.
+
+    Functional form for call sites that cannot hold a context manager
+    open (async pull completions); :class:`Transfer` wraps this.
+    ``peer=None`` records ledger-only (a local snapshot handoff moves
+    bytes but has no wire peer to attribute them to).
+    """
+    mon = monitor if monitor is not None else get_monitor()
+    if peer is not None and nbytes:
+        account(direction, nbytes, peer=peer, plane=plane, monitor=mon)
+    mon.inc("kungfu_tpu_state_moved_bytes_total", float(nbytes),
+            labels={"op": op})
+    mon.observe("kungfu_tpu_net_transfer_seconds", float(wall),
+                labels={"op": op})
+    for name, dur in (phases or {}).items():
+        mon.observe("kungfu_tpu_net_phase_seconds", float(dur),
+                    labels={"op": op, "phase": name})
+    if wall > 0.0 and nbytes:
+        mon.set_gauge("kungfu_tpu_state_move_gib_s",
+                      nbytes / wall / 2**30, labels={"op": op})
+
+
+class Transfer:
+    """One logical state movement (a pull, a snapshot publish, a resize
+    adoption): times the whole transfer plus per-phase sub-timers,
+    feeds the Monitor on success, and emits the ``net.transfer`` span
+    tree.  Usage::
+
+        with net.Transfer("store.load", peer=spec) as t:
+            with t.phase("wire"):
+                raw = pull()
+            with t.phase("deserialize"):
+                arr = decode(raw)
+            t.add(arr.nbytes)
+
+    A phase may be entered many times (once per chunk); durations
+    accumulate, so the per-phase sum tracks the transfer wall time.
+    Nothing is recorded when the body raises — a half-finished pull
+    must not pollute the bandwidth series the doctor compares.
+    """
+
+    def __init__(self, op: str, *, peer: Optional[str] = None,
+                 direction: str = "ingress", plane: str = PLANE_DATA,
+                 rank: Optional[int] = None,
+                 version: Optional[int] = None,
+                 monitor: Optional[Monitor] = None) -> None:
+        self.op = op
+        self.peer = peer
+        self.direction = direction
+        self.plane = plane
+        self.nbytes = 0
+        self._rank = rank
+        self._version = version
+        self._monitor = monitor
+        self._phases: Dict[str, float] = {}
+        self._span = None
+        self._sp = None
+        self._t0 = 0.0
+
+    def add(self, nbytes: int) -> None:
+        self.nbytes += int(nbytes)
+
+    def phase(self, name: str, **attrs) -> "_Phase":
+        return _Phase(self, name, attrs)
+
+    @property
+    def phases(self) -> Dict[str, float]:
+        return dict(self._phases)
+
+    def __enter__(self) -> "Transfer":
+        attrs = {"op": self.op, "direction": self.direction,
+                 "plane": self.plane}
+        if self.peer is not None:
+            attrs["peer"] = self.peer
+        self._span = _trace_span("net.transfer", category="net",
+                                 rank=self._rank, version=self._version,
+                                 attrs=attrs)
+        self._sp = self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        wall = time.perf_counter() - self._t0
+        if etype is None:
+            record_transfer(self.op, nbytes=self.nbytes, wall=wall,
+                            direction=self.direction, peer=self.peer,
+                            plane=self.plane, phases=dict(self._phases),
+                            monitor=self._monitor)
+            if self._sp is not None:
+                gib = self.nbytes / wall / 2**30 if wall > 0 else 0.0
+                self._sp.set(nbytes=self.nbytes, gib_s=round(gib, 4),
+                             **{f"{k}_s": round(v, 6)
+                                for k, v in self._phases.items()})
+        self._span.__exit__(etype, evalue, tb)
+        return False
+
+
+class _Phase:
+    """Sub-timer inside a :class:`Transfer`: accumulates into the
+    parent's per-phase table and nests a ``net.<phase>`` span per entry
+    (chunk-level timing falls out of entering once per chunk)."""
+
+    def __init__(self, xfer: Transfer, name: str, attrs: dict) -> None:
+        self._x = xfer
+        self._name = name
+        self._attrs = attrs
+        self._span = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Phase":
+        a = dict(self._attrs)
+        a["op"] = self._x.op
+        self._span = _trace_span(f"net.{self._name}", category="net",
+                                 rank=self._x._rank, attrs=a)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        self._x._phases[self._name] = \
+            self._x._phases.get(self._name, 0.0) + dur
+        self._span.__exit__(etype, evalue, tb)
+        return False

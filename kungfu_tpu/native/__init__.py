@@ -450,12 +450,27 @@ class NativePeer:
         and zero-fill the whole mapping every time — measured 0.6-1.5
         GiB/s fresh vs 3.2 GiB/s reused for a 1 GB pull on loopback
         (benchmarks/p2p.py measures both modes)."""
+        import time as _time
+
+        from ..monitor import net as _net
         out = self._check_out(out, like)
+        peer = self._peer_spec(target)
+        t0 = _time.perf_counter()
+
+        def result():
+            # completion runs on the native callback thread: the
+            # kfnet ledger sees the pull's true wall (submit->done)
+            wall = _time.perf_counter() - t0
+            _net.record_transfer("p2p.pull", nbytes=out.nbytes,
+                                 wall=wall, peer=peer,
+                                 phases={"wire": wall})
+            return out
+
         return self._async_op(
             lambda cb: _check(self._lib.kft_request_async(
                 self._h, target, name.encode(), out.ctypes.data,
                 out.nbytes, version, cb, None), "request_async"),
-            (out,), lambda: out)
+            (out,), result)
 
     @staticmethod
     def _check_out(out, like) -> np.ndarray:
@@ -473,11 +488,22 @@ class NativePeer:
                 f"like {like.dtype}/{like.nbytes}B")
         return out
 
+    def _peer_spec(self, j: int) -> str:
+        """host:port of peer ``j`` — the kfnet counter target, so the
+        bandwidth matrix names real workers, not rank integers."""
+        return self._peers[j] if 0 <= j < len(self._peers) else str(j)
+
     # ---------------------------------------------------------------- p2p
     def save(self, name: str, x: np.ndarray, version: int = -1) -> None:
-        x = np.ascontiguousarray(x)
-        _check(self._lib.kft_save(self._h, name.encode(), x.ctypes.data,
-                                  x.nbytes, version), "save")
+        from ..monitor import net as _net
+        with _net.Transfer("p2p.save", direction="egress") as xf:
+            with xf.phase("serialize"):
+                x = np.ascontiguousarray(x)
+            with xf.phase("copy"):
+                _check(self._lib.kft_save(self._h, name.encode(),
+                                          x.ctypes.data, x.nbytes,
+                                          version), "save")
+            xf.add(x.nbytes)
 
     def request(self, target: int, name: str, like: np.ndarray,
                 version: int = -1,
@@ -486,10 +512,15 @@ class NativePeer:
         destination buffer (see :meth:`request_async` — reuse it for
         large models; fresh per-pull allocations cost 2-5x in kernel
         page-fault work at GB scale)."""
+        from ..monitor import net as _net
         out = self._check_out(out, like)
-        _check(self._lib.kft_request(self._h, target, name.encode(),
-                                     out.ctypes.data, out.nbytes, version),
-               "request")
+        with _net.Transfer("p2p.pull", peer=self._peer_spec(target),
+                           rank=self.rank, version=version) as xf:
+            with xf.phase("wire"):
+                _check(self._lib.kft_request(
+                    self._h, target, name.encode(), out.ctypes.data,
+                    out.nbytes, version), "request")
+            xf.add(out.nbytes)
         return out
 
     # --------------------------------------------------------- monitoring
@@ -600,6 +631,9 @@ def resize_from_url(timeout: float = 5.0):
             # worker in-process (the launcher respawns it instead)
             return changed, True
         new_rank = specs.index(me)
+        import time as _time
+        old_specs = set(p.peers) if p is not None else set()
+        t_rebuild = _time.perf_counter()
         use_peer(None)
         if p is not None:
             p.close()  # frees this worker's listen port for the rebuild
@@ -622,6 +656,18 @@ def resize_from_url(timeout: float = 5.0):
             use_peer(None)
             newp.close()
             continue
+        # kfnet: ledger the rebuild wall and drop per-peer counters for
+        # members that left — their rate series otherwise outlive the
+        # peer as ghost rows in the bandwidth matrix (pruned rather
+        # than tombstoned: a spec that rejoins simply re-creates its
+        # counters from zero)
+        from ..monitor import get_monitor as _get_monitor
+        from ..monitor import net as _net
+        _net.record_transfer("resize.rebuild", nbytes=0,
+                             wall=_time.perf_counter() - t_rebuild)
+        gone = old_specs - set(specs)
+        if gone:
+            _get_monitor().prune_targets(sorted(gone))
 
 
 def recover_from_failure(timeout: float = 60.0, poll: float = 0.1
@@ -726,13 +772,30 @@ def _maybe_start_metrics(p: NativePeer, worker_port: int) -> None:
     if not knobs.get(E.ENABLE_MONITORING):
         return
 
+    last: dict = {}  # peer rank -> last native egress total bridged
+
     def native_lines():
         lines = []
+        mon = M.get_monitor()
+        # minimal peer API for this provider is (size, rank,
+        # egress_bytes) — tests stub exactly that, so the kfnet spec
+        # lookup must stay optional
+        spec_of = getattr(p, "_peer_spec", str)
         for j in range(p.size):
             if j == p.rank:
                 continue
+            total = p.egress_bytes(j)
             lines.append('kft_peer_egress_bytes_total{peer="%d"} %d'
-                         % (j, p.egress_bytes(j)))
+                         % (j, total))
+            # kfnet bridge: the SERVER side of a p2p pull runs inside
+            # the native runtime, invisible to the Python Monitor —
+            # fold the native per-peer counter deltas into the egress
+            # table so the _rate gauges and the cluster bandwidth
+            # matrix see served pulls, not just issued ones
+            prev = last.get(j, 0)
+            if total > prev:
+                mon.egress(total - prev, target=spec_of(j))
+            last[j] = total
         return lines
 
     try:

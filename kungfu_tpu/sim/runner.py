@@ -130,6 +130,11 @@ class SimClusterRunner:
                 str(r) for r in sc.sim_slow_ranks),
             "KFT_SIM_SLOW_FACTOR": str(sc.sim_slow_factor),
             "KFT_SIM_DRAIN_S": str(sc.sim_drain_s),
+            "KFT_SIM_NET_BYTES": str(sc.sim_net_bytes),
+            "KFT_SIM_NET_SLOW_RANKS": ",".join(
+                str(r) for r in sc.sim_net_slow_ranks),
+            "KFT_SIM_NET_SLOW_FACTOR": str(sc.sim_net_slow_factor),
+            "KFT_NET_RATE_PERIOD_S": str(sc.sim_net_rate_period_s),
             # workers pump leases at this cadence; the TTL side goes to
             # watch_run directly (lease_ttl_s), not through env
             "KFT_HEARTBEAT_S": str(sc.sim_heartbeat_s),

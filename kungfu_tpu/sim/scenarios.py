@@ -184,6 +184,53 @@ def sim_scenarios() -> Dict[str, Scenario]:
             doctor_expect={"kind": "straggler", "rank": 77},
             timeout_s=600.0),
         Scenario(
+            name="sim-slowlink-doctor-100",
+            desc="100 fake workers each pushing synthetic per-peer "
+                 "traffic; rank 77's INGRESS is throttled 8x while its "
+                 "egress stays healthy: detect_slowlink over the "
+                 "doctor's scrape windows must name exactly rank 77 "
+                 "(asymmetry evidence: ingress) and no other — the "
+                 "bandwidth-matrix plumbing proven end to end at a "
+                 "scale the real tier cannot spawn",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=100,
+            # same shape as sim-straggler-doctor-100: long enough that
+            # rank 77's late spawn still lands several throttled rate
+            # windows in the doctor's history before drain
+            target_steps=60,
+            sim_step_s=0.25,
+            # ~4 MiB/s healthy per-link vs 0.5 MiB/s throttled: an 8x
+            # gap sits far below the lower-median/4 threshold even
+            # with scrape-phase jitter, and far above the idle floor
+            sim_net_bytes=1 << 20,
+            sim_net_slow_ranks=(77,),
+            sim_net_slow_factor=8.0,
+            # 100 procs oversubscribe this box's cores: average rates
+            # over 10s so scheduler starvation cannot fake a slow link
+            sim_net_rate_period_s=10.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=420.0,
+            doctor_expect={"kind": "slowlink", "rank": 77},
+            timeout_s=600.0),
+        Scenario(
+            name="sim-slowlink-doctor-clean",
+            desc="the slowlink clean twin: 20 fake workers, identical "
+                 "synthetic traffic, NO throttled rank — the doctor "
+                 "must raise no slowlink finding on the whole run "
+                 "(false-positive guard for the matrix threshold)",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=20,
+            target_steps=40,
+            sim_step_s=0.25,
+            sim_net_bytes=1 << 20,
+            sim_net_rate_period_s=10.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=300.0,
+            doctor_expect={"absent_kind": "slowlink"},
+            timeout_s=480.0),
+        Scenario(
             name="sim-spot-trace",
             desc="30 fake workers under a replayed spot-preemption "
                  "trace (single reclaims, a correlated 3-worker burst, "

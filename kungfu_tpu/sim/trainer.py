@@ -49,6 +49,7 @@ from ..elastic.config_server import fetch_config, fetch_health, put_config
 from ..elastic.heartbeat import HeartbeatSender
 from ..launcher import env as E
 from ..monitor import MONITOR_PORT_OFFSET, Monitor
+from ..monitor import net as _net
 from ..plan.cluster import Cluster
 from ..plan.hostspec import HostList
 from ..store import VersionedStore
@@ -67,6 +68,10 @@ def _metrics_handler(trainer: "FakeTrainer"):
                     body = trainer.monitor.render_metrics().encode()
                 elif self.path.startswith("/state"):
                     body = json.dumps(trainer.committed_state()).encode()
+                    # kfnet: the adoption path's server side.  "state"
+                    # has no colon so it is ledger-only, never a peer
+                    # row in the bandwidth matrix.
+                    trainer.monitor.egress(len(body), target="state")
                 else:
                     self.send_response(404)
                     self.end_headers()
@@ -115,6 +120,17 @@ class FakeTrainer:
         slow = knobs.get("KFT_SIM_SLOW_RANKS")
         self.slow_factor = (knobs.get("KFT_SIM_SLOW_FACTOR")
                             if self.init_rank in slow else 1.0)
+        # kfnet chaos surface: synthetic per-peer traffic so the
+        # bandwidth matrix / slowlink doctor can be exercised at n=100
+        # without a data plane.  A slow rank's INGRESS is divided (its
+        # pulls crawl) while its egress stays healthy — the asymmetry
+        # detect_slowlink names.
+        self.net_bytes = knobs.get("KFT_SIM_NET_BYTES")
+        self.net_peers = knobs.get("KFT_SIM_NET_PEERS")
+        net_slow = knobs.get("KFT_SIM_NET_SLOW_RANKS")
+        self.net_slow_div = (knobs.get("KFT_SIM_NET_SLOW_FACTOR")
+                             if self.init_rank in net_slow else 1.0)
+        self._net_last = time.monotonic()
         # scripted per-worker jitter: deterministic per (seed, port)
         self._jitter = random.Random((self.seed << 17) ^ self.port)
 
@@ -201,10 +217,19 @@ class FakeTrainer:
                 break
             probed += 1
             try:
-                raw = _rpc.call(
-                    f"http://{p.host}:{p.port + MONITOR_PORT_OFFSET}"
-                    f"/state", attempt_timeout=0.5)
-                d = json.loads(raw.decode())
+                with _net.Transfer("state.adopt",
+                                   peer=f"{p.host}:{p.port}",
+                                   direction="ingress", rank=self.rank,
+                                   version=self.version,
+                                   monitor=self.monitor) as xf:
+                    with xf.phase("wire"):
+                        raw = _rpc.call(
+                            f"http://{p.host}"
+                            f":{p.port + MONITOR_PORT_OFFSET}"
+                            f"/state", attempt_timeout=0.5)
+                    with xf.phase("deserialize"):
+                        d = json.loads(raw.decode())
+                    xf.add(len(raw))
             except (OSError, ValueError):
                 continue  # peer not up yet / dying: fresh start is fine
             if (isinstance(d, dict) and d.get("seed") == self.seed
@@ -221,6 +246,42 @@ class FakeTrainer:
                       size=len(self.workers), version=self.version,
                       wsum=self.w)
 
+    # ------------------------------------------------------------ kfnet
+    def _emit_net_traffic(self) -> None:
+        """Scripted per-peer byte counters: KFT_SIM_NET_BYTES *per
+        step-time* to each of up to KFT_SIM_NET_PEERS ring neighbours,
+        ingress divided by the slow factor on throttled ranks.  Drives
+        the egress/ingress rate gauges exactly like the real store-pull
+        path would, without moving any data.
+
+        Emission is WALL-CLOCK scaled (bytes ~ elapsed / step_s), and
+        the drain loop keeps calling this: workers reach the target at
+        jittered times, and if an early finisher's counters just
+        decayed to zero while the stragglers kept pushing, the doctor
+        would flag the *fastest* workers as slow links during the
+        transition."""
+        if self.net_bytes <= 0 or len(self.workers) < 2:
+            return
+        now = time.monotonic()
+        # no cap: after scheduler starvation (100 procs on one core can
+        # stall a worker for seconds) the catch-up burst is exactly the
+        # bytes the link "carried" meanwhile — dropping any of it would
+        # depress this worker's average rate and fake a slow link
+        elapsed = now - self._net_last
+        self._net_last = now
+        if elapsed <= 0:
+            return
+        nbytes = int(self.net_bytes * elapsed / self.step_s)
+        n = len(self.workers)
+        for k in range(1, min(self.net_peers, n - 1) + 1):
+            p = self.workers[(self.rank + k) % n]
+            if p.host == self.host and p.port == self.port:
+                continue
+            spec = f"{p.host}:{p.port}"
+            self.monitor.egress(nbytes, target=spec)
+            self.monitor.ingress(int(nbytes / self.net_slow_div),
+                                 target=spec)
+
     # ----------------------------------------------------------- resize
     def _apply_config(self, version: int, cluster) -> bool:
         """Adopt a new membership; returns False when this worker was
@@ -233,6 +294,12 @@ class FakeTrainer:
                 break
         if rank is None:
             return False
+        # kfnet satellite: drop per-peer rate counters for members that
+        # left, else their last-window rates linger as ghost matrix rows
+        gone = ({f"{p.host}:{p.port}" for p in self.workers}
+                - {f"{p.host}:{p.port}" for p in workers})
+        if gone:
+            self.monitor.prune_targets(sorted(gone))
         self.version = version
         self.workers = workers
         self.rank = rank
@@ -331,6 +398,7 @@ class FakeTrainer:
             self.w += step_increment(self.seed, self.step)
             wall = time.monotonic() - t0
             self.monitor.observe("kungfu_tpu_step_seconds", wall)
+            self._emit_net_traffic()
             # scripted phase split: a fixed device-less "roofline"
             for phase, share in (("compute", 0.65), ("allreduce", 0.25),
                                  ("other", 0.10)):
@@ -359,6 +427,7 @@ class FakeTrainer:
         pause = max(self.poll_s, 0.015 * len(self.workers))
         while time.monotonic() < deadline:
             self._beat()
+            self._emit_net_traffic()
             if not self._poll_config(force=True):
                 return self._detach()
             try:

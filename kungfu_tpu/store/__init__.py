@@ -204,14 +204,18 @@ class ModelStore:
         import jax
 
         from ..chaos import point as _chaos_point
+        from ..monitor import net as _net
         from ..trace import span as _trace_span
         _chaos_point("store.save", version=version)
         with _trace_span("store.save", category="store", version=version,
-                         attrs={"blob": name}) as sp:
+                         attrs={"blob": name}) as sp, \
+                _net.Transfer("store.save", direction="egress",
+                              version=version) as xf:
             # pipelined D2H: every leaf's transfer is dispatched before
             # the first is joined (no-op for host trees)
             from ..elastic import snapshot as _kfsnap
-            host = _kfsnap.snapshot(tree)
+            with xf.phase("serialize"):
+                host = _kfsnap.snapshot(tree)
             leaves, _ = jax.tree_util.tree_flatten(host)
             threshold = _kfsnap.chunk_threshold_bytes()
             nbytes = 0
@@ -221,22 +225,32 @@ class ModelStore:
                     arr = np.asarray(leaf)
                     nbytes += arr.nbytes
                     self._put_leaf(f"{name}/{i}", arr, version, owned,
-                                   threshold)
+                                   threshold, xfer=xf)
+            xf.add(nbytes)
             if sp is not None:
                 sp.set(nbytes=nbytes)
 
     def _put_leaf(self, key: str, arr: np.ndarray,
                   version: Optional[int], owned: bool,
-                  threshold: int) -> None:
+                  threshold: int, xfer=None) -> None:
         """Store one leaf, as chunk views above the size threshold so a
         multi-GB blob streams in bounded pieces.  Chunks of an owned
-        save are views into the caller's array — still zero-copy."""
-        def put(k: str, a: np.ndarray) -> None:
+        save are views into the caller's array — still zero-copy.
+        ``xfer`` (a kfnet Transfer) times each put as a "copy" phase,
+        one sub-span per chunk for the ``.cN`` tier."""
+        def raw_put(k: str, a: np.ndarray) -> None:
             if version is None:
                 (self._flat.set_owned if owned else self._flat.set)(k, a)
             else:
                 (self._vs.save_owned if owned
                  else self._vs.save)(version, k, a)
+
+        def put(k: str, a: np.ndarray, **pattrs) -> None:
+            if xfer is None:
+                raw_put(k, a)
+                return
+            with xfer.phase("copy", key=k, **pattrs):
+                raw_put(k, a)
 
         if arr.nbytes > threshold and arr.size > 1:
             flat = (arr.reshape(-1) if arr.flags["C_CONTIGUOUS"]
@@ -246,7 +260,7 @@ class ModelStore:
             put(f"{key}.meta",
                 np.asarray([nchunks, per] + list(arr.shape), np.int64))
             for j in range(nchunks):
-                put(f"{key}.c{j}", flat[j * per:(j + 1) * per])
+                put(f"{key}.c{j}", flat[j * per:(j + 1) * per], chunk=j)
         else:
             put(key, arr)
 
@@ -255,15 +269,18 @@ class ModelStore:
         import jax
 
         from ..chaos import point as _chaos_point
+        from ..monitor import net as _net
         from ..trace import span as _trace_span
         _chaos_point("store.load", version=version)
         with _trace_span("store.load", category="store", version=version,
-                         attrs={"blob": name}) as sp:
+                         attrs={"blob": name}) as sp, \
+                _net.Transfer("store.load", direction="ingress",
+                              version=version) as xf:
             leaves, treedef = jax.tree_util.tree_flatten(template)
             out = []
             nbytes = 0
             for i, leaf in enumerate(leaves):
-                arr = self._get_leaf(f"{name}/{i}", version)
+                arr = self._get_leaf(f"{name}/{i}", version, xfer=xf)
                 nbytes += arr.nbytes
                 # the template contributes SHAPE only: read it off the
                 # leaf directly — np.asarray(leaf) here would D2H the
@@ -272,20 +289,27 @@ class ModelStore:
                 if shape is None:
                     shape = np.shape(leaf)
                 out.append(arr.reshape(shape))
+            xf.add(nbytes)
             if sp is not None:
                 sp.set(nbytes=nbytes)
             return jax.tree_util.tree_unflatten(treedef, out)
 
-    def _get_leaf(self, key: str, version: Optional[int]) -> np.ndarray:
+    def _get_leaf(self, key: str, version: Optional[int],
+                  xfer=None) -> np.ndarray:
         """One leaf back out of the store, reassembling chunked blobs.
         Chunks are read through the zero-copy view tier, so reassembly
-        costs exactly one copy (view -> output), not two."""
+        costs exactly one copy (view -> output), not two.  ``xfer`` (a
+        kfnet Transfer) times the whole-blob read as a "copy" phase and
+        each chunk reassembly copy as a "deserialize" phase."""
         get = (self._flat.get if version is None
                else lambda k: self._vs.get(version, k))
         get_view = (self._flat.get_view if version is None
                     else lambda k: self._vs.get_view(version, k))
         try:
-            return get(key)
+            if xfer is None:
+                return get(key)
+            with xfer.phase("copy", key=key):
+                return get(key)
         except KeyError:
             meta = get_view(f"{key}.meta")
         nchunks = int(meta[0])
@@ -295,6 +319,10 @@ class ModelStore:
         at = 0
         for j in range(nchunks):
             c = first if j == 0 else get_view(f"{key}.c{j}")
-            out[at:at + c.size] = c
+            if xfer is None:
+                out[at:at + c.size] = c
+            else:
+                with xfer.phase("deserialize", key=key, chunk=j):
+                    out[at:at + c.size] = c
             at += c.size
         return out.reshape(shape)

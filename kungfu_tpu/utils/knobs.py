@@ -274,6 +274,10 @@ _def("KFT_ROOFLINE", "str", None,
 _def("KFT_PROF_COST", "bool", True,
      "Run the AOT cost-analysis compile for compiled-cost gauges; 0 "
      "skips it.", group=_TRACE)
+_def("KFT_NET_RATE_PERIOD_S", "float", 1.0,
+     "kfnet: RateCounter sampling-window period for the per-target "
+     "egress/ingress rate gauges (scrape cadence rolls the windows).",
+     group=_TRACE)
 
 _DOCTOR = "Doctor thresholds (kfdoctor)"
 _def("KFT_DOCTOR_SKEW", "float", 1.5,
@@ -299,6 +303,12 @@ _def("KFT_DOCTOR_ROOFLINE_DROP", "float", 2.0,
 _def("KFT_DOCTOR_BURN", "float", 2.0,
      "SLO: sustained error-budget burn rate that raises an "
      "slo-violation finding.", group=_DOCTOR)
+_def("KFT_DOCTOR_SLOWLINK", "float", 4.0,
+     "Slowlink: cluster-median pull bandwidth over an instance's, "
+     "required in every evidence window.", group=_DOCTOR)
+_def("KFT_DOCTOR_SLOWLINK_MIN_BPS", "float", 1024.0,
+     "Slowlink: idle-cluster floor — windows whose median pull "
+     "bandwidth sits below this are inconclusive.", group=_DOCTOR)
 
 _OPS = "Kernels (ops)"
 _def("KFT_FLASH_MASK_SKIP", "bool", None,
@@ -352,6 +362,20 @@ _def("KFT_SIM_SLOW_RANKS", "intset", frozenset(),
 _def("KFT_SIM_SLOW_FACTOR", "float", 8.0,
      "Step-time multiplier applied to the scripted stragglers.",
      group=_SIM)
+_def("KFT_SIM_NET_BYTES", "int", 0,
+     "kfnet sim: synthetic per-peer transfer bytes each fake-trainer "
+     "step publishes into its egress/ingress counters (0 disables).",
+     group=_SIM)
+_def("KFT_SIM_NET_PEERS", "int", 6,
+     "kfnet sim: how many neighbouring peers each fake trainer "
+     "exchanges synthetic bytes with (bounds matrix cardinality).",
+     group=_SIM)
+_def("KFT_SIM_NET_SLOW_RANKS", "intset", frozenset(),
+     "kfnet sim: comma list of ranks scripted with a throttled pull "
+     "path (their ingress counters advance slower).", group=_SIM)
+_def("KFT_SIM_NET_SLOW_FACTOR", "float", 8.0,
+     "kfnet sim: ingress-byte divisor applied to the scripted "
+     "slowlink ranks.", group=_SIM)
 
 _BENCH = "Benchmarks"
 _def("KFT_SCALING_OUT", "str", None,

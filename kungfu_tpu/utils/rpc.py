@@ -328,6 +328,24 @@ def outage_age(url: str) -> Optional[float]:
     return None if t0 is None else time.monotonic() - t0
 
 
+_NET_ACCOUNT = None
+
+
+def _account_bytes(key: str, out_bytes: int, in_bytes: int) -> None:
+    """kfnet: request/response bytes per server, tagged control-plane
+    (the target renders as ``ctrl:host:port`` so the bandwidth matrix
+    and kfnet_report separate rpc overhead from state movement).  The
+    import resolves once; afterwards the healthy path pays two counter
+    adds — within the hot-path budget tests/test_kfguard.py pins."""
+    global _NET_ACCOUNT
+    if _NET_ACCOUNT is None:
+        from ..monitor import net as _net
+        _NET_ACCOUNT = _net.account
+    if out_bytes:
+        _NET_ACCOUNT("egress", out_bytes, peer=key, plane="control")
+    _NET_ACCOUNT("ingress", in_bytes, peer=key, plane="control")
+
+
 def _count_retry(key: str, exc: BaseException) -> None:
     _STATS["retries"] += 1
     kind = classify(exc)
@@ -377,6 +395,7 @@ def call(url: str, *, method: str = "GET", body: Optional[bytes] = None,
             try:
                 with _urlopen(req, timeout=attempt_timeout) as r:
                     raw = r.read()
+                _account_bytes(key, len(body) if body else 0, len(raw))
                 out = raw if check is None else check(raw)
             except urllib.error.HTTPError as e:
                 # an HTTP status is an ANSWER: the server is alive

@@ -745,10 +745,18 @@ class Peer {
                         // concurrent saves)
                         auto blob = store_.get_blob(m.name, ver);
                         if (blob) {
-                            std::lock_guard<std::mutex> wg(
-                                conn->write_mu);
-                            send_msg_ref(conn->fd, r, blob->data(),
-                                         blob->size());
+                            {
+                                std::lock_guard<std::mutex> wg(
+                                    conn->write_mu);
+                                send_msg_ref(conn->fd, r, blob->data(),
+                                             blob->size());
+                            }
+                            // served pulls ARE the server's egress:
+                            // without this the per-peer counters (and
+                            // the kfnet bandwidth matrix bridged from
+                            // them) only ever see request headers
+                            monitor_.add(conn->remote_rank,
+                                         int64_t(blob->size()));
                             break;
                         }
                         r.flags |= FLAG_FAILED;

@@ -289,6 +289,23 @@ def test_silent_except_covers_slo_plane(tmp_path):
     assert {f.rule for f in fs if f.path.endswith("engine.py")} == set()
 
 
+def test_silent_except_covers_kfnet_tools(tmp_path):
+    """The kfnet report/bench CLIs are inside the silent-except scope —
+    a report that eats a parse failure renders an empty matrix that
+    reads as 'no traffic', and a bench that eats a pull error commits
+    a zero baseline."""
+    src = """
+        def render(url):
+            try:
+                fetch_matrix(url)
+            except Exception:
+                pass
+    """
+    for rel in ("tools/kfnet_report.py", "tools/bench_p2p.py"):
+        fs = run_on(tmp_path, src, relpath=rel)
+        assert rules_fired(fs) == {"silent-except"}, rel
+
+
 def test_silent_except_covers_kfsim(tmp_path):
     """The kfsim fake-trainer plane (kungfu_tpu/sim/) is inside the
     silent-except scope — it speaks the real control plane, and a fake

@@ -83,7 +83,8 @@ def test_sim_scenarios_merged_into_cli_matrix():
     m = scenarios()
     sims = {n for n, sc in m.items() if sc.tier == "sim"}
     assert {"sim-smoke", "sim-preemption-wave-100", "sim-lease-cascade",
-            "sim-straggler-doctor-100", "sim-spot-trace",
+            "sim-straggler-doctor-100", "sim-slowlink-doctor-100",
+            "sim-slowlink-doctor-clean", "sim-spot-trace",
             "sim-grow-join"} <= sims
     for n in sims:
         sc = m[n]

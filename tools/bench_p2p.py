@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Repo-root wrapper for the P2P model-store benchmark.
+
+Runs :mod:`kungfu_tpu.benchmarks.p2p` (the versioned-store
+save/request path over the native host plane) and emits the
+``p2p-phase-v1`` artifact — per-worker sync/hidden pull rates plus the
+kfnet per-phase breakdown (serialize / wire / deserialize GiB/s, whole
+blob and chunked ``{key}.cN`` tier).  The committed P2P_BENCH.json is
+this tool's output at ``-np 2``; regenerate with:
+
+    python tools/bench_p2p.py -np 2 --size-mb 1728 \\
+        --compute-ms 1050 --out P2P_BENCH.json
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kungfu_tpu.benchmarks.p2p import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

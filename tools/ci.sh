@@ -94,6 +94,16 @@ python -m kungfu_tpu.chaos.runner --scenario sim-smoke || exit 1
 say "0f/3 kfload serving SLO smoke"
 python tools/kfload.py --smoke || exit 1
 
+# kfnet smoke (`make net-smoke`): two in-process workers with real
+# MetricsServers, a real ModelStore save/load for the state-movement
+# ledger, per-peer transfers both directions — asserts the aggregated
+# /cluster_metrics matrix carries nonzero egress AND ingress links,
+# the ledger families render, and the --history path round-trips.
+# Pure CPU, no data-plane gate, must never self-skip (~5 s;
+# docs/monitoring.md "Transport (kfnet)")
+say "0g/3 kfnet transport observability smoke"
+python tools/kfnet_report.py --smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

@@ -285,10 +285,13 @@ class SilentExcept(Rule):
     # kfguard rpc client): scoped by file, not by widening all of
     # utils; serving/slo.py and tools/kfload.py are the SLO plane and
     # its load harness — a swallowed error there silently corrupts the
-    # very numbers the plane exists to report
+    # very numbers the plane exists to report; likewise the kfnet
+    # report/bench tools, whose output is the transport baseline
     path_filter = (r"(^|/)(elastic|launcher|comm|chaos|store|trace"
                    r"|monitor|sim)(/|$)|(^|/)utils/rpc\.py$"
-                   r"|(^|/)serving/slo\.py$|(^|/)tools/kfload\.py$")
+                   r"|(^|/)serving/slo\.py$|(^|/)tools/kfload\.py$"
+                   r"|(^|/)tools/kfnet_report\.py$"
+                   r"|(^|/)tools/bench_p2p\.py$")
 
     BROAD = {"Exception", "BaseException"}
 

@@ -1,0 +1,342 @@
+#!/usr/bin/env python
+"""kfnet report: render a cluster's data-movement picture.
+
+Three sources (docs/monitoring.md "Transport (kfnet)"):
+
+  --url URL        a running watcher's debug address — one GET of
+                   /cluster_metrics yields the pre-joined
+                   ``kungfu_tpu_peer_bandwidth_bytes_s`` matrix plus
+                   every worker's per-target byte totals
+  --history FILE   offline: a MetricsHistory JSONL capture — the matrix
+                   is re-joined from each instance's latest rate gauges
+  --smoke          self-contained CPU check for CI (ci.sh step 0g,
+                   ``make net-smoke``): two in-process workers with real
+                   MetricsServers, a real ModelStore save/load for the
+                   ledger, per-peer Transfers both directions, asserts
+                   the aggregated matrix carries nonzero egress AND
+                   ingress links, renders through the same path as
+                   --url, and round-trips the --history path
+
+The report shows: the N×N peer-bandwidth matrix (or the top links when
+the fleet is wide), top talkers by egress/ingress, and the
+control-plane vs data-plane byte share (``ctrl:``-prefixed targets are
+control traffic — see kungfu_tpu/monitor/net.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kungfu_tpu.monitor.history import (                      # noqa: E402
+    MetricsHistory, parse_metrics)
+from kungfu_tpu.monitor.net import CTRL_PREFIX, is_peer_target  # noqa: E402
+
+# one measured link: (src, dst, direction-it-was-measured-from, bytes/s)
+Link = Tuple[str, str, str, float]
+
+
+# ------------------------------------------------------------- collect
+def links_from_cluster_text(text: str) -> List[Link]:
+    """The pre-joined matrix out of a /cluster_metrics exposition."""
+    links: List[Link] = []
+    for (name, labels), value in parse_metrics(text).items():
+        if name != "kungfu_tpu_peer_bandwidth_bytes_s":
+            continue
+        lab = dict(labels)
+        links.append((lab.get("src", "?"), lab.get("dst", "?"),
+                      lab.get("direction", "?"), value))
+    return sorted(links)
+
+
+def totals_from_cluster_text(text: str) -> Dict[Tuple[str, str, str],
+                                                float]:
+    """Per ``(instance, direction, target)`` lifetime byte totals —
+    the control-vs-data share and top-talker inputs."""
+    out: Dict[Tuple[str, str, str], float] = {}
+    for (name, labels), value in parse_metrics(text).items():
+        for direction in ("egress", "ingress"):
+            if name == f"kungfu_tpu_{direction}_bytes_total":
+                lab = dict(labels)
+                key = (lab.get("instance", "local"), direction,
+                       lab.get("target", "?"))
+                out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def links_from_history(history: MetricsHistory) -> List[Link]:
+    """Re-join each instance's LATEST rate gauges into matrix links —
+    the same join :func:`kungfu_tpu.monitor.cluster.aggregate` does at
+    scrape time, for offline captures."""
+    links: List[Link] = []
+    for inst in history.instances():
+        snaps = history.snapshots(inst)
+        if not snaps:
+            continue
+        for (name, labels), value in sorted(snaps[-1].samples.items()):
+            for direction in ("egress", "ingress"):
+                if name != f"kungfu_tpu_{direction}_bytes_rate":
+                    continue
+                tgt = dict(labels).get("target", "?")
+                src, dst = ((inst, tgt) if direction == "egress"
+                            else (tgt, inst))
+                links.append((src, dst, direction, value))
+    return sorted(links)
+
+
+# -------------------------------------------------------------- digest
+def digest(links: List[Link],
+           totals: Dict[Tuple[str, str, str], float]) -> dict:
+    """One JSON-ready summary from the raw links + byte totals."""
+    peer_links = [(s, d, di, r) for s, d, di, r in links
+                  if is_peer_target(s) and is_peer_target(d)]
+    nodes = sorted({s for s, _, _, _ in peer_links}
+                   | {d for _, d, _, _ in peer_links})
+    talkers: Dict[str, Dict[str, float]] = {}
+    for src, dst, direction, rate in peer_links:
+        inst = src if direction == "egress" else dst
+        t = talkers.setdefault(inst, {"egress": 0.0, "ingress": 0.0})
+        t[direction] += rate
+    ctrl = sum(v for (_, _, tgt), v in totals.items()
+               if tgt.startswith(CTRL_PREFIX))
+    data = sum(v for (_, _, tgt), v in totals.items()
+               if not tgt.startswith(CTRL_PREFIX))
+    share = {"control_bytes": round(ctrl, 1), "data_bytes": round(data, 1)}
+    if ctrl + data > 0:
+        share["control_frac"] = round(ctrl / (ctrl + data), 6)
+    return {
+        "workers": len(nodes),
+        "links": [{"src": s, "dst": d, "direction": di,
+                   "bytes_per_s": round(r, 1)} for s, d, di, r in links],
+        "top_talkers": {
+            inst: {k: round(v, 1) for k, v in t.items()}
+            for inst, t in sorted(
+                talkers.items(),
+                key=lambda kv: -(kv[1]["egress"] + kv[1]["ingress"]))},
+        "plane_share": share,
+    }
+
+
+# -------------------------------------------------------------- render
+def _fmt_bps(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for unit, scale in (("G", 2**30), ("M", 2**20), ("K", 2**10)):
+        if v >= scale:
+            return f"{v / scale:.1f}{unit}"
+    return f"{v:.0f}"
+
+
+def render_report(links: List[Link],
+                  totals: Dict[Tuple[str, str, str], float],
+                  matrix_width: int = 8) -> str:
+    d = digest(links, totals)
+    if not d["links"]:
+        return ("kfnet: no bandwidth links found — have workers moved "
+                "state with monitoring enabled?\n")
+    out: List[str] = []
+    peer_links = [(l["src"], l["dst"], l["direction"], l["bytes_per_s"])
+                  for l in d["links"]
+                  if is_peer_target(l["src"]) and is_peer_target(l["dst"])]
+    nodes = sorted({s for s, _, _, _ in peer_links}
+                   | {d_ for _, d_, _, _ in peer_links})
+    # each (src, dst) may be measured from both ends; prefer the
+    # sender's (egress) measurement, fall back to the receiver's
+    cell: Dict[Tuple[str, str], float] = {}
+    for src, dst, direction, rate in peer_links:
+        if direction == "egress" or (src, dst) not in cell:
+            cell[(src, dst)] = rate
+    if nodes and len(nodes) <= matrix_width:
+        out.append(f"bandwidth matrix (bytes/s, row=src, col=dst; "
+                   f"{len(nodes)} peers)")
+        head = f"{'':<22}" + "".join(f"{n[-12:]:>13}" for n in nodes)
+        out.append(head)
+        for src in nodes:
+            row = f"{src[-20:]:<22}"
+            for dst in nodes:
+                v = cell.get((src, dst))
+                row += (f"{'.':>13}" if src == dst
+                        else f"{_fmt_bps(v):>13}")
+            out.append(row)
+    elif peer_links:
+        out.append(f"top links ({len(nodes)} peers — matrix too wide)")
+        top = sorted(peer_links, key=lambda l: -l[3])[:16]
+        for src, dst, direction, rate in top:
+            out.append(f"  {src} -> {dst}  {_fmt_bps(rate)}/s "
+                       f"(measured: {direction})")
+    if d["top_talkers"]:
+        out.append("top talkers (bytes/s)")
+        for inst, t in list(d["top_talkers"].items())[:8]:
+            out.append(f"  {inst:<22} egress {_fmt_bps(t['egress']):>9}"
+                       f"/s  ingress {_fmt_bps(t['ingress']):>9}/s")
+    sh = d["plane_share"]
+    if "control_frac" in sh:
+        out.append(f"plane share: control {100 * sh['control_frac']:.1f}% "
+                   f"({_fmt_bps(sh['control_bytes'])}B) vs data "
+                   f"{_fmt_bps(sh['data_bytes'])}B lifetime")
+    return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------------- smoke
+def smoke() -> int:
+    """CPU CI check: drive the kfnet plane end to end in-process."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                    Monitor, get_monitor)
+    from kungfu_tpu.monitor import cluster as _cluster
+    from kungfu_tpu.monitor import net as _net
+    from kungfu_tpu.store import ModelStore
+
+    mon_a = get_monitor()   # the store path records into the global one
+    mon_b = Monitor()
+    srv_a = MetricsServer(mon_a, port=0).start()
+    srv_b = MetricsServer(mon_b, port=0).start()
+    inst_a = f"127.0.0.1:{srv_a.port - MONITOR_PORT_OFFSET}"
+    inst_b = f"127.0.0.1:{srv_b.port - MONITOR_PORT_OFFSET}"
+    try:
+        # the ledger: a REAL ModelStore round trip (save is the
+        # serialize+copy side, request the copy+deserialize side)
+        store = ModelStore()
+        tree = {"w": np.ones((256, 256), np.float32),
+                "b": np.zeros((256,), np.float32)}
+        store.save("model", tree, version=1)
+        out = store.request("model", tree, version=1)
+        if out["w"].shape != (256, 256):
+            print("kfnet smoke: FAIL store round trip", file=sys.stderr)
+            return 1
+        # the wire: A pulls from B, B pushes to A — both ends account
+        # the same bytes, so the matrix gets one link measured twice
+        blob = np.ones(1 << 20, np.uint8)
+        with _net.Transfer("p2p.pull", peer=inst_b, direction="ingress",
+                           monitor=mon_a) as xf:
+            with xf.phase("wire"):
+                raw = blob.tobytes()
+            with xf.phase("deserialize"):
+                arr = np.frombuffer(raw, np.uint8)
+            xf.add(arr.nbytes)
+        with _net.Transfer("p2p.push", peer=inst_a, direction="egress",
+                           monitor=mon_b) as xf:
+            with xf.phase("serialize"):
+                raw = blob.tobytes()
+            xf.add(len(raw))
+        # control plane: heartbeat-sized traffic to a ctrl: target
+        _net.account("egress", 512, peer="127.0.0.1:19999",
+                     plane="control", monitor=mon_a)
+        _net.account("ingress", 2048, peer="127.0.0.1:19999",
+                     plane="control", monitor=mon_a)
+        time.sleep(0.05)   # a nonzero rate window to measure over
+        hist = MetricsHistory(window=8)
+        text = _cluster.aggregate(
+            [("127.0.0.1", srv_a.port - MONITOR_PORT_OFFSET),
+             ("127.0.0.1", srv_b.port - MONITOR_PORT_OFFSET)],
+            history=hist)
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+    links = links_from_cluster_text(text)
+    eg = [r for s, d, di, r in links if di == "egress"
+          and is_peer_target(s) and is_peer_target(d) and r > 0]
+    ig = [r for s, d, di, r in links if di == "ingress"
+          and is_peer_target(s) and is_peer_target(d) and r > 0]
+    if not eg or not ig:
+        print(f"kfnet smoke: FAIL matrix lacks nonzero egress "
+              f"({len(eg)}) or ingress ({len(ig)}) links\n{text}",
+              file=sys.stderr)
+        return 1
+    for needle in ('kungfu_tpu_state_moved_bytes_total{',
+                   'op="store.save"', 'op="store.load"',
+                   'kungfu_tpu_net_phase_seconds',
+                   'kungfu_tpu_state_move_gib_s',
+                   'target="ctrl:127.0.0.1:19999"'):
+        if needle not in text:
+            print(f"kfnet smoke: FAIL /cluster_metrics lacks {needle!r}",
+                  file=sys.stderr)
+            return 1
+    totals = totals_from_cluster_text(text)
+    d = digest(links, totals)
+    if d["plane_share"].get("control_frac", 0) <= 0:
+        print("kfnet smoke: FAIL control-plane share is zero",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(render_report(links, totals))
+    # --history round trip: the offline join must see the same links
+    td = tempfile.mkdtemp(prefix="kfnet-smoke-")
+    path = os.path.join(td, "history.jsonl")
+    hist.save(path)
+    h2 = MetricsHistory.load(path)
+    offline = [(s, d_, di, r) for s, d_, di, r in links_from_history(h2)
+               if r > 0 and is_peer_target(s) and is_peer_target(d_)]
+    if not offline:
+        print("kfnet smoke: FAIL --history path found no links",
+              file=sys.stderr)
+        return 1
+    json.loads(json.dumps(d))   # the --json block must validate
+    print(f"kfnet smoke: OK ({len(eg)} egress / {len(ig)} ingress "
+          f"link(s), history round trip {len(offline)} link(s))")
+    return 0
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kfnet-report",
+        description="render a kungfu_tpu cluster's data-movement "
+                    "picture: peer-bandwidth matrix, top talkers, "
+                    "control-vs-data share (docs/monitoring.md)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="watcher debug address; "
+                                   "/cluster_metrics is appended")
+    src.add_argument("--history", metavar="FILE.jsonl",
+                     help="offline: a MetricsHistory JSONL capture")
+    src.add_argument("--smoke", action="store_true",
+                     help="self-contained CPU CI check")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the digest JSON instead of the report")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if args.url:
+        import urllib.request
+        url = args.url.rstrip("/")
+        if not url.endswith("/cluster_metrics"):
+            url += "/cluster_metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                text = r.read().decode()
+        except (OSError, ValueError) as e:
+            print(f"kfnet: cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+        links = links_from_cluster_text(text)
+        totals = totals_from_cluster_text(text)
+    else:
+        history = MetricsHistory.load(args.history)
+        links = links_from_history(history)
+        totals = {}
+        for inst in history.instances():
+            snaps = history.snapshots(inst)
+            if not snaps:
+                continue
+            for (name, labels), value in snaps[-1].samples.items():
+                for direction in ("egress", "ingress"):
+                    if name == f"kungfu_tpu_{direction}_bytes_total":
+                        tgt = dict(labels).get("target", "?")
+                        totals[(inst, direction, tgt)] = value
+    if args.json:
+        print(json.dumps(digest(links, totals), indent=2))
+        return 0
+    sys.stdout.write(render_report(links, totals))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
