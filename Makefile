@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke policy-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -86,6 +86,15 @@ slo-smoke:
 # sim-slowlink-doctor-clean.
 net-smoke:
 	python tools/kfnet_report.py --smoke
+
+# kfpolicy smoke: the shadow decision plane on CPU — two live workers
+# with a 10x step-time skew behind a real watcher debug server; one
+# exclusion proposal, the JSONL ledger, the /decisions endpoint, and
+# `kft-policy --history` replay identity (docs/policy.md).  The
+# fleet-scale proof runs as chaos scenarios: sim-policy-shadow-100 /
+# sim-policy-shadow-clean.
+policy-smoke:
+	python tools/kfpolicy.py --smoke
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

@@ -308,6 +308,13 @@ class Scenario:
     # false-positive guard for the clean twin).  Enabling this exports
     # KFT_CONFIG_ENABLE_MONITORING=1 so workers serve /metrics.
     doctor_expect: Optional[Dict[str, object]] = None
+    # kfpolicy shadow-proof loop (docs/policy.md): {"rule": R, "rank":
+    # N} requires the policy sampler's ledger to contain EXACTLY ONE
+    # would-act decision from rule R naming rank N (no other rank, no
+    # withdrawal — the zero-flapping contract) and its --history replay
+    # to reproduce the ledger bit-identically; {"zero_would_act": True}
+    # requires a ledger with no would-act entry at all (the clean twin)
+    policy_expect: Optional[Dict[str, object]] = None
     # ---- kfsim (docs/chaos.md "Simulation tier"): tier="sim" runs the
     # scenario over fake trainers (kungfu_tpu/sim/) under the real
     # watcher — no jax, no data plane, scales to 100+ processes.
@@ -798,6 +805,115 @@ class _DoctorSampler(threading.Thread):
                            key=lambda d: (d["kind"], str(d["rank"])))
         with open(self.path, "w") as f:
             json.dump(found, f, indent=2)
+
+
+class _PolicySampler(threading.Thread):
+    """The kfpolicy shadow-proof loop for ``policy_expect`` scenarios:
+    the same scrape cadence as :class:`_DoctorSampler`, but every
+    scrape is journaled through a :class:`~kungfu_tpu.policy.engine.
+    PolicyEngine` (the engine duck-types as the aggregation's history
+    sink) and each sample period runs diagnose + one policy tick.
+    Private monitor for the same reason as the doctor sampler.  On
+    ``stop()`` it persists the three proof artifacts: the fsync'd
+    decision ledger (written live), the tick journal
+    (``policy_history.jsonl`` — what ``kft-policy --history`` replays),
+    and the ring dump (``policy_decisions.json``).  The loop parks
+    itself if the tick journal ring would overflow — replay identity
+    needs the journal to cover every evaluation since tick 0."""
+
+    def __init__(self, cluster, out_dir: str):
+        super().__init__(daemon=True, name="kfchaos-policy")
+        from ..monitor import Monitor
+        from ..monitor.doctor import Doctor
+        from ..monitor.history import MetricsHistory
+        from ..policy.engine import PolicyEngine, derive_ranks
+        peers = list(cluster.workers)
+        self.targets = [(p.host, p.port) for p in peers]
+        instances = [f"{p.host}:{p.port}" for p in peers]
+        # derive_ranks (not enumerate) so live and replay agree on the
+        # numbering even for instances that never answer a scrape; for
+        # the sim fleet (ascending ports) both are the launch order
+        self.ranks = derive_ranks(instances)
+        hist = MetricsHistory(window=256)
+        mon = Monitor()
+        self.doctor = Doctor(history=hist, monitor=mon)
+        self.engine = PolicyEngine(
+            history=hist, monitor=mon,
+            ledger_path=os.path.join(out_dir, "policy_ledger.jsonl"))
+        self.engine.set_targets(instances)
+        self.history_path = os.path.join(out_dir, "policy_history.jsonl")
+        self.decisions_path = os.path.join(out_dir,
+                                           "policy_decisions.json")
+        self.decisions: List[dict] = []
+        self.stop_event = threading.Event()
+        self._lock = threading.Lock()
+
+    def run(self) -> None:
+        from ..monitor import cluster as _mcluster
+        while not self.stop_event.is_set():
+            if self.engine.tick_count >= self.engine.history.window:
+                self.stop_event.wait(0.5)   # journal full: park
+                continue
+            _mcluster.aggregate(self.targets, timeout=1.0,
+                                history=self.engine)
+            findings = self.doctor.diagnose(ranks=self.ranks)
+            with self._lock:
+                self.engine.tick(findings, ranks=self.ranks)
+            self.stop_event.wait(0.5)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.join(timeout=10)
+        with self._lock:
+            self.engine.save_history(self.history_path)
+            self.decisions = [d.to_dict()
+                              for d in self.engine.decisions()]
+            self.engine.close()
+        with open(self.decisions_path, "w") as f:
+            json.dump(self.decisions, f, indent=2)
+
+
+def policy_violations(policy_expect: Dict[str, object],
+                      decisions: List[dict]) -> List[str]:
+    """Check a scenario's ``policy_expect`` contract against the
+    decision dicts a :class:`_PolicySampler` accumulated."""
+    violations: List[str] = []
+    would = [d for d in decisions if d.get("verdict") == "would-act"]
+    if policy_expect.get("zero_would_act"):
+        if would:
+            violations.append(
+                f"policy: clean run but the shadow ledger holds "
+                f"{len(would)} would-act decision(s): "
+                f"{[(d.get('rule'), d.get('rank')) for d in would]}")
+        return violations
+    rule = policy_expect.get("rule", "straggler-exclusion")
+    exp_rank = policy_expect.get("rank")
+    ruled = [d for d in would if d.get("rule") == rule]
+    hits = [d for d in ruled if d.get("rank") == exp_rank]
+    if not hits:
+        violations.append(
+            f"policy: expected a {rule!r} would-act naming rank "
+            f"{exp_rank}; saw ranks "
+            f"{sorted(str(d.get('rank')) for d in ruled)}")
+    wrong = [d for d in ruled if d.get("rank") != exp_rank]
+    if wrong:
+        violations.append(
+            f"policy: {rule!r} proposal misattributed to rank(s) "
+            f"{sorted(str(d.get('rank')) for d in wrong)} "
+            f"(only rank {exp_rank} was degraded)")
+    if len(hits) > 1:
+        violations.append(
+            f"policy: flapping — {len(hits)} would-act decisions for "
+            f"rank {exp_rank} (at most one standing proposal allowed)")
+    withdrawn = [d for d in decisions
+                 if d.get("verdict") == "withdrawn"
+                 and d.get("rule") == rule]
+    if withdrawn:
+        violations.append(
+            f"policy: flapping — {len(withdrawn)} withdrawal(s) under "
+            f"a steady degradation: "
+            f"{[d.get('target') for d in withdrawn]}")
+    return violations
 
 
 def doctor_violations(doctor_expect: Dict[str, object],

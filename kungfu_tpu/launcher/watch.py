@@ -232,29 +232,40 @@ def _doctor_targets(w: "Watcher"):
     return targets, ranks
 
 
-def _doctor_tick(w: "Watcher", doctor):
+def _doctor_tick(w: "Watcher", doctor, policy=None):
     """One diagnosis pass: scrape every worker into the history ring,
     fold in the runner's own metrics (lease ages, rpc outage gauges —
-    the control-plane signals), and run the detectors."""
+    the control-plane signals), and run the detectors.  When a shadow
+    policy engine rides along it sees the same scrape (the engine
+    duck-types as the history sink) and evaluates right after the
+    diagnosis — one tick, one consistent snapshot for both planes."""
     from ..monitor import get_monitor
     from ..monitor import cluster as _cluster
     from ..monitor.doctor import RUNNER_INSTANCE
     targets, ranks = _doctor_targets(w)
-    _cluster.aggregate(targets, history=doctor.history)
+    doctor.prune_membership(ranks)
+    _cluster.aggregate(
+        targets, history=policy if policy is not None else doctor.history)
     doctor.observe(RUNNER_INSTANCE, get_monitor().render_metrics())
-    return doctor.diagnose(ranks=ranks, version=w.version)
+    findings = doctor.diagnose(ranks=ranks, version=w.version)
+    if policy is not None:
+        policy.tick(findings, ranks=ranks, version=w.version)
+    return findings
 
 
-def _start_debug_server(w: "Watcher", port: int, doctor=None):
+def _start_debug_server(w: "Watcher", port: int, doctor=None,
+                        policy=None):
     """HTTP endpoint dumping the runner's applied Stage history + live
     worker state (reference: runner -debug-port, handler.go:117-122),
     plus ``/cluster_metrics`` — every live worker's /metrics endpoint
     scraped and merged with per-worker instance labels — and
     ``/findings`` — the kfdoctor diagnosis (each hit scrapes one more
     snapshot into the history window and re-runs the detectors) — and
+    ``/decisions`` — the shadow policy engine's ledger tail + standing
+    proposals (each hit is one more doctor+policy tick) — and
     ``/profile?duration_s=N`` — a kfprof device-trace capture fanned to
     every live worker (kungfu_tpu.monitor.{cluster,doctor,profiler};
-    docs/monitoring.md).
+    docs/monitoring.md, docs/policy.md).
     """
     import json as _json
     from http.server import BaseHTTPRequestHandler
@@ -265,6 +276,9 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
 
     if doctor is None:
         doctor = Doctor()
+    if policy is None:
+        from ..policy.engine import PolicyEngine
+        policy = PolicyEngine(history=doctor.history)
 
     def factory(_srv):
         class Handler(BaseHTTPRequestHandler):
@@ -311,10 +325,29 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
                     self.wfile.write(body)
                     return
                 if self.path.startswith("/findings"):
-                    findings = _doctor_tick(w, doctor)
+                    findings = _doctor_tick(w, doctor, policy)
                     body = _json.dumps({
                         "version": w.version,
                         "findings": [f.to_dict() for f in findings],
+                    }, indent=2).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/decisions"):
+                    # shadow policy plane (docs/policy.md): one more
+                    # doctor+policy tick, then the ledger tail — what
+                    # the engine WOULD be doing, never what it did
+                    _doctor_tick(w, doctor, policy)
+                    body = _json.dumps({
+                        "version": w.version,
+                        "shadow": True,
+                        "ticks": policy.tick_count,
+                        "active": policy.active(),
+                        "decisions": [d.to_dict()
+                                      for d in policy.decisions()],
                     }, indent=2).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -347,6 +380,7 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None):
     bind = knobs.get("KFT_DEBUG_BIND")
     srv = BackgroundHTTPServer(factory, host=bind, port=port).start()
     srv.doctor = doctor  # reachable for tests and the watch loop
+    srv.policy = policy
     return srv
 
 
@@ -414,8 +448,16 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     doctor_scrape_s = knobs.get("KFT_DOCTOR_SCRAPE_S")
     doctor = Doctor() if (doctor_scrape_s > 0 or debug_port) else None
     doctor_last = -float("inf")
+    # the shadow policy engine rides the doctor's tick: same scrape,
+    # same findings, decisions to the ledger/gauges/traces only —
+    # never to the config server (docs/policy.md "Shadow -> act")
+    policy = None
+    if doctor is not None:
+        from ..policy.engine import PolicyEngine
+        policy = PolicyEngine(history=doctor.history)
     prober = PeerLatencyProber.from_env(lambda: _doctor_targets(w)[0])
-    debug = (_start_debug_server(w, debug_port, doctor=doctor)
+    debug = (_start_debug_server(w, debug_port, doctor=doctor,
+                                 policy=policy)
              if debug_port else None)
     control = None
     try:
@@ -531,6 +573,12 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                     # reference runner does on worker death
                     w.failed = 1
                     continue
+                if policy is not None and nv != -1:
+                    # counterfactual hindsight: a shadowed exclusion
+                    # target that actually died is vindicated
+                    for p in dead:
+                        policy.note_outcome(f"{p.host}:{p.port}",
+                                            "died")
             w.retry_pending()
             if pushed_size[0] is not None:
                 global_size = pushed_size[0]
@@ -577,6 +625,13 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                                                      expired) is None:
                                     w.failed = 1
                                     continue
+                                if policy is not None:
+                                    # hindsight: the lease path beat
+                                    # the shadow proposal to it
+                                    for p in expired:
+                                        policy.note_outcome(
+                                            f"{p.host}:{p.port}",
+                                            "lease-excluded")
                             except (OSError, ValueError):
                                 # server flaked between /health and
                                 # the CAS: retry at the next poll
@@ -585,7 +640,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                 now = time.monotonic()
                 if now - doctor_last >= doctor_scrape_s:
                     doctor_last = now
-                    _doctor_tick(w, doctor)
+                    _doctor_tick(w, doctor, policy)
             if stop_when_empty and w.alive() == 0 and (
                     not config_url or global_size == 0
                     or w.all_local_done()):
@@ -601,3 +656,5 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             control.stop()
         if debug is not None:
             debug.stop()
+        if policy is not None:
+            policy.close()

@@ -155,6 +155,29 @@ _HELP = {
         "Cluster bandwidth matrix: per-link bytes/sec between src and "
         "dst workers, joined from per-worker rate gauges by "
         "cluster.aggregate (direction names the measuring side).",
+    "kungfu_tpu_finding_duration_seconds":
+        "kfdoctor: lifetime of a cleared finding, per kind — raise to "
+        "clear in the doctor's active set (policy hysteresis input, "
+        "post-mortem evidence).",
+    "kungfu_tpu_scrape_seconds":
+        "cluster.aggregate self-observability: wall time of the last "
+        "scrape of each worker's /metrics endpoint — a starved or "
+        "slow sampler shows up in the data it produces.",
+    "kungfu_tpu_scrape_errors_total":
+        "cluster.aggregate self-observability: failed scrapes per "
+        "worker since this process started.",
+    "kungfu_tpu_policy_evaluations_total":
+        "kfpolicy: policy-engine evaluation ticks (shadow mode).",
+    "kungfu_tpu_policy_decisions_total":
+        "kfpolicy: decisions appended to the ledger, per rule and "
+        "verdict (would-act/suppressed/withdrawn/hold).",
+    "kungfu_tpu_policy_suppressed_total":
+        "kfpolicy: rule firings held back, per rule and reason "
+        "(hysteresis or rate-limit).",
+    "kungfu_tpu_policy_would_act":
+        "kfpolicy: currently-standing shadow proposals per rule — "
+        "what the engine would be doing to the cluster right now if "
+        "actuation were on.",
 }
 
 # satellite guard: a buggy caller labeling by request id would grow the
@@ -432,6 +455,22 @@ class Monitor:
             if not self._admit(key, self._gauges):
                 return
             self._gauges[key] = float(value)
+
+    def remove_gauge(self, metric: str,
+                     labels: Optional[Dict[str, str]] = None) -> bool:
+        """Drop one gauge series and release its label-set slot — the
+        membership-change counterpart of :meth:`prune_targets` for
+        labeled gauges (a departed rank's ``finding_active`` must not
+        read as live forever)."""
+        key = self._key(metric, labels)
+        with self._lock:
+            if key not in self._gauges:
+                return False
+            del self._gauges[key]
+            n = self._labelsets.get(metric, 0)
+            if n > 0:
+                self._labelsets[metric] = n - 1
+            return True
 
     def inc(self, metric: str, value: float = 1.0,
             labels: Optional[Dict[str, str]] = None) -> None:

@@ -21,12 +21,21 @@ from __future__ import annotations
 
 import http.client
 import re
-from typing import Iterable, List, Optional, Tuple
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from . import MONITOR_PORT_OFFSET, _esc
 
 __all__ = ["scrape", "merge_metrics", "aggregate", "phase_shares",
            "peer_rates", "MONITOR_PORT_OFFSET"]
+
+# Self-observability: failed scrapes per instance since this process
+# started.  Process-wide (module-level) on purpose — the n=100 failure
+# mode is a *sampler* starved across many aggregate() calls, which a
+# per-call counter could never show.
+_SCRAPE_ERRORS: Dict[str, int] = {}
+_SCRAPE_LOCK = threading.Lock()
 
 # `name{labels} value` | `name value` (+ optional timestamp); group 1 =
 # metric name, 2 = existing label body (no braces), 3 = rest
@@ -153,11 +162,15 @@ def aggregate(targets: Iterable[Tuple[str, int]],
     ups: List[Tuple[str, int]] = []
     shares: List[Tuple[str, "dict"]] = []
     links: List[Tuple[str, str, str, float]] = []  # src, dst, dir, rate
+    durs: List[Tuple[str, float]] = []
+    errs: List[Tuple[str, int]] = []
     for host, port in targets:
         instance = f"{host}:{port}"
+        t0 = time.perf_counter()
         try:
             text = scrape(host, port + MONITOR_PORT_OFFSET,
                           timeout=timeout)
+            durs.append((instance, time.perf_counter() - t0))
             scraped.append((instance, text))
             ups.append((instance, 1))
             sh = phase_shares(text)
@@ -175,9 +188,18 @@ def aggregate(targets: Iterable[Tuple[str, int]],
             if history is not None:
                 history.observe_text(instance, text)
         except (OSError, ValueError, http.client.HTTPException) as e:
+            durs.append((instance, time.perf_counter() - t0))
+            with _SCRAPE_LOCK:
+                _SCRAPE_ERRORS[instance] = \
+                    _SCRAPE_ERRORS.get(instance, 0) + 1
             ups.append((instance, 0))
             scraped.append(
                 (instance, f"# scrape failed: {type(e).__name__}\n"))
+    with _SCRAPE_LOCK:
+        for instance, _up in ups:
+            n = _SCRAPE_ERRORS.get(instance)
+            if n:
+                errs.append((instance, n))
     body = merge_metrics(scraped)
     up_lines = ["# HELP kungfu_tpu_worker_up 1 when the worker's "
                 "/metrics endpoint answered the aggregation scrape.",
@@ -190,6 +212,26 @@ def aggregate(targets: Iterable[Tuple[str, int]],
                     "this launcher at aggregation time.")
     up_lines.append("# TYPE kungfu_tpu_cluster_workers gauge")
     up_lines.append(f"kungfu_tpu_cluster_workers {workers}")
+    if durs:
+        # sampler self-observability: a starved/slow aggregation loop
+        # (the n=100 failure mode) must be visible in the data it
+        # produces, not only in its absence
+        up_lines.append("# HELP kungfu_tpu_scrape_seconds wall time of "
+                        "this aggregation's scrape of each worker's "
+                        "/metrics endpoint (failures time out here too).")
+        up_lines.append("# TYPE kungfu_tpu_scrape_seconds gauge")
+        for instance, dt in durs:
+            up_lines.append(
+                f'kungfu_tpu_scrape_seconds{{'
+                f'instance="{_esc(instance)}"}} {dt:.6f}')
+    if errs:
+        up_lines.append("# HELP kungfu_tpu_scrape_errors_total failed "
+                        "scrapes per worker since this process started.")
+        up_lines.append("# TYPE kungfu_tpu_scrape_errors_total counter")
+        for instance, n in errs:
+            up_lines.append(
+                f'kungfu_tpu_scrape_errors_total{{'
+                f'instance="{_esc(instance)}"}} {n}')
     if shares:
         # kfprof attribution meta: each worker's lifetime phase shares,
         # pre-digested so `kft-doctor --url` / kfprof_report render the

@@ -600,6 +600,7 @@ class Doctor:
         self.slowlink = knobs.get("KFT_DOCTOR_SLOWLINK")
         self.slowlink_min_bps = knobs.get("KFT_DOCTOR_SLOWLINK_MIN_BPS")
         self._active: Dict[Tuple[str, str], Finding] = {}
+        self._raised_ts: Dict[Tuple[str, str], float] = {}
         self.last: List[Finding] = []
 
     def observe(self, instance: str, text: str,
@@ -647,21 +648,61 @@ class Doctor:
         an unchanged cluster re-emits nothing."""
         from .. import trace as _trace
         mon = self._mon if self._mon is not None else get_monitor()
+        now = time.time()
         now_active = {f.key(): f for f in findings}
         for key in self._active:
             if key not in now_active:
                 mon.set_gauge("kungfu_tpu_finding_active", 0.0,
                               labels={"kind": key[0], "rank": key[1]})
+                dur = now - self._raised_ts.pop(key, now)
+                mon.observe("kungfu_tpu_finding_duration_seconds", dur,
+                            labels={"kind": key[0]})
                 _trace.event("doctor.cleared", category="doctor",
-                             attrs={"kind": key[0], "rank": key[1]})
+                             attrs={"kind": key[0], "rank": key[1],
+                                    "duration_s": round(dur, 3)})
         for key, f in now_active.items():
             mon.set_gauge("kungfu_tpu_finding_active", 1.0,
                           labels={"kind": key[0], "rank": key[1]})
             if key not in self._active:
+                self._raised_ts.setdefault(key, now)
                 _trace.event("doctor.finding", category="doctor",
                              rank=f.rank, version=f.version,
                              attrs=f.to_dict())
         self._active = now_active
+
+    def prune_membership(self, ranks: Dict[str, int]) -> None:
+        """Membership shrank: drop active findings (and their
+        ``kungfu_tpu_finding_active{kind,rank}`` gauge label-sets)
+        whose rank or instance is no longer in the live map — the same
+        prune treatment the per-peer rate gauges get, else a departed
+        rank's finding reads as live forever.  Control-plane keys
+        (runner / config-server identities) are never pruned."""
+        from .. import trace as _trace
+        mon = self._mon if self._mon is not None else get_monitor()
+        live_ranks = {str(r) for r in ranks.values()}
+        live_inst = set(ranks)
+        now = time.time()
+        for key in list(self._active):
+            ident = key[1]
+            if ident.isdigit():
+                gone = ident not in live_ranks
+            elif ":" in ident and not ident.startswith(("http", "ctrl")) \
+                    and ident != RUNNER_INSTANCE:
+                gone = ident not in live_inst
+            else:
+                gone = False
+            if not gone:
+                continue
+            del self._active[key]
+            mon.remove_gauge("kungfu_tpu_finding_active",
+                             labels={"kind": key[0], "rank": key[1]})
+            dur = now - self._raised_ts.pop(key, now)
+            mon.observe("kungfu_tpu_finding_duration_seconds", dur,
+                        labels={"kind": key[0]})
+            _trace.event("doctor.cleared", category="doctor",
+                         attrs={"kind": key[0], "rank": key[1],
+                                "duration_s": round(dur, 3),
+                                "reason": "membership"})
 
 
 def render_report(findings: Iterable[Finding]) -> str:

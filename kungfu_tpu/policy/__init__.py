@@ -1,0 +1,35 @@
+"""kfpolicy — the decision-observability plane (shadow mode).
+
+The paper's signature capability is *acting* on monitoring signals
+(adaptive strategy switches, ``resize_cluster``); this repo has both
+halves — kfdoctor emits structured Findings with evidence, and the
+typed knob registry plus ``propose_exclusion`` / config-server CAS
+form a uniform actuation surface — but nothing sits between them.
+This package is that controller, shipped observation-first:
+
+- :mod:`.rules` — a small typed rule set (straggler → exclusion
+  proposal with hysteresis + a rate limiter; GNS-optimal worker count
+  from the ``kungfu_tpu_grad_noise_scale`` gauge; snapshot-cadence
+  retune from measured commit cost vs ``KFT_SNAPSHOT_BUDGET``;
+  SLO-burn → replica/admission recommendation);
+- :mod:`.ledger` — every evaluation's verdict as a :class:`Decision`
+  record in a bounded ring + fsync'd JSONL ledger, with counterfactual
+  ``outcome`` annotations (vindicated / spurious / overtaken) when
+  hindsight arrives;
+- :mod:`.engine` — the deterministic evaluator: runs inside the
+  watcher loop (``/decisions`` on the debug port) or as a standalone
+  sampler, and replays bit-identically over a saved
+  :class:`~kungfu_tpu.monitor.history.MetricsHistory` journal
+  (``kft-policy --history``) — determinism is the acceptance gate for
+  flipping actuation on.
+
+Shadow mode is absolute: nothing in this package mutates cluster
+state.  See docs/policy.md.
+"""
+from __future__ import annotations
+
+from .ledger import Decision, DecisionLedger
+from .engine import PolicyEngine, derive_ranks, verify_replay
+
+__all__ = ["Decision", "DecisionLedger", "PolicyEngine",
+           "derive_ranks", "verify_replay"]

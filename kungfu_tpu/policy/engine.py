@@ -1,0 +1,331 @@
+"""The shadow policy engine: deterministic evaluation + replay.
+
+:class:`PolicyEngine` sits between the sensors (the metrics journal
+plus kfdoctor Findings) and the actuation surface (``propose_exclusion``,
+config-server CAS, the typed knobs) — but in shadow mode the actuation
+edge is cut: every evaluation only *records* what it would do, into the
+:class:`~.ledger.DecisionLedger`, the policy metric families
+(``..._policy_evaluations_total`` and friends), and kftrace
+``policy.decision`` events.
+
+Replay determinism is the design center.  The engine duck-types as the
+``history`` argument to :func:`kungfu_tpu.monitor.cluster.aggregate`
+(it implements ``observe_text``), so every scrape row flows through the
+engine: it lands in the underlying
+:class:`~kungfu_tpu.monitor.history.MetricsHistory` *and* in a per-tick
+journal.  :meth:`PolicyEngine.save_history` writes that journal as
+tick-annotated JSONL — a strict superset of the ``MetricsHistory.save``
+format (``MetricsHistory.load`` ignores the extra keys, so ``kft-doctor
+--history`` reads the same file) — and :meth:`PolicyEngine.replay`
+re-feeds it tick by tick through the *same* evaluation path.  Because
+rules consume only snapshot state and snapshot time (never
+``time.time()``), the replayed ledger reproduces the live one
+bit-identically modulo the counterfactual ``outcome`` fields, which
+depend on wall-clock hindsight the journal cannot carry.  That identity
+(:func:`verify_replay`) is the acceptance gate for ever flipping
+actuation on.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+from ..monitor import Monitor, get_monitor
+from ..monitor.doctor import Doctor, Finding, _fresh_instances
+from ..monitor.history import MetricsHistory, parse_metrics
+from ..utils import knobs
+from .ledger import Decision, DecisionLedger, OVERTAKEN, SPURIOUS, VINDICATED
+from .rules import EvalContext, Rule, default_rules
+
+__all__ = ["PolicyEngine", "derive_ranks", "verify_replay"]
+
+# journal row: (instance, ts, parsed samples)
+_Row = Tuple[str, float, Dict[object, float]]
+
+# hindsight event -> counterfactual outcome for an active proposal
+_OUTCOMES = {"died": VINDICATED, "preempted": VINDICATED,
+             "lease-excluded": OVERTAKEN, "excluded": OVERTAKEN,
+             "recovered": SPURIOUS}
+
+
+def derive_ranks(instances: Iterable[str]) -> Dict[str, int]:
+    """Deterministic instance -> rank map shared by the live samplers
+    and replay: sort by (host, numeric port).  Matches the launcher's
+    rank assignment wherever ports ascend with rank (the sim cluster
+    and the smoke fixtures)."""
+    def key(inst: str) -> Tuple[str, int, str]:
+        host, _, port = inst.rpartition(":")
+        try:
+            return (host, int(port), inst)
+        except ValueError:
+            return (inst, -1, inst)
+    return {inst: i for i, inst in enumerate(sorted(set(instances),
+                                                    key=key))}
+
+
+class PolicyEngine:
+    """Evaluate the rule set over the journal; record, never act."""
+
+    def __init__(self, history: Optional[MetricsHistory] = None,
+                 monitor: Optional[Monitor] = None,
+                 rules: Optional[List[Rule]] = None,
+                 ledger: Optional[DecisionLedger] = None,
+                 ledger_path: Optional[str] = None):
+        self.history = history if history is not None else MetricsHistory()
+        self._mon = monitor
+        self.rules = rules if rules is not None else default_rules()
+        if ledger is None:
+            if ledger_path is None:
+                tdir = knobs.get("KFT_TRACE_DIR")
+                if tdir:
+                    ledger_path = os.path.join(
+                        str(tdir), f"kfpolicy.{os.getpid()}.jsonl")
+            ledger = DecisionLedger(ring=knobs.get("KFT_POLICY_RING"),
+                                    path=ledger_path)
+        self.ledger = ledger
+        self.stale_s = knobs.get("KFT_DOCTOR_STALE_S")
+        self.tick_count = 0
+        # per-tick journal: bounded like the history ring so a
+        # long-lived watcher engine stays O(window) in memory; the sim
+        # samplers size the history to cover the whole run.
+        self._journal: Deque[Tuple[int, List[_Row]]] = collections.deque(
+            maxlen=self.history.window)
+        self._pending: List[_Row] = []
+        self._targets: List[str] = []
+        # (rule, target) -> seq of the live would-act decision, for
+        # withdrawal + counterfactual annotation.
+        self._would_act: Dict[Tuple[str, str], int] = {}
+
+    def set_targets(self, instances: Iterable[str]) -> None:
+        """Record the scrape roster.  The samplers call this once so the
+        saved journal carries the full instance universe — replay must
+        derive the SAME rank numbering even for instances that never
+        answered a scrape."""
+        self._targets = sorted(set(instances))
+
+    # ------------------------------------------------------------ ingest
+    def observe_text(self, instance: str, text: str,
+                     ts: Optional[float] = None) -> None:
+        """Duck-types as ``aggregate(..., history=engine)``: the row
+        lands in the history AND the tick journal."""
+        t = time.time() if ts is None else float(ts)
+        samples = parse_metrics(text)
+        self.history.append(instance, samples, ts=t)
+        self._pending.append((instance, t, samples))
+
+    # -------------------------------------------------------- evaluation
+    def tick(self, findings: Iterable[Finding] = (),
+             ranks: Optional[Dict[str, int]] = None,
+             version: Optional[int] = None) -> List[Decision]:
+        """One evaluation over everything scraped since the last tick."""
+        rows, self._pending = self._pending, []
+        self._journal.append((self.tick_count, rows))
+        now = self.history.latest_ts() or 0.0
+        ctx = EvalContext(
+            history=self.history, findings=list(findings),
+            ranks=dict(ranks or {}),
+            fresh=_fresh_instances(self.history, self.stale_s),
+            now=now, tick=self.tick_count, version=version)
+        mon = self._mon if self._mon is not None else get_monitor()
+        out: List[Decision] = []
+        for rule in self.rules:
+            for p in rule.evaluate(ctx):
+                out.append(self._record(mon, rule.name, p, now, version))
+        self.tick_count += 1
+        mon.inc("kungfu_tpu_policy_evaluations_total")
+        active_by_rule: Dict[str, int] = {r.name: 0 for r in self.rules}
+        for (rname, _t) in self._would_act:
+            active_by_rule[rname] = active_by_rule.get(rname, 0) + 1
+        for rname, n in active_by_rule.items():
+            mon.set_gauge("kungfu_tpu_policy_would_act", float(n),
+                          labels={"rule": rname})
+        return out
+
+    def _record(self, mon: Monitor, rule: str, p: Dict[str, object],
+                now: float, version: Optional[int]) -> Decision:
+        from .. import trace as _trace
+        d = Decision(
+            seq=self.ledger.next_seq(), tick=self.tick_count, ts=now,
+            rule=rule, verdict=str(p["verdict"]),
+            action=str(p.get("action", "")),
+            target=p.get("target"), rank=p.get("rank"),  # type: ignore
+            inputs=dict(p.get("inputs") or {}),          # type: ignore
+            suppressed_by=p.get("suppressed_by"),        # type: ignore
+            version=version)
+        self.ledger.append(d)
+        mon.inc("kungfu_tpu_policy_decisions_total",
+                labels={"rule": rule, "verdict": d.verdict})
+        if d.suppressed_by:
+            mon.inc("kungfu_tpu_policy_suppressed_total",
+                    labels={"rule": rule, "reason": d.suppressed_by})
+        _trace.event("policy.decision", category="policy",
+                     rank=d.rank, version=version, attrs=d.to_dict())
+        key = (rule, d.target or "")
+        if d.verdict == "would-act" and d.target is not None:
+            self._would_act[key] = d.seq
+        elif d.verdict == "withdrawn":
+            seq = self._would_act.pop(key, None)
+            if seq is not None:
+                self.ledger.annotate(seq, SPURIOUS, reason="recovered",
+                                     ts=now)
+        return d
+
+    # ------------------------------------------------------- hindsight
+    def note_outcome(self, target: str, event: str,
+                     ts: Optional[float] = None) -> int:
+        """Counterfactual annotation: the watcher saw hindsight for
+        ``target`` (``died`` / ``lease-excluded`` / ``recovered``).
+        Annotates every active shadow proposal naming the target and
+        drops the rules' per-target state so no withdrawal fires for a
+        peer that no longer exists.  Returns annotations applied."""
+        outcome = _OUTCOMES.get(event)
+        if outcome is None:
+            return 0
+        n = 0
+        for (rname, t), seq in list(self._would_act.items()):
+            if t != target:
+                continue
+            if self.ledger.annotate(
+                    seq, outcome, reason=event,
+                    ts=time.time() if ts is None else ts):
+                n += 1
+            del self._would_act[(rname, t)]
+        if n:
+            for rule in self.rules:
+                rule.forget_target(target)
+        return n
+
+    # --------------------------------------------------------- accessors
+    def decisions(self) -> List[Decision]:
+        return self.ledger.decisions()
+
+    def active(self) -> List[Dict[str, object]]:
+        """The currently-standing shadow proposals."""
+        by_seq = {d.seq: d for d in self.ledger.decisions()}
+        return [by_seq[seq].to_dict()
+                for seq in sorted(self._would_act.values())
+                if seq in by_seq]
+
+    def close(self) -> None:
+        self.ledger.close()
+
+    # ----------------------------------------------------- save / replay
+    def save_history(self, path: str) -> None:
+        """Tick-annotated journal JSONL.  Superset of
+        ``MetricsHistory.save``: every line still carries
+        ``instance``/``ts``/``samples`` (so ``MetricsHistory.load`` and
+        ``kft-doctor --history`` accept it) plus ``tick`` and the ring
+        ``window``, which :meth:`replay` needs for bit-identity."""
+        rows = [(tick, inst, ts, samples)
+                for tick, tick_rows in list(self._journal)
+                for (inst, ts, samples) in tick_rows]
+        with open(path, "w", encoding="utf-8") as f:
+            first = True
+            for tick, inst, ts, samples in rows:
+                doc: Dict[str, object] = {
+                    "tick": tick, "window": self.history.window,
+                    "instance": inst, "ts": ts,
+                    "samples": [[name, dict(lab), v]
+                                for (name, lab), v in samples.items()],
+                }
+                if first:
+                    # Journal meta rides on the first row only (every
+                    # row must keep the MetricsHistory.load shape):
+                    # the scrape roster (rank numbering must cover
+                    # never-answering instances too) and the total tick
+                    # count (trailing all-failed ticks leave no rows).
+                    doc["targets"] = list(self._targets)
+                    doc["ticks"] = self.tick_count
+                    first = False
+                f.write(json.dumps(doc) + "\n")
+
+    @classmethod
+    def replay(cls, path: str,
+               rules: Optional[List[Rule]] = None) -> "PolicyEngine":
+        """Re-run the evaluation over a saved journal.
+
+        Rows grouped by their exact ``tick`` reproduce the live scrape
+        batching (including mid-run flakes); files saved by plain
+        ``MetricsHistory.save`` (no ``tick`` key) fall back to one row
+        per instance per tick, end-aligned.  Findings are regenerated by
+        a private :class:`Doctor` with the same knob-resolved thresholds;
+        ranks come from :func:`derive_ranks` — the map the live samplers
+        use.  ``version`` stays ``None``, as it does in the samplers."""
+        ticks: Dict[int, List[_Row]] = {}
+        window = 0
+        total_ticks: Optional[int] = None
+        targets: Optional[List[str]] = None
+        fallback: Dict[str, List[_Row]] = {}
+        tickless = False
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                samples = {(name, tuple(sorted(lab.items()))): float(v)
+                           for name, lab, v in doc["samples"]}
+                row: _Row = (doc["instance"], float(doc["ts"]), samples)
+                if targets is None and "targets" in doc:
+                    targets = [str(t) for t in doc["targets"]]
+                if total_ticks is None and "ticks" in doc:
+                    total_ticks = int(doc["ticks"])
+                if "tick" in doc:
+                    ticks.setdefault(int(doc["tick"]), []).append(row)
+                    window = max(window, int(doc.get("window", 0)))
+                else:
+                    tickless = True
+                    fallback.setdefault(doc["instance"], []).append(row)
+        if tickless and not ticks:
+            depth = max((len(rs) for rs in fallback.values()), default=0)
+            for inst, rs in sorted(fallback.items()):
+                pad = depth - len(rs)       # end-aligned prefixes
+                for i, row in enumerate(rs):
+                    ticks.setdefault(pad + i, []).append(row)
+            window = depth
+        mon = Monitor()
+        eng = cls(history=MetricsHistory(window=max(1, window)),
+                  monitor=mon, rules=rules,
+                  ledger=DecisionLedger(
+                      ring=knobs.get("KFT_POLICY_RING"), path=None))
+        if targets is not None:
+            eng.set_targets(targets)
+        doctor = Doctor(history=eng.history, monitor=mon)
+        seen: set = set()
+        n_ticks = total_ticks if total_ticks is not None else (
+            max(ticks) + 1 if ticks else 0)
+        for tick in range(n_ticks):
+            for inst, ts, samples in ticks.get(tick, []):
+                eng.history.append(inst, samples, ts=ts)
+                eng._pending.append((inst, ts, samples))
+                seen.add(inst)
+            ranks = derive_ranks(targets if targets is not None else seen)
+            findings = doctor.diagnose(ranks=ranks)
+            eng.tick(findings, ranks=ranks, version=None)
+        return eng
+
+
+def verify_replay(history_path: str, live: List[Dict[str, object]],
+                  rules: Optional[List[Rule]] = None) -> List[str]:
+    """Bit-identity check between a live ledger and its replay.
+
+    ``live`` is the live run's decisions as dicts (e.g. loaded from the
+    ledger JSONL).  Compares :meth:`Decision.replay_view` projections —
+    everything except the wall-clock ``outcome`` fields.  Returns
+    human-readable mismatches; empty means the gate holds."""
+    replayed = PolicyEngine.replay(history_path, rules=rules).decisions()
+    errs: List[str] = []
+    want = [Decision.from_dict(d).replay_view() for d in live]
+    got = [d.replay_view() for d in replayed]
+    if len(want) != len(got):
+        errs.append(f"decision count: live={len(want)} replay={len(got)}")
+    for i, (w, g) in enumerate(zip(want, got)):
+        if w != g:
+            for k in sorted(set(w) | set(g)):
+                if w.get(k) != g.get(k):
+                    errs.append(f"decision[{i}].{k}: "
+                                f"live={w.get(k)!r} replay={g.get(k)!r}")
+    return errs

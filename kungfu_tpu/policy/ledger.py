@@ -1,0 +1,199 @@
+"""Decision records and the durable, replayable decision ledger.
+
+A :class:`Decision` is one policy evaluation's verdict about one
+target: the rule that fired, the exact action it *would* take (shadow
+mode never takes it), the deterministic inputs snapshot the verdict was
+derived from, and the suppression state (hysteresis / rate limiter)
+when the rule held fire.  Decisions are emitted on verdict
+*transitions* — the same idiom as the doctor's active-set export — so
+"zero flapping" is checkable as "exactly one would-act entry and no
+withdrawal" straight off the ledger.
+
+The :class:`DecisionLedger` keeps a bounded in-memory ring (the
+``/decisions`` endpoint serves from it) and, when given a path, appends
+each record to a JSONL file with an fsync per line so a SIGKILL'd
+watcher loses at most the decision in flight.  Counterfactual
+``outcome`` annotations (vindicated / spurious / overtaken) arrive
+*after* the decision was written; JSONL is append-only, so they are
+appended as separate ``{"kind": "annotation", "seq": ...}`` records and
+patched into the ring copy.  Replay identity therefore compares
+decisions *minus* the outcome fields (:meth:`Decision.replay_view`):
+hindsight depends on wall-clock events the saved metrics journal does
+not carry.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, IO, List, Optional
+
+__all__ = ["Decision", "DecisionLedger",
+           "VINDICATED", "SPURIOUS", "OVERTAKEN"]
+
+# Counterfactual outcomes, annotated with hindsight:
+VINDICATED = "vindicated"   # the shadowed target later died / was preempted
+SPURIOUS = "spurious"       # the shadowed target recovered on its own
+OVERTAKEN = "overtaken"     # the lease path excluded it before policy would
+
+
+@dataclass
+class Decision:
+    """One policy evaluation's verdict about one target (shadow mode).
+
+    ``ts`` is *snapshot time* — the newest scrape timestamp visible at
+    the evaluation, never ``time.time()`` — so a replay over the saved
+    journal reproduces it bit-identically.  ``outcome``/``outcome_ts``
+    are the only wall-clock-dependent fields and are excluded from
+    replay identity (:meth:`replay_view`).
+    """
+
+    seq: int                  # ledger sequence number (per engine)
+    tick: int                 # evaluation index the decision fired on
+    ts: float                 # snapshot time of the evaluation window
+    rule: str                 # e.g. "straggler-exclusion"
+    verdict: str              # would-act | suppressed | withdrawn | hold
+    action: str               # the exact action shadow mode withheld
+    target: Optional[str] = None    # instance host:port (None: cluster)
+    rank: Optional[int] = None
+    inputs: Dict[str, object] = field(default_factory=dict)
+    suppressed_by: Optional[str] = None   # hysteresis | rate-limit
+    version: Optional[int] = None         # membership version, if known
+    outcome: Optional[str] = None         # vindicated|spurious|overtaken
+    outcome_ts: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, object]:
+        d: Dict[str, object] = {
+            "seq": self.seq, "tick": self.tick, "ts": self.ts,
+            "rule": self.rule, "verdict": self.verdict,
+            "action": self.action, "target": self.target,
+            "rank": self.rank, "inputs": dict(self.inputs),
+            "suppressed_by": self.suppressed_by, "version": self.version,
+        }
+        if self.outcome is not None:
+            d["outcome"] = self.outcome
+            d["outcome_ts"] = self.outcome_ts
+        return d
+
+    def replay_view(self) -> Dict[str, object]:
+        """The deterministic projection compared across live vs replay."""
+        d = self.to_dict()
+        d.pop("outcome", None)
+        d.pop("outcome_ts", None)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Decision":
+        return cls(seq=int(d["seq"]), tick=int(d["tick"]),
+                   ts=float(d["ts"]), rule=str(d["rule"]),
+                   verdict=str(d["verdict"]), action=str(d["action"]),
+                   target=d.get("target"),      # type: ignore[arg-type]
+                   rank=(None if d.get("rank") is None
+                         else int(d["rank"])),  # type: ignore[arg-type]
+                   inputs=dict(d.get("inputs") or {}),
+                   suppressed_by=d.get("suppressed_by"),  # type: ignore
+                   version=(None if d.get("version") is None
+                            else int(d["version"])),  # type: ignore
+                   outcome=d.get("outcome"),        # type: ignore
+                   outcome_ts=(None if d.get("outcome_ts") is None
+                               else float(d["outcome_ts"])))  # type: ignore
+
+
+class DecisionLedger:
+    """Bounded ring + fsync'd JSONL of :class:`Decision` records."""
+
+    def __init__(self, ring: int = 512, path: Optional[str] = None):
+        self._ring: "collections.deque[Decision]" = \
+            collections.deque(maxlen=max(1, int(ring)))
+        self._by_seq: Dict[int, Decision] = {}
+        self._next_seq = 0
+        self._lock = threading.Lock()
+        self.path = path
+        self._fh: Optional[IO[str]] = None
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a", encoding="utf-8")
+
+    def next_seq(self) -> int:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
+
+    def append(self, d: Decision) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                old = self._ring[0]
+                self._by_seq.pop(old.seq, None)
+            self._ring.append(d)
+            self._by_seq[d.seq] = d
+            self._write({"kind": "decision", **d.to_dict()})
+
+    def annotate(self, seq: int, outcome: str, *, reason: str,
+                 ts: Optional[float] = None) -> bool:
+        """Patch hindsight onto an earlier decision; append-only on disk."""
+        with self._lock:
+            d = self._by_seq.get(seq)
+            if d is None or d.outcome is not None:
+                return False
+            d.outcome = outcome
+            d.outcome_ts = ts
+            self._write({"kind": "annotation", "seq": seq,
+                         "outcome": outcome, "reason": reason, "ts": ts})
+            return True
+
+    def _write(self, doc: Dict[str, object]) -> None:
+        # Callers hold self._lock.
+        if self._fh is None:
+            return
+        try:
+            self._fh.write(json.dumps(doc, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError:
+            # Durability is best-effort: a full/odd filesystem must not
+            # take down the watcher loop the ledger observes.
+            pass
+
+    def decisions(self) -> List[Decision]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    @staticmethod
+    def load(path: str) -> List[Decision]:
+        """Read a ledger JSONL back, applying annotation records."""
+        out: List[Decision] = []
+        by_seq: Dict[int, Decision] = {}
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                doc = json.loads(line)
+                if doc.get("kind") == "annotation":
+                    d = by_seq.get(int(doc["seq"]))
+                    if d is not None and d.outcome is None:
+                        d.outcome = doc.get("outcome")
+                        d.outcome_ts = doc.get("ts")
+                    continue
+                d = Decision.from_dict(doc)
+                out.append(d)
+                by_seq[d.seq] = d
+        return out

@@ -1,0 +1,359 @@
+"""The typed rule set the shadow policy engine evaluates each tick.
+
+Every rule is a pure-ish object: ``evaluate(ctx)`` reads only the
+deterministic :class:`EvalContext` (the metrics journal, the doctor's
+findings, the rank map, and *snapshot* time) plus its own knob-resolved
+parameters and internal streak state, and returns verdict *transitions*
+as plain dicts — the engine stamps them into :class:`~.ledger.Decision`
+records.  Nothing here reads ``time.time()`` or any other ambient
+state, which is what makes ``kft-policy --history`` replay reproduce a
+live ledger bit-identically.
+
+Rules:
+
+``straggler-exclusion``
+    Consumes ``straggler`` findings.  Hysteresis: the finding must hold
+    for ``KFT_POLICY_HYSTERESIS`` consecutive evaluations before the
+    rule would act (the first sighting logs a ``suppressed`` decision
+    so the build-up is visible).  Rate limiter: at most
+    ``KFT_POLICY_MAX_PROPOSALS`` concurrent proposals and a
+    ``KFT_POLICY_COOLDOWN_S`` gap (in snapshot time) between proposals.
+    A proposal whose finding stays clear for
+    ``KFT_POLICY_CLEAR_HYSTERESIS`` evaluations is withdrawn (the
+    engine annotates it ``spurious``).
+
+``gns-worker-count``
+    Reads the ``kungfu_tpu_grad_noise_scale`` gauge (published by
+    ``publish_optimizer_gauges``) across fresh instances; the
+    critical-batch heuristic says ~``B_crit = gns`` samples/step, so
+    with ``KFT_POLICY_GNS_BATCH`` samples per worker the efficient
+    worker count is ``gns / batch``, quantized to a power of two.  Only
+    recommends when the target differs from the current fleet by the
+    ``KFT_POLICY_GNS_DEADBAND`` factor.
+
+``snapshot-cadence``
+    Compares measured commit cost (``kungfu_tpu_snapshot_seconds`` p50)
+    against the step-time budget ``KFT_SNAPSHOT_BUDGET`` and recommends
+    snapshotting every ``k = ceil(snap_p50 / (budget * step_p50))``
+    steps.
+
+``slo-burn``
+    Consumes ``slo-violation`` findings (kfload/serving plane) with the
+    same hysteresis as the straggler rule; the would-take action is
+    capacity (queue-dominated burn) or a profile retune (prefill- or
+    decode-dominated).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..monitor.doctor import Finding, _lower_median
+from ..monitor.history import MetricsHistory
+from ..utils import knobs
+
+__all__ = ["EvalContext", "Rule", "StragglerExclusionRule",
+           "GNSWorkerCountRule", "SnapshotCadenceRule", "SLOBurnRule",
+           "default_rules"]
+
+
+@dataclass
+class EvalContext:
+    """Everything a rule may look at.  All fields are deterministic
+    functions of the saved journal (``now`` is snapshot time)."""
+
+    history: MetricsHistory
+    findings: List[Finding]
+    ranks: Dict[str, int]          # instance -> rank
+    fresh: List[str]               # non-stale worker instances
+    now: float                     # newest snapshot ts at this tick
+    tick: int
+    version: Optional[int] = None
+
+
+class Rule:
+    """Base class: stateful transition detector over evaluations."""
+
+    name = "rule"
+
+    def evaluate(self, ctx: EvalContext) -> List[Dict[str, object]]:
+        raise NotImplementedError
+
+    def forget_target(self, target: str) -> None:
+        """Drop per-target state after hindsight resolved it (the
+        target died or was excluded) so no withdrawal fires later."""
+
+
+def _latest(history: MetricsHistory, inst: str, metric: str,
+            labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+    pts = history.series(inst, metric, labels)
+    return pts[-1][1] if pts else None
+
+
+@dataclass
+class _Proposal:
+    rank: Optional[int]
+    ts: float
+
+
+class StragglerExclusionRule(Rule):
+    """straggler finding, held through hysteresis -> would propose
+    excluding the rank via the config-server CAS (shadow: withheld)."""
+
+    name = "straggler-exclusion"
+
+    def __init__(self) -> None:
+        self.hysteresis = max(1, knobs.get("KFT_POLICY_HYSTERESIS"))
+        self.clear_hysteresis = max(
+            1, knobs.get("KFT_POLICY_CLEAR_HYSTERESIS"))
+        self.cooldown_s = knobs.get("KFT_POLICY_COOLDOWN_S")
+        self.max_proposals = max(1, knobs.get("KFT_POLICY_MAX_PROPOSALS"))
+        self._streak: Dict[str, int] = {}
+        self._clear_streak: Dict[str, int] = {}
+        self._active: Dict[str, _Proposal] = {}
+        self._suppressed: Dict[str, str] = {}   # target -> last reason
+        self._last_proposal_ts: Optional[float] = None
+
+    def forget_target(self, target: str) -> None:
+        self._streak.pop(target, None)
+        self._clear_streak.pop(target, None)
+        self._active.pop(target, None)
+        self._suppressed.pop(target, None)
+
+    @staticmethod
+    def _inputs(f: Finding) -> Dict[str, object]:
+        # Finding evidence is already rounded, deterministic values;
+        # detected_ts is wall clock and must stay out of Decision.inputs.
+        return {"kind": f.kind, "severity": f.severity,
+                "windows": f.windows, **dict(f.evidence)}
+
+    def evaluate(self, ctx: EvalContext) -> List[Dict[str, object]]:
+        out: List[Dict[str, object]] = []
+        current = {f.instance: f for f in ctx.findings
+                   if f.kind == "straggler"}
+        for target, f in sorted(current.items()):
+            self._clear_streak.pop(target, None)
+            streak = self._streak.get(target, 0) + 1
+            self._streak[target] = streak
+            rank = f.rank if f.rank is not None else ctx.ranks.get(target)
+            action = (f"propose_exclusion: CAS-remove {target}"
+                      + (f" (rank {rank})" if rank is not None else "")
+                      + " from the membership")
+            if target in self._active:
+                continue            # already proposed; hold, no flap
+            if streak < self.hysteresis:
+                if self._suppressed.get(target) != "hysteresis":
+                    self._suppressed[target] = "hysteresis"
+                    out.append({
+                        "verdict": "suppressed",
+                        "suppressed_by": "hysteresis",
+                        "target": target, "rank": rank, "action": action,
+                        "inputs": {**self._inputs(f), "streak": streak,
+                                   "need": self.hysteresis}})
+                continue
+            limited = None
+            if len(self._active) >= self.max_proposals:
+                limited = "rate-limit"
+            elif (self._last_proposal_ts is not None
+                  and ctx.now - self._last_proposal_ts < self.cooldown_s):
+                limited = "rate-limit"
+            if limited:
+                if self._suppressed.get(target) != limited:
+                    self._suppressed[target] = limited
+                    out.append({
+                        "verdict": "suppressed", "suppressed_by": limited,
+                        "target": target, "rank": rank, "action": action,
+                        "inputs": {**self._inputs(f),
+                                   "active_proposals": len(self._active),
+                                   "cooldown_s": self.cooldown_s}})
+                continue
+            self._active[target] = _Proposal(rank=rank, ts=ctx.now)
+            self._last_proposal_ts = ctx.now
+            self._suppressed.pop(target, None)
+            out.append({
+                "verdict": "would-act", "target": target, "rank": rank,
+                "action": action,
+                "inputs": {**self._inputs(f), "streak": streak}})
+        # Recovery: targets we were tracking that no longer have a
+        # finding.  An active proposal is withdrawn only after
+        # clear_hysteresis consecutive clean evaluations (scrape flake
+        # must not read as recovery).
+        for target in sorted(set(self._streak) - set(current)):
+            self._streak.pop(target, None)
+            self._suppressed.pop(target, None)
+        for target in sorted(set(self._active) - set(current)):
+            c = self._clear_streak.get(target, 0) + 1
+            self._clear_streak[target] = c
+            if c < self.clear_hysteresis:
+                continue
+            prop = self._active.pop(target)
+            self._clear_streak.pop(target, None)
+            out.append({
+                "verdict": "withdrawn", "target": target,
+                "rank": prop.rank,
+                "action": "drop shadow exclusion proposal for "
+                          f"{target}: finding cleared",
+                "inputs": {"clear_evals": c,
+                           "need": self.clear_hysteresis}})
+        return out
+
+
+class GNSWorkerCountRule(Rule):
+    """gradient-noise-scale gauge -> efficient worker-count target."""
+
+    name = "gns-worker-count"
+
+    def __init__(self) -> None:
+        self.batch_per_worker = max(1, knobs.get("KFT_POLICY_GNS_BATCH"))
+        self.deadband = max(1.0, knobs.get("KFT_POLICY_GNS_DEADBAND"))
+        self._last_rec: Optional[int] = None
+
+    def evaluate(self, ctx: EvalContext) -> List[Dict[str, object]]:
+        vals = []
+        for inst in ctx.fresh:
+            v = _latest(ctx.history, inst, "kungfu_tpu_grad_noise_scale")
+            if v is not None and v > 0:
+                vals.append(v)
+        n_now = len(ctx.fresh)
+        if not vals or n_now < 1:
+            return []
+        gns = _lower_median(vals)
+        n_raw = max(1.0, gns / self.batch_per_worker)
+        n_opt = 2 ** int(round(math.log2(n_raw)))
+        inputs = {"gns_median": round(gns, 3),
+                  "batch_per_worker": self.batch_per_worker,
+                  "workers_now": n_now, "workers_opt": n_opt}
+        ratio = max(n_opt, n_now) / max(1, min(n_opt, n_now))
+        if ratio >= self.deadband and n_opt != n_now:
+            if self._last_rec == n_opt:
+                return []
+            self._last_rec = n_opt
+            verb = "grow" if n_opt > n_now else "shrink"
+            return [{"verdict": "would-act", "action":
+                     f"resize_cluster: {verb} from {n_now} to {n_opt} "
+                     "workers (critical-batch heuristic)",
+                     "inputs": inputs}]
+        if self._last_rec is not None:
+            self._last_rec = None
+            return [{"verdict": "hold", "action":
+                     f"keep {n_now} workers: grad-noise scale back "
+                     "inside the deadband", "inputs": inputs}]
+        return []
+
+
+class SnapshotCadenceRule(Rule):
+    """measured commit cost vs KFT_SNAPSHOT_BUDGET -> cadence retune."""
+
+    name = "snapshot-cadence"
+
+    def __init__(self) -> None:
+        self.budget = max(1e-6, knobs.get("KFT_SNAPSHOT_BUDGET"))
+        self._last_rec: Optional[int] = None
+
+    def evaluate(self, ctx: EvalContext) -> List[Dict[str, object]]:
+        steps, snaps = [], []
+        for inst in ctx.fresh:
+            s = _latest(ctx.history, inst, "kungfu_tpu_step_seconds",
+                        {"quantile": "0.5"})
+            c = _latest(ctx.history, inst, "kungfu_tpu_snapshot_seconds",
+                        {"quantile": "0.5"})
+            if s is not None and s > 0 and c is not None and c > 0:
+                steps.append(s)
+                snaps.append(c)
+        if not steps:
+            return []
+        step_p50 = _lower_median(steps)
+        snap_p50 = _lower_median(snaps)
+        k = max(1, int(math.ceil(snap_p50 / (self.budget * step_p50))))
+        inputs = {"step_p50_s": round(step_p50, 6),
+                  "snapshot_p50_s": round(snap_p50, 6),
+                  "budget": self.budget, "cadence_steps": k}
+        if k != (self._last_rec if self._last_rec is not None else 1):
+            self._last_rec = k
+            if k == 1:
+                return [{"verdict": "hold", "action":
+                         "snapshot cadence back to every step: commit "
+                         "cost fits the budget", "inputs": inputs}]
+            return [{"verdict": "would-act", "action":
+                     f"retune snapshot cadence to every {k} steps so "
+                     "commit cost stays within "
+                     f"{self.budget:.0%} of step time", "inputs": inputs}]
+        return []
+
+
+class SLOBurnRule(Rule):
+    """slo-violation finding, held through hysteresis -> capacity or
+    profile recommendation keyed by the dominant phase."""
+
+    name = "slo-burn"
+
+    def __init__(self) -> None:
+        self.hysteresis = max(1, knobs.get("KFT_POLICY_HYSTERESIS"))
+        self.clear_hysteresis = max(
+            1, knobs.get("KFT_POLICY_CLEAR_HYSTERESIS"))
+        self._streak: Dict[str, int] = {}
+        self._clear_streak: Dict[str, int] = {}
+        self._active: Dict[str, Dict[str, object]] = {}
+
+    def forget_target(self, target: str) -> None:
+        self._streak.pop(target, None)
+        self._clear_streak.pop(target, None)
+        self._active.pop(target, None)
+
+    @staticmethod
+    def _action(target: str, f: Finding) -> str:
+        phase = str(f.evidence.get("dominant_phase", ""))
+        if phase == "queue":
+            return (f"add serving capacity for {target}: another "
+                    "replica behind the router or more admission slots "
+                    "(queue-dominated burn)")
+        return (f"retune the serving profile at {target}: "
+                f"{phase or 'compute'}-dominated burn (batching/"
+                "chunking, not capacity)")
+
+    def evaluate(self, ctx: EvalContext) -> List[Dict[str, object]]:
+        out: List[Dict[str, object]] = []
+        current = {f.instance: f for f in ctx.findings
+                   if f.kind == "slo-violation"}
+        for target, f in sorted(current.items()):
+            self._clear_streak.pop(target, None)
+            streak = self._streak.get(target, 0) + 1
+            self._streak[target] = streak
+            if target in self._active:
+                continue
+            inputs = {"kind": f.kind, "severity": f.severity,
+                      "windows": f.windows, **dict(f.evidence),
+                      "streak": streak}
+            if streak < self.hysteresis:
+                if streak == 1:
+                    out.append({"verdict": "suppressed",
+                                "suppressed_by": "hysteresis",
+                                "target": target,
+                                "action": self._action(target, f),
+                                "inputs": {**inputs,
+                                           "need": self.hysteresis}})
+                continue
+            self._active[target] = {"ts": ctx.now}
+            out.append({"verdict": "would-act", "target": target,
+                        "action": self._action(target, f),
+                        "inputs": inputs})
+        for target in sorted(set(self._streak) - set(current)):
+            self._streak.pop(target, None)
+        for target in sorted(set(self._active) - set(current)):
+            c = self._clear_streak.get(target, 0) + 1
+            self._clear_streak[target] = c
+            if c < self.clear_hysteresis:
+                continue
+            self._active.pop(target)
+            self._clear_streak.pop(target, None)
+            out.append({"verdict": "withdrawn", "target": target,
+                        "action": f"drop serving recommendation for "
+                                  f"{target}: burn cleared",
+                        "inputs": {"clear_evals": c}})
+        return out
+
+
+def default_rules() -> List[Rule]:
+    return [StragglerExclusionRule(), GNSWorkerCountRule(),
+            SnapshotCadenceRule(), SLOBurnRule()]

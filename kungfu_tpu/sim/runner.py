@@ -33,8 +33,9 @@ from ..chaos import invariants
 from ..chaos.runner import (Scenario, ScenarioResult,
                             _collect_events, _collect_fired,
                             _CrashRestartOrchestrator, _DoctorSampler,
-                            _free_port,
-                            doctor_violations, floor_violations)
+                            _free_port, _PolicySampler,
+                            doctor_violations, floor_violations,
+                            policy_violations)
 
 # The spawned payload: sets lite mode BEFORE any kungfu_tpu import (a
 # belt to the env var's braces), then runs the fake trainer.  The
@@ -157,6 +158,7 @@ class SimClusterRunner:
         observer = _CrashRestartOrchestrator(
             sc, types.SimpleNamespace(url=url), out_dir)
         sampler = None
+        psampler = None
         watchdog = threading.Timer(sc.timeout_s,
                                    self._kill_fleet, args=(out_dir,))
         watchdog.daemon = True
@@ -166,6 +168,9 @@ class SimClusterRunner:
             if sc.doctor_expect is not None:
                 sampler = _DoctorSampler(cluster, out_dir)
                 sampler.start()
+            if sc.policy_expect is not None:
+                psampler = _PolicySampler(cluster, out_dir)
+                psampler.start()
             watchdog.start()
             # worker settings ride the Job (NOT os.environ): two
             # concurrent runs in one process must not bleed plans,
@@ -181,6 +186,8 @@ class SimClusterRunner:
             watchdog.cancel()
             if sampler is not None:
                 sampler.stop()
+            if psampler is not None:
+                psampler.stop()
             observer.stop()
             srv.stop()
             from ..utils import rpc as _rpc
@@ -214,6 +221,19 @@ class SimClusterRunner:
             found = (list(sampler.seen.values())
                      if sampler is not None else [])
             violations += doctor_violations(sc.doctor_expect, found)
+        if sc.policy_expect:
+            decisions = (psampler.decisions
+                         if psampler is not None else [])
+            violations += policy_violations(sc.policy_expect, decisions)
+            # the actuation gate: the saved tick journal must replay to
+            # the exact live ledger (bit-identity, not just same rank)
+            if psampler is not None:
+                from ..policy.engine import verify_replay
+                try:
+                    errs = verify_replay(psampler.history_path, decisions)
+                except (OSError, ValueError, KeyError) as e:
+                    errs = [f"replay failed to run: {e}"]
+                violations += [f"policy replay: {e}" for e in errs]
         fired = _collect_fired(log_prefix)
         violations += floor_violations(sc, fired, events)
         res = ScenarioResult(scenario=sc.name, rc=rc,

@@ -231,6 +231,49 @@ def sim_scenarios() -> Dict[str, Scenario]:
             doctor_expect={"absent_kind": "slowlink"},
             timeout_s=480.0),
         Scenario(
+            name="sim-policy-shadow-100",
+            desc="100 fake workers, rank 77 scripted 4x slower: the "
+                 "kfpolicy shadow sampler (doctor + rule engine over "
+                 "one shared scrape loop) must log an exclusion "
+                 "proposal naming exactly rank 77, with zero flapping "
+                 "(one would-act, no withdrawals), and the saved tick "
+                 "journal must REPLAY to the bit-identical ledger — "
+                 "proposal accuracy proven at a scale the real tier "
+                 "cannot spawn",
+            plan=Plan(seed=None),
+            tier="sim",
+            # same fleet shape and timing rationale as
+            # sim-straggler-doctor-100: the run must outlast the spawn
+            # storm and keep training long enough for the doctor's
+            # consecutive straggler windows PLUS the policy engine's
+            # hysteresis build-up to land before drain
+            nprocs=100,
+            target_steps=60,
+            sim_step_s=0.25,
+            sim_slow_ranks=(77,),
+            sim_slow_factor=4.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=420.0,
+            policy_expect={"rule": "straggler-exclusion", "rank": 77},
+            timeout_s=600.0),
+        Scenario(
+            name="sim-policy-shadow-clean",
+            desc="the kfpolicy clean twin: 20 fake workers, no "
+                 "degradation anywhere — the shadow ledger must hold "
+                 "ZERO would-act decisions on the whole run (the "
+                 "false-proposal guard: an engine that proposes on a "
+                 "healthy fleet can never be promoted to actuation), "
+                 "and the tick journal must still replay identically",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=20,
+            target_steps=40,
+            sim_step_s=0.25,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=300.0,
+            policy_expect={"zero_would_act": True},
+            timeout_s=480.0),
+        Scenario(
             name="sim-spot-trace",
             desc="30 fake workers under a replayed spot-preemption "
                  "trace (single reclaims, a correlated 3-worker burst, "

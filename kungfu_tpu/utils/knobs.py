@@ -310,6 +310,32 @@ _def("KFT_DOCTOR_SLOWLINK_MIN_BPS", "float", 1024.0,
      "Slowlink: idle-cluster floor — windows whose median pull "
      "bandwidth sits below this are inconclusive.", group=_DOCTOR)
 
+_POLICY = "Policy engine (kfpolicy, shadow mode)"
+_def("KFT_POLICY_HYSTERESIS", "int", 2,
+     "Consecutive evaluations a finding must hold before a rule "
+     "would act (the build-up logs a suppressed decision).",
+     group=_POLICY)
+_def("KFT_POLICY_CLEAR_HYSTERESIS", "int", 6,
+     "Consecutive clean evaluations before an active shadow proposal "
+     "is withdrawn (and annotated spurious) — a scrape flake must "
+     "not read as recovery.", group=_POLICY)
+_def("KFT_POLICY_COOLDOWN_S", "float", 300.0,
+     "Rate limiter: minimum gap, in snapshot time, between exclusion "
+     "proposals.", group=_POLICY)
+_def("KFT_POLICY_MAX_PROPOSALS", "int", 1,
+     "Rate limiter: concurrent shadow exclusion proposals the "
+     "straggler rule may hold.", group=_POLICY)
+_def("KFT_POLICY_RING", "int", 512,
+     "Bounded in-memory decision ring served by /decisions.",
+     group=_POLICY)
+_def("KFT_POLICY_GNS_BATCH", "int", 8,
+     "GNS rule: per-worker batch size the critical-batch heuristic "
+     "divides the gradient-noise scale by.", group=_POLICY)
+_def("KFT_POLICY_GNS_DEADBAND", "float", 2.0,
+     "GNS rule: factor the power-of-two worker-count target must "
+     "differ from the fleet by before a recommendation fires.",
+     group=_POLICY)
+
 _OPS = "Kernels (ops)"
 _def("KFT_FLASH_MASK_SKIP", "bool", None,
      "Flash attention: skip fully-masked KV tiles. Tri-state — unset "

@@ -322,6 +322,24 @@ def test_silent_except_covers_kfsim(tmp_path):
     assert rules_fired(fs) == {"silent-except"}
 
 
+def test_silent_except_covers_kfpolicy(tmp_path):
+    """The kfpolicy decision plane (kungfu_tpu/policy/ and its
+    tools/kfpolicy.py CLI) is inside the silent-except scope — an
+    engine that eats a rule error records a silently wrong (or
+    silently missing) proposal, which is exactly the failure the
+    shadow ledger exists to make auditable."""
+    src = """
+        def tick(rules, ctx):
+            try:
+                rules.evaluate(ctx)
+            except Exception:
+                pass
+    """
+    for rel in ("kungfu_tpu/policy/engine.py", "tools/kfpolicy.py"):
+        fs = run_on(tmp_path, src, relpath=rel)
+        assert rules_fired(fs) == {"silent-except"}, rel
+
+
 def test_silent_except_bare_and_negative(tmp_path):
     fs = run_on(tmp_path, """
         def a(url):

@@ -1,0 +1,373 @@
+"""kfpolicy: the shadow decision plane (kungfu_tpu.policy).
+
+The engine must evaluate deterministically over the metrics journal +
+doctor findings (snapshot time only — never wall clock), emit decisions
+on verdict TRANSITIONS (hysteresis build-up visible, no flapping),
+persist them to a replayable fsync'd ledger, annotate counterfactual
+outcomes with hindsight, and replay a saved tick journal to the
+bit-identical ledger — the acceptance gate for ever acting.
+
+Also the satellite planes this PR ships: the doctor's finding-gauge
+membership prune, the finding-duration summary, cluster.aggregate's
+scrape self-observability, and the optimizer-gauge -> history
+round-trip the gns rule consumes.
+"""
+import json
+import math
+
+import pytest
+
+from kungfu_tpu import trace as _trace
+from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                Monitor, publish_optimizer_gauges)
+from kungfu_tpu.monitor.cluster import aggregate
+from kungfu_tpu.monitor.doctor import Doctor, Finding
+from kungfu_tpu.monitor.history import MetricsHistory
+from kungfu_tpu.policy.engine import (PolicyEngine, derive_ranks,
+                                      verify_replay)
+from kungfu_tpu.policy.ledger import (Decision, DecisionLedger,
+                                      SPURIOUS, VINDICATED)
+from kungfu_tpu.policy.rules import (EvalContext, GNSWorkerCountRule,
+                                     SLOBurnRule, SnapshotCadenceRule,
+                                     StragglerExclusionRule)
+
+
+def _step_expo(p50: float) -> str:
+    return (f'kungfu_tpu_step_seconds{{quantile="0.5"}} {p50}\n'
+            f"kungfu_tpu_step_seconds_sum {p50 * 3}\n"
+            f"kungfu_tpu_step_seconds_count 3\n")
+
+
+def _straggler(inst: str, rank: int) -> Finding:
+    return Finding(kind="straggler", severity="warn", instance=inst,
+                   rank=rank, windows=3,
+                   evidence={"skew_ratio": 4.0}, action="exclude",
+                   detected_ts=123.4)
+
+
+def _ctx(findings=(), now=100.0, tick=0, fresh=(), history=None,
+         ranks=None):
+    return EvalContext(history=history or MetricsHistory(),
+                       findings=list(findings),
+                       ranks=dict(ranks or {}), fresh=list(fresh),
+                       now=now, tick=tick)
+
+
+# ------------------------------------------------------------- ranks
+def test_derive_ranks_orders_by_host_then_numeric_port():
+    ranks = derive_ranks(["10.0.0.2:9", "10.0.0.1:10", "10.0.0.1:9"])
+    assert ranks == {"10.0.0.1:9": 0, "10.0.0.1:10": 1, "10.0.0.2:9": 2}
+    # numeric, not lexicographic: port 10 > port 9
+    assert derive_ranks(["h:100", "h:20"]) == {"h:20": 0, "h:100": 1}
+
+
+# ------------------------------------------------------------ ledger
+def test_ledger_ring_bound_and_jsonl_round_trip(tmp_path):
+    p = str(tmp_path / "ledger.jsonl")
+    led = DecisionLedger(ring=2, path=p)
+    for i in range(3):
+        led.append(Decision(seq=led.next_seq(), tick=i, ts=float(i),
+                            rule="r", verdict="would-act", action="a",
+                            target=f"t{i}"))
+    assert led.annotate(2, VINDICATED, reason="died", ts=9.0)
+    # re-annotation is refused (first hindsight wins)
+    assert not led.annotate(2, SPURIOUS, reason="recovered", ts=10.0)
+    ring = led.decisions()
+    assert [d.seq for d in ring] == [1, 2]      # ring bounded
+    assert ring[-1].outcome == VINDICATED      # patched in place
+    led.close()
+    # the JSONL keeps ALL decisions (append-only durability) and
+    # applies annotation records on load
+    loaded = DecisionLedger.load(p)
+    assert [d.seq for d in loaded] == [0, 1, 2]
+    assert loaded[2].outcome == VINDICATED
+    assert loaded[2].outcome_ts == 9.0
+
+
+def test_replay_view_excludes_only_the_outcome_fields():
+    d = Decision(seq=0, tick=1, ts=2.0, rule="r", verdict="would-act",
+                 action="a", target="t", rank=3, outcome=VINDICATED,
+                 outcome_ts=99.0)
+    v = d.replay_view()
+    assert "outcome" not in v and "outcome_ts" not in v
+    assert v["seq"] == 0 and v["target"] == "t" and v["rank"] == 3
+    assert Decision.from_dict(d.to_dict()) == d
+
+
+# ----------------------------------------------- straggler-exclusion
+def test_straggler_rule_hysteresis_then_one_proposal(monkeypatch):
+    monkeypatch.setenv("KFT_POLICY_HYSTERESIS", "2")
+    r = StragglerExclusionRule()
+    f = _straggler("h:1", 0)
+    first = r.evaluate(_ctx([f], tick=0))
+    assert [d["verdict"] for d in first] == ["suppressed"]
+    assert first[0]["suppressed_by"] == "hysteresis"
+    # detected_ts is wall clock: it must never reach Decision.inputs
+    assert "detected_ts" not in first[0]["inputs"]
+    second = r.evaluate(_ctx([f], tick=1))
+    assert [d["verdict"] for d in second] == ["would-act"]
+    assert "propose_exclusion" in second[0]["action"]
+    # holding the finding re-emits NOTHING (transitions, not levels)
+    assert r.evaluate(_ctx([f], tick=2)) == []
+
+
+def test_straggler_rule_rate_limits_second_target(monkeypatch):
+    monkeypatch.setenv("KFT_POLICY_HYSTERESIS", "1")
+    monkeypatch.setenv("KFT_POLICY_MAX_PROPOSALS", "1")
+    r = StragglerExclusionRule()
+    fs = [_straggler("h:1", 0), _straggler("h:2", 1)]
+    out = r.evaluate(_ctx(fs))
+    assert [(d["verdict"], d["target"]) for d in out] == \
+        [("would-act", "h:1"), ("suppressed", "h:2")]
+    assert out[1]["suppressed_by"] == "rate-limit"
+
+
+def test_straggler_rule_withdraws_after_clear_hysteresis(monkeypatch):
+    monkeypatch.setenv("KFT_POLICY_HYSTERESIS", "1")
+    monkeypatch.setenv("KFT_POLICY_CLEAR_HYSTERESIS", "3")
+    r = StragglerExclusionRule()
+    f = _straggler("h:1", 0)
+    assert [d["verdict"] for d in r.evaluate(_ctx([f]))] == ["would-act"]
+    # two clean evaluations: scrape flake must not read as recovery
+    assert r.evaluate(_ctx([])) == []
+    assert r.evaluate(_ctx([])) == []
+    out = r.evaluate(_ctx([]))
+    assert [d["verdict"] for d in out] == ["withdrawn"]
+    assert out[0]["target"] == "h:1"
+
+
+# ----------------------------------------------------- gns / cadence
+def test_gns_rule_recommends_power_of_two_workers():
+    h = MetricsHistory()
+    for inst in ("h:1", "h:2"):
+        h.observe_text(inst, "kungfu_tpu_grad_noise_scale 64\n", ts=1.0)
+    r = GNSWorkerCountRule()
+    r.batch_per_worker = 8
+    out = r.evaluate(_ctx(history=h, fresh=["h:1", "h:2"]))
+    assert len(out) == 1 and out[0]["verdict"] == "would-act"
+    assert out[0]["inputs"]["workers_opt"] == 8      # 64/8, pow2
+    assert "grow from 2 to 8" in out[0]["action"]
+    # same recommendation again: silent (transition already logged)
+    assert r.evaluate(_ctx(history=h, fresh=["h:1", "h:2"])) == []
+
+
+def test_snapshot_cadence_rule_fits_budget(monkeypatch):
+    monkeypatch.setenv("KFT_SNAPSHOT_BUDGET", "0.05")
+    h = MetricsHistory()
+    h.observe_text("h:1", _step_expo(0.1)
+                   + 'kungfu_tpu_snapshot_seconds{quantile="0.5"} 0.2\n',
+                   ts=1.0)
+    r = SnapshotCadenceRule()
+    out = r.evaluate(_ctx(history=h, fresh=["h:1"]))
+    assert len(out) == 1 and out[0]["verdict"] == "would-act"
+    k = out[0]["inputs"]["cadence_steps"]
+    assert k == math.ceil(0.2 / (0.05 * 0.1)) == 40
+
+
+def test_slo_rule_keys_action_on_dominant_phase(monkeypatch):
+    monkeypatch.setenv("KFT_POLICY_HYSTERESIS", "1")
+    r = SLOBurnRule()
+    f = Finding(kind="slo-violation", severity="critical",
+                instance="h:1", rank=None, windows=3,
+                evidence={"dominant_phase": "queue"}, action="scale")
+    out = r.evaluate(_ctx([f]))
+    assert len(out) == 1 and out[0]["verdict"] == "would-act"
+    assert "capacity" in out[0]["action"]
+
+
+# ------------------------------------------------------------ engine
+def _skewed_engine(tmp_path, ticks=4):
+    """Two instances, one 10x slower, fed with explicit timestamps."""
+    hist = MetricsHistory(window=32)
+    mon = Monitor()
+    doctor = Doctor(history=hist, monitor=mon)
+    eng = PolicyEngine(history=hist, monitor=mon,
+                       ledger_path=str(tmp_path / "ledger.jsonl"))
+    eng.set_targets(["h:1", "h:2"])
+    ranks = derive_ranks(["h:1", "h:2"])
+    for t in range(ticks):
+        eng.observe_text("h:1", _step_expo(0.1), ts=float(t))
+        eng.observe_text("h:2", _step_expo(1.0), ts=float(t))
+        eng.tick(doctor.diagnose(ranks=ranks), ranks=ranks)
+    return eng, ranks
+
+
+def test_engine_decision_ts_is_snapshot_time(tmp_path):
+    eng, ranks = _skewed_engine(tmp_path)
+    try:
+        rows = [d.to_dict() for d in eng.decisions()]
+        would = [d for d in rows if d["verdict"] == "would-act"]
+        assert len(would) == 1
+        assert would[0]["target"] == "h:2"
+        assert would[0]["rank"] == ranks["h:2"]
+        # snapshot time, not time.time(): the explicit ts fed above
+        assert all(d["ts"] < 10.0 for d in rows)
+        assert eng.active()[0]["target"] == "h:2"
+    finally:
+        eng.close()
+
+
+def test_engine_replay_identity_and_doctor_compat(tmp_path):
+    eng, _ranks = _skewed_engine(tmp_path)
+    hist_path = str(tmp_path / "journal.jsonl")
+    try:
+        eng.save_history(hist_path)
+        live = [d.to_dict() for d in eng.decisions()]
+        assert live  # the gate must compare something
+        assert verify_replay(hist_path, live) == []
+        # a perturbed live ledger must be CAUGHT, not waved through
+        forged = [dict(live[0], rank=99)] + live[1:]
+        assert verify_replay(hist_path, forged)
+        # the journal is a MetricsHistory superset: kft-doctor --history
+        # loads it (extra tick/window/meta keys ignored)
+        h2 = MetricsHistory.load(hist_path)
+        assert set(h2.instances()) == {"h:1", "h:2"}
+    finally:
+        eng.close()
+
+
+def test_engine_replay_covers_trailing_empty_ticks(tmp_path):
+    eng, ranks = _skewed_engine(tmp_path)
+    try:
+        # two all-failed scrape rounds: no journal rows, but the tick
+        # counter advances — replay must reproduce those evaluations
+        # (clear-streak accounting runs on them) from the "ticks" meta
+        eng.tick([], ranks=ranks)
+        eng.tick([], ranks=ranks)
+        hist_path = str(tmp_path / "journal.jsonl")
+        eng.save_history(hist_path)
+        live = [d.to_dict() for d in eng.decisions()]
+        assert verify_replay(hist_path, live) == []
+        replayed = PolicyEngine.replay(hist_path)
+        assert replayed.tick_count == eng.tick_count
+    finally:
+        eng.close()
+
+
+def test_engine_counterfactual_annotation(tmp_path):
+    eng, _ranks = _skewed_engine(tmp_path)
+    try:
+        assert eng.note_outcome("h:2", "died", ts=50.0) == 1
+        d = [x for x in eng.decisions()
+             if x.verdict == "would-act"][0]
+        assert d.outcome == VINDICATED
+        assert eng.active() == []          # resolved, no longer standing
+        # hindsight cleared the rule state: no withdrawal ever fires
+        for _ in range(10):
+            eng.tick([], ranks=_ranks)
+        assert not [x for x in eng.decisions()
+                    if x.verdict == "withdrawn"]
+        # unknown events annotate nothing
+        assert eng.note_outcome("h:2", "no-such-event") == 0
+    finally:
+        eng.close()
+    # the annotation rides the JSONL as an append-only record
+    with open(str(tmp_path / "ledger.jsonl")) as f:
+        kinds = [json.loads(line)["kind"] for line in f if line.strip()]
+    assert "annotation" in kinds
+
+
+# ------------------------------------------- satellite: label prune
+def test_prune_membership_drops_departed_finding_labelsets():
+    hist = MetricsHistory()
+    mon = Monitor()
+    doctor = Doctor(history=hist, monitor=mon)
+    ranks = {"h:1": 0, "h:2": 1, "h:3": 2}
+    for ts in (1.0, 2.0, 3.0):
+        for inst, p50 in (("h:1", 0.1), ("h:2", 0.1), ("h:3", 1.0)):
+            hist.observe_text(inst, _step_expo(p50), ts=ts)
+    fs = doctor.diagnose(ranks=ranks)
+    assert [f.rank for f in fs] == [2]
+    assert 'kungfu_tpu_finding_active{kind="straggler",rank="2"} 1' \
+        in mon.render_metrics()
+    before = mon._labelsets.get("kungfu_tpu_finding_active", 0)
+    # membership shrank: rank 2 left the cluster
+    doctor.prune_membership({"h:1": 0, "h:2": 1})
+    body = mon.render_metrics()
+    assert 'rank="2"' not in body          # label-set GONE, not zeroed
+    assert mon._labelsets.get("kungfu_tpu_finding_active", 0) == \
+        before - 1
+    # its lifetime landed in the duration summary on the way out
+    assert "kungfu_tpu_finding_duration_seconds" in body
+    # survivors' findings are untouched
+    doctor.prune_membership(ranks)
+
+
+# --------------------------------------- satellite: finding duration
+def test_finding_duration_published_on_clear():
+    hist = MetricsHistory(window=16)
+    mon = Monitor()
+    doctor = Doctor(history=hist, monitor=mon)
+    ranks = {"h:1": 0, "h:2": 1, "h:3": 2}
+    rec = _trace.arm()
+    try:
+        for ts in (1.0, 2.0, 3.0):
+            for inst, p50 in (("h:1", 0.1), ("h:2", 0.1), ("h:3", 1.0)):
+                hist.observe_text(inst, _step_expo(p50), ts=ts)
+        assert doctor.diagnose(ranks=ranks)
+        # the straggler heals: healthy windows push the skew out
+        for ts in (4.0, 5.0, 6.0, 7.0):
+            for inst in ranks:
+                hist.observe_text(inst, _step_expo(0.1), ts=ts)
+        assert doctor.diagnose(ranks=ranks) == []
+        body = mon.render_metrics()
+        assert "kungfu_tpu_finding_duration_seconds_count" \
+            '{kind="straggler"} 1' in body
+        cleared = [e for e in rec.tail()
+                   if e["name"] == "doctor.cleared"]
+        assert cleared and "duration_s" in cleared[-1]["attrs"]
+    finally:
+        _trace.disarm()
+
+
+# ------------------------------------ satellite: scrape observability
+def test_aggregate_publishes_scrape_timings_and_errors():
+    mon = Monitor()
+    mon.observe("kungfu_tpu_step_seconds", 0.1)
+    srv = MetricsServer(mon).start()
+    try:
+        live = ("127.0.0.1", srv.port - MONITOR_PORT_OFFSET)
+        dead = ("127.0.0.1", 1)        # nothing listens on metrics port
+        body = aggregate([live, dead], timeout=2.0)
+        live_i, dead_i = (f"{h}:{p}" for h, p in (live, dead))
+        # wall time for BOTH outcomes: failures time out here too
+        assert f'kungfu_tpu_scrape_seconds{{instance="{live_i}"}}' in body
+        assert f'kungfu_tpu_scrape_seconds{{instance="{dead_i}"}}' in body
+        # error counter only for the failing instance
+        assert (f'kungfu_tpu_scrape_errors_total{{'
+                f'instance="{dead_i}"}}') in body
+        assert (f'kungfu_tpu_scrape_errors_total{{'
+                f'instance="{live_i}"}}') not in body
+    finally:
+        srv.stop()
+
+
+# ------------------------- satellite: optimizer gauges -> history
+def test_optimizer_gauges_round_trip_into_history():
+    """publish_optimizer_gauges -> /metrics -> aggregate(history=...)
+    -> MetricsHistory.series(): the exact path the gns-worker-count
+    rule consumes."""
+    jnp = pytest.importorskip("jax.numpy")
+    from kungfu_tpu.optimizers.monitors import NoiseScaleState
+    ns = NoiseScaleState(base=(), ema_s=jnp.asarray(2.0),
+                         ema_g2=jnp.asarray(1.0),
+                         noise_scale=jnp.asarray(48.0),
+                         step=jnp.asarray(3))
+    mon = Monitor()
+    assert publish_optimizer_gauges((ns,), monitor=mon) == \
+        {"kungfu_tpu_grad_noise_scale": 48.0}
+    srv = MetricsServer(mon).start()
+    try:
+        target = ("127.0.0.1", srv.port - MONITOR_PORT_OFFSET)
+        inst = f"{target[0]}:{target[1]}"
+        hist = MetricsHistory(window=8)
+        aggregate([target], timeout=2.0, history=hist)
+        pts = hist.series(inst, "kungfu_tpu_grad_noise_scale")
+        assert [v for _t, v in pts] == [48.0]
+        # and the rule sees it end to end
+        r = GNSWorkerCountRule()
+        r.batch_per_worker = 8
+        out = r.evaluate(_ctx(history=hist, fresh=[inst]))
+        assert out and out[0]["inputs"]["gns_median"] == 48.0
+    finally:
+        srv.stop()

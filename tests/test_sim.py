@@ -84,7 +84,8 @@ def test_sim_scenarios_merged_into_cli_matrix():
     sims = {n for n, sc in m.items() if sc.tier == "sim"}
     assert {"sim-smoke", "sim-preemption-wave-100", "sim-lease-cascade",
             "sim-straggler-doctor-100", "sim-slowlink-doctor-100",
-            "sim-slowlink-doctor-clean", "sim-spot-trace",
+            "sim-slowlink-doctor-clean", "sim-policy-shadow-100",
+            "sim-policy-shadow-clean", "sim-spot-trace",
             "sim-grow-join"} <= sims
     for n in sims:
         sc = m[n]

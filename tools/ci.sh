@@ -104,6 +104,16 @@ python tools/kfload.py --smoke || exit 1
 say "0g/3 kfnet transport observability smoke"
 python tools/kfnet_report.py --smoke || exit 1
 
+# kfpolicy smoke (`make policy-smoke`): two live workers with a 10x
+# step-time skew behind a real watcher debug server — asserts exactly
+# one shadow exclusion proposal naming the slow worker (hysteresis
+# build-up logged, no flapping), the fsync'd JSONL ledger, the
+# /decisions endpoint shape, and `kft-policy --history` replay
+# identity (the actuation gate).  Pure CPU, no data-plane gate, must
+# never self-skip (~10 s; docs/policy.md)
+say "0h/3 kfpolicy shadow-decision smoke"
+python tools/kfpolicy.py --smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

@@ -286,11 +286,14 @@ class SilentExcept(Rule):
     # utils; serving/slo.py and tools/kfload.py are the SLO plane and
     # its load harness — a swallowed error there silently corrupts the
     # very numbers the plane exists to report; likewise the kfnet
-    # report/bench tools, whose output is the transport baseline
+    # report/bench tools, whose output is the transport baseline, and
+    # the kfpolicy decision plane, where a swallowed error IS a
+    # silently wrong proposal
     path_filter = (r"(^|/)(elastic|launcher|comm|chaos|store|trace"
-                   r"|monitor|sim)(/|$)|(^|/)utils/rpc\.py$"
+                   r"|monitor|policy|sim)(/|$)|(^|/)utils/rpc\.py$"
                    r"|(^|/)serving/slo\.py$|(^|/)tools/kfload\.py$"
                    r"|(^|/)tools/kfnet_report\.py$"
+                   r"|(^|/)tools/kfpolicy\.py$"
                    r"|(^|/)tools/bench_p2p\.py$")
 
     BROAD = {"Exception", "BaseException"}

@@ -192,7 +192,9 @@ class MetricsConsistency(ProgramPass):
     # call (regex parsing, threshold tables, smoke asserts)
     CONSUMERS = re.compile(
         r"^kungfu_tpu/monitor/(doctor|history|cluster)\.py$"
-        r"|^tools/(kfprof_report|kfnet_report|metrics_trace_smoke)\.py$")
+        r"|^kungfu_tpu/policy/(engine|rules)\.py$"
+        r"|^tools/(kfprof_report|kfnet_report|kfpolicy"
+        r"|metrics_trace_smoke)\.py$")
     SUFFIXES = ("_sum", "_count", "_bucket")
 
     def _norm(self, name: str) -> str:

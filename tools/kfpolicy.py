@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""kft-policy — inspect the shadow policy engine's decision ledger.
+
+Modes (docs/policy.md):
+
+  --url http://127.0.0.1:PORT   GET the watcher debug port's /decisions
+                                (each hit is one more doctor+policy
+                                tick) and render the ledger tail.
+  --history FILE.jsonl          offline: REPLAY the policy engine over a
+                                saved tick journal (the superset of the
+                                MetricsHistory JSONL `kft-doctor
+                                --history` reads) and render the
+                                decisions the live run must have made —
+                                bit-identity with the live ledger is the
+                                acceptance gate for actuation.
+  --smoke                       CI self-check: two live workers with a
+                                10x step-time skew behind a real watcher
+                                debug server; assert the ledger entry,
+                                the /decisions shape, and --history
+                                replay identity.  Exit 0/1.
+
+`--json` emits raw decision dicts instead of the report.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def render_decisions(rows, active=None, shadow=True) -> str:
+    """Human report: one block per ledger entry, newest last."""
+    tag = " [shadow — no action was taken]" if shadow else ""
+    if not rows:
+        return f"kft-policy: empty ledger{tag}\n"
+    out = [f"kft-policy: {len(rows)} decision(s)"
+           + (f", {len(active)} standing proposal(s)"
+              if active is not None else "") + tag]
+    for d in rows:
+        head = (f"  [seq {d['seq']:03d} tick {d['tick']}] "
+                f"{d['rule']} {d['verdict'].upper()}")
+        if d.get("target"):
+            head += f" target={d['target']}"
+        if d.get("rank") is not None:
+            head += f" rank={d['rank']}"
+        if d.get("suppressed_by"):
+            head += f" (by {d['suppressed_by']})"
+        out.append(head)
+        out.append(f"      action: {d['action']}")
+        if d.get("inputs"):
+            ev = ", ".join(f"{k}={v}"
+                           for k, v in sorted(d["inputs"].items()))
+            out.append(f"      inputs: {ev}")
+        if d.get("version") is not None:
+            out.append(f"      membership version: {d['version']}")
+        if d.get("outcome"):
+            out.append(f"      outcome: {d['outcome']}")
+    return "\n".join(out) + "\n"
+
+
+def _decisions_from_url(url: str) -> dict:
+    if not url.rstrip("/").endswith("/decisions"):
+        url = url.rstrip("/") + "/decisions"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+# ------------------------------------------------------------------ smoke
+def _expect(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+def check_smoke() -> None:
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import Watcher, _start_debug_server
+    from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,
+                                    Monitor)
+    from kungfu_tpu.monitor import cluster as _mcluster
+    from kungfu_tpu.monitor.doctor import Doctor
+    from kungfu_tpu.monitor.history import MetricsHistory
+    from kungfu_tpu.policy.engine import (PolicyEngine, derive_ranks,
+                                          verify_replay)
+    from kungfu_tpu.plan import PeerID
+
+    class _AliveProc:
+        def poll(self):
+            return None
+
+    tmp = tempfile.mkdtemp(prefix="kfpolicy-smoke-")
+    ledger_path = os.path.join(tmp, "ledger.jsonl")
+    history_path = os.path.join(tmp, "history.jsonl")
+
+    # two live workers with a 10x step-time skew (the synthetic
+    # straggler window); worker 1 is the slow one
+    servers = []
+    for i in (0, 1):
+        mon = Monitor()
+        for _ in range(8):
+            mon.observe("kungfu_tpu_step_seconds",
+                        1.0 if i == 1 else 0.1)
+        servers.append(MetricsServer(mon).start())
+    targets = [(("127.0.0.1"), s.port - MONITOR_PORT_OFFSET)
+               for s in servers]
+    instances = [f"{h}:{p}" for h, p in targets]
+    slow = instances[1]
+    dbg = None
+    try:
+        # 1) standalone sampler: the engine IS the history sink, so the
+        # journal it saves replays the exact live evaluation
+        hist = MetricsHistory(window=32)
+        mon = Monitor()
+        doctor = Doctor(history=hist, monitor=mon)
+        engine = PolicyEngine(history=hist, monitor=mon,
+                              ledger_path=ledger_path)
+        engine.set_targets(instances)
+        ranks = derive_ranks(instances)
+        for _ in range(6):
+            _mcluster.aggregate(targets, timeout=5.0, history=engine)
+            findings = doctor.diagnose(ranks=ranks)
+            engine.tick(findings, ranks=ranks)
+        rows = [d.to_dict() for d in engine.decisions()]
+        would = [d for d in rows
+                 if d["verdict"] == "would-act"
+                 and d["rule"] == "straggler-exclusion"]
+        supp = [d for d in rows if d["verdict"] == "suppressed"]
+        _expect(len(would) == 1,
+                f"expected exactly one would-act, got {rows}")
+        _expect(would[0]["target"] == slow,
+                f"would-act misattributed (slow={slow}): {would}")
+        _expect(would[0]["rank"] == ranks[slow],
+                f"would-act rank wrong: {would}")
+        _expect(supp and all(d["suppressed_by"] == "hysteresis"
+                             for d in supp),
+                f"hysteresis build-up not logged: {rows}")
+        _expect(not [d for d in rows if d["verdict"] == "withdrawn"],
+                f"flapping: withdrawal in a steady skew: {rows}")
+        print("kfpolicy-smoke: shadow straggler proposal OK")
+
+        # 2) the fsync'd JSONL ledger carries the same decisions
+        with open(ledger_path) as f:
+            disk = [json.loads(line) for line in f if line.strip()]
+        ondisk = [d for d in disk if d.get("kind") == "decision"]
+        _expect([{k: v for k, v in d.items() if k != "kind"}
+                 for d in ondisk] == rows,
+                "ledger JSONL diverges from the in-memory ring")
+        print("kfpolicy-smoke: JSONL ledger OK")
+
+        # 3) --history replay identity (the actuation gate)
+        engine.save_history(history_path)
+        errs = verify_replay(history_path, rows)
+        _expect(not errs, "replay identity broken:\n  "
+                + "\n  ".join(errs))
+        print("kfpolicy-smoke: replay identity OK")
+
+        # 4) the same replay through the CLI subprocess
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "kfpolicy.py"),
+             "--history", history_path, "--json"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        _expect(proc.returncode == 0, proc.stdout + proc.stderr)
+        cli_rows = json.loads(proc.stdout)
+        _expect(cli_rows == rows,
+                f"CLI replay diverges:\n{proc.stdout}")
+        print("kfpolicy-smoke: kft-policy --history CLI OK")
+
+        # 5) /decisions on a real watcher debug server (its own
+        # doctor+engine; each GET is one tick)
+        job = Job(prog=sys.executable, args=["-c", "pass"])
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 1))
+        w.current = {
+            PeerID("127.0.0.1", s.port - MONITOR_PORT_OFFSET, i):
+                _AliveProc()
+            for i, s in enumerate(servers)}
+        dbg = _start_debug_server(w, 0)
+        url = f"http://127.0.0.1:{dbg.port}/decisions"
+        for _ in range(6):
+            doc = _decisions_from_url(url)
+        for key in ("version", "shadow", "ticks", "active", "decisions"):
+            _expect(key in doc, f"/decisions missing {key!r}: {doc}")
+        _expect(doc["shadow"] is True, f"/decisions not shadow: {doc}")
+        ep_would = [d for d in doc["decisions"]
+                    if d["verdict"] == "would-act"
+                    and d["rule"] == "straggler-exclusion"]
+        _expect(len(ep_would) == 1 and ep_would[0]["target"] == slow,
+                f"/decisions proposal wrong (slow={slow}): {doc}")
+        _expect(doc["active"] and doc["active"][0]["target"] == slow,
+                f"standing proposal missing from active: {doc}")
+        print("kfpolicy-smoke: /decisions endpoint OK")
+    finally:
+        if dbg is not None:
+            dbg.stop()
+        for s in servers:
+            s.stop()
+        engine.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="kft-policy",
+        description="inspect the shadow policy engine's decision "
+                    "ledger: live via the watcher's /decisions "
+                    "endpoint, or offline by replaying a saved tick "
+                    "journal (docs/policy.md)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="watcher debug address (e.g. "
+                     "http://127.0.0.1:PORT); /decisions is appended")
+    src.add_argument("--history", metavar="FILE.jsonl",
+                     help="offline: replay the engine over a saved "
+                          "tick journal and print the decisions")
+    src.add_argument("--smoke", action="store_true",
+                     help="CI self-check (2 live workers, straggler "
+                          "window, replay identity)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw decision JSON instead of the report")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        check_smoke()
+        print("kfpolicy-smoke: ALL OK")
+        return 0
+    if args.url:
+        try:
+            doc = _decisions_from_url(args.url)
+        except (OSError, ValueError) as e:
+            # a dead watcher is an answer, not a traceback
+            print(f"kft-policy: cannot reach {args.url}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            sys.stdout.write(render_decisions(
+                doc.get("decisions", []), active=doc.get("active"),
+                shadow=doc.get("shadow", True)))
+        return 0
+    from kungfu_tpu.policy.engine import PolicyEngine
+    try:
+        eng = PolicyEngine.replay(args.history)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"kft-policy: cannot replay {args.history}: {e}",
+              file=sys.stderr)
+        return 2
+    rows = [d.to_dict() for d in eng.decisions()]
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        sys.stdout.write(render_decisions(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
