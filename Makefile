@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke policy-smoke clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench p2p-smoke doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke policy-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -101,6 +101,14 @@ policy-smoke:
 # (docs/elastic.md "Async commit pipeline").  CI runs `--smoke`.
 snapshot-bench:
 	python tools/bench_snapshot.py
+
+# kffast smoke: one small 2-worker p2p bench pass over the native
+# plane — shm lane engaged, segment-mapped copy vs socket wire, chunk
+# streaming vs per-chunk RPCs, buffer-pool fresh-alloc pin
+# (docs/elastic.md "Store fast lane").  Regenerate the committed
+# P2P_BENCH.json with tools/bench_p2p.py (see its docstring).
+p2p-smoke: native
+	python tools/bench_p2p.py --smoke
 
 native:
 	$(MAKE) -C native

@@ -9,13 +9,13 @@ finally produces instead of assuming).
 Two numbers per worker:
 
 - ``pull``: synchronous ``request`` of the whole model from a random
-  other peer, tight loop — the raw store+transport throughput
-  (framing, rendezvous, memcpy over the abstract-unix socket when
-  colocated).  NOTE: p2p requests ride CLS_P2P connections, which do
-  NOT negotiate the shm bulk lane (that lane is collective-class only —
-  native/src/peer.cc); ``shm_lane_bytes`` is reported to make that
-  explicit — it is structurally 0 here, so the measured rate is the
-  socket path, a LOWER bound on colocated transport;
+  other peer, tight loop — the raw store+transport throughput.  Since
+  kffast, same-host pulls ride the Python shm lane (store/shm.py):
+  the puller maps the publisher's named /dev/shm segment and the
+  "wire" collapses to one memcpy.  (The native CLS_P2P socket still
+  does not negotiate the C++ shm bulk lane — that one stays
+  collective-class, native/src/peer.cc; ``shm_lane_bytes`` sums both
+  counters, and with kffast it is nonzero on any colocated run.)
 - ``hidden``: ``request_async`` issued before a simulated compute step
   (``--compute-ms``), awaited after — the PairAveraging shape
   (AsyncRequestModel's prefetch double-buffer, peer_to_peer.cpp:8-524).
@@ -23,9 +23,14 @@ Two numbers per worker:
   i.e. how much of the exchange the compute actually hides.
 
 Since kfnet the artifact also carries a per-phase breakdown
-(``schema: p2p-phase-v1``): serialize / wire / deserialize GiB/s for
-the whole-blob pull and for the chunked ``{key}.cN`` tier — the
-committed P2P_BENCH.json baseline transport optimisations must beat.
+(``schema: p2p-phase-v2``): serialize / wire / deserialize GiB/s for
+the whole-blob pull and the chunked ``{key}.cN`` tier — measured with
+the shm lane OFF so they stay comparable to the committed socket-path
+baseline — plus the kffast lanes the optimisation work added:
+``pull_shm`` (same-host segment-mapped copy GiB/s) and
+``pull_streamed`` (the chunk tier pipelined ``KFT_STREAM_DEPTH``-deep
+on one connection instead of one round trip per chunk).  Every loop
+asserts bit-identical content against the publisher's fill value.
 
 Run (spawns workers through the launcher; ``tools/bench_p2p.py`` is
 the repo-root wrapper):
@@ -64,6 +69,18 @@ def _worker(args) -> None:
     # fresh GB-scale destination per pull makes the kernel re-fault
     # + zero-fill the whole mapping each time)
     dst = np.empty_like(model)
+    # untimed warm-up: fault dst's pages once and prime the peer
+    # connection + shm attach.  Concurrent GB-scale first-touch
+    # collapses to ~0.12 GiB/s/worker on this box (both workers
+    # zero-fill simultaneously), a one-time mapping cost a real
+    # exchange loop amortizes over thousands of steps — timed, it
+    # eats the whole measurement window and gets published as the
+    # lane's throughput (the v1 baseline's 0.088 sync row was exactly
+    # that artifact; the steady-state socket rate sat 10x higher in
+    # its own wire phase row).  Every persistent destination below
+    # gets the same one-touch treatment; the fresh-alloc loop stays
+    # cold on purpose (the per-pull allocation cost IS its subject).
+    p.request(others[0], "model", model, version=0, out=dst)
     pulled = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < args.secs:
@@ -92,6 +109,7 @@ def _worker(args) -> None:
     # the next is issued (pair_avg needs TWO slots because its mix
     # still reads the previous pull while the next prefetch runs)
     hdst = np.empty_like(model)
+    hdst[:] = 0.0                             # fault pages untimed
     hidden_done = 0
     hidden_total = 0
     t0 = time.perf_counter()
@@ -106,15 +124,21 @@ def _worker(args) -> None:
     hid_secs = time.perf_counter() - t0
     hid_rate = hidden_total * model.nbytes / hid_secs / (1 << 30)
 
-    # --- per-phase breakdown (kfnet: P2P_BENCH schema p2p-phase-v1) --
+    # --- per-phase breakdown (kfnet: P2P_BENCH schema p2p-phase-v2) --
     # where a pull's time goes, phase by phase: serialize (the
     # publisher's ascontiguous + kft_save), wire (the socket pull into
     # a reused destination — the sync loop's rate, re-measured inside
     # the same iteration), deserialize (the consumer-side copy out of
     # the pull buffer into the arrays compute reads).  A distinct key
     # for the serialize loop keeps the re-publish from racing peers
-    # still pulling "model".
+    # still pulling "model".  The shm lane is forced OFF for the
+    # legacy phase loops so these rows keep measuring the socket path
+    # the committed baseline measured (the kffast lanes get their own
+    # blocks below).
+    os.environ["KFT_SHM_LANE"] = "0"
     consumer = np.empty_like(model)
+    consumer[:] = 0.0                         # fault pages untimed
+    p.save("phase-probe", model, version=0)   # fault the store blob
     ph = {"serialize": 0.0, "wire": 0.0, "deserialize": 0.0}
     ph_bytes = 0
     t0 = time.perf_counter()
@@ -143,6 +167,7 @@ def _worker(args) -> None:
         p.save(f"model.c{j}", model[j * per:(j + 1) * per], version=0)
     p.barrier(name="p2p-bench-chunks")
     cdst = np.empty(per, np.float32)
+    cdst[:] = 0.0                             # fault pages untimed
     cph = {"wire": 0.0, "deserialize": 0.0}
     c_bytes = 0
     t0 = time.perf_counter()
@@ -160,6 +185,57 @@ def _worker(args) -> None:
             c_bytes += got.nbytes
     chunk_gib = {k: (c_bytes / v / (1 << 30) if v > 0 else 0.0)
                  for k, v in cph.items()}
+    os.environ["KFT_SHM_LANE"] = "1"
+
+    # --- kffast shm lane (phases.pull_shm) ---------------------------
+    # the same whole-model pull with the lane back ON: the puller maps
+    # the publisher's /dev/shm segment and copies — what "wire" becomes
+    # for colocated peers.  Lane engagement is ASSERTED via the lane
+    # byte counter, so a regression to the socket path fails loudly
+    # instead of publishing a slow number as the shm rate.
+    from ..store import shm as _shm
+    lane0 = _shm.lane_bytes()
+    shm_t = 0.0
+    shm_pulled = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        t = time.perf_counter()
+        got = p.request(peer, "model", model, version=0, out=dst)
+        shm_t += time.perf_counter() - t
+        assert got[0] == peer + 1.0 and got[-1] == peer + 1.0
+        shm_pulled += got.nbytes
+    shm_copy_gib = (shm_pulled / shm_t / (1 << 30) if shm_t > 0 else 0.0)
+    if size > 1 and _shm.available():
+        assert _shm.lane_bytes() > lane0, \
+            "shm lane never engaged on a colocated pull loop"
+
+    # --- kffast chunk streaming (phases.pull_streamed) ---------------
+    # the `{key}.cN` tier pipelined KFT_STREAM_DEPTH-deep on ONE
+    # connection, every chunk direct-deposited into its span of one
+    # flat destination — the per-chunk round-trip gap (the committed
+    # pull_chunked wire collapse) removed.  request_streamed never
+    # probes shm, so this measures the wire pipeline itself.
+    flat = np.empty(n_f32, np.float32)
+    flat[:] = 0.0                             # fault pages untimed
+    snames = []
+    spans = []
+    for j in range(nchunks):
+        span = flat[j * per:(j + 1) * per]
+        if span.size:
+            snames.append(f"model.c{j}")
+            spans.append(span)
+    st_t = 0.0
+    st_bytes = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < args.secs:
+        peer = others[rng.randint(len(others))]
+        t = time.perf_counter()
+        p.request_streamed(peer, snames, spans, version=0)
+        st_t += time.perf_counter() - t
+        assert flat[0] == peer + 1.0 and flat[-1] == peer + 1.0
+        st_bytes += flat.nbytes
+    streamed_gib = (st_bytes / st_t / (1 << 30) if st_t > 0 else 0.0)
 
     p.barrier(name="p2p-bench-end")
     row = np.asarray([sync_gib, hid_rate,
@@ -167,7 +243,9 @@ def _worker(args) -> None:
                       fresh_gib,
                       phase_gib["serialize"], phase_gib["wire"],
                       phase_gib["deserialize"],
-                      chunk_gib["wire"], chunk_gib["deserialize"]],
+                      chunk_gib["wire"], chunk_gib["deserialize"],
+                      shm_copy_gib, streamed_gib,
+                      float(_shm.lane_bytes())],
                      np.float64)
     allrows = p.gather(row, root=0, name="p2p-bench-rows")
     if rank == 0:
@@ -186,10 +264,13 @@ def _worker(args) -> None:
             "hidden_fraction": round(float(allrows[:, 2].mean()), 3),
             "sync_pull_fresh_alloc_gib_s": round(
                 float(allrows[:, 3].mean()), 3),
-            "shm_lane_bytes": int(shm),
+            # native bulk-lane bytes (rank 0) + the kffast Python shm
+            # lane bytes summed over every worker's pull loops
+            "shm_lane_bytes": int(shm) + int(allrows[:, 11].sum()),
             # kfnet per-phase schema: the committed baseline the
-            # transport optimisation work must beat, phase by phase
-            "schema": "p2p-phase-v1",
+            # transport optimisation work must beat, phase by phase;
+            # v2 adds the kffast lanes (pull_shm, pull_streamed)
+            "schema": "p2p-phase-v2",
             "phases": {
                 "pull": {
                     "serialize_gib_s": round(
@@ -202,6 +283,13 @@ def _worker(args) -> None:
                     "wire_gib_s": round(float(allrows[:, 7].mean()), 3),
                     "deserialize_gib_s": round(
                         float(allrows[:, 8].mean()), 3),
+                },
+                "pull_shm": {
+                    "copy_gib_s": round(float(allrows[:, 9].mean()), 3),
+                },
+                "pull_streamed": {
+                    "wire_gib_s": round(
+                        float(allrows[:, 10].mean()), 3),
                 },
             },
         }

@@ -145,6 +145,46 @@ def check_no_orphans(pids: Sequence[int],
     return bad
 
 
+def check_no_shm_orphans(pids: Sequence[int] = ()) -> List[str]:
+    """No kffast shared-memory segment outlives its creator (kffast
+    leak protection, store/shm.py).  Clean exits, crashes and SIGTERMs
+    unlink through the registry's chained handlers; SIGKILL cannot run
+    handlers, so a segment whose creator pid is dead — or belongs to
+    this scenario's worker set — is an orphan: flagged AND unlinked
+    here (the reap mirrors :func:`check_no_orphans`'s kill: never leave
+    it behind either way).  Segments of live foreign processes are
+    someone else's concurrent run and are left alone."""
+    import os
+    from ..store import shm as _shm
+    bad = []
+    ours = {int(p) for p in pids}
+    try:
+        entries = os.listdir(_shm.segment_dir())
+    except OSError:
+        return bad   # no /dev/shm on this platform: nothing to leak
+    for entry in entries:
+        pid = _shm.parse_segment_pid(entry)
+        if pid is None:
+            continue
+        if pid not in ours and pid != os.getpid():
+            try:
+                os.kill(pid, 0)
+            except (ProcessLookupError, PermissionError):
+                pass     # creator is gone: orphan
+            else:
+                continue  # live foreign creator: not ours to judge
+        if pid == os.getpid():
+            continue      # the runner's own live segments are not leaks
+        try:
+            os.unlink(os.path.join(_shm.segment_dir(), entry))
+        except OSError:
+            continue      # raced another reaper: already clean
+        bad.append(
+            f"/dev/shm/{entry} orphaned by pid {pid}: the creator died "
+            f"without unlinking (reaped)")
+    return bad
+
+
 def check_sync_from_committed(events: Sequence[Event]) -> List[str]:
     """Every recovery/resize restore lands EXACTLY on a commit some
     worker recorded: a ``sync`` event's restored progress pair must
@@ -237,6 +277,7 @@ def run_all(events: Sequence[Event], pids: Sequence[int] = (),
     bad += check_single_winner(events)
     bad += check_version_monotonic_across_epochs(events)
     bad += check_no_orphans(pids, marker=pid_marker)
+    bad += check_no_shm_orphans(pids)
     if oracle_wsum is not None:
         bad += check_trajectory(events, oracle_wsum)
     return bad

@@ -344,6 +344,15 @@ class Scenario:
     # this many journal fires / distinct observed config versions
     min_fired: int = 0
     min_config_versions: int = 0
+    # kffast fan-out proof floor: at least this many DISTINCT donors in
+    # the join ledger (``sync`` events carrying a ``donor`` field) —
+    # proves a grow's adoption pulls spread over the holders instead of
+    # every joiner converging on one
+    min_sync_donors: int = 0
+    # extra worker-side environment (knob overrides) merged over the
+    # runner's base env — e.g. KFT_SHM_MIN_KB=0 so the tiny chaos model
+    # still rides the shm fast lane (kill-during-shm-pull)
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def scenarios() -> Dict[str, Scenario]:
@@ -378,6 +387,21 @@ def scenarios() -> Dict[str, Scenario]:
                  "commit with the trajectory oracle intact",
             plan=Plan(seed=None).add("snapshot.commit", "kill",
                                      rank=1, step=6)),
+        Scenario(
+            name="kill-during-shm-pull",
+            desc="SIGKILL rank 1 INSIDE the shm attach window of a "
+                 "same-host fast-lane pull (store.shm.attach, kffast): "
+                 "the dead puller never owned the segment it was "
+                 "mapping, so /dev/shm must hold NO orphan from any "
+                 "dead worker (check_no_shm_orphans) and the publisher's "
+                 "live segment must survive the reader's death; "
+                 "KFT_SHM_MIN_KB=0 drives even the tiny chaos model "
+                 "down the shm lane and min_fired proves the lane "
+                 "actually ran in the real tier",
+            plan=Plan(seed=None).add("store.shm.attach", "kill",
+                                     rank=1),
+            env={"KFT_SHM_MIN_KB": "0"},
+            min_fired=1),
         Scenario(
             name="config-server-crash-restart-mid-resize",
             desc="SIGKILL the WAL-backed config server the moment a "
@@ -966,6 +990,15 @@ def floor_violations(sc: Scenario, fired: List[dict],
                 f"only {len(seen)} distinct config version(s) observed "
                 f"{sorted(v for v in seen if v is not None)} (scenario "
                 f"requires >= {sc.min_config_versions})")
+    if sc.min_sync_donors:
+        donors = {e.get("donor") for e in events
+                  if e.get("kind") == "sync" and e.get("donor")}
+        if len(donors) < sc.min_sync_donors:
+            violations.append(
+                f"join ledger shows only {len(donors)} distinct sync "
+                f"donor(s) {sorted(donors)} (scenario requires >= "
+                f"{sc.min_sync_donors}: the kffast fan-out pull pattern "
+                f"must spread joiners across holders)")
     return violations
 
 
@@ -1015,6 +1048,7 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         "KFT_RECV_TIMEOUT_S": "3",
         "KFT_CONN_RETRIES": "10",
     }
+    env.update(sc.env)
     if sc.server != "inproc":
         # a subprocess server restart pays a full interpreter + jax
         # import before it serves again; survivors must out-wait it

@@ -117,6 +117,12 @@ SITES: Dict[str, str] = {
     "store.load": (
         "ModelStore.request of a pytree — an exception here models a "
         "corrupt/evicted blob"),
+    "store.shm.attach": (
+        "kffast same-host lane (store/shm.py), before a puller maps a "
+        "publisher's named /dev/shm segment — a kill here is the "
+        "kill-during-shm-pull scenario (the dead puller must leave no "
+        "orphaned segment: it never owned one); an exception models a "
+        "vanished/foreign segment and must fall back to the wire"),
 }
 
 

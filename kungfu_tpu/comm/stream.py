@@ -1,0 +1,95 @@
+"""kffast lane selection for host-plane p2p pulls.
+
+The native peer exposes three ways to move a blob:
+
+- ``request``            — one synchronous RPC; probes the same-host
+  shared-memory lane first (:mod:`kungfu_tpu.store.shm`).
+- ``request_streamed``   — many blobs pipelined on one connection with
+  ``KFT_STREAM_DEPTH`` in flight; the cross-host fast lane.
+- ``request_async``      — the raw building block of both.
+
+This module is the POLICY layer callers actually want: give it a peer,
+a target, and what you need, and it picks the lane — same-host targets
+go blob-by-blob so each pull can ride the shm segment; cross-host
+multi-blob batches stream (gated by ``KFT_STREAM_PIPELINE``).
+Destinations come from the (dtype, nbytes) buffer pool
+(:mod:`kungfu_tpu.store.pool`) unless the caller passes its own.
+
+docs/elastic.md ("Store fast lane") documents the selection rules.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..store.pool import default_pool
+from ..utils import knobs
+
+__all__ = ["same_host", "pull_blobs", "pull_chunked"]
+
+
+def same_host(peer, target: int) -> bool:
+    """True when ``target`` shares this peer's host (the shm-lane
+    audience).  Works on anything with the NativePeer peer-spec shape;
+    False on stubs that don't carry one."""
+    host_of = getattr(peer, "_host_of", None)
+    if host_of is None:
+        return False
+    return host_of(target) == host_of(peer.rank)
+
+
+def pull_blobs(peer, target: int, specs: Sequence[tuple],
+               version: int = -1,
+               outs: Optional[Sequence[np.ndarray]] = None
+               ) -> List[np.ndarray]:
+    """Pull the blobs described by ``specs`` — ``(name, dtype, shape)``
+    triples — from ``target``, down whichever lane is fastest for the
+    topology:
+
+    - same host: per-blob ``request`` — each blob probes the shm
+      descriptor and lands at memcpy speed, no pipelining needed;
+    - cross host, more than one blob: ``request_streamed`` — the
+      per-blob Python round-trip gap is the chunked tier's collapse;
+    - otherwise: plain sequential requests.
+
+    ``outs`` overrides the pooled destinations (must match sizes)."""
+    if outs is None:
+        outs = [default_pool().take(dt, shape) for _, dt, shape in specs]
+    names = [n for n, _, _ in specs]
+    if (len(names) > 1 and knobs.get("KFT_STREAM_PIPELINE")
+            and not same_host(peer, target)
+            and hasattr(peer, "request_streamed")):
+        return peer.request_streamed(target, names, list(outs),
+                                     version=version)
+    return [peer.request(target, n, o, version=version, out=o)
+            for n, o in zip(names, outs)]
+
+
+def pull_chunked(peer, target: int, key: str, nchunks: int, per: int,
+                 dtype, shape, version: int = -1) -> np.ndarray:
+    """Reassemble a ``{key}.cN``-chunked blob from ``target`` into ONE
+    pooled destination: every chunk's request lands direct-deposit in
+    its span of the output, streamed ``KFT_STREAM_DEPTH``-deep on one
+    connection — no per-chunk round-trip gap, no per-chunk staging
+    buffer, no reassembly copy.  ``per`` is elements per chunk (the
+    store's ``.meta`` layout); the final chunk may be shorter."""
+    dt = np.dtype(dtype)
+    size = int(np.prod(tuple(int(s) for s in shape), dtype=np.int64))
+    out = default_pool().take(dt, (size,))
+    names, spans = [], []
+    for j in range(nchunks):
+        lo, hi = j * per, min((j + 1) * per, size)
+        if hi <= lo:
+            break
+        names.append(f"{key}.c{j}")
+        spans.append(out[lo:hi])
+    if names:
+        if (knobs.get("KFT_STREAM_PIPELINE")
+                and hasattr(peer, "request_streamed")
+                and not same_host(peer, target)):
+            peer.request_streamed(target, names, spans, version=version)
+        else:
+            for n, sp in zip(names, spans):
+                peer.request(target, n, sp, version=version, out=sp)
+    return out.reshape(shape)

@@ -236,10 +236,16 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             pred = (p.rank - 1) % nproc
             _, _, block_len = _layout(self._vec_size, ndev, nproc)
             dt = self._vec_dtypes()
-            held[pred] = {
-                name: p.request(pred, f"kftsh:{name}@{self.version}",
-                                np.empty(block_len, dt[name]), version=seq)
-                for name in blocks}
+            # kffast: all vectors pull through one lane decision —
+            # colocated predecessors serve over shm, remote ones stream
+            # the whole batch pipelined on one connection
+            from ..comm import stream as _stream
+            names = list(blocks)
+            got = _stream.pull_blobs(
+                p, pred,
+                [(f"kftsh:{name}@{self.version}", dt[name], (block_len,))
+                 for name in names], version=seq)
+            held[pred] = dict(zip(names, got))
         # record only AFTER the exchange: a commit interrupted by a peer
         # death must not count (recovery falls back to the previous one)
         _chaos_point("elastic.commit.record", rank=p.rank, step=seq,
@@ -327,15 +333,17 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         _, _, _, ndev, nproc, _ = self._held_meta[seq]
         _, _, block_len = _layout(self._vec_size, ndev, nproc)
         dt = self._vec_dtypes()
+        from ..comm import stream as _stream
         for r in departing:
             succ = next(i for k in range(1, len(old) + 1)
                         for i in [(r + k) % len(old)] if i in alive)
             if p.rank == succ and r not in self._held[seq]:
-                self._held[seq][r] = {
-                    name: p.request(r, f"kftsh:{name}@{self.version}",
-                                    np.empty(block_len, dt[name]),
-                                    version=seq)
-                    for name in self._vec_names()}
+                names = self._vec_names()
+                got = _stream.pull_blobs(
+                    p, r,
+                    [(f"kftsh:{name}@{self.version}", dt[name],
+                      (block_len,)) for name in names], version=seq)
+                self._held[seq][r] = dict(zip(names, got))
         p.barrier(name=f"kftsh-handoff@{self.version}")
 
     # ------------------------------------------------------------- resync
@@ -460,6 +468,12 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                 if r < old_nproc:
                     mine[r] = 1
             avail = p.all_gather(mine, name=f"kftsh-avail@{self.version}")
+        # kffast fan-out: every holder of a block is a valid source, so
+        # spread pulls across them instead of converging every puller on
+        # the first (or recorded-owner) holder — with every survivor
+        # serving below, a grow's join traffic divides over the whole
+        # old membership rather than hammering one donor's NIC
+        me = 0 if p is None else p.rank
         src: Dict[int, int] = {}
         for r in range(old_nproc):
             js = [j for j in range(avail.shape[0]) if avail[j, r]]
@@ -468,14 +482,16 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
                     f"sharded elastic: old rank {r}'s state shard is on "
                     "no survivor (more simultaneous failures than the "
                     "single-failure ring replica covers)")
-            own = [j for j in js if old_rank_of.get(j) == r]
-            src[r] = own[0] if own else js[0]
+            src[r] = js[(me + r) % len(js)]
         # --- serve what we hold, then pull what our new range needs ------
+        # EVERY holder serves every block it has (not just the assigned
+        # source): the spread assignment above only works if any holder
+        # can answer, and a straggling assigned source no longer
+        # bottlenecks the whole resync
         if p is not None and nproc > 1:
             for r, blks in self._held.get(M, {}).items():
-                if src.get(r) == p.rank:
-                    for name, b in blks.items():
-                        p.save(f"kftre:{name}:{r}", b, version=M)
+                for name, b in blks.items():
+                    p.save(f"kftre:{name}:{r}", b, version=M)
             p.barrier(name=f"kftsh-serve@{self.version}")
         import jax
         devs = jax.devices()
@@ -491,15 +507,29 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         dt = self._vec_dtypes()
         pulled: Dict[str, Dict[int, np.ndarray]] = {
             name: {} for name in self._vec_names()}
+        # kffast: group remote blocks by source and pull each group down
+        # one lane decision — colocated sources serve over shm, remote
+        # ones stream every block pipelined on one connection instead of
+        # a round trip per (vector, old-rank) pair
+        from ..comm import stream as _stream
+        by_src: Dict[int, List[int]] = {}
         for r in need:
             local = self._held.get(M, {}).get(r)
-            for name in self._vec_names():
-                if local is not None:
+            if local is not None:
+                for name in self._vec_names():
                     pulled[name][r] = local[name]
-                else:
-                    pulled[name][r] = p.request(
-                        src[r], f"kftre:{name}:{r}",
-                        np.empty(old_block, dt[name]), version=M)
+            else:
+                by_src.setdefault(src[r], []).append(r)
+        for tgt, rs in sorted(by_src.items()):
+            names = self._vec_names()
+            got = _stream.pull_blobs(
+                p, tgt,
+                [(f"kftre:{name}:{r}", dt[name], (old_block,))
+                 for r in rs for name in names], version=M)
+            it = iter(got)
+            for r in rs:
+                for name in names:
+                    pulled[name][r] = next(it)
         small_root = min(holders) if hdrs is not None else 0
         _, _, small_tpl, _, _, _ = (
             self._held_meta[M] if M in self._held_meta else

@@ -32,6 +32,14 @@ kfsnap splits the commit into pipelined phases:
   in exactly that window and the ``kill-during-async-commit`` scenario
   proves a kill there recovers from the previous durable commit.
 
+The owned/view tier is also the producer side of the kffast store fast
+lane (docs/elastic.md "Store fast lane"): a native-peer ``save`` of a
+published blob additionally lands it in a named shared-memory segment
+(:mod:`kungfu_tpu.store.shm`), so same-host pulls map it at memcpy
+speed, and the ``.cN`` chunk views are exactly the units the
+chunk-streamed cross-host pull pipelines on one connection — kfsnap
+callers change nothing to feed either lane.
+
 :class:`AsyncCommitter` runs join+publish on a background thread with a
 one-deep pipeline (double buffering): ``step()`` initiates commit ``k``
 while commit ``k-1`` is still joining; initiating while the previous

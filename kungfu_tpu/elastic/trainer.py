@@ -38,7 +38,12 @@ from .snapshot import snapshot as _snapshot
 
 def _restack(host_tree, n_new: int, mesh):
     """Re-lay host replicas onto a new mesh: survivors keep their replica,
-    newcomers clone lane 0 (the reference's broadcast-from-rank-0 sync)."""
+    newcomers clone lane 0 (the reference's broadcast-from-rank-0 sync).
+    The grow case stages through the kffast buffer pool: repeated
+    resizes recycle one host staging buffer per (dtype, nbytes) class
+    instead of fresh-allocating the full host tree each time
+    (``device_put`` copies out before the pool slot can be reused)."""
+    from ..store.pool import default_pool
     spec = P(mesh.axis_names)
 
     def re(t):
@@ -47,8 +52,9 @@ def _restack(host_tree, n_new: int, mesh):
         if n_new <= n_old:
             out = t[:n_new]
         else:
-            extra = np.broadcast_to(t[0:1], (n_new - n_old,) + t.shape[1:])
-            out = np.concatenate([t, extra], axis=0)
+            out = default_pool().take(t.dtype, (n_new,) + t.shape[1:])
+            out[:n_old] = t
+            out[n_old:] = t[0:1]
         return jax.device_put(jnp.asarray(out), NamedSharding(mesh, spec))
     return jax.tree_util.tree_map(re, host_tree)
 

@@ -140,7 +140,8 @@ _HELP = {
         "workers).",
     "kungfu_tpu_net_transfer_seconds":
         "kfnet ledger: wall time of one logical state movement, per op "
-        "(store.save/store.load/p2p.pull/state.adopt/resize.sync).",
+        "(store.save/store.load/p2p.pull/pull_shm/pull_streamed/"
+        "state.adopt/resize.sync).",
     "kungfu_tpu_net_phase_seconds":
         "kfnet ledger: per-phase wall time within a transfer "
         "(serialize/copy/wire/deserialize), per op.",
@@ -150,7 +151,15 @@ _HELP = {
         "per op.",
     "kungfu_tpu_state_move_gib_s":
         "kfnet ledger: effective GiB/s of the last completed state "
-        "movement, per op.",
+        "movement, per op (op=pull_shm is the same-host segment lane; "
+        "op=pull_streamed the pipelined chunk lane — kffast, "
+        "docs/elastic.md 'Store fast lane').",
+    "kungfu_tpu_shm_lane_bytes_total":
+        "kffast: payload bytes served through the same-host "
+        "shared-memory lane instead of the socket (python segment "
+        "pulls; the native ring's bytes ride NativePeer.shm_bytes). "
+        "Zero on a colocated cluster means the fast lane never "
+        "engaged.",
     "kungfu_tpu_peer_bandwidth_bytes_s":
         "Cluster bandwidth matrix: per-link bytes/sec between src and "
         "dst workers, joined from per-worker rate gauges by "

@@ -479,7 +479,11 @@ class NativePeer:
         same-nbytes wrong-dtype buffer would return silently
         reinterpreted garbage."""
         if out is None:
-            return np.empty_like(np.ascontiguousarray(like))
+            # kffast: draw from the (dtype, nbytes) pool — a recycled
+            # destination's pages are already faulted in, a fresh
+            # GB-scale one costs the whole zero-fill again
+            from ..store.pool import default_pool
+            return default_pool().take(like.dtype, like.shape)
         if not out.flags["C_CONTIGUOUS"]:
             raise ValueError("out buffer must be C-contiguous")
         if out.nbytes != like.nbytes or out.dtype != like.dtype:
@@ -504,6 +508,7 @@ class NativePeer:
                                           x.ctypes.data, x.nbytes,
                                           version), "save")
             xf.add(x.nbytes)
+        self._shm_publish(name, x, version)
 
     def request(self, target: int, name: str, like: np.ndarray,
                 version: int = -1,
@@ -511,9 +516,13 @@ class NativePeer:
         """Synchronous p2p pull.  ``out``: optional persistent
         destination buffer (see :meth:`request_async` — reuse it for
         large models; fresh per-pull allocations cost 2-5x in kernel
-        page-fault work at GB scale)."""
+        page-fault work at GB scale).  Colocated targets are probed for
+        the kffast shm lane first; any lane failure silently takes the
+        wire path below."""
         from ..monitor import net as _net
         out = self._check_out(out, like)
+        if self._shm_try_pull(target, name, out, version):
+            return out
         with _net.Transfer("p2p.pull", peer=self._peer_spec(target),
                            rank=self.rank, version=version) as xf:
             with xf.phase("wire"):
@@ -522,6 +531,149 @@ class NativePeer:
                     out.nbytes, version), "request")
             xf.add(out.nbytes)
         return out
+
+    def request_streamed(self, target: int, names: Sequence[str],
+                         outs: Sequence[np.ndarray],
+                         version: int = -1) -> List[np.ndarray]:
+        """Pipelined multi-blob pull: every (name, out) pair streams over
+        the ONE p2p connection to ``target`` with up to
+        ``KFT_STREAM_DEPTH`` requests in flight, each landing
+        direct-deposit in its destination.  This is the cross-host fast
+        lane for the store's ``{key}.cN`` chunk tier — the per-chunk
+        Python round-trip gap of sequential :meth:`request` calls is
+        what collapses the chunked wire rate (benchmarks/p2p.py
+        pull_chunked vs pull_streamed).  Destinations must be
+        C-contiguous and exactly the blob size (kfsnap chunk spans are).
+        The whole batch is one ``pull_streamed`` ledger entry."""
+        import time as _time
+        from collections import deque
+
+        from ..monitor import net as _net
+        if len(names) != len(outs):
+            raise ValueError("names/outs length mismatch")
+        for o in outs:
+            if not o.flags["C_CONTIGUOUS"]:
+                raise ValueError("streamed destinations must be "
+                                 "C-contiguous")
+        depth = max(1, int(knobs.get("KFT_STREAM_DEPTH")))
+        t0 = _time.perf_counter()
+        window: deque = deque()
+        err: Optional[BaseException] = None
+
+        def drain_one():
+            nonlocal err
+            try:
+                window.popleft().result()
+            except BaseException as e:   # keep draining; raise at end
+                if err is None:
+                    err = e
+
+        for name, out in zip(names, outs):
+            if err is not None:
+                break
+            while len(window) >= depth:
+                drain_one()
+            try:
+                fut = self._async_op(
+                    lambda cb, n=name, o=out: _check(
+                        self._lib.kft_request_async(
+                            self._h, target, n.encode(), o.ctypes.data,
+                            o.nbytes, version, cb, None),
+                        "request_async"),
+                    (out,), lambda o=out: o)
+            except BaseException as e:
+                if err is None:
+                    err = e
+                break
+            window.append(fut)
+        while window:
+            drain_one()
+        if err is not None:
+            raise err
+        wall = _time.perf_counter() - t0
+        total = int(sum(o.nbytes for o in outs))
+        _net.record_transfer("pull_streamed", nbytes=total, wall=wall,
+                             peer=self._peer_spec(target),
+                             phases={"wire": wall})
+        return list(outs)
+
+    # ----------------------------------------------------- kffast shm lane
+    def _host_of(self, j: int) -> str:
+        spec = self._peer_spec(j)
+        return spec.rsplit(":", 1)[0]
+
+    def _shm_eligible(self, nbytes: int) -> bool:
+        if not knobs.get("KFT_SHM_LANE"):
+            return False
+        if nbytes <= knobs.get("KFT_SHM_MIN_KB") * 1024.0:
+            return False
+        from ..store import shm as _shm
+        return _shm.available()
+
+    def _has_colocated_peer(self) -> bool:
+        """Any OTHER peer on this worker's host (the only audience the
+        shm lane can serve)."""
+        me = self._host_of(self.rank)
+        return any(self._host_of(j) == me
+                   for j in range(len(self._peers)) if j != self.rank)
+
+    def _shm_publish(self, name: str, x: np.ndarray,
+                     version: int) -> None:
+        """Land the blob in a named segment and save its 512-byte
+        descriptor under the ``kfshm::`` key (same version) so
+        colocated pullers can skip the wire.  Best-effort: the payload
+        blob is already saved, so any failure just means wire pulls."""
+        if not self._shm_eligible(x.nbytes) or not self._has_colocated_peer():
+            return
+        from ..store import shm as _shm
+        try:
+            desc = np.frombuffer(_shm.publish(name, x), np.uint8)
+            _check(self._lib.kft_save(
+                self._h, _shm.descriptor_key(name).encode(),
+                desc.ctypes.data, desc.nbytes, version), "save")
+        except (OSError, ValueError, NativeError):
+            pass
+
+    def _shm_try_pull(self, target: int, name: str, out: np.ndarray,
+                      version: int) -> bool:
+        """Serve a pull through the shm lane when the target is
+        colocated and published a descriptor.  False — for ANY reason:
+        lane off, cross-host, no descriptor, stale generation, chaos
+        fault at store.shm.attach — sends the caller down the wire."""
+        if not self._shm_eligible(out.nbytes):
+            return False
+        if self._host_of(target) != self._host_of(self.rank):
+            return False
+        import time as _time
+
+        from ..monitor import net as _net
+        from ..store import shm as _shm
+        t0 = _time.perf_counter()
+        if target == self.rank:
+            desc = _shm.descriptor(name)   # self-pull: no RPC at all
+            if desc is None:
+                return False
+        else:
+            dbuf = np.zeros(_shm.DESC_BYTES, np.uint8)
+            try:
+                _check(self._lib.kft_request(
+                    self._h, target, _shm.descriptor_key(name).encode(),
+                    dbuf.ctypes.data, dbuf.nbytes, version), "request")
+            except NativeError:
+                return False   # no descriptor published for this blob
+            desc = dbuf.tobytes()
+        try:
+            ok = _shm.read_into(desc, out, rank=self.rank,
+                                version=version)
+        except Exception:
+            return False   # incl. chaos-injected attach faults
+        if not ok:
+            return False
+        wall = _time.perf_counter() - t0
+        _net.record_transfer("pull_shm", nbytes=out.nbytes, wall=wall,
+                             peer=self._peer_spec(target),
+                             phases={"copy": wall})
+        return True
 
     # --------------------------------------------------------- monitoring
     def egress_bytes(self, peer: int = -1) -> int:

@@ -305,5 +305,22 @@ def sim_scenarios() -> Dict[str, Scenario]:
             sim_step_s=0.1,
             min_config_versions=2,
             timeout_s=240.0),
+        Scenario(
+            name="sim-grow-fanout",
+            desc="the kffast fan-out twin of sim-grow-join: 12 fake "
+                 "workers grow to 16, and the join ledger must show "
+                 "the joiners' state pulls SPREAD across holders — at "
+                 "least 2 distinct sync donors — proving the "
+                 "rank-rotated donor selection (no single holder "
+                 "serves every joiner, the resize pull fan-out)",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=12,
+            propose=((4, 16),),
+            target_steps=14,
+            sim_step_s=0.1,
+            min_config_versions=2,
+            min_sync_donors=2,
+            timeout_s=240.0),
     ]
     return {s.name: s for s in m}

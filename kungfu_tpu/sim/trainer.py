@@ -210,7 +210,17 @@ class FakeTrainer:
                      version=self.version)
         best: Optional[dict] = None
         probed = 0
-        for p in self.workers:
+        # kffast fan-out: rotate the probe order by rank so a grow's
+        # joiners spread their adoption pulls over all live donors
+        # instead of converging on the list head — with equal committed
+        # progress the first donor probed wins, so the rotation alone
+        # divides the join traffic (the sync event's ``donor`` field is
+        # how the join ledger proves the spread)
+        order = list(self.workers)
+        if order:
+            k = self.rank % len(order)
+            order = order[k:] + order[:k]
+        for p in order:
             if p.host == self.host and p.port == self.port:
                 continue
             if probed >= 8:
@@ -237,14 +247,15 @@ class FakeTrainer:
                     and (best is None
                          or int(d["samples"]) > best["samples"])):
                 best = {"samples": int(d["samples"]),
-                        "step": int(d["step"]), "w": float(d["w"])}
+                        "step": int(d["step"]), "w": float(d["w"]),
+                        "donor": f"{p.host}:{p.port}"}
         if best is not None:
             self.samples = best["samples"]
             self.step = best["step"]
             self.w = best["w"]
             self.emit("sync", step=self.step, samples=self.samples,
                       size=len(self.workers), version=self.version,
-                      wsum=self.w)
+                      wsum=self.w, donor=best["donor"])
 
     # ------------------------------------------------------------ kfnet
     def _emit_net_traffic(self) -> None:

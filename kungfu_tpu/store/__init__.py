@@ -80,7 +80,15 @@ class Store:
         with self._lock:
             if name not in self._blobs:
                 raise KeyError(name)
-            return self._blobs[name].copy()
+            blob = self._blobs[name]
+        # kffast: the caller's private copy lands in a pooled buffer —
+        # repeated gets of same-class blobs skip the fresh allocation's
+        # page-fault/zero-fill cost (blob reference is stable outside
+        # the lock: set() replaces, never mutates)
+        from .pool import default_pool
+        out = default_pool().take(blob.dtype, blob.shape)
+        np.copyto(out, blob)
+        return out
 
     def get_view(self, name: str) -> np.ndarray:
         """Zero-copy read-only view of a blob (the kfsnap read tier):
@@ -315,7 +323,9 @@ class ModelStore:
         nchunks = int(meta[0])
         shape = tuple(int(x) for x in meta[2:])
         first = get_view(f"{key}.c0")
-        out = np.empty(int(np.prod(shape, dtype=np.int64)), first.dtype)
+        from .pool import default_pool
+        out = default_pool().take(first.dtype,
+                                  int(np.prod(shape, dtype=np.int64)))
         at = 0
         for j in range(nchunks):
             c = first if j == 0 else get_view(f"{key}.c{j}")

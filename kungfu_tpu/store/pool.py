@@ -1,0 +1,102 @@
+"""kffast: a destination-buffer pool for p2p store pulls.
+
+A fresh gigabyte-scale pull destination makes the kernel fault in and
+zero-fill the whole mapping before the first payload byte lands —
+benchmarks/p2p.py measures fresh-alloc pulls at a fraction of the
+reused-buffer rate.  Callers that own a long-lived destination should
+keep passing it explicitly (``out=``); this pool covers everyone else:
+``take(dtype, shape)`` hands back a previously-warmed buffer of the
+same (dtype, nbytes) class when one is free, a fresh one otherwise.
+
+Freeness is reference-counted, not signalled: the pool keeps strong
+references to the buffers it has minted and a buffer is reusable only
+while nothing outside the pool still references it (``sys.getrefcount``
+probe).  Callers therefore never return buffers — dropping the last
+view IS the return.  The pool never hands out a buffer somebody still
+holds, so the worst failure mode is a silent cache miss.
+
+``KFT_POOL_SLOTS`` caps retained buffers per (dtype, nbytes) class;
+0 disables retention entirely (every take is a fresh allocation).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..utils import knobs
+
+__all__ = ["BufferPool", "default_pool", "reset_default_pool"]
+
+# refcount of a pooled flat buffer with no outside holders: the pool's
+# list slot, the `buf` loop variable, and getrefcount's own argument
+_IDLE_REFS = 3
+
+
+class BufferPool:
+    """Per-(dtype, nbytes)-class recycling of pull destinations."""
+
+    def __init__(self, slots: int = None):
+        self._slots = (knobs.get("KFT_POOL_SLOTS")
+                       if slots is None else int(slots))
+        self._lock = threading.Lock()
+        self._bufs: Dict[Tuple[str, int], List[np.ndarray]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def take(self, dtype, shape) -> np.ndarray:
+        """A C-contiguous ndarray of (dtype, shape): recycled when a
+        warmed same-class buffer is idle, freshly allocated otherwise.
+        Contents are UNINITIALIZED either way (pull destinations get
+        fully overwritten)."""
+        dt = np.dtype(dtype)
+        if isinstance(shape, (int, np.integer)):
+            shape = (int(shape),)
+        else:
+            shape = tuple(int(s) for s in shape)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        key = (dt.str, nbytes)
+        with self._lock:
+            for buf in self._bufs.get(key, ()):
+                if sys.getrefcount(buf) == _IDLE_REFS:
+                    self.hits += 1
+                    return buf[:nbytes].view(dt).reshape(shape)
+            self.misses += 1
+            buf = np.empty(max(1, nbytes), np.uint8)
+            if self._slots > 0:
+                lst = self._bufs.setdefault(key, [])
+                if len(lst) < self._slots:
+                    lst.append(buf)
+        return buf[:nbytes].view(dt).reshape(shape)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._bufs.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "classes": len(self._bufs),
+                    "buffers": sum(len(v) for v in self._bufs.values())}
+
+
+_default: BufferPool = None
+_default_lock = threading.Lock()
+
+
+def default_pool() -> BufferPool:
+    """The process-wide pool ModelStore/NativePeer pulls draw from."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = BufferPool()
+        return _default
+
+
+def reset_default_pool() -> None:
+    """Drop the process pool (tests; also re-reads KFT_POOL_SLOTS)."""
+    global _default
+    with _default_lock:
+        _default = None

@@ -1,0 +1,456 @@
+"""kffast: named shared-memory segments for the same-host pull lane.
+
+The p2p model store's wire path pays a serialize + socket + deserialize
+round trip even when both peers sit on one host.  This module gives the
+store a second lane: a publisher lands a blob in a named
+``multiprocessing.shared_memory`` segment and saves only a fixed
+512-byte *descriptor* under the store key; a colocated puller requests
+the descriptor (a sub-millisecond RPC), attaches the segment, and
+copies — or maps — the payload at memcpy speed.  Cross-host peers never
+see the lane: they pull the payload blob the store also keeps.
+
+Segment layout (one blob per segment)::
+
+    [ 64-byte header | payload bytes ]
+    header = 3 little-endian int64s: MAGIC, generation, payload nbytes
+
+The generation field is a seqlock: a publisher republishing into the
+same segment bumps it to odd, copies, then bumps to even, and every
+descriptor carries the generation it was minted at.  Readers require
+the header generation to equal their descriptor's — before AND after
+the copy — so neither an overlapped republish (torn blob) nor an
+already-completed one (wrong version) can be handed out; on a mismatch
+they report failure and the caller takes the wire.  Descriptors are
+JSON padded to :data:`DESC_BYTES` so the native store can serve them
+through the normal fixed-size request path.
+
+Leak protection: every segment this process CREATED is recorded in a
+process-local registry and unlinked on clean shutdown (atexit) AND from
+the excepthook/SIGTERM handlers — chained exactly like
+:func:`kungfu_tpu.trace.crashdump.install`, preserving the -SIGTERM
+returncode the watcher's preemption detection keys on.  SIGKILL cannot
+run handlers; :func:`kungfu_tpu.chaos.invariants.check_no_shm_orphans`
+reaps (and flags) segments whose creator pid is gone.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import mmap
+import os
+import signal
+import sys
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DESC_BYTES", "available", "publish", "read_into", "attach_view",
+    "descriptor", "is_descriptor_key", "descriptor_key", "payload_key",
+    "lane_bytes", "owned_segments", "cleanup", "segment_dir",
+    "parse_segment_pid",
+]
+
+DESC_BYTES = 512          # fixed descriptor size served via the store
+_MAGIC = 0x6B6673686D31   # "kfshm1"
+_HDR_I64 = 3              # magic, generation, payload nbytes
+_HDR = 64                 # header bytes (payload starts 64-byte aligned)
+_PREFIX = "kfshm"         # /dev/shm entry: kfshm-<pid>-<seq>
+_DESC_PREFIX = "kfshm::"  # store-key namespace for descriptors
+
+_lock = threading.RLock()
+_seq = 0
+# segments this process created (it owns the unlink) keyed by publish key
+_owned: "Dict[str, _Publication]" = {}
+# reader-side attach cache: segment name -> SharedMemory (LRU, bounded —
+# a mapped segment pins its memory until closed, and pullers touch the
+# same few publisher segments over and over)
+_attached: "OrderedDict[str, object]" = OrderedDict()
+_ATTACH_CACHE = 8
+_hooks_installed = False
+_lane_bytes = 0           # python-side shm-lane byte odometer
+
+
+def available() -> bool:
+    """True when this interpreter/platform can create named segments."""
+    try:
+        from multiprocessing import shared_memory  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def segment_dir() -> str:
+    """Where named segments appear as files (POSIX)."""
+    return "/dev/shm"
+
+
+def parse_segment_pid(entry: str) -> Optional[int]:
+    """Creator pid of a ``kfshm-<pid>-<seq>`` /dev/shm entry, else None."""
+    parts = entry.split("-")
+    if len(parts) != 3 or parts[0] != _PREFIX:
+        return None
+    try:
+        return int(parts[1])
+    except ValueError:
+        return None
+
+
+def descriptor_key(key: str) -> str:
+    """The store key a blob's shm descriptor is published under."""
+    return _DESC_PREFIX + key
+
+
+def is_descriptor_key(key: str) -> bool:
+    return key.startswith(_DESC_PREFIX)
+
+
+def payload_key(desc_key: str) -> str:
+    return desc_key[len(_DESC_PREFIX):]
+
+
+class _Publication:
+    """One owned segment: the SharedMemory plus its header view."""
+
+    def __init__(self, shm, capacity: int):
+        self.shm = shm
+        self.capacity = capacity
+        self.hdr = np.frombuffer(shm.buf, np.int64, _HDR_I64)
+        self.gen = 0
+
+    def payload(self, nbytes: int) -> np.ndarray:
+        return np.frombuffer(self.shm.buf, np.uint8, nbytes, offset=_HDR)
+
+
+def _new_segment(capacity: int):
+    """Create a fresh named segment sized header + capacity."""
+    global _seq
+    from multiprocessing import shared_memory
+    with _lock:
+        _seq += 1
+        name = f"{_PREFIX}-{os.getpid()}-{_seq}"
+    return shared_memory.SharedMemory(name=name, create=True,
+                                      size=_HDR + max(1, capacity))
+
+
+class _ReaderMapping:
+    """Reader-side attach via plain ``open``+``mmap`` — deliberately
+    NOT ``multiprocessing.shared_memory``: this interpreter registers
+    every attach with the resource tracker, and when workers share one
+    tracker (mp-spawn children inherit the parent's) the attach-side
+    unregister workaround strips the PUBLISHER's create registration
+    too (the tracker cache holds one set entry per name) — KeyError
+    spam at owner unlink, and the tracker's leak backstop disarmed for
+    the owner.  A raw read-only mapping never talks to the tracker;
+    the attach side never owns the unlink anyway."""
+
+    __slots__ = ("name", "size", "_mmap", "buf")
+
+    def __init__(self, name: str):
+        self.name = name
+        fd = os.open(os.path.join(segment_dir(), name), os.O_RDONLY)
+        try:
+            self.size = os.fstat(fd).st_size
+            self._mmap = mmap.mmap(fd, self.size, mmap.MAP_SHARED,
+                                   mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        self.buf = memoryview(self._mmap)
+
+    def close(self) -> None:
+        if self.buf is not None:
+            self.buf.release()   # BufferError while views still exported
+            self.buf = None
+        if self._mmap is not None:
+            self._mmap.close()
+            self._mmap = None
+
+
+def _attach_segment(name: str):
+    """Attach (reader side).  Raises OSError when the segment vanished.
+    Platforms whose named segments don't appear under
+    :func:`segment_dir` (non-Linux) fall back to a tracked
+    ``SharedMemory`` attach with the unregister workaround."""
+    try:
+        return _ReaderMapping(name)
+    except FileNotFoundError:
+        pass
+    from multiprocessing import shared_memory
+    shm = shared_memory.SharedMemory(name=name)
+    if not name.startswith(f"{_PREFIX}-{os.getpid()}-"):
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(
+                getattr(shm, "_name", "/" + name), "shared_memory")
+        except (ImportError, AttributeError, KeyError, ValueError):
+            pass
+    return shm
+
+
+# --------------------------------------------------------------- cleanup
+_zombies: list = []   # close-refused handles, pinned so __del__ never fires
+
+
+def _close_quiet(shm) -> None:
+    """Close a mapping; when numpy views still export its buffer the
+    memory must stay mapped for them, so instead of raising we disarm
+    the handle (null its internals and pin it) — otherwise GC retries
+    the close in ``__del__`` and spams 'Exception ignored' BufferErrors."""
+    try:
+        shm.close()
+    except BufferError:
+        try:
+            shm._buf = None
+            shm._mmap = None
+        except AttributeError:
+            pass
+        _zombies.append(shm)
+    except OSError:
+        pass
+
+
+def _unlink_quiet(shm) -> None:
+    try:
+        shm.unlink()
+    except (OSError, FileNotFoundError):
+        pass
+
+
+def cleanup() -> None:
+    """Unlink every owned segment and drop the attach cache.  Idempotent
+    and safe from handlers: a vanished segment is already clean."""
+    with _lock:
+        pubs = list(_owned.values())
+        _owned.clear()
+        attached = list(_attached.values())
+        _attached.clear()
+    for pub in pubs:
+        pub.hdr = None
+        _close_quiet(pub.shm)
+        _unlink_quiet(pub.shm)
+    for shm in attached:
+        _close_quiet(shm)
+
+
+def _ensure_hooks() -> None:
+    """Arm the crash-safe unlink path once: atexit for clean exits, a
+    chained excepthook for crashes, a chained SIGTERM handler for
+    preemption-class kills.  The SIGTERM chain mirrors
+    trace/crashdump.py: whoever sits innermost restores SIG_DFL and
+    re-raises, so the process still dies with returncode -15."""
+    global _hooks_installed
+    with _lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+
+    atexit.register(cleanup)
+
+    prev_hook = sys.excepthook
+
+    def _hook(etype, value, tb):
+        cleanup()
+        prev_hook(etype, value, tb)
+
+    sys.excepthook = _hook
+
+    if threading.current_thread() is not threading.main_thread():
+        return
+    try:
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            cleanup()
+            if callable(prev_term):
+                prev_term(signum, frame)
+                return
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError) as e:
+        # embedded interpreters can refuse signal.signal; the atexit +
+        # excepthook paths (and the orphan reaper) still cover us
+        print(f"kfshm: SIGTERM cleanup handler not installed: {e}",
+              file=sys.stderr)
+
+
+# --------------------------------------------------------------- publish
+def publish(key: str, data: np.ndarray) -> bytes:
+    """Land ``data``'s bytes in this process's segment for ``key`` and
+    return the fixed-size descriptor to save under
+    :func:`descriptor_key`.  Same key + same size republishes in place
+    under the seqlock; a size change retires the old segment (existing
+    reader mappings stay valid — POSIX keeps the memory until the last
+    close) and mints a fresh, never-reused name: a stale descriptor
+    either fails attach (fresh process) or serves the retired segment's
+    final payload from a cached mapping — always the blob the
+    descriptor named, never silently the new one."""
+    flat = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    nbytes = int(flat.nbytes)
+    _ensure_hooks()
+    with _lock:
+        pub = _owned.get(key)
+        if pub is not None and pub.capacity < nbytes:
+            _owned.pop(key, None)
+            pub.hdr = None
+            _close_quiet(pub.shm)
+            _unlink_quiet(pub.shm)
+            pub = None
+        if pub is None:
+            pub = _Publication(_new_segment(nbytes), nbytes)
+            pub.hdr[0] = _MAGIC
+            pub.hdr[1] = 0
+            _owned[key] = pub
+    # seqlock write: odd while the payload is inconsistent
+    pub.gen += 1
+    pub.hdr[1] = pub.gen
+    pub.hdr[2] = nbytes
+    if nbytes:
+        np.copyto(pub.payload(nbytes), flat)
+    pub.gen += 1
+    pub.hdr[1] = pub.gen
+    desc = json.dumps({"seg": pub.shm.name, "nbytes": nbytes,
+                       "gen": pub.gen}).encode()
+    if len(desc) > DESC_BYTES:
+        raise ValueError(f"shm descriptor overflow ({len(desc)} bytes)")
+    return desc.ljust(DESC_BYTES, b"\0")
+
+
+def parse_descriptor(desc: bytes) -> Optional[dict]:
+    """Decode a descriptor blob; None when it isn't one (wrong size,
+    junk bytes) — callers treat that as 'no shm lane' and take the
+    wire."""
+    if len(desc) != DESC_BYTES:
+        return None
+    try:
+        d = json.loads(bytes(desc).rstrip(b"\0").decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(d, dict) or "seg" not in d or "nbytes" not in d:
+        return None
+    return d
+
+
+def _attach(seg: str, nbytes: int, *, rank=None, version=None):
+    """Attach-with-cache; validates the header.  Raises OSError /
+    ValueError on a vanished or foreign segment (callers fall back)."""
+    from ..chaos import point as _chaos_point
+    _chaos_point("store.shm.attach", rank=rank, version=version)
+    with _lock:
+        shm = _attached.pop(seg, None)
+        if shm is not None:
+            _attached[seg] = shm   # refresh LRU slot
+    if shm is None:
+        shm = _attach_segment(seg)
+        with _lock:
+            _attached[seg] = shm
+            while len(_attached) > _ATTACH_CACHE:
+                _, old = _attached.popitem(last=False)
+                _close_quiet(old)
+    hdr = np.frombuffer(shm.buf, np.int64, _HDR_I64)
+    if int(hdr[0]) != _MAGIC:
+        raise ValueError(f"segment {seg} has no kfshm header")
+    if shm.size < _HDR + nbytes:
+        raise ValueError(f"segment {seg} smaller than descriptor claims")
+    return shm, hdr
+
+
+def read_into(desc: bytes, out: np.ndarray, *, rank=None,
+              version=None, retries: int = 3) -> bool:
+    """Copy a published blob into ``out`` (contiguous, exactly the
+    descriptor's size).  False means the lane could not serve the pull
+    — vanished segment, live republish that never settled, junk
+    descriptor — and the caller must take the wire path."""
+    d = parse_descriptor(desc)
+    if d is None:
+        return False
+    nbytes = int(d["nbytes"])
+    if out.nbytes != nbytes or not out.flags["C_CONTIGUOUS"]:
+        return False
+    try:
+        shm, hdr = _attach(str(d["seg"]), nbytes, rank=rank,
+                           version=version)
+    except (OSError, ValueError):
+        return False
+    want_gen = int(d.get("gen", -1))
+    dst = out.view(np.uint8).reshape(-1)
+    src = np.frombuffer(shm.buf, np.uint8, nbytes, offset=_HDR)
+    for _ in range(max(1, retries)):
+        g0 = int(hdr[1])
+        if g0 != want_gen:   # republished since the descriptor was
+            return False     # minted (or mid-write): the segment no
+                             # longer holds the named blob — take the wire
+        if nbytes:
+            np.copyto(dst, src)
+        if int(hdr[1]) == g0:
+            _count_lane(nbytes)
+            return True
+    return False
+
+
+def _count_lane(nbytes: int) -> None:
+    global _lane_bytes
+    with _lock:
+        _lane_bytes += nbytes
+    # lazy import: shm must stay importable before the monitor package
+    # (KFT_SIM_LITE workers import the store first)
+    from .. import monitor as _monitor
+    _monitor.get_monitor().inc("kungfu_tpu_shm_lane_bytes_total",
+                               float(nbytes))
+
+
+def attach_view(desc: bytes, dtype, shape, *, rank=None,
+                version=None) -> Optional[np.ndarray]:
+    """Map a published blob zero-copy as a READ-ONLY ndarray (the
+    kfsnap owned/view tier: hand it to ``Store.set_owned`` and
+    ``get_view``/``get_latest_view`` serve the segment with no copy).
+    None when the lane can't serve it.  The mapping stays valid for the
+    attach cache's lifetime; treat it as a transient view, not storage."""
+    d = parse_descriptor(desc)
+    if d is None:
+        return None
+    nbytes = int(d["nbytes"])
+    dt = np.dtype(dtype)
+    if int(np.prod(shape)) * dt.itemsize != nbytes:
+        return None
+    try:
+        shm, hdr = _attach(str(d["seg"]), nbytes, rank=rank,
+                           version=version)
+    except (OSError, ValueError):
+        return None
+    if int(hdr[1]) != int(d.get("gen", -1)):
+        return None          # republished since the descriptor: stale
+    view = np.frombuffer(shm.buf, np.uint8, nbytes,
+                         offset=_HDR).view(dt).reshape(shape)
+    view.flags.writeable = False
+    _count_lane(nbytes)
+    return view
+
+
+def descriptor(key: str) -> Optional[bytes]:
+    """This process's live descriptor for ``key`` (None when never
+    published) — the self-pull shortcut: the publisher reads its own
+    segment without any RPC."""
+    with _lock:
+        pub = _owned.get(key)
+        if pub is None:
+            return None
+        desc = json.dumps({"seg": pub.shm.name,
+                           "nbytes": int(pub.hdr[2]),
+                           "gen": pub.gen}).encode()
+    return desc.ljust(DESC_BYTES, b"\0")
+
+
+def lane_bytes() -> int:
+    """Bytes this process pulled through the shm lane (python side;
+    the native ring's counter rides ``NativePeer.shm_bytes``)."""
+    with _lock:
+        return _lane_bytes
+
+
+def owned_segments() -> Tuple[str, ...]:
+    with _lock:
+        return tuple(p.shm.name for p in _owned.values())

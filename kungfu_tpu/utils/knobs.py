@@ -257,6 +257,29 @@ _def("KFT_RPC_BREAKER_COOLDOWN_S", "float", 1.0,
      "Breaker cooldown before a half-open probe is let through.",
      group=_ELASTIC)
 
+_FAST = "Store fast lane (kffast)"
+_def("KFT_SHM_LANE", "bool", True,
+     "Same-host shared-memory fast lane for p2p store pulls: saves "
+     "with a colocated peer also land in a named /dev/shm segment and "
+     "same-host pulls attach it instead of riding the socket. 0 "
+     "disables (every pull uses the wire path).", group=_FAST)
+_def("KFT_SHM_MIN_KB", "float", 64.0,
+     "Blobs at or below this many KiB skip the shm lane — the "
+     "descriptor round trip + attach only beats the socket above it.",
+     group=_FAST)
+_def("KFT_STREAM_DEPTH", "int", 4,
+     "In-flight request window of the chunk-streamed pull lane "
+     "(requests pipeline back-to-back on one connection; deserialize "
+     "overlaps the wire).", group=_FAST)
+_def("KFT_STREAM_PIPELINE", "bool", True,
+     "Stream multi-chunk / multi-block pulls through the async p2p "
+     "lane instead of one synchronous round trip per piece. 0 falls "
+     "back to sequential pulls.", group=_FAST)
+_def("KFT_POOL_SLOTS", "int", 4,
+     "Destination-buffer pool slots per (dtype, nbytes) class for "
+     "store pulls; 0 disables reuse (every pull allocates fresh).",
+     group=_FAST)
+
 _TRACE = "Tracing, metrics & profiling"
 _def("KFT_TRACE", "bool", False,
      "Arm the kftrace flight-recorder ring at import.", group=_TRACE)

@@ -86,7 +86,7 @@ def test_sim_scenarios_merged_into_cli_matrix():
             "sim-straggler-doctor-100", "sim-slowlink-doctor-100",
             "sim-slowlink-doctor-clean", "sim-policy-shadow-100",
             "sim-policy-shadow-clean", "sim-spot-trace",
-            "sim-grow-join"} <= sims
+            "sim-grow-join", "sim-grow-fanout"} <= sims
     for n in sims:
         sc = m[n]
         assert sc.parent_port is None  # concurrency: OS-assigned ports

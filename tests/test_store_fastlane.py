@@ -1,0 +1,491 @@
+"""kffast store fast lane: the buffer pool, the same-host shm lane,
+lane-selection policy, and the chunk-streamed pull path.
+
+Three layers, matching docs/elastic.md "Store fast lane":
+
+- :mod:`kungfu_tpu.store.pool` — (dtype, nbytes)-keyed destination
+  recycling, refcount-probed freeness;
+- :mod:`kungfu_tpu.store.shm` — named /dev/shm segments, generation-
+  pinned descriptors, crash-safe unlink;
+- :mod:`kungfu_tpu.comm.stream` — the policy layer picking per-blob
+  shm-probing requests same-host and pipelined streaming cross-host.
+
+The native end-to-end tests (2 real processes) prove the lane against
+the real transport: bit-identical shm pulls with exact lane
+accounting, sub-floor blobs falling back to the wire, streamed chunks
+with a non-divisible tail, and a chaos-plan SIGKILL inside the shm
+attach window leaving no /dev/shm orphan.
+"""
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu import native  # noqa: E402
+from kungfu_tpu.store import shm as kfshm  # noqa: E402
+from kungfu_tpu.store.pool import BufferPool  # noqa: E402
+
+
+# ------------------------------------------------------------- pool
+class TestBufferPool:
+    @staticmethod
+    def _ptr(a):
+        # compare recycling by data POINTER: holding any view (even
+        # `.base`) would keep the buffer referenced and defeat the
+        # pool's refcount freeness probe
+        return a.__array_interface__["data"][0]
+
+    def test_keyed_reuse(self):
+        pool = BufferPool(slots=4)
+        a = pool.take(np.float32, (16,))
+        ptr = self._ptr(a)
+        a[:] = 7.0
+        del a                      # dropping the last view IS the return
+        b = pool.take(np.float32, 16)  # int shape == tuple shape
+        assert self._ptr(b) == ptr     # same backing buffer recycled
+        assert pool.stats() == {"hits": 1, "misses": 1,
+                                "classes": 1, "buffers": 1}
+
+    def test_live_reference_blocks_reuse(self):
+        pool = BufferPool(slots=4)
+        a = pool.take(np.int64, (8,))
+        b = pool.take(np.int64, (8,))   # a still held -> fresh buffer
+        assert self._ptr(a) != self._ptr(b)
+        assert pool.stats()["misses"] == 2
+
+    def test_dtype_and_shape_preserved(self):
+        pool = BufferPool(slots=4)
+        a = pool.take(np.float64, (3, 5))
+        assert a.dtype == np.float64 and a.shape == (3, 5)
+        assert a.flags["C_CONTIGUOUS"]
+        del a
+        # same nbytes, different dtype: a DIFFERENT class, no aliasing
+        b = pool.take(np.int32, (5, 6))
+        c = pool.take(np.float32, (30,))
+        assert b.dtype == np.int32 and b.shape == (5, 6)
+        assert c.dtype == np.float32 and self._ptr(b) != self._ptr(c)
+
+    def test_zero_size(self):
+        pool = BufferPool(slots=4)
+        z = pool.take(np.float32, (0,))
+        assert z.size == 0 and z.dtype == np.float32
+        z2 = pool.take(np.float32, (4, 0))
+        assert z2.shape == (4, 0)
+
+    def test_slots_zero_disables_retention(self):
+        pool = BufferPool(slots=0)
+        a = pool.take(np.uint8, (32,))
+        del a
+        pool.take(np.uint8, (32,))
+        assert pool.stats()["hits"] == 0
+        assert pool.stats()["buffers"] == 0
+
+
+# -------------------------------------------------------------- shm
+@pytest.mark.skipif(not kfshm.available(), reason="no /dev/shm")
+class TestShmLane:
+    def test_publish_read_roundtrip(self):
+        blob = np.arange(70000, dtype=np.float32)  # > 64 KB floor
+        desc = kfshm.publish("t-round", blob)
+        d = kfshm.parse_descriptor(desc)
+        assert d is not None and d["nbytes"] == blob.nbytes
+        out = np.empty_like(blob)
+        before = kfshm.lane_bytes()
+        assert kfshm.read_into(desc, out)
+        assert np.array_equal(out, blob)
+        assert kfshm.lane_bytes() == before + blob.nbytes
+
+    def test_zero_size_publish(self):
+        desc = kfshm.publish("t-zero", np.empty(0, np.float32))
+        out = np.empty(0, np.float32)
+        assert kfshm.read_into(desc, out)
+
+    def test_stale_descriptor_rejected_after_republish(self):
+        """Generation pinning: a republish bumps the segment header
+        generation, so a descriptor captured before it must read False
+        (same-capacity republish REUSES the segment — without the pin a
+        stale descriptor would silently read the NEW key's bytes)."""
+        blob1 = np.full(70000, 1.0, np.float32)
+        stale = kfshm.publish("t-gen", blob1)
+        blob2 = np.full(70000, 2.0, np.float32)
+        fresh = kfshm.publish("t-gen", blob2)
+        out = np.empty_like(blob1)
+        assert not kfshm.read_into(stale, out)
+        assert kfshm.read_into(fresh, out)
+        assert np.array_equal(out, blob2)
+
+    def test_descriptor_key_scheme(self):
+        k = kfshm.descriptor_key("model/0")
+        assert kfshm.is_descriptor_key(k)
+        assert not kfshm.is_descriptor_key("model/0")
+        assert kfshm.payload_key(k) == "model/0"
+
+    def test_self_pull_descriptor(self):
+        blob = np.arange(70000, dtype=np.int32)
+        kfshm.publish("t-self", blob)
+        desc = kfshm.descriptor("t-self")
+        assert desc is not None
+        out = np.empty_like(blob)
+        assert kfshm.read_into(desc, out)
+        assert np.array_equal(out, blob)
+
+
+# ----------------------------------------------------- lane policy
+class _FakePeer:
+    """Records which lane pull_blobs/pull_chunked picked and serves
+    deterministic content: blob ``name`` filled with hash(name) % 97."""
+
+    def __init__(self, rank=0, hosts=("a", "b")):
+        self.rank = rank
+        self._hosts = hosts
+        self.calls = []
+
+    def _host_of(self, j):
+        return self._hosts[j % len(self._hosts)]
+
+    @staticmethod
+    def _fill(name, out):
+        out.view(np.uint8).reshape(-1)[:] = sum(map(ord, name)) % 97
+
+    def request(self, target, name, template, version=-1, out=None):
+        self.calls.append(("request", name))
+        self._fill(name, out)
+        return out
+
+    def request_streamed(self, target, names, outs, version=-1):
+        self.calls.append(("streamed", tuple(names)))
+        for n, o in zip(names, outs):
+            self._fill(n, o)
+        return outs
+
+
+class TestLanePolicy:
+    def test_same_host_goes_per_blob(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "a"))
+        specs = [("x", np.float32, (4,)), ("y", np.float32, (4,))]
+        outs = stream.pull_blobs(p, 1, specs)
+        assert [c[0] for c in p.calls] == ["request", "request"]
+        assert [o.shape for o in outs] == [(4,), (4,)]
+
+    def test_cross_host_multi_blob_streams(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        specs = [("x", np.float32, (4,)), ("y", np.int64, (2, 3))]
+        outs = stream.pull_blobs(p, 1, specs)
+        assert p.calls == [("streamed", ("x", "y"))]
+        assert outs[0].dtype == np.float32 and outs[0].shape == (4,)
+        assert outs[1].dtype == np.int64 and outs[1].shape == (2, 3)
+
+    def test_single_blob_never_streams(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        stream.pull_blobs(p, 1, [("x", np.float32, (4,))])
+        assert [c[0] for c in p.calls] == ["request"]
+
+    def test_pipeline_knob_off_goes_sequential(self, monkeypatch):
+        from kungfu_tpu.comm import stream
+        monkeypatch.setenv("KFT_STREAM_PIPELINE", "0")
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        stream.pull_blobs(p, 1, [("x", np.float32, (4,)),
+                                 ("y", np.float32, (4,))])
+        assert [c[0] for c in p.calls] == ["request", "request"]
+
+    def test_stub_without_host_never_streams_shm_policy(self):
+        from kungfu_tpu.comm import stream
+        assert stream.same_host(object(), 0) is False
+
+    def test_pull_chunked_non_divisible_spans(self):
+        """50000 elements over per=7000: 8 chunks, the last one 1000
+        long — spans must tile exactly, the reassembled blob must carry
+        dtype+shape, and over-reported chunk counts (a short tail that
+        rounds to zero) must be skipped, not requested."""
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        out = stream.pull_chunked(p, 1, "w", nchunks=8, per=7000,
+                                  dtype=np.float32, shape=(50000,))
+        assert out.dtype == np.float32 and out.shape == (50000,)
+        (kind, names), = p.calls
+        assert kind == "streamed" and len(names) == 8
+        # every span landed its fill value: chunk 7 covers the tail
+        want = np.empty(50000, np.float32)
+        for j in range(8):
+            _FakePeer._fill(f"w.c{j}",
+                            want[j * 7000:min((j + 1) * 7000, 50000)])
+        assert np.array_equal(out, want)
+
+    def test_pull_chunked_skips_empty_tail(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        # 10 elements, per=4 -> 3 real chunks; nchunks over-reported
+        out = stream.pull_chunked(p, 1, "w", nchunks=6, per=4,
+                                  dtype=np.int32, shape=(10,))
+        assert out.shape == (10,)
+        (kind, names), = p.calls
+        assert list(names) == ["w.c0", "w.c1", "w.c2"]
+
+    def test_pull_chunked_same_host_per_chunk(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "a"))
+        stream.pull_chunked(p, 1, "w", nchunks=2, per=5,
+                            dtype=np.float32, shape=(10,))
+        assert [c[0] for c in p.calls] == ["request", "request"]
+
+    def test_pull_chunked_2d_shape_restored(self):
+        from kungfu_tpu.comm import stream
+        p = _FakePeer(rank=0, hosts=("a", "b"))
+        out = stream.pull_chunked(p, 1, "m", nchunks=4, per=6,
+                                  dtype=np.float64, shape=(4, 6))
+        assert out.dtype == np.float64 and out.shape == (4, 6)
+
+
+# ------------------------------------------------- native end-to-end
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(target, n, *extra, timeout=120):
+    ports = _free_ports(n)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(r, peers, q) + extra)
+             for r in range(n)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(n):
+            r, val = q.get(timeout=timeout)
+            if isinstance(val, str) and val.startswith("ERROR"):
+                raise AssertionError(f"worker {r}: {val}")
+            results[r] = val
+        for p in procs:
+            p.join(timeout=30)
+            assert p.exitcode == 0
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    return results
+
+
+def _no_orphans(pids, budget_s=10.0):
+    """No kfshm-<pid>-* entry of any of ``pids`` left in /dev/shm
+    (cleanup hooks and the resource tracker are asynchronous: poll)."""
+    deadline = time.time() + budget_s
+    while True:
+        left = [e for e in os.listdir(kfshm.segment_dir())
+                if kfshm.parse_segment_pid(e) in set(pids)]
+        if not left:
+            return True
+        if time.time() > deadline:
+            return left
+        time.sleep(0.2)
+
+
+def _w_fastlane(rank, peers, q):
+    """2-proc kffast proof: shm lane bit-identical with exact lane
+    accounting, sub-floor wire fallback, streamed non-divisible
+    chunks, legacy-vs-fastlane bit-identity, missing-blob error."""
+    try:
+        from kungfu_tpu.native import NativePeer
+        from kungfu_tpu.store import shm
+        with NativePeer(rank, peers) as p:
+            rng = np.random.RandomState(11)
+            blob = rng.randn(300000).astype(np.float32)   # 1.2 MB
+            if rank == 0:
+                p.save("model", blob, version=1)
+                p.save("small", blob[:16], version=1)
+            p.barrier("pub")
+            if rank == 1:
+                # shm lane: bit-identical + exact lane byte accounting
+                out = p.request(0, "model", blob, version=1)
+                assert np.array_equal(out, blob), "shm pull mismatch"
+                assert shm.lane_bytes() == blob.nbytes, \
+                    f"lane {shm.lane_bytes()} != {blob.nbytes}"
+                # sub-floor blob rides the wire, content still exact
+                got = p.request(0, "small", blob[:16], version=1)
+                assert np.array_equal(got, blob[:16])
+                assert shm.lane_bytes() == blob.nbytes  # unchanged
+                # legacy wire pull of the SAME blob: bit-identical to
+                # the shm-lane pull
+                os.environ["KFT_SHM_LANE"] = "0"
+                legacy = p.request(0, "model", blob, version=1,
+                                   out=np.empty_like(blob))
+                os.environ["KFT_SHM_LANE"] = "1"
+                assert np.array_equal(legacy, out), \
+                    "legacy vs shm lane content diverged"
+            # streamed chunk tier with a NON-DIVISIBLE tail
+            per, total, nch = 7000, 50000, 8  # last chunk 1000
+            flat = rng.randn(total).astype(np.float64)
+            if rank == 0:
+                for j in range(nch):
+                    p.save(f"w.c{j}", flat[j * per:(j + 1) * per],
+                           version=2)
+            p.barrier("chunks")
+            if rank == 1:
+                dst = np.empty(total, np.float64)
+                names = [f"w.c{j}" for j in range(nch)]
+                spans = [dst[j * per:min((j + 1) * per, total)]
+                         for j in range(nch)]
+                p.request_streamed(0, names, spans, version=2)
+                assert np.array_equal(dst, flat), \
+                    "streamed reassembly mismatch"
+                # dtype/shape preservation through the policy layer
+                from kungfu_tpu.comm import stream
+                out2 = stream.pull_chunked(p, 0, "w", nch, per,
+                                           np.float64, (total,),
+                                           version=2)
+                assert out2.dtype == np.float64
+                assert out2.shape == (total,)
+                assert np.array_equal(out2, flat)
+                # missing blob: error propagates, connection survives
+                try:
+                    p.request_streamed(0, ["nope.c0"],
+                                       [np.empty(4, np.float64)],
+                                       version=2)
+                    raise AssertionError("missing blob did not raise")
+                except AssertionError:
+                    raise
+                except Exception:
+                    pass
+                got = p.request(0, "small", blob[:16], version=1)
+                assert np.array_equal(got, blob[:16])
+            p.barrier("done")
+            q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        import traceback
+        traceback.print_exc()
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(not kfshm.available(), reason="no /dev/shm")
+def test_native_fastlane_end_to_end():
+    results = _spawn(_w_fastlane, 2)
+    assert all(v == "ok" for v in results.values())
+
+
+def _w_publisher(rank, peers, q, ev):
+    try:
+        from kungfu_tpu.native import NativePeer
+        with NativePeer(rank, peers) as p:
+            blob = np.arange(300000, dtype=np.float32)
+            p.save("model", blob, version=1)
+            q.put((rank, "published"))
+            ev.wait(60)
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, f"ERROR {type(e).__name__}: {e}"))
+
+
+def _w_doomed_puller(rank, peers, q, plan_path):
+    # arm in-process (env arming is import-time, and the spawn child
+    # imports kungfu_tpu while unpickling this module — too early):
+    # the plan SIGKILLs this process inside the shm attach window
+    from kungfu_tpu import chaos
+    from kungfu_tpu.chaos.plan import Plan
+    from kungfu_tpu.native import NativePeer
+    chaos.arm(Plan.load(plan_path))
+    with NativePeer(rank, peers) as p:
+        blob = np.empty(300000, np.float32)
+        p.request(0, "model", blob, version=1)
+    q.put((rank, "survived"))  # must never be reached
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+@pytest.mark.skipif(not kfshm.available(), reason="no /dev/shm")
+def test_kill_during_shm_pull_leaves_no_orphans(tmp_path):
+    """The kill-during-shm-pull contract (chaos scenario of the same
+    name): SIGKILL the puller at the ``store.shm.attach`` site — the
+    publisher's live segment survives the reader's death, and once the
+    publisher exits cleanly /dev/shm holds no kfshm orphan of either
+    pid."""
+    from kungfu_tpu.chaos.plan import Plan
+    plan_path = str(tmp_path / "plan.json")
+    Plan(seed=None).add("store.shm.attach", "kill",
+                        rank=1).save(plan_path)
+
+    ports = _free_ports(2)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    ev = ctx.Event()
+    pub = ctx.Process(target=_w_publisher, args=(0, peers, q, ev))
+    pub.start()
+    try:
+        r, val = q.get(timeout=60)
+        assert (r, val) == (0, "published"), (r, val)
+        puller = ctx.Process(target=_w_doomed_puller,
+                             args=(1, peers, q, plan_path))
+        puller.start()
+        puller.join(timeout=60)
+        assert puller.exitcode == -9, \
+            f"puller exitcode {puller.exitcode} (expected SIGKILL)"
+        # the publisher's segment must SURVIVE the reader's death
+        assert any(kfshm.parse_segment_pid(e) == pub.pid
+                   for e in os.listdir(kfshm.segment_dir())), \
+            "publisher segment vanished when the reader died"
+        ev.set()
+        r, val = q.get(timeout=60)
+        assert (r, val) == (0, "ok"), (r, val)
+        pub.join(timeout=30)
+        assert pub.exitcode == 0
+        left = _no_orphans([pub.pid, puller.pid])
+        assert left is True, f"orphaned /dev/shm segments: {left}"
+    finally:
+        ev.set()
+        for p in (pub,):
+            if p.is_alive():
+                p.terminate()
+
+
+# ------------------------------------------- store pool integration
+def test_store_get_zero_size_leaf_roundtrip():
+    from kungfu_tpu.store import ModelStore
+    store = ModelStore()
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "z": np.empty((0, 7), np.float32)}
+    store.save("m", tree, version=1)
+    out = store.request("m", tree, version=1)
+    assert np.array_equal(out["w"], tree["w"])
+    assert out["z"].shape == (0, 7) and out["z"].dtype == np.float32
+
+
+def test_store_chunked_leaf_pooled_reassembly(monkeypatch):
+    """A leaf above KFT_SNAP_CHUNK_MB stores as `.cN` views and the
+    reassembly draws its destination from the pool — repeated loads
+    of the same leaf recycle one buffer."""
+    from kungfu_tpu.store import ModelStore
+    from kungfu_tpu.store.pool import default_pool, reset_default_pool
+    monkeypatch.setenv("KFT_SNAP_CHUNK_MB", "0.01")  # 10 KB chunks
+    reset_default_pool()
+    try:
+        store = ModelStore()
+        leaf = np.random.RandomState(5).randn(20000).astype(np.float32)
+        store.save("big", {"x": leaf}, version=1)
+        out1 = store.request("big", {"x": leaf}, version=1)
+        assert np.array_equal(out1["x"], leaf)
+        hits0 = default_pool().stats()["hits"]
+        del out1
+        out2 = store.request("big", {"x": leaf}, version=1)
+        assert np.array_equal(out2["x"], leaf)
+        assert default_pool().stats()["hits"] > hits0
+    finally:
+        reset_default_pool()

@@ -3,18 +3,33 @@
 
 Runs :mod:`kungfu_tpu.benchmarks.p2p` (the versioned-store
 save/request path over the native host plane) and emits the
-``p2p-phase-v1`` artifact — per-worker sync/hidden pull rates plus the
+``p2p-phase-v2`` artifact — per-worker sync/hidden pull rates, the
 kfnet per-phase breakdown (serialize / wire / deserialize GiB/s, whole
-blob and chunked ``{key}.cN`` tier).  The committed P2P_BENCH.json is
-this tool's output at ``-np 2``; regenerate with:
+blob and chunked ``{key}.cN`` tier, measured on the legacy socket
+path), and the kffast fast-lane blocks (``pull_shm`` same-host
+segment-mapped copies, ``pull_streamed`` chunk pipelining).  The
+committed P2P_BENCH.json is this tool's output at ``-np 2``;
+regenerate with:
 
     python tools/bench_p2p.py -np 2 --size-mb 1728 \\
         --compute-ms 1050 --out P2P_BENCH.json
+
+``--smoke`` (ci.sh, ``make p2p-smoke``) runs a small self-contained
+2-worker pass and asserts the kffast structure: the shm lane engaged
+(``shm_lane_bytes > 0``), the segment-mapped copy is not slower than
+the socket wire, chunk streaming did not regress against per-chunk
+RPCs, and the pooled fresh-alloc pull holds its regression pin against
+the reused-destination pull (the (dtype, nbytes) buffer pool — a
+collapse here means fresh destinations went back to fault-and-zero).
+Bit-identical content is asserted inside every worker loop.
 """
 from __future__ import annotations
 
+import json
 import os
+import subprocess
 import sys
+import tempfile
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -22,5 +37,68 @@ if _REPO not in sys.path:
 
 from kungfu_tpu.benchmarks.p2p import main  # noqa: E402
 
+
+def smoke() -> int:
+    """CPU CI check: one small 2-worker bench run, kffast asserted."""
+    from kungfu_tpu import native
+    if not native.available():
+        print("p2p smoke: SKIP (native comm library unavailable)")
+        return 0
+    td = tempfile.mkdtemp(prefix="kfp2p-smoke-")
+    out = os.path.join(td, "p2p.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.benchmarks.p2p", "-np", "2",
+         "--size-mb", "4", "--secs", "0.5", "--compute-ms", "5",
+         "--out", out],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    if r.returncode != 0:
+        print(f"p2p smoke: FAIL bench rc={r.returncode}\n"
+              f"{r.stdout}\n{r.stderr}", file=sys.stderr)
+        return 1
+    with open(out) as f:
+        doc = json.load(f)
+    ph = doc.get("phases", {})
+    checks = [
+        ("schema is p2p-phase-v2",
+         doc.get("schema") == "p2p-phase-v2"),
+        ("2 workers", doc.get("workers") == 2),
+        ("shm lane engaged (shm_lane_bytes > 0)",
+         doc.get("shm_lane_bytes", 0) > 0),
+        ("pull_shm block present with nonzero copy rate",
+         ph.get("pull_shm", {}).get("copy_gib_s", 0) > 0),
+        ("pull_streamed block present with nonzero wire rate",
+         ph.get("pull_streamed", {}).get("wire_gib_s", 0) > 0),
+        # the fast lanes must not be SLOWER than what they replace
+        # (lenient floors: a loaded 1-core CI box is noisy, but a lane
+        # that lost to its legacy path has structurally regressed)
+        ("shm copy >= legacy socket wire",
+         ph.get("pull_shm", {}).get("copy_gib_s", 0)
+         >= ph.get("pull", {}).get("wire_gib_s", 0)),
+        ("streamed wire >= 0.8x per-chunk-RPC wire",
+         ph.get("pull_streamed", {}).get("wire_gib_s", 0)
+         >= 0.8 * ph.get("pull_chunked", {}).get("wire_gib_s", 0)),
+        # the buffer-pool regression pin: pooled fresh-alloc pulls must
+        # hold near the reused-destination rate
+        ("pooled fresh-alloc >= 0.5x reused-destination sync pull",
+         doc.get("sync_pull_fresh_alloc_gib_s", 0)
+         >= 0.5 * doc.get("sync_pull_gib_s_per_worker", 0)),
+    ]
+    failed = [name for name, ok in checks if not ok]
+    if failed:
+        print("p2p smoke: FAIL\n  - " + "\n  - ".join(failed)
+              + "\n" + json.dumps(doc, indent=2), file=sys.stderr)
+        return 1
+    print(f"p2p smoke: OK (shm_lane_bytes={doc['shm_lane_bytes']}, "
+          f"shm copy {ph['pull_shm']['copy_gib_s']} GiB/s vs socket "
+          f"wire {ph['pull']['wire_gib_s']} GiB/s, streamed "
+          f"{ph['pull_streamed']['wire_gib_s']} GiB/s vs per-chunk "
+          f"{ph['pull_chunked']['wire_gib_s']} GiB/s)")
+    return 0
+
+
 if __name__ == "__main__":
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(smoke())
     sys.exit(main())

@@ -229,6 +229,25 @@ def smoke() -> int:
             with xf.phase("serialize"):
                 raw = blob.tobytes()
             xf.add(len(raw))
+        # the kffast lanes: a REAL shm publish + read_into (counts
+        # kungfu_tpu_shm_lane_bytes_total through the lane's own
+        # accounting) plus a pull_shm / pull_streamed ledger entry —
+        # the op set the docs/elastic.md "Store fast lane" promises
+        from kungfu_tpu.store import shm as _shm
+        lane_blob = np.arange(1 << 16, dtype=np.uint8)
+        desc = _shm.publish("kfnet-smoke", lane_blob)
+        lane_out = np.empty_like(lane_blob)
+        if not _shm.read_into(desc, lane_out) or not np.array_equal(
+                lane_blob, lane_out):
+            print("kfnet smoke: FAIL shm lane round trip",
+                  file=sys.stderr)
+            return 1
+        _net.record_transfer("pull_shm", nbytes=lane_out.nbytes,
+                             wall=1e-4, peer=inst_b,
+                             phases={"copy": 1e-4}, monitor=mon_a)
+        _net.record_transfer("pull_streamed", nbytes=blob.nbytes,
+                             wall=1e-3, peer=inst_b,
+                             phases={"wire": 1e-3}, monitor=mon_a)
         # control plane: heartbeat-sized traffic to a ctrl: target
         _net.account("egress", 512, peer="127.0.0.1:19999",
                      plane="control", monitor=mon_a)
@@ -255,8 +274,10 @@ def smoke() -> int:
         return 1
     for needle in ('kungfu_tpu_state_moved_bytes_total{',
                    'op="store.save"', 'op="store.load"',
+                   'op="pull_shm"', 'op="pull_streamed"',
                    'kungfu_tpu_net_phase_seconds',
                    'kungfu_tpu_state_move_gib_s',
+                   'kungfu_tpu_shm_lane_bytes_total',
                    'target="ctrl:127.0.0.1:19999"'):
         if needle not in text:
             print(f"kfnet smoke: FAIL /cluster_metrics lacks {needle!r}",
