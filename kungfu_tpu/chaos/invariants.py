@@ -149,11 +149,15 @@ def check_no_shm_orphans(pids: Sequence[int] = ()) -> List[str]:
     """No kffast shared-memory segment outlives its creator (kffast
     leak protection, store/shm.py).  Clean exits, crashes and SIGTERMs
     unlink through the registry's chained handlers; SIGKILL cannot run
-    handlers, so a segment whose creator pid is dead — or belongs to
-    this scenario's worker set — is an orphan: flagged AND unlinked
-    here (the reap mirrors :func:`check_no_orphans`'s kill: never leave
-    it behind either way).  Segments of live foreign processes are
-    someone else's concurrent run and are left alone."""
+    handlers, so a segment whose creator pid is DEAD is an orphan:
+    flagged AND unlinked here (the reap mirrors
+    :func:`check_no_orphans`'s kill: never leave it behind either way).
+    The liveness probe applies to the scenario's own ``pids`` exactly
+    like foreign ones — a scenario worker still running owns its
+    segments and unlinks them itself at exit, so reaping them out from
+    under it would silently degrade its colocated pulls to the wire.
+    ``pids`` only scopes the report: a live foreign creator is someone
+    else's concurrent run and is left alone without comment."""
     import os
     from ..store import shm as _shm
     bad = []
@@ -166,22 +170,22 @@ def check_no_shm_orphans(pids: Sequence[int] = ()) -> List[str]:
         pid = _shm.parse_segment_pid(entry)
         if pid is None:
             continue
-        if pid not in ours and pid != os.getpid():
-            try:
-                os.kill(pid, 0)
-            except (ProcessLookupError, PermissionError):
-                pass     # creator is gone: orphan
-            else:
-                continue  # live foreign creator: not ours to judge
         if pid == os.getpid():
             continue      # the runner's own live segments are not leaks
+        try:
+            os.kill(pid, 0)
+        except (ProcessLookupError, PermissionError):
+            pass          # creator is gone: orphan (ours or foreign)
+        else:
+            continue      # live creator still owns its unlink
         try:
             os.unlink(os.path.join(_shm.segment_dir(), entry))
         except OSError:
             continue      # raced another reaper: already clean
+        who = "worker" if pid in ours else "pid"
         bad.append(
-            f"/dev/shm/{entry} orphaned by pid {pid}: the creator died "
-            f"without unlinking (reaped)")
+            f"/dev/shm/{entry} orphaned by {who} {pid}: the creator "
+            f"died without unlinking (reaped)")
     return bad
 
 

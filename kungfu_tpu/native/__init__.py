@@ -627,7 +627,7 @@ class NativePeer:
             return
         from ..store import shm as _shm
         try:
-            desc = np.frombuffer(_shm.publish(name, x), np.uint8)
+            desc = np.frombuffer(_shm.publish(name, x, version), np.uint8)
             _check(self._lib.kft_save(
                 self._h, _shm.descriptor_key(name).encode(),
                 desc.ctypes.data, desc.nbytes, version), "save")
@@ -650,7 +650,10 @@ class NativePeer:
         from ..store import shm as _shm
         t0 = _time.perf_counter()
         if target == self.rank:
-            desc = _shm.descriptor(name)   # self-pull: no RPC at all
+            # self-pull: no RPC at all — but the segment only holds the
+            # LATEST publish, so descriptor() refuses any other version
+            # and the versioned wire path serves it instead
+            desc = _shm.descriptor(name, version)
             if desc is None:
                 return False
         else:

@@ -48,6 +48,7 @@ import numpy as np
 
 __all__ = [
     "DESC_BYTES", "available", "publish", "read_into", "attach_view",
+    "still_valid",
     "descriptor", "is_descriptor_key", "descriptor_key", "payload_key",
     "lane_bytes", "owned_segments", "cleanup", "segment_dir",
     "parse_segment_pid",
@@ -119,6 +120,7 @@ class _Publication:
         self.capacity = capacity
         self.hdr = np.frombuffer(shm.buf, np.int64, _HDR_I64)
         self.gen = 0
+        self.version = -1   # store version of the LATEST publish
 
     def payload(self, nbytes: int) -> np.ndarray:
         return np.frombuffer(self.shm.buf, np.uint8, nbytes, offset=_HDR)
@@ -266,6 +268,9 @@ def _ensure_hooks() -> None:
             if callable(prev_term):
                 prev_term(signum, frame)
                 return
+            if prev_term is signal.SIG_IGN:
+                return   # the process ignored SIGTERM before the hooks
+                         # armed: clean up but keep ignoring it
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
             os.kill(os.getpid(), signum)
 
@@ -278,19 +283,24 @@ def _ensure_hooks() -> None:
 
 
 # --------------------------------------------------------------- publish
-def publish(key: str, data: np.ndarray) -> bytes:
+def publish(key: str, data: np.ndarray, version: int = -1) -> bytes:
     """Land ``data``'s bytes in this process's segment for ``key`` and
     return the fixed-size descriptor to save under
-    :func:`descriptor_key`.  Same key + same size republishes in place
-    under the seqlock; a size change retires the old segment (existing
-    reader mappings stay valid — POSIX keeps the memory until the last
-    close) and mints a fresh, never-reused name: a stale descriptor
-    either fails attach (fresh process) or serves the retired segment's
-    final payload from a cached mapping — always the blob the
-    descriptor named, never silently the new one."""
+    :func:`descriptor_key`.  ``version`` is the store version the blob
+    is saved under; :func:`descriptor` pins self-pulls to it.  Same
+    key + same size republishes in place under the seqlock; a size
+    change retires the old segment (existing reader mappings stay
+    valid — POSIX keeps the memory until the last close) and mints a
+    fresh, never-reused name: a stale descriptor either fails attach
+    (fresh process) or serves the retired segment's final payload from
+    a cached mapping — always the blob the descriptor named, never
+    silently the new one."""
     flat = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
     nbytes = int(flat.nbytes)
     _ensure_hooks()
+    # the whole seqlock write sits under _lock: two concurrent saves of
+    # one key would otherwise interleave gen bumps and payload copies,
+    # letting the header settle EVEN over a torn mix of both writes
     with _lock:
         pub = _owned.get(key)
         if pub is not None and pub.capacity < nbytes:
@@ -304,16 +314,17 @@ def publish(key: str, data: np.ndarray) -> bytes:
             pub.hdr[0] = _MAGIC
             pub.hdr[1] = 0
             _owned[key] = pub
-    # seqlock write: odd while the payload is inconsistent
-    pub.gen += 1
-    pub.hdr[1] = pub.gen
-    pub.hdr[2] = nbytes
-    if nbytes:
-        np.copyto(pub.payload(nbytes), flat)
-    pub.gen += 1
-    pub.hdr[1] = pub.gen
-    desc = json.dumps({"seg": pub.shm.name, "nbytes": nbytes,
-                       "gen": pub.gen}).encode()
+        # seqlock write: odd while the payload is inconsistent
+        pub.gen += 1
+        pub.hdr[1] = pub.gen
+        pub.hdr[2] = nbytes
+        if nbytes:
+            np.copyto(pub.payload(nbytes), flat)
+        pub.gen += 1
+        pub.hdr[1] = pub.gen
+        pub.version = int(version)
+        desc = json.dumps({"seg": pub.shm.name, "nbytes": nbytes,
+                           "gen": pub.gen, "ver": pub.version}).encode()
     if len(desc) > DESC_BYTES:
         raise ValueError(f"shm descriptor overflow ({len(desc)} bytes)")
     return desc.ljust(DESC_BYTES, b"\0")
@@ -404,11 +415,18 @@ def _count_lane(nbytes: int) -> None:
 
 def attach_view(desc: bytes, dtype, shape, *, rank=None,
                 version=None) -> Optional[np.ndarray]:
-    """Map a published blob zero-copy as a READ-ONLY ndarray (the
-    kfsnap owned/view tier: hand it to ``Store.set_owned`` and
-    ``get_view``/``get_latest_view`` serve the segment with no copy).
-    None when the lane can't serve it.  The mapping stays valid for the
-    attach cache's lifetime; treat it as a transient view, not storage."""
+    """Map a published blob zero-copy as a READ-ONLY-flagged ndarray.
+    None when the lane can't serve it.
+
+    The mapping ALIASES the publisher's live segment: a later same-size
+    republish mutates these bytes in place (including transient torn
+    mid-copy state) — the writeable=False flag stops this process
+    writing, not the publisher.  So the view is a TRANSIENT read
+    window, not storage: do NOT retain it (e.g. via ``Store.set_owned``
+    for serving) — copy out with :func:`read_into` for that.  Callers
+    that hold the view across any time gap must call
+    :func:`still_valid` with the same descriptor immediately before
+    each use and fall back to the wire when it reports False."""
     d = parse_descriptor(desc)
     if d is None:
         return None
@@ -426,21 +444,44 @@ def attach_view(desc: bytes, dtype, shape, *, rank=None,
     view = np.frombuffer(shm.buf, np.uint8, nbytes,
                          offset=_HDR).view(dt).reshape(shape)
     view.flags.writeable = False
+    if int(hdr[1]) != int(d.get("gen", -1)):
+        return None          # republished while minting the view
     _count_lane(nbytes)
     return view
 
 
-def descriptor(key: str) -> Optional[bytes]:
-    """This process's live descriptor for ``key`` (None when never
-    published) — the self-pull shortcut: the publisher reads its own
-    segment without any RPC."""
+def still_valid(desc: bytes) -> bool:
+    """True while the segment still holds EXACTLY the blob ``desc``
+    names (header generation unchanged since publish).  The required
+    pre-use re-check for any retained :func:`attach_view` mapping: a
+    republish bumps the generation first, so False means the aliased
+    bytes may already be changing under the view."""
+    d = parse_descriptor(desc)
+    if d is None:
+        return False
+    try:
+        shm, hdr = _attach(str(d["seg"]), int(d["nbytes"]))
+    except (OSError, ValueError):
+        return False
+    return int(hdr[1]) == int(d.get("gen", -1))
+
+
+def descriptor(key: str, version: int = -1) -> Optional[bytes]:
+    """This process's live descriptor for ``key`` — the self-pull
+    shortcut: the publisher reads its own segment without any RPC.
+    The segment only ever holds the LATEST publish, so a ``version``
+    other than the one recorded at publish time returns None and the
+    caller takes the versioned wire path (which still serves older
+    versions from the store window); ``version=-1`` means latest."""
     with _lock:
         pub = _owned.get(key)
         if pub is None:
             return None
+        if int(version) >= 0 and pub.version != int(version):
+            return None
         desc = json.dumps({"seg": pub.shm.name,
                            "nbytes": int(pub.hdr[2]),
-                           "gen": pub.gen}).encode()
+                           "gen": pub.gen, "ver": pub.version}).encode()
     return desc.ljust(DESC_BYTES, b"\0")
 
 

@@ -136,6 +136,85 @@ class TestShmLane:
         assert kfshm.read_into(desc, out)
         assert np.array_equal(out, blob)
 
+    def test_descriptor_refuses_other_versions(self):
+        """The segment only holds the LATEST publish, so a self-pull
+        descriptor for any OTHER version must be refused (the caller
+        then takes the versioned wire path) — without the pin,
+        request(self, key, version=1) of a re-saved key silently
+        returned version 2's bytes."""
+        blob1 = np.full(70000, 1.0, np.float32)
+        blob2 = np.full(70000, 2.0, np.float32)
+        kfshm.publish("t-ver", blob1, version=1)
+        assert kfshm.descriptor("t-ver", 1) is not None
+        assert kfshm.descriptor("t-ver", 2) is None
+        kfshm.publish("t-ver", blob2, version=2)
+        assert kfshm.descriptor("t-ver", 1) is None   # superseded
+        out = np.empty_like(blob2)
+        desc = kfshm.descriptor("t-ver", 2)
+        assert desc is not None and kfshm.read_into(desc, out)
+        assert np.array_equal(out, blob2)
+        # -1 means latest, matching the native store's request default
+        assert kfshm.descriptor("t-ver", -1) is not None
+        assert kfshm.descriptor("t-ver") is not None
+
+    def test_still_valid_flips_on_republish(self):
+        """attach_view mappings alias live publisher memory; the
+        documented pre-use re-check is still_valid(desc)."""
+        blob1 = np.full(70000, 3.0, np.float32)
+        desc = kfshm.publish("t-sv", blob1)
+        view = kfshm.attach_view(desc, np.float32, (70000,))
+        assert view is not None and not view.flags.writeable
+        assert np.array_equal(view, blob1)
+        assert kfshm.still_valid(desc)
+        fresh = kfshm.publish("t-sv", np.full(70000, 4.0, np.float32))
+        assert not kfshm.still_valid(desc)   # view bytes now changed
+        assert kfshm.still_valid(fresh)
+        assert kfshm.attach_view(desc, np.float32, (70000,)) is None
+
+    def test_concurrent_publish_never_torn(self):
+        """Two threads hammering publish() on ONE key: the seqlock
+        write section runs under the module lock, so a reader that
+        gets True must see one writer's payload in full — never an
+        interleaved mix (the header would otherwise settle even over
+        a torn copy)."""
+        import threading
+        n = 50000
+        stop = threading.Event()
+
+        def writer(val):
+            blob = np.full(n, val, np.float32)
+            while not stop.is_set():
+                kfshm.publish("t-torn", blob, version=int(val))
+                time.sleep(0.0005)   # give readers a settled window
+
+        threads = [threading.Thread(target=writer, args=(v,))
+                   for v in (1.0, 2.0)]
+        for t in threads:
+            t.start()
+        out = np.empty(n, np.float32)
+        try:
+            deadline = time.time() + 30
+            while kfshm.descriptor("t-torn") is None:   # first publish
+                assert time.time() < deadline, "writers never published"
+                time.sleep(0.001)
+            for _ in range(300):
+                desc = kfshm.descriptor("t-torn")
+                if desc is None or not kfshm.read_into(desc, out):
+                    continue   # republished mid-read: correctly refused
+                vals = np.unique(out)
+                assert vals.size == 1 and vals[0] in (1.0, 2.0), \
+                    f"torn shm read: {vals[:8]}"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        # writers quiesced: the settled segment must read clean
+        desc = kfshm.descriptor("t-torn")
+        assert desc is not None and kfshm.read_into(desc, out)
+        vals = np.unique(out)
+        assert vals.size == 1 and vals[0] in (1.0, 2.0), \
+            f"torn shm read after quiesce: {vals[:8]}"
+
 
 # ----------------------------------------------------- lane policy
 class _FakePeer:
@@ -313,6 +392,22 @@ def _w_fastlane(rank, peers, q):
                 p.save("model", blob, version=1)
                 p.save("small", blob[:16], version=1)
             p.barrier("pub")
+            if rank == 0:
+                # versioned self-pull: the shm segment only holds the
+                # LATEST publish — requesting an older version of a
+                # re-saved key must fall back to the versioned wire
+                # store, never serve the newest blob's bytes
+                a = np.full(40000, 1.0, np.float32)   # > 64 KB floor
+                b = np.full(40000, 2.0, np.float32)
+                p.save("vkey", a, version=1)
+                p.save("vkey", b, version=2)
+                got = p.request(0, "vkey", a, version=1,
+                                out=np.empty_like(a))
+                assert np.array_equal(got, a), \
+                    "self-pull v1 served the v2 bytes"
+                got = p.request(0, "vkey", b, version=2,
+                                out=np.empty_like(b))
+                assert np.array_equal(got, b), "self-pull v2 mismatch"
             if rank == 1:
                 # shm lane: bit-identical + exact lane byte accounting
                 out = p.request(0, "model", blob, version=1)
@@ -454,6 +549,80 @@ def test_kill_during_shm_pull_leaves_no_orphans(tmp_path):
         for p in (pub,):
             if p.is_alive():
                 p.terminate()
+
+
+_SIG_IGN_SCRIPT = r"""
+import os, signal, sys
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+from kungfu_tpu.store import shm
+shm.publish("k", np.ones(100, np.float32))   # arms the SIGTERM hook
+os.kill(os.getpid(), signal.SIGTERM)
+# a pre-existing SIG_IGN disposition must survive hook arming: the
+# handler cleans up and returns instead of restoring SIG_DFL + re-kill
+assert not shm.owned_segments(), "cleanup did not run on SIGTERM"
+print("SURVIVED", flush=True)
+"""
+
+
+@pytest.mark.skipif(not kfshm.available(), reason="no /dev/shm")
+def test_sigterm_hook_preserves_sig_ign():
+    """A process that set SIGTERM to SIG_IGN before publishing must
+    still ignore SIGTERM afterwards (the chained handler used to
+    treat any non-callable disposition as 'restore SIG_DFL and
+    re-kill', silently making ignoring processes mortal)."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", _SIG_IGN_SCRIPT, repo],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "SURVIVED" in r.stdout
+
+
+_LIVE_WORKER_SCRIPT = r"""
+import sys
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from kungfu_tpu.store import shm
+shm.publish("w", np.ones(64, np.uint8))
+print("UP", flush=True)
+sys.stdin.readline()   # hold the segment until the parent releases us
+"""
+
+
+@pytest.mark.skipif(not kfshm.available(), reason="no /dev/shm")
+def test_shm_orphan_check_spares_live_workers(tmp_path):
+    """check_no_shm_orphans probes liveness for the scenario's OWN
+    pids too: a worker still running owns its segments (it used to be
+    reaped unconditionally, yanking live workers' lanes), while the
+    same worker SIGKILLed is an orphan — flagged and reaped."""
+    import subprocess
+
+    from kungfu_tpu.chaos.invariants import check_no_shm_orphans
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c",
+                             _LIVE_WORKER_SCRIPT, repo],
+                            stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "UP"
+        seg = [e for e in os.listdir(kfshm.segment_dir())
+               if kfshm.parse_segment_pid(e) == proc.pid]
+        assert seg, "worker published no segment"
+        assert check_no_shm_orphans([proc.pid]) == []
+        assert os.path.exists(os.path.join(kfshm.segment_dir(), seg[0])), \
+            "live worker's segment was reaped"
+        proc.kill()          # SIGKILL: no handler runs, segment leaks
+        proc.wait(timeout=30)
+        bad = check_no_shm_orphans([proc.pid])
+        assert any(str(proc.pid) in b for b in bad), bad
+        assert not os.path.exists(
+            os.path.join(kfshm.segment_dir(), seg[0])), "orphan not reaped"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
 
 
 # ------------------------------------------- store pool integration
