@@ -6,7 +6,9 @@
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
 # control-plane excepts, plus the whole-program passes incl. the
 # phase-3 dataflow family: use-after-donate, sharding-mismatch,
-# host-roundtrip-traced).  Fails on any non-baselined finding;
+# host-roundtrip-traced, and the phase-4 protocol family:
+# lock-ordering, wal-discipline, version-fence, seqlock-shape,
+# thread-lifecycle).  Fails on any non-baselined finding;
 # see docs/static-analysis.md.
 lint:
 	python -m tools.kfcheck

@@ -126,12 +126,16 @@ class DecisionLedger:
 
     def append(self, d: Decision) -> None:
         with self._lock:
+            # write-ahead: the record must be durable before it is
+            # published to the ring the /decisions endpoint serves — a
+            # crash in between would otherwise leave a served decision
+            # the journal never saw, and replay would diverge
+            self._write({"kind": "decision", **d.to_dict()})
             if len(self._ring) == self._ring.maxlen:
                 old = self._ring[0]
                 self._by_seq.pop(old.seq, None)
             self._ring.append(d)
             self._by_seq[d.seq] = d
-            self._write({"kind": "decision", **d.to_dict()})
 
     def annotate(self, seq: int, outcome: str, *, reason: str,
                  ts: Optional[float] = None) -> bool:
@@ -140,10 +144,12 @@ class DecisionLedger:
             d = self._by_seq.get(seq)
             if d is None or d.outcome is not None:
                 return False
-            d.outcome = outcome
-            d.outcome_ts = ts
+            # same write-ahead order as append(): journal the
+            # annotation, then patch the served record
             self._write({"kind": "annotation", "seq": seq,
                          "outcome": outcome, "reason": reason, "ts": ts})
+            d.outcome = outcome
+            d.outcome_ts = ts
             return True
 
     def _write(self, doc: Dict[str, object]) -> None:

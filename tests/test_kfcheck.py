@@ -1362,3 +1362,750 @@ def test_cli_program_mode_on_synthetic_tree(tmp_path):
               "--no-cache", str(tmp_path)])
     assert r.returncode == 1, r.stdout + r.stderr
     assert "knob-registry" in r.stdout
+
+
+# ================================================ protocol passes (phase 4)
+import re  # noqa: E402
+
+from tools.kfcheck.protocol import (JOURNAL_FAMILIES,  # noqa: E402
+                                    SEQLOCK_SHAPES)
+
+
+def test_protocol_registries_name_real_files():
+    """Anti-drift pin: every registry path matches a shipped file (a
+    renamed journal/seqlock file must be re-registered, not silently
+    unchecked)."""
+    tree = [p.relative_to(REPO).as_posix()
+            for p in (REPO / "kungfu_tpu").rglob("*.py")]
+    for fam in JOURNAL_FAMILIES:
+        assert any(re.search(fam["path"], p) for p in tree), fam["name"]
+    for sh in SEQLOCK_SHAPES:
+        assert any(re.search(sh["path"], p) for p in tree), sh["name"]
+
+
+# ------------------------------------------------------------ lock-ordering
+def test_lock_ordering_cycle_nested_with(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/m.py": """
+        import threading
+
+        _lock_a = threading.Lock()
+        _lock_b = threading.Lock()
+
+        def f():
+            with _lock_a:
+                with _lock_b:
+                    pass
+
+        def g():
+            with _lock_b:
+                with _lock_a:
+                    pass
+    """})
+    assert rules_fired(fs) == {"lock-ordering"}
+    assert "lock-order cycle" in fs[0].message
+    assert "_lock_a" in fs[0].message and "_lock_b" in fs[0].message
+
+
+def test_lock_ordering_consistent_order_clean(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/m.py": """
+        import threading
+
+        _lock_a = threading.Lock()
+        _lock_b = threading.Lock()
+
+        def f():
+            with _lock_a:
+                with _lock_b:
+                    pass
+
+        def g():
+            with _lock_a:
+                with _lock_b:
+                    pass
+    """})
+    assert fs == []
+
+
+def test_lock_ordering_cycle_across_files_call_through(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/__init__.py": "",
+        "kungfu_tpu/a.py": """
+            import threading
+            from . import b
+
+            _alock = threading.Lock()
+
+            def fa():
+                with _alock:
+                    b.fb()
+        """,
+        "kungfu_tpu/b.py": """
+            import threading
+            from . import a
+
+            _block = threading.Lock()
+
+            def fb():
+                with _block:
+                    pass
+
+            def fg():
+                with _block:
+                    a.fa()
+        """})
+    assert rules_fired(fs) == {"lock-ordering"}
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_lock_ordering_nonreentrant_reacquire_via_callee(tmp_path):
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.{kind}()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """
+    fs = run_program(tmp_path,
+                     {"kungfu_tpu/m.py": src.format(kind="Lock")})
+    assert rules_fired(fs) == {"lock-ordering"}
+    assert "re-acquire" in fs[0].message or "acquires it again" \
+        in fs[0].message
+    # reentrant RLock: same shape, no deadlock
+    fs = run_program(tmp_path,
+                     {"kungfu_tpu/m.py": src.format(kind="RLock")})
+    assert fs == []
+
+
+def test_lock_ordering_suppression(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/m.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    # kfcheck: disable=lock-ordering
+                    with self._lock:
+                        pass
+    """})
+    assert fs == []
+
+
+# ----------------------------------------------------------- wal-discipline
+LEDGER_SHAPE = """
+    import json
+    import os
+
+    class DecisionLedger:
+        def _write(self, doc):
+            self._fh.write(json.dumps(doc) + "\\n")
+            {flush}
+            {fsync}
+
+        def append(self, d):
+            {pre}self._write(d.to_dict())
+            self._ring.append(d)
+            self._by_seq[d.seq] = d
+"""
+
+
+def _ledger_tree(flush="self._fh.flush()",
+                 fsync="os.fsync(self._fh.fileno())", pre=""):
+    return {"kungfu_tpu/policy/ledger.py": LEDGER_SHAPE.format(
+        flush=flush, fsync=fsync, pre=pre)}
+
+
+def test_wal_triple_clean(tmp_path):
+    assert run_program(tmp_path, _ledger_tree()) == []
+
+
+def test_wal_flush_without_fsync(tmp_path):
+    fs = run_program(tmp_path, _ledger_tree(fsync="pass"))
+    assert rules_fired(fs) == {"wal-discipline"}
+    assert "never fsyncs" in fs[0].message
+
+
+def test_wal_write_without_flush(tmp_path):
+    fs = run_program(tmp_path, _ledger_tree(flush="pass", fsync="pass"))
+    assert rules_fired(fs) == {"wal-discipline"}
+    assert "without flushing" in fs[0].message
+
+
+def test_wal_fsync_wrong_fd(tmp_path):
+    fs = run_program(tmp_path, _ledger_tree(
+        fsync="os.fsync(self._other.fileno())"))
+    assert rules_fired(fs) == {"wal-discipline"}
+    assert "wrong fd" in fs[0].message
+
+
+def test_wal_side_effect_before_journal(tmp_path):
+    fs = run_program(tmp_path, _ledger_tree(
+        pre="self._ring.append(d)\n            "))
+    assert rules_fired(fs) == {"wal-discipline"}
+    assert "BEFORE the journal append" in fs[0].message
+    assert "_ring" in fs[0].message
+
+
+def test_wal_registry_drift_is_a_finding(tmp_path):
+    # a journal-family file whose declared writer vanished (renamed)
+    # must go red, not silently unchecked
+    fs = run_program(tmp_path, {"kungfu_tpu/policy/ledger.py": """
+        import json
+
+        class DecisionLedger:
+            def _write_renamed(self, doc):
+                self._fh.write(json.dumps(doc) + "\\n")
+    """})
+    assert rules_fired(fs) == {"wal-discipline"}
+    assert "registry" in fs[0].message and "stale" in fs[0].message
+
+
+def test_wal_suppression(tmp_path):
+    tree = _ledger_tree(fsync="pass")
+    src = tree["kungfu_tpu/policy/ledger.py"]
+    src = src.replace(
+        "            self._fh.flush()",
+        "            # kfcheck: disable=wal-discipline\n"
+        "            self._fh.flush()")
+    assert run_program(
+        tmp_path, {"kungfu_tpu/policy/ledger.py": src}) == []
+
+
+# ------------------------------------------------------------ version-fence
+def test_version_fence_unfenced_put_config(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": """
+        def seed(url, cluster):
+            put_config(url, cluster)
+    """})
+    assert rules_fired(fs) == {"version-fence"}
+    assert "if_version" in fs[0].message
+
+
+def test_version_fence_fenced_put_config_clean(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": """
+        def resize(url, cluster, version):
+            put_config(url, cluster, if_version=version)
+    """})
+    assert fs == []
+
+
+def test_version_fence_out_of_scope_clean(tmp_path):
+    # chaos/sim tiers deliberately drive unfenced writes to exercise
+    # the server's CAS rejection
+    fs = run_program(tmp_path, {"kungfu_tpu/chaos/m.py": """
+        def stir(url, cluster):
+            put_config(url, cluster)
+    """})
+    assert fs == []
+
+
+def test_version_fence_put_builder_without_if_match(tmp_path):
+    src = """
+        def put_thing(url, body{sig}):
+            {hdr}return rpc_call(url, method="PUT", body=body{use})
+    """
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": src.format(
+        sig="", hdr="", use="")})
+    assert rules_fired(fs) == {"version-fence"}
+    assert "If-Match" in fs[0].message
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": src.format(
+        sig=", version",
+        hdr='headers = {"If-Match": str(version)}\n            ',
+        use=", headers=headers")})
+    assert fs == []
+
+
+def test_version_fence_versioned_store_save(tmp_path):
+    src = """
+        def push(p, name, b, seq):
+            p.save(f"kftsh:{{name}}", b{fence})
+    """
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": src.format(
+        fence="")})
+    assert rules_fired(fs) == {"version-fence"}
+    assert "version=" in fs[0].message
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": src.format(
+        fence=", version=seq")})
+    assert fs == []
+
+
+def test_version_fence_suppression(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/elastic/m.py": """
+        def seed(url, cluster):
+            # kfcheck: disable=version-fence
+            put_config(url, cluster)
+    """})
+    assert fs == []
+
+
+# ------------------------------------------------------------ seqlock-shape
+SEQ_WRITER = """
+    import threading
+    import numpy as np
+
+    _lock = threading.RLock()
+
+    def publish(seg, payload, nbytes):
+        hdr = seg.hdr
+        {body}
+"""
+
+SEQ_WRITER_OK = """with _lock:
+            seg.gen += 1
+            hdr[1] = seg.gen
+            hdr[2] = nbytes
+            np.copyto(seg.payload, payload)
+            seg.gen += 1
+            hdr[1] = seg.gen"""
+
+
+def test_seqlock_writer_clean(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_WRITER.format(body=SEQ_WRITER_OK)})
+    assert fs == []
+
+
+def test_seqlock_writer_single_bump(tmp_path):
+    body = """with _lock:
+            seg.gen += 1
+            hdr[1] = seg.gen
+            np.copyto(seg.payload, payload)"""
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_WRITER.format(body=body)})
+    assert rules_fired(fs) == {"seqlock-shape"}
+    assert "bump" in fs[0].message
+
+
+def test_seqlock_writer_not_under_lock(tmp_path):
+    body = """seg.gen += 1
+        np.copyto(seg.payload, payload)
+        seg.gen += 1"""
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_WRITER.format(body=body)})
+    assert rules_fired(fs) == {"seqlock-shape"}
+    assert "not entirely under one lock" in fs[0].message
+
+
+SEQ_READER = """
+    import numpy as np
+
+    def read_into(seg, dst, want_gen, retries=2):
+        hdr = seg.hdr
+        src = seg.payload
+        {loop}
+            g0 = int(hdr[1])
+            if g0 != want_gen:
+                return False
+            np.copyto(dst, src)
+            {recheck}
+        return False
+"""
+
+
+def test_seqlock_reader_clean(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_READER.format(
+            loop="for _ in range(max(1, retries)):",
+            recheck="if int(hdr[1]) == g0:\n                return True")})
+    assert fs == []
+
+
+def test_seqlock_reader_unbounded_retry(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_READER.format(
+            loop="while True:",
+            recheck="if int(hdr[1]) == g0:\n                return True")})
+    assert rules_fired(fs) == {"seqlock-shape"}
+    assert "while" in fs[0].message and "bound" in fs[0].message.lower()
+
+
+def test_seqlock_reader_no_recheck_after_copy(tmp_path):
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/store/shm.py": SEQ_READER.format(
+            loop="for _ in range(max(1, retries)):",
+            recheck="return True")})
+    assert rules_fired(fs) == {"seqlock-shape"}
+    assert "re-check" in fs[0].message or "pinning" in fs[0].message
+
+
+def test_seqlock_real_shm_is_shape_clean(tmp_path):
+    src = (REPO / "kungfu_tpu" / "store" / "shm.py").read_text()
+    fp = tmp_path / "kungfu_tpu" / "store" / "shm.py"
+    fp.parent.mkdir(parents=True)
+    fp.write_text(src)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    fs = [f for f in run_passes(facts)
+          if f.rule in ("seqlock-shape", "lock-ordering")]
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_seqlock_suppression(tmp_path):
+    body = """with _lock:
+            # kfcheck: disable=seqlock-shape
+            seg.gen += 1
+            np.copyto(seg.payload, payload)"""
+    src = SEQ_WRITER.format(body=body)
+    # the single-bump finding anchors at the writer def line
+    src = src.replace("    def publish(",
+                      "    # kfcheck: disable=seqlock-shape\n"
+                      "    def publish(")
+    fs = run_program(tmp_path, {"kungfu_tpu/store/shm.py": src})
+    assert fs == []
+
+
+# --------------------------------------------------------- thread-lifecycle
+def test_thread_lifecycle_daemon_loop_without_stop(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._results = {}
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                while True:
+                    self._results["k"] = object()
+    """})
+    assert rules_fired(fs) == {"thread-lifecycle"}
+    assert "stop" in fs[0].message and "_results" in fs[0].message
+
+
+def test_thread_lifecycle_stop_event_loop_clean(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._results = {}
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                while not self._stop.wait(0.5):
+                    self._results["k"] = object()
+    """})
+    assert [f for f in fs if f.rule == "thread-lifecycle"] == []
+
+
+def test_thread_lifecycle_start_before_attrs(tmp_path):
+    src = """
+        import threading
+
+        class W:
+            def __init__(self, q):
+                {a}self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+                {b}
+            def _run(self):
+                return self._q
+    """
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": src.format(
+        a="", b="self._q = q\n")})
+    assert rules_fired(fs) == {"thread-lifecycle"}
+    assert "before assigning" in fs[0].message and "_q" in fs[0].message
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": src.format(
+        a="self._q = q\n                ", b="")})
+    assert fs == []
+
+
+def test_thread_lifecycle_unbounded_join_on_stop_path(tmp_path):
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                self._stop.set()
+                self._thread.join({timeout})
+
+            def wait_done(self):
+                self._thread.join()
+    """
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": src.format(timeout="")})
+    assert rules_fired(fs) == {"thread-lifecycle"}
+    assert "stop" in fs[0].message and "deadline" in fs[0].message
+    # bounded join on the stop path: clean (wait_done is not a stop
+    # path, so its unbounded join is a deliberate blocking wait)
+    fs = run_program(tmp_path, {
+        "kungfu_tpu/w.py": src.format(timeout="timeout=5.0")})
+    assert fs == []
+
+
+def test_thread_lifecycle_ignores_non_thread_handles(tmp_path):
+    # launcher/watch.py regression: worker-process handles and futures
+    # have start()/join() too — not this pass's business
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        class Watcher:
+            def _spawn(self, peer):
+                proc = self.job.new_proc(peer)
+                proc.start()
+                self.current[peer] = proc
+
+            def fetch(self, pend):
+                host = pend.join()
+                return host
+    """})
+    assert fs == []
+
+
+def test_thread_lifecycle_suppression(tmp_path):
+    fs = run_program(tmp_path, {"kungfu_tpu/w.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                # kfcheck: disable=thread-lifecycle
+                self._thread.join()
+    """})
+    assert fs == []
+
+
+# -------------------------------------- real-source acceptance gates (ph 4)
+def _analyze_mutated(tmp_path, files):
+    for rel, text in files.items():
+        fp = tmp_path / rel
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(text)
+    _, facts, errors = analyze([tmp_path], [], [], tmp_path,
+                               use_cache=False)
+    assert not errors, errors
+    return run_passes(facts)
+
+
+def test_wal_real_ledger_fsync_removal_fails_ci(tmp_path):
+    """Acceptance gate (a): remove the os.fsync from the REAL policy
+    ledger and the checker (CI step 0) goes red."""
+    src = (REPO / "kungfu_tpu" / "policy" / "ledger.py").read_text()
+    marker = "            os.fsync(self._fh.fileno())\n"
+    assert marker in src, "fixture went stale"
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/policy/ledger.py": src.replace(marker, "", 1)})
+    hits = [f for f in fs if f.rule == "wal-discipline"
+            and "DecisionLedger._write" in f.message]
+    assert hits, [f.render() for f in fs]
+    r = _cli(["--program", "--no-baseline", "--no-cache",
+              "--root", str(tmp_path), str(tmp_path)])
+    assert r.returncode == 1 and "wal-discipline" in r.stdout, \
+        r.stdout + r.stderr
+
+
+def test_lock_ordering_real_monitor_inversion_fails_ci(tmp_path):
+    """Acceptance gate (b): nest the REAL profiler's two module locks in
+    opposite orders on two paths and the checker goes red with a cycle."""
+    src = (REPO / "kungfu_tpu" / "monitor" / "profiler.py").read_text()
+    m1 = ("    with _state_lock:\n"
+          "        flops, hbm = _last_cost\n")
+    m2 = ("    with _capture_seq_lock:\n"
+          "        _capture_seq += 1\n"
+          "        seq = _capture_seq\n")
+    assert m1 in src and m2 in src, "fixture went stale"
+    mutated = src.replace(m1, (
+        "    with _state_lock:\n"
+        "        with _capture_seq_lock:\n"
+        "            flops, hbm = _last_cost\n"), 1)
+    mutated = mutated.replace(m2, (
+        "    with _capture_seq_lock:\n"
+        "        with _state_lock:\n"
+        "            _capture_seq += 1\n"
+        "            seq = _capture_seq\n"), 1)
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/monitor/profiler.py": mutated})
+    hits = [f for f in fs if f.rule == "lock-ordering"
+            and "cycle" in f.message]
+    assert hits, [f.render() for f in fs]
+    assert any("_state_lock" in f.message and "_capture_seq_lock"
+               in f.message for f in hits)
+    r = _cli(["--program", "--no-baseline", "--no-cache",
+              "--root", str(tmp_path), str(tmp_path)])
+    assert r.returncode == 1 and "lock-ordering" in r.stdout, \
+        r.stdout + r.stderr
+
+
+def test_version_fence_real_dropped_if_match_fails_ci(tmp_path):
+    """Acceptance gate (c): drop the If-Match header from the REAL
+    config-server CAS builder and the checker goes red."""
+    src = (REPO / "kungfu_tpu" / "elastic" / "config_server.py").read_text()
+    marker = ("    if if_version is not None:\n"
+              "        headers[\"If-Match\"] = str(if_version)\n")
+    assert marker in src, "fixture went stale"
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/elastic/config_server.py": src.replace(marker, "", 1)})
+    hits = [f for f in fs if f.rule == "version-fence"
+            and "If-Match" in f.message]
+    assert hits, [f.render() for f in fs]
+    assert any("put_config" in f.message for f in hits)
+    r = _cli(["--program", "--no-baseline", "--no-cache",
+              "--root", str(tmp_path), str(tmp_path)])
+    assert r.returncode == 1 and "version-fence" in r.stdout, \
+        r.stdout + r.stderr
+
+
+# ------------------------------------------- burned-down-fix regressions
+def test_ledger_append_journals_before_publish(tmp_path):
+    """Regression for the wal-discipline fix: the decision must be
+    durable BEFORE it appears in the ring the /decisions endpoint
+    serves."""
+    from kungfu_tpu.policy.ledger import Decision, DecisionLedger
+    led = DecisionLedger(ring=4, path=str(tmp_path / "led.jsonl"))
+    order = []
+    orig = led._write
+
+    def spy(doc):
+        order.append((doc["kind"], len(led._ring)))
+        orig(doc)
+
+    led._write = spy  # type: ignore[method-assign]
+    led.append(Decision(seq=0, tick=1, ts=1.0, rule="r",
+                        verdict="would-act", action="exclude"))
+    assert order == [("decision", 0)]  # journaled while ring still empty
+
+
+def test_ledger_annotate_journals_before_patch(tmp_path):
+    from kungfu_tpu.policy.ledger import Decision, DecisionLedger
+    led = DecisionLedger(ring=4, path=str(tmp_path / "led.jsonl"))
+    d = Decision(seq=0, tick=1, ts=1.0, rule="r",
+                 verdict="would-act", action="exclude")
+    led.append(d)
+    at_write = []
+    orig = led._write
+
+    def spy(doc):
+        if doc["kind"] == "annotation":
+            at_write.append(d.outcome)
+        orig(doc)
+
+    led._write = spy  # type: ignore[method-assign]
+    assert led.annotate(0, "vindicated", reason="died")
+    assert at_write == [None]  # journaled before the ring copy mutated
+    assert d.outcome == "vindicated"
+
+
+# --------------------------------------------------- phase-4 cache behavior
+def test_facts_schema_bump_invalidates_cache(tmp_path, monkeypatch):
+    import tools.kfcheck.facts as fmod
+    fp = tmp_path / "m.py"
+    fp.write_text("X = 1\n")
+    cp = tmp_path / ".cache.json"
+    c = fmod.FactCache(cp)
+    c.put("m.py", fp.stat(), {"fake": 1})
+    c.save()
+    assert fmod.FactCache(cp).get("m.py", fp.stat()) is not None
+    monkeypatch.setattr(fmod, "FACTS_SCHEMA", fmod.FACTS_SCHEMA + 1)
+    assert fmod.FactCache(cp).files == {}
+
+
+def test_analyze_serves_primary_facts_from_cache(tmp_path):
+    """The warm-run budget holds because PRIMARY files' fact collection
+    (the dataflow + protocol walks) is served from the cache too — the
+    rules re-parse, the collectors don't rerun."""
+    pr = tmp_path / "kungfu_tpu" / "m.py"
+    pr.parent.mkdir(parents=True)
+    pr.write_text("X = 'KFT_CACHED_KNOB'\n")
+    cp = tmp_path / ".cache.json"
+    kw = dict(use_cache=True, cache_path=cp)
+    analyze([tmp_path / "kungfu_tpu"], [], [], tmp_path, **kw)
+    data = json.loads(cp.read_text())
+    entry = data["files"]["kungfu_tpu/m.py"]
+    entry["facts"]["knob_literals"][0]["name"] = "KFT_FROM_CACHE"
+    cp.write_text(json.dumps(data))
+    _, facts, _ = analyze([tmp_path / "kungfu_tpu"], [], [], tmp_path,
+                          **kw)
+    assert facts["kungfu_tpu/m.py"]["knob_literals"][0]["name"] == \
+        "KFT_FROM_CACHE"
+
+
+def test_phase4_passes_run_from_warm_cache(tmp_path):
+    """--fast's contract: phase 4 consumes facts["protocol"] straight
+    from the warm cache (poisoned cache => poisoned finding)."""
+    src = tmp_path / "kungfu_tpu" / "elastic" / "x.py"
+    src.parent.mkdir(parents=True)
+    src.write_text("def seed(url, c):\n    pass\n")
+    cp = tmp_path / ".cache.json"
+    kw = dict(use_cache=True, cache_path=cp)
+    analyze([], [tmp_path / "kungfu_tpu"], [], tmp_path, **kw)
+    data = json.loads(cp.read_text())
+    entry = data["files"]["kungfu_tpu/elastic/x.py"]
+    entry["facts"]["protocol"]["fence"]["mutators"].append(
+        {"line": 2, "symbol": "seed", "snippet": "put_config(url, c)",
+         "name": "put_config", "npos": 2, "kwargs": []})
+    cp.write_text(json.dumps(data))
+    _, facts, _ = analyze([], [tmp_path / "kungfu_tpu"], [], tmp_path,
+                          **kw)
+    fs = run_passes(facts)
+    assert any(f.rule == "version-fence" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_warm_repo_run_stays_fast():
+    """Warm-cache repo-wide run stays under the ~2.5s budget the --fast
+    CI lane is sized for (first run warms, second is measured)."""
+    import time
+    _cli([])  # warm
+    t0 = time.monotonic()
+    r = _cli([])
+    dt = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert dt < 2.5, f"warm kfcheck run took {dt:.2f}s"
+
+
+# --------------------------------------------------- phase-4 CLI plumbing
+def test_silent_except_scope_covers_protocol():
+    from tools.kfcheck.rules import SilentExcept
+    assert re.search(SilentExcept.path_filter,
+                     "tools/kfcheck/protocol.py")
+
+
+def test_cli_pass_filter_focused_gate(tmp_path):
+    (tmp_path / "kungfu_tpu" / "elastic").mkdir(parents=True)
+    (tmp_path / "kungfu_tpu" / "elastic" / "x.py").write_text(
+        "def seed(url, cluster):\n    put_config(url, cluster)\n")
+    base = ["--no-baseline", "--no-cache", "--root", str(tmp_path),
+            str(tmp_path)]
+    r = _cli(["--pass", "version-fence", *base])
+    assert r.returncode == 1 and "version-fence" in r.stdout, \
+        r.stdout + r.stderr
+    # the filter really filters: a different pass sees nothing here
+    r = _cli(["--pass", "knob-registry", *base])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_pass_unknown_name():
+    r = _cli(["--pass", "no-such-pass"])
+    assert r.returncode == 2
+    assert "unknown pass" in r.stderr
+
+
+def test_cli_pass_version_fence_repo_green():
+    # the exact focused invocation ci.sh step 0h runs
+    r = _cli(["--program", "--pass", "version-fence"])
+    assert r.returncode == 0, r.stdout + r.stderr

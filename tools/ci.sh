@@ -42,9 +42,11 @@ say() { printf '\n==== %s ====\n' "$*"; }
 
 say "0/3 kfcheck static analysis"
 # --fast scopes the per-file rules to git-changed files; the
-# whole-program passes (lock/knob/metrics/chaos + the phase-3 dataflow
-# family: use-after-donate, sharding-mismatch, host-roundtrip-traced)
-# always cover the full tree via the fact cache
+# whole-program passes (lock/knob/metrics/chaos, the phase-3 dataflow
+# family: use-after-donate, sharding-mismatch, host-roundtrip-traced,
+# and the phase-4 protocol family: lock-ordering, wal-discipline,
+# version-fence, seqlock-shape, thread-lifecycle) always cover the
+# full tree via the fact cache
 if [ "$FAST" = 1 ]; then
   python -m tools.kfcheck --fast || exit 1
 else
@@ -113,6 +115,11 @@ python tools/kfnet_report.py --smoke || exit 1
 # never self-skip (~10 s; docs/policy.md)
 say "0h/3 kfpolicy shadow-decision smoke"
 python tools/kfpolicy.py --smoke || exit 1
+# the shadow->act contract (docs/policy.md) requires every
+# control-plane write to be version-fenced; run the focused pass here,
+# next to the policy smoke, so a fencing regression is named at the
+# step that owns the contract (warm fact cache: ~0.3 s)
+python -m tools.kfcheck --program --pass version-fence || exit 1
 
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1

@@ -88,6 +88,11 @@ def main(argv=None) -> int:
                         "whole-program passes (dataflow included) still "
                         "cover the full tree, served from the warm fact "
                         "cache")
+    p.add_argument("--pass", action="append", dest="only_passes",
+                   metavar="NAME",
+                   help="run only the named whole-program pass(es) "
+                        "(repeatable; e.g. --pass version-fence for the "
+                        "focused CI gate) — per-file rules are skipped")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the OK summary line")
@@ -119,15 +124,28 @@ def main(argv=None) -> int:
         context = [Path("tools"), Path("tests")]
         run_program = True
 
+    passes = None
+    rules = ALL_RULES
+    if args.only_passes:
+        known = {ps.name: ps for ps in ALL_PASSES}
+        bad = [n for n in args.only_passes if n not in known]
+        if bad:
+            print(f"kfcheck: unknown pass(es): {', '.join(bad)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        passes = [known[n] for n in args.only_passes]
+        rules = []   # focused gate: facts still collected, rules skipped
+        run_program = True
+
     if run_program:
         findings, facts, errors = analyze(
-            primary, context, ALL_RULES, root,
+            primary, context, rules, root,
             use_cache=not args.no_cache)
         facts.update(scan_native(root))
-        findings = findings + run_passes(facts)
+        findings = findings + run_passes(facts, passes=passes)
         findings.sort(key=lambda f: (f.path, f.line, f.rule))
     else:
-        findings, errors = check_paths(primary, ALL_RULES, root)
+        findings, errors = check_paths(primary, rules, root)
     for e in errors:
         print(f"kfcheck: ERROR {e}", file=sys.stderr)
 
@@ -148,10 +166,11 @@ def main(argv=None) -> int:
             print(f"kfcheck: bad baseline: {e}", file=sys.stderr)
             return 2
         new, old_findings, stale = bl.split(findings)
-        if args.fast:
-            # unchanged files were never rule-checked, so their
-            # baselined findings are absent — not fixed; only the full
-            # run may call a baseline entry stale
+        if args.fast or args.only_passes:
+            # unchanged files were never rule-checked (--fast), or only
+            # a subset of passes ran (--pass), so absent baselined
+            # findings are not fixed; only the full run may call a
+            # baseline entry stale
             stale = []
 
     if args.as_json:

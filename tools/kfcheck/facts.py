@@ -34,10 +34,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .dataflow import collect_dataflow
 from .engine import Module, Rule, iter_py_files
+from .protocol import collect_protocol
 from .rules import call_name, dotted, tail
 
 # bump to invalidate every cached fact when the extraction shape changes
-FACTS_SCHEMA = 2
+FACTS_SCHEMA = 3
 
 DEFAULT_CACHE = Path(__file__).resolve().parent / ".cache.json"
 
@@ -259,6 +260,7 @@ def collect_facts(mod: Module) -> dict:
         "metric_names": [], "chaos_points": [], "chaos_site_defs": [],
         "chaos_site_refs": [], "classes": [],
         "dataflow": collect_dataflow(mod),
+        "protocol": collect_protocol(mod),
         "suppressed": {str(k): sorted(v)
                        for k, v in mod.suppressed.items()},
     }
@@ -395,17 +397,20 @@ def scan_native(root: Path) -> Dict[str, dict]:
                         "knob_defs": [], "metric_names": [],
                         "chaos_points": [], "chaos_site_defs": [],
                         "chaos_site_refs": [], "classes": [],
-                        "dataflow": {}, "suppressed": {}}
+                        "dataflow": {}, "protocol": {},
+                        "suppressed": {}}
     return out
 
 
 # ------------------------------------------------------------------ cache
 def _tool_hash() -> str:
-    # the dataflow collector feeds facts["dataflow"], so its source is
-    # part of the cache key too — stale facts must not mask a finding
+    # the dataflow/protocol collectors feed facts["dataflow"] and
+    # facts["protocol"], so their sources are part of the cache key too
+    # (editing a protocol registry must invalidate stale facts)
     h = hashlib.md5(str(FACTS_SCHEMA).encode())
     h.update(Path(__file__).read_bytes())
     h.update((Path(__file__).parent / "dataflow.py").read_bytes())
+    h.update((Path(__file__).parent / "protocol.py").read_bytes())
     return h.hexdigest()
 
 
@@ -472,12 +477,12 @@ def analyze(primary: Sequence[Path], context: Sequence[Path],
             except OSError as e:
                 errors.append(f"{rel}: unreadable: {e}")
                 continue
-            if not run_rules:
-                cached = cache.get(rel, st) if cache else None
-                if cached is not None:
-                    if not excluded:
-                        facts_by_path[rel] = cached
-                    continue
+            cached = cache.get(rel, st) if cache else None
+            if cached is not None and not run_rules:
+                # context file, facts warm: no parse needed at all
+                if not excluded:
+                    facts_by_path[rel] = cached
+                continue
             try:
                 mod = Module(rel, fp.read_text())
             except (SyntaxError, UnicodeDecodeError, OSError) as e:
@@ -490,8 +495,11 @@ def analyze(primary: Sequence[Path], context: Sequence[Path],
                     for f in rule.check(mod):
                         if not mod.is_suppressed(f.rule, f.line):
                             findings.append(f)
-            fx = collect_facts(mod)
-            if cache:
+            # primary files are parsed for the rules every run, but the
+            # fact collectors (dataflow + protocol walks) are the
+            # expensive half — serve those from the warm cache too
+            fx = cached if cached is not None else collect_facts(mod)
+            if cache and cached is None:
                 cache.put(rel, st, fx)
             if not excluded:
                 facts_by_path[rel] = fx

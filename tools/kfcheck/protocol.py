@@ -1,0 +1,1187 @@
+"""kfcheck phase 4: concurrency & durability protocol passes.
+
+The phase-1 rules see single files; phase 2 joins names; phase 3 traces
+the jit hot path.  None of them can prove the *protocols* the elastic
+control plane is built on — the disciplines ROADMAP item 2's actuation
+executor must land under: lock acquisition ORDER across modules, the
+write/flush/fsync WAL triple ahead of every guarded side effect, the
+membership-version fence on every control-plane mutation, the shm
+seqlock's bump/payload/bump shape, and the stop-signal/bounded-join
+thread lifecycle.  This module adds exactly that — a per-file fact
+collector (:func:`collect_protocol`, cached with everything else in
+``.cache.json``; ``_tool_hash`` covers this file, so editing a registry
+invalidates stale facts) plus five whole-program passes:
+
+  lock-ordering     global lock-order graph from every acquisition with
+                    its held-set (lexical ``with`` nesting +
+                    acquire()/release() + one level of call-through into
+                    same-repo callees); any cycle is a deadlock finding,
+                    and a non-reentrant Lock re-acquired on a path where
+                    it may already be held is flagged
+  wal-discipline    per journal family (:data:`JOURNAL_FAMILIES`): the
+                    write/flush/os.fsync triple on ONE fd inside the
+                    writer, and the journal append ahead of the guarded
+                    side effect in every function that does both
+  version-fence     registered control-plane mutations
+                    (:data:`FENCED_MUTATORS`) must thread a
+                    membership/epoch version (If-Match header, fence
+                    kwarg, versioned store key) on every call path in
+                    elastic/policy/launcher scope
+  seqlock-shape     declared generation protocols
+                    (:data:`SEQLOCK_SHAPES`): writer = bump → payload →
+                    bump under one lock; reader = gen pinned before AND
+                    after the copy, retries bounded, mismatch = fallback
+  thread-lifecycle  daemon loops mutating shared state must check a
+                    stop signal; ``start()`` must come after every
+                    shared attr is assigned; joins on stop paths must
+                    carry a deadline (the HeartbeatSender wedge fix,
+                    enforced everywhere)
+
+Heuristic honesty (same contract as facts.py/dataflow.py): extraction
+is AST-shaped.  Locks canonicalize to ``Class.attr`` (self attrs, or
+through a parameter's class annotation) and ``module.path:name``
+(module-level locks, resolved through each file's import map); an
+acquisition through an arbitrary object expression is *dropped*, not
+guessed — fewer edges, no phantom cycles.  The registries below are
+plain data so the actuation executor registers its ledger, its fence
+and its journal family the same way the existing planes do.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, Module
+from .rules import call_name, dotted, tail
+
+# bump (with FACTS_SCHEMA) when the record shape or registries change
+# in a way cached facts must not survive
+PROTOCOL_SCHEMA = 1
+
+# protocol findings apply to runtime sources; tests/tools spin up
+# threads and journals in ways that are fixture plumbing, not protocol
+SCOPE = "kungfu_tpu/"
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_LOCK_KINDS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+_LOCKISH = re.compile(r"lock|cond|mutex|guard", re.IGNORECASE)
+
+# method names that are a stop/teardown path: an unbounded join here
+# wedges the caller on a wedged thread
+STOP_PATH = re.compile(r"stop|close|shutdown|teardown|finalize|__exit__|"
+                       r"atexit|reap", re.IGNORECASE)
+
+# attr names that read as a stop/liveness signal inside a thread loop
+STOP_SIGNAL = re.compile(r"stop|shutdown|done|exit|running|alive|quit|"
+                         r"closed|halt", re.IGNORECASE)
+
+
+def _lockish(name: str) -> bool:
+    return bool(_LOCKISH.search(name)) or name.strip("_") == "cv"
+
+
+# ------------------------------------------------------------- registries
+#
+# JOURNAL_FAMILIES: each entry declares one write-ahead journal — the
+# function that owns the write/flush/fsync triple ("writers"), the call
+# tokens that append to it ("journal_calls"), and the guarded side
+# effects that must never precede the append ("actions").  Action specs:
+#   "mut:<attr>"   a mutation of self.<attr> (assign/augassign/
+#                  subscript store/in-place mutator call)
+#   "tail:<name>"  any call whose final attribute is <name>
+#   "call:<token>" a call whose dotted form equals <token>
+# ROADMAP item 2's actuation executor registers its decision ledger
+# here (writer = the fn owning the fsync'd append; actions = the CAS /
+# exclusion calls) and inherits the gate with zero new analysis code.
+JOURNAL_FAMILIES: Tuple[dict, ...] = (
+    {
+        # kfguard: the config server's fsync'd WAL of (epoch, version,
+        # cluster) transitions — append BEFORE the in-memory state
+        # mutates or the client is acked (docs/elastic.md)
+        "name": "config-server-wal",
+        "path": r"(^|/)elastic/config_server\.py$",
+        "writers": ("_WAL.append",),
+        "journal_calls": ("self.wal.append",),
+        "actions": ("mut:version", "mut:cluster", "mut:history"),
+    },
+    {
+        # chaos fault journal: a kill action must still leave a record,
+        # so the journal line lands before fault.execute (docs/chaos.md)
+        "name": "chaos-journal",
+        "path": r"(^|/)chaos/__init__\.py$",
+        "writers": ("ArmedPlan._record",),
+        "journal_calls": ("self._record",),
+        "actions": ("tail:execute",),
+    },
+    {
+        # kfpolicy decision ledger: the shadow proposal is durable
+        # before it is published to the in-memory ring the /decisions
+        # endpoint serves (docs/policy.md)
+        "name": "policy-ledger",
+        "path": r"(^|/)policy/ledger\.py$",
+        "writers": ("DecisionLedger._write",),
+        "journal_calls": ("self._write",),
+        "actions": ("mut:_ring", "mut:_by_seq"),
+    },
+    {
+        # serving request journal: post-hoc observability records (no
+        # guarded side effect, hence no actions); the triple check
+        # still applies to its writers — deliberate durability trades
+        # are baselined, not invisible
+        "name": "request-journal",
+        "path": r"(^|/)serving/slo\.py$",
+        "writers": ("RequestJournal._write_anchor",
+                    "RequestJournal._sink_write"),
+        "journal_calls": (),
+        "actions": (),
+    },
+)
+
+# SEQLOCK_SHAPES: generation-counter protocols.  "gen" is the counter
+# attr the writer bumps, "hdr" the mapped header array readers pin the
+# generation from (at "gen_index"), "copy_tails" the payload-copy calls.
+# ROADMAP item 4's relay fan-out tiers add their shape here.
+SEQLOCK_SHAPES: Tuple[dict, ...] = (
+    {
+        "name": "shm-lane",
+        "path": r"(^|/)store/shm\.py$",
+        "writers": ("publish",),
+        "readers": ("read_into", "attach_view"),
+        "gen": "gen",
+        "hdr": "hdr",
+        "gen_index": 1,
+        "copy_tails": ("copyto",),
+    },
+)
+
+# FENCED_MUTATORS: control-plane mutations that must carry a
+# membership/epoch fence.  kind "call": a named mutator that takes the
+# fence as kwarg/positional.  kind "store_save": versioned-key model
+# store saves (key prefix convention "kft…") that must thread version=.
+# The PUT-builder check below is registry-free: any function in fence
+# scope that builds a literal method="PUT" request must set If-Match.
+FENCED_MUTATORS: Tuple[dict, ...] = (
+    {
+        "name": "put_config",
+        "kind": "call",
+        "tails": ("put_config",),
+        "fence_kwargs": ("if_version",),
+        "fence_pos": 3,   # put_config(url, cluster, timeout, if_version)
+        "hint": ("CAS it: fetch (version, cluster) first and pass "
+                 "if_version=version so a concurrent membership change "
+                 "409s instead of being silently overwritten"),
+    },
+    {
+        "name": "versioned-store-save",
+        "kind": "store_save",
+        "fence_kwargs": ("version",),
+        "fence_pos": 2,   # save(name, value, version)
+        "hint": ("thread the membership version into the versioned-key "
+                 "save so a stale peer cannot clobber the new epoch's "
+                 "shard"),
+    },
+)
+
+# dirs whose control-plane writes must be fenced; chaos/ and sim/ are
+# deliberately out — those tiers drive unfenced writes to exercise the
+# server's CAS rejection
+FENCE_SCOPE = re.compile(
+    r"^kungfu_tpu/(elastic|policy|launcher)/|^kungfu_tpu/__init__\.py$")
+PUT_BUILDER_SCOPE = re.compile(
+    r"^kungfu_tpu/(elastic|policy|launcher)/"
+    r"|^kungfu_tpu/utils/rpc\.py$|^kungfu_tpu/__init__\.py$")
+
+STORE_KEY_PREFIX = "kft"
+
+
+# ----------------------------------------------------------- module names
+def _module_of(path: str) -> str:
+    """Dotted module name of a repo-relative posix path."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _import_map(mod: Module) -> Dict[str, str]:
+    """alias -> dotted target for this file's imports (absolute form;
+    relative imports resolved against the file's package)."""
+    module = _module_of(mod.path)
+    is_pkg = mod.path.endswith("/__init__.py")
+    package = module if is_pkg else module.rsplit(".", 1)[0] \
+        if "." in module else ""
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if "." not in a.name or a.asname:
+                    out[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = package.split(".") if package else []
+                parts = parts[: len(parts) - (node.level - 1)]
+                base = ".".join(p for p in parts if p)
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*" or not base:
+                    continue
+                out[a.asname or a.name] = f"{base}.{a.name}"
+    return out
+
+
+def _param_classes(fn: ast.AST) -> Dict[str, str]:
+    """param name -> annotated class name (``w: "Watcher"`` or
+    ``w: Watcher``) — lets ``w._lock`` canonicalize to the class."""
+    out: Dict[str, str] = {}
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        ann = p.annotation
+        name = None
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value
+        if name and re.fullmatch(r"[A-Z]\w*", name):
+            out[p.arg] = name
+    return out
+
+
+# -------------------------------------------------------- lock resolution
+class _Resolver:
+    """Canonical lock tokens: ``Class.attr`` / ``module.path:name``."""
+
+    def __init__(self, mod: Module, imports: Dict[str, str],
+                 module_locks: Set[str], class_locks: Dict[str, Set[str]]):
+        self.module = _module_of(mod.path)
+        self.imports = imports
+        self.module_locks = module_locks
+        self.class_locks = class_locks
+
+    def lock_token(self, expr: ast.AST, cls: Optional[str],
+                   params: Dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return f"{self.module}:{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and cls is not None:
+                if _lockish(attr) or attr in self.class_locks.get(cls, ()):
+                    return f"{cls}.{attr}"
+                return None
+            if base in params and _lockish(attr):
+                return f"{params[base]}.{attr}"
+            if base in self.imports and _lockish(attr):
+                return f"{self.imports[base]}:{attr}"
+        return None
+
+    def callee_token(self, call: ast.Call) -> Optional[str]:
+        """Resolvable callee: ``f`` / ``self.m`` / ``mod.f`` — anything
+        else is dropped (no guessed call-through edges)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self":
+                return f"self.{f.attr}"
+            if f.value.id in self.imports:
+                return f"{self.imports[f.value.id]}:{f.attr}"
+        return None
+
+
+# --------------------------------------------------------- the lock walk
+class _FnWalker:
+    """One function's lock-aware walk: acquisitions with held-sets,
+    calls under lock, and (via hooks) seqlock events with their lock
+    and loop context."""
+
+    def __init__(self, mod: Module, resolver: _Resolver,
+                 cls: Optional[str], fn: ast.AST,
+                 seq_shape: Optional[dict] = None):
+        self.mod = mod
+        self.r = resolver
+        self.cls = cls
+        self.fn = fn
+        self.params = _param_classes(fn)
+        self.acquires: List[dict] = []
+        self.calls: List[dict] = []
+        self.seq_shape = seq_shape
+        self.seq_events: List[dict] = []
+        self.loops: List[str] = []   # innermost-last loop kinds
+
+    def _rec(self, node: ast.AST, **extra) -> dict:
+        line = getattr(node, "lineno", 1)
+        d = {"line": line, "symbol": self.mod.symbol_at(line),
+             "snippet": self.mod.snippet_at(line)}
+        d.update(extra)
+        return d
+
+    def run(self) -> None:
+        self._block(self.fn.body, set())
+
+    # ---- statements
+    def _block(self, stmts: Sequence[ast.stmt], held: Set[str]) -> None:
+        for s in stmts:
+            self._stmt(s, held)
+
+    def _loop_kind(self, s: ast.stmt) -> str:
+        if isinstance(s, ast.While):
+            if isinstance(s.test, ast.Constant) and s.test.value:
+                return "while_true"
+            return "while"
+        it = getattr(s, "iter", None)
+        if isinstance(it, ast.Call) and tail(call_name(it)) == "range":
+            return "for_range"
+        return "for"
+
+    def _stmt(self, s: ast.stmt, held: Set[str]) -> None:
+        if isinstance(s, _FN) or isinstance(s, ast.ClassDef):
+            return  # nested frames are their own walk
+        if isinstance(s, ast.With) or isinstance(s, ast.AsyncWith):
+            new: List[str] = []
+            for item in s.items:
+                lk = self.r.lock_token(item.context_expr, self.cls,
+                                       self.params)
+                if lk is not None:
+                    self.acquires.append(self._rec(
+                        item.context_expr, lock=lk,
+                        held=sorted(held | set(new)), via="with"))
+                    new.append(lk)
+                else:
+                    self._expr(item.context_expr, held)
+            self._block(s.body, held | set(new) if new else held)
+        elif isinstance(s, ast.If):
+            self._expr(s.test, held)
+            self._block(s.body, set(held))
+            self._block(s.orelse, set(held))
+        elif isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            self._expr(s.test if isinstance(s, ast.While) else s.iter,
+                       held)
+            self.loops.append(self._loop_kind(s))
+            self._block(s.body, set(held))
+            self.loops.pop()
+            self._block(s.orelse, set(held))
+        elif isinstance(s, ast.Try):
+            self._block(s.body, set(held))
+            for h in s.handlers:
+                self._block(h.body, set(held))
+            self._block(s.orelse, set(held))
+            self._block(s.finalbody, set(held))
+        else:
+            self._expr(s, held)
+
+    # ---- expressions (held mutates: acquire()/release() are linear)
+    def _expr(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, _FN) or isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+        self._seq_node(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, held)
+
+    def _call(self, node: ast.Call, held: Set[str]) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire",
+                                                       "release"):
+            lk = self.r.lock_token(f.value, self.cls, self.params)
+            if lk is not None:
+                if f.attr == "acquire":
+                    self.acquires.append(self._rec(
+                        node, lock=lk, held=sorted(held), via="acquire"))
+                    held.add(lk)
+                else:
+                    held.discard(lk)
+                return
+        if held:
+            tok = self.r.callee_token(node)
+            if tok is not None and not tok.endswith("_locked"):
+                self.calls.append(self._rec(node, callee=tok,
+                                            held=sorted(held)))
+
+    # ---- seqlock events (only when this fn is a declared writer/reader)
+    def _seq_node(self, node: ast.AST, held: Set[str]) -> None:
+        sh = self.seq_shape
+        if sh is None:
+            return
+        loop = self.loops[-1] if self.loops else None
+
+        def last_attr(e: ast.AST) -> str:
+            if isinstance(e, ast.Attribute):
+                return e.attr
+            if isinstance(e, ast.Name):
+                return e.id
+            return ""
+
+        if isinstance(node, ast.AugAssign) and \
+                last_attr(node.target) == sh["gen"]:
+            self.seq_events.append(self._rec(
+                node, kind="bump", held=sorted(held), loop=loop))
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        last_attr(t.value) == sh["hdr"]:
+                    idx = t.slice.value \
+                        if isinstance(t.slice, ast.Constant) else None
+                    self.seq_events.append(self._rec(
+                        node, kind="hdr_store", index=idx,
+                        held=sorted(held), loop=loop))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                last_attr(node.value) == sh["hdr"] and \
+                isinstance(node.slice, ast.Constant) and \
+                node.slice.value == sh["gen_index"]:
+            self.seq_events.append(self._rec(
+                node, kind="gen_read", held=sorted(held), loop=loop))
+        elif isinstance(node, ast.Call) and \
+                tail(call_name(node)) in sh["copy_tails"]:
+            self.seq_events.append(self._rec(
+                node, kind="copy", held=sorted(held), loop=loop))
+
+
+# --------------------------------------------------------- wal extraction
+def _first_arg_prefix(node: ast.Call) -> Optional[str]:
+    """Leading literal text of a str/f-string first argument."""
+    if not node.args:
+        return None
+    a = node.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value
+    if isinstance(a, ast.JoinedStr) and a.values and \
+            isinstance(a.values[0], ast.Constant) and \
+            isinstance(a.values[0].value, str):
+        return a.values[0].value
+    return None
+
+
+def _mutated_attr(node: ast.AST) -> Optional[str]:
+    """self-attr name a statement/call mutates, else None."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                return base.attr
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("append", "appendleft", "extend", "add",
+                               "update", "insert", "remove", "discard",
+                               "pop", "popleft", "popitem", "clear",
+                               "setdefault", "put", "sort", "reverse"):
+        recv = node.func.value
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self":
+            return recv.attr
+    return None
+
+
+def _wal_events(mod: Module, fn: ast.AST, family: dict,
+                rec) -> List[dict]:
+    """Line-ordered write/flush/fsync/journal/action events of one fn."""
+    events: List[dict] = []
+    action_muts = {a[4:] for a in family["actions"]
+                   if a.startswith("mut:")}
+    action_tails = {a[5:] for a in family["actions"]
+                    if a.startswith("tail:")}
+    action_calls = {a[5:] for a in family["actions"]
+                    if a.startswith("call:")}
+    for node in ast.walk(fn):
+        if isinstance(node, _FN) and node is not fn:
+            continue
+        attr = _mutated_attr(node)
+        if attr is not None and attr in action_muts:
+            events.append(rec(node, kind="action", what=f"self.{attr}"))
+        if not isinstance(node, ast.Call):
+            continue
+        cn = dotted(node.func)
+        t = tail(cn)
+        if t == "write" and "." in cn:
+            events.append(rec(node, kind="write",
+                              recv=cn.rsplit(".", 1)[0]))
+        elif t == "flush" and "." in cn:
+            events.append(rec(node, kind="flush",
+                              recv=cn.rsplit(".", 1)[0]))
+        elif t == "fsync":
+            recv = ""
+            if node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Call):
+                    ad = dotted(a.func)
+                    if tail(ad) == "fileno" and "." in ad:
+                        recv = ad.rsplit(".", 1)[0]
+                elif isinstance(a, (ast.Name, ast.Attribute)):
+                    recv = dotted(a)
+            events.append(rec(node, kind="fsync", recv=recv))
+        if cn in family["journal_calls"]:
+            events.append(rec(node, kind="journal", what=cn))
+        if t in action_tails or cn in action_calls:
+            events.append(rec(node, kind="action", what=cn))
+    events.sort(key=lambda e: e["line"])
+    return events
+
+
+# ------------------------------------------------------ thread lifecycle
+def _thread_facts(mod: Module, cls: ast.ClassDef, rec) -> dict:
+    threads: List[dict] = []
+    starts: List[dict] = []
+    joins: List[dict] = []
+    methods: Dict[str, dict] = {}
+    # receivers that ARE threads (assigned a Thread() in this class) —
+    # start()/join() on anything else (worker processes, futures,
+    # samplers) is not this pass's business
+    thread_recvs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                tail(call_name(node.value)) == "Thread":
+            for t in node.targets:
+                tok = dotted(t)
+                if tok:
+                    thread_recvs.add(tok)
+
+    def threadish(recv: str) -> bool:
+        return recv in thread_recvs or \
+            bool(re.search(r"thread", recv, re.IGNORECASE))
+
+    for m in [n for n in cls.body if isinstance(n, _FN)]:
+        mutated: Set[str] = set()
+        unchecked: Optional[dict] = None
+        for node in ast.walk(m):
+            attr = _mutated_attr(node)
+            if attr is not None and not _lockish(attr):
+                is_flag = isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and isinstance(getattr(node, "value", None),
+                                   ast.Constant)
+                if not is_flag:
+                    mutated.add(attr)
+            if isinstance(node, ast.While) and unchecked is None:
+                if not (isinstance(node.test, ast.Constant)
+                        and node.test.value):
+                    continue  # non-constant test IS the stop check
+                ok = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Break):
+                        ok = True
+                    elif isinstance(sub, ast.Attribute) and \
+                            STOP_SIGNAL.search(sub.attr):
+                        ok = True
+                    elif isinstance(sub, ast.Call) and \
+                            tail(call_name(sub)) in ("is_set", "wait"):
+                        ok = True
+                if not ok:
+                    unchecked = rec(node)
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            t = tail(cn)
+            if t == "Thread":
+                target = daemon = None
+                for kw in node.keywords:
+                    if kw.arg == "target" and \
+                            isinstance(kw.value, ast.Attribute) and \
+                            isinstance(kw.value.value, ast.Name) and \
+                            kw.value.value.id == "self":
+                        target = kw.value.attr
+                    elif kw.arg == "daemon" and \
+                            isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                threads.append(rec(node, target=target, daemon=daemon,
+                                   method=m.name))
+            elif t == "start" and "." in cn and \
+                    threadish(cn.rsplit(".", 1)[0]):
+                recv = cn.rsplit(".", 1)[0]
+                later: List[dict] = []
+                for sub in ast.walk(m):
+                    if getattr(sub, "lineno", 0) <= node.lineno:
+                        continue
+                    a2 = None
+                    if isinstance(sub, (ast.Assign, ast.AnnAssign)) and \
+                            getattr(sub, "value", None) is not None and \
+                            not isinstance(sub.value, ast.Constant):
+                        a2 = _mutated_attr(sub)
+                    if a2 and not _lockish(a2) and \
+                            f"self.{a2}" != recv:
+                        later.append(rec(sub, attr=a2))
+                starts.append(rec(node, recv=recv, method=m.name,
+                                  later=later))
+            elif t == "join" and "." in cn and \
+                    threadish(cn.rsplit(".", 1)[0]):
+                has_timeout = bool(node.args) or \
+                    any(kw.arg == "timeout" for kw in node.keywords)
+                joins.append(rec(node, recv=cn.rsplit(".", 1)[0],
+                                 method=m.name, has_timeout=has_timeout))
+        methods[m.name] = {"mutated": sorted(mutated),
+                           "unchecked_loop": unchecked}
+    return {"name": cls.name, "line": cls.lineno, "threads": threads,
+            "starts": starts, "joins": joins, "methods": methods}
+
+
+# ---------------------------------------------------------- the collector
+def collect_protocol(mod: Module) -> dict:
+    """One file's phase-4 facts (JSON-able; registry-aware so the cache
+    stays small — only files a registry names carry wal/seqlock facts)."""
+
+    def rec(node: ast.AST, **extra) -> dict:
+        line = getattr(node, "lineno", 1)
+        d = {"line": line, "symbol": mod.symbol_at(line),
+             "snippet": mod.snippet_at(line)}
+        d.update(extra)
+        return d
+
+    module = _module_of(mod.path)
+    imports = _import_map(mod)
+
+    # ---- declared locks and their kinds
+    module_locks: Set[str] = set()
+    class_locks: Dict[str, Set[str]] = {}
+    lock_kinds: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                tail(call_name(node.value)) in _LOCK_KINDS:
+            nm = node.targets[0].id
+            module_locks.add(nm)
+            lock_kinds[f"{module}:{nm}"] = tail(call_name(node.value))
+
+    functions: List[Tuple[Optional[str], ast.AST]] = []
+    for node in mod.tree.body:
+        if isinstance(node, _FN):
+            functions.append((None, node))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, _FN):
+                    functions.append((node.name, sub))
+            attrs: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call) and \
+                        tail(call_name(sub.value)) in _LOCK_KINDS:
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            attrs.add(t.attr)
+                            lock_kinds[f"{node.name}.{t.attr}"] = \
+                                tail(call_name(sub.value))
+            if attrs:
+                class_locks[node.name] = attrs
+
+    resolver = _Resolver(mod, imports, module_locks, class_locks)
+
+    seq_shape = next((s for s in SEQLOCK_SHAPES
+                      if re.search(s["path"], mod.path)), None)
+    wal_family = next((f for f in JOURNAL_FAMILIES
+                       if re.search(f["path"], mod.path)), None)
+
+    fn_recs: List[dict] = []
+    seqlock: Dict[str, dict] = {}
+    wal_fns: List[dict] = []
+    for cls, fn in functions:
+        qual = f"{cls}.{fn.name}" if cls else fn.name
+        fn_seq = seq_shape if seq_shape is not None and \
+            fn.name in (seq_shape["writers"] + seq_shape["readers"]) \
+            else None
+        w = _FnWalker(mod, resolver, cls, fn, seq_shape=fn_seq)
+        w.run()
+        if w.acquires or w.calls:
+            fn_recs.append({"qual": qual, "cls": cls, "name": fn.name,
+                            "line": fn.lineno,
+                            "acquires": w.acquires, "calls": w.calls})
+        if fn_seq is not None:
+            role = "writer" if fn.name in fn_seq["writers"] else "reader"
+            seqlock[fn.name] = {"role": role, "shape": fn_seq["name"],
+                                "line": fn.lineno,
+                                "symbol": mod.symbol_at(fn.lineno),
+                                "snippet": mod.snippet_at(fn.lineno),
+                                "events": w.seq_events}
+        if wal_family is not None:
+            ev = _wal_events(mod, fn, wal_family, rec)
+            if ev:
+                wal_fns.append({"qual": qual, "line": fn.lineno,
+                                "symbol": mod.symbol_at(fn.lineno),
+                                "snippet": mod.snippet_at(fn.lineno),
+                                "events": ev})
+
+    # ---- version-fence facts (cheap, collected everywhere)
+    call_tails = {t for m in FENCED_MUTATORS if m["kind"] == "call"
+                  for t in m["tails"]}
+    mutator_calls: List[dict] = []
+    store_saves: List[dict] = []
+    builders: List[dict] = []
+    for cls, fn in functions:
+        put_site: Optional[dict] = None
+        has_if_match = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Constant) and node.value == "If-Match":
+                has_if_match = True
+            if not isinstance(node, ast.Call):
+                continue
+            t = tail(call_name(node))
+            kwargs = sorted(kw.arg for kw in node.keywords if kw.arg)
+            if t in call_tails:
+                mutator_calls.append(rec(node, name=t,
+                                         npos=len(node.args),
+                                         kwargs=kwargs))
+            elif t in ("save", "save_owned"):
+                prefix = _first_arg_prefix(node)
+                if prefix is not None and \
+                        prefix.startswith(STORE_KEY_PREFIX):
+                    store_saves.append(rec(node, name=t,
+                                           npos=len(node.args),
+                                           kwargs=kwargs))
+            if put_site is None and any(
+                    kw.arg == "method" and
+                    isinstance(kw.value, ast.Constant) and
+                    kw.value.value == "PUT" for kw in node.keywords):
+                put_site = rec(node)
+        if put_site is not None:
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            builders.append(dict(put_site, fn=qual,
+                                 has_if_match=has_if_match))
+    # module-level mutator calls (launcher mains seed outside any def)
+    in_fn = {id(n) for _, fn in functions for n in ast.walk(fn)}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and id(node) not in in_fn and \
+                tail(call_name(node)) in call_tails:
+            mutator_calls.append(rec(node, name=tail(call_name(node)),
+                                     npos=len(node.args),
+                                     kwargs=sorted(
+                                         kw.arg for kw in node.keywords
+                                         if kw.arg)))
+
+    threads = [_thread_facts(mod, node, rec) for node in ast.walk(mod.tree)
+               if isinstance(node, ast.ClassDef)]
+    threads = [t for t in threads
+               if t["threads"] or t["starts"] or t["joins"]]
+
+    out: dict = {"module": module, "lock_kinds": lock_kinds,
+                 "functions": fn_recs, "threads": threads,
+                 "fence": {"mutators": mutator_calls,
+                           "builders": builders,
+                           "store_saves": store_saves}}
+    if seqlock:
+        out["seqlock"] = seqlock
+    if wal_fns:
+        out["wal"] = {"family": wal_family["name"], "functions": wal_fns}
+    return out
+
+
+# ================================================================ passes
+class _ProtocolPass:
+    """Shared scoping + fact plumbing for the phase-4 passes."""
+
+    name = ""
+
+    def _files(self, pm) -> Iterator[Tuple[str, dict]]:
+        for path, f in sorted(pm.files.items()):
+            if not path.startswith(SCOPE):
+                continue
+            proto = f.get("protocol") or {}
+            if proto:
+                yield path, proto
+
+    def _finding(self, path: str, r: dict, message: str) -> Finding:
+        return Finding(rule=self.name, path=path, line=r["line"],
+                       symbol=r["symbol"], message=message,
+                       snippet=r["snippet"])
+
+
+# ----------------------------------------------------------- lock-ordering
+class LockOrderingLogic(_ProtocolPass):
+    name = "lock-ordering"
+
+    def _index(self, pm) -> Tuple[Dict[Tuple[str, str], dict],
+                                  Dict[Tuple[str, str], Tuple[str, dict]]]:
+        """((path, qual) -> fnrec, (module, name) -> (path, fnrec))."""
+        by_qual: Dict[Tuple[str, str], dict] = {}
+        by_mod: Dict[Tuple[str, str], Tuple[str, dict]] = {}
+        for path, proto in self._files(pm):
+            for fn in proto.get("functions", ()):
+                by_qual[(path, fn["qual"])] = fn
+                if fn["cls"] is None:
+                    by_mod[(proto["module"], fn["name"])] = (path, fn)
+        return by_qual, by_mod
+
+    def _resolve_callee(self, call: dict, fn: dict, path: str,
+                        proto: dict, by_qual, by_mod) -> Optional[dict]:
+        tok = call["callee"]
+        if tok.startswith("self."):
+            if fn["cls"] is None:
+                return None
+            return by_qual.get((path, f"{fn['cls']}.{tok[5:]}"))
+        if ":" in tok:
+            modname, name = tok.split(":", 1)
+            hit = by_mod.get((modname, name))
+            return hit[1] if hit else None
+        hit = by_mod.get((proto["module"], tok))
+        if hit:
+            return hit[1]
+        return None
+
+    def findings(self, pm) -> Iterator[Finding]:
+        kinds: Dict[str, str] = {}
+        for _, proto in self._files(pm):
+            kinds.update(proto.get("lock_kinds", {}))
+        by_qual, by_mod = self._index(pm)
+
+        # edge (a, b): a held while b acquired; first witness kept
+        edges: Dict[Tuple[str, str], Tuple[str, dict, str]] = {}
+
+        def add_edge(a: str, b: str, path: str, r: dict,
+                     note: str) -> None:
+            if a != b:
+                edges.setdefault((a, b), (path, r, note))
+
+        for path, proto in self._files(pm):
+            for fn in proto.get("functions", ()):
+                for acq in fn["acquires"]:
+                    for h in acq["held"]:
+                        add_edge(h, acq["lock"], path, acq,
+                                 f"`{fn['qual']}` acquires "
+                                 f"`{acq['lock']}` while holding `{h}`")
+                    if acq["lock"] in acq["held"] and \
+                            kinds.get(acq["lock"]) == "Lock":
+                        yield self._finding(
+                            path, acq,
+                            f"non-reentrant Lock `{acq['lock']}` is "
+                            f"re-acquired in `{fn['qual']}` on a path "
+                            f"that already holds it — this deadlocks "
+                            f"immediately; make it an RLock or refactor "
+                            f"to a `_locked` helper the holder calls")
+                for call in fn["calls"]:
+                    callee = self._resolve_callee(call, fn, path, proto,
+                                                  by_qual, by_mod)
+                    if callee is None:
+                        continue
+                    for acq in callee["acquires"]:
+                        for h in call["held"]:
+                            add_edge(h, acq["lock"], path, call,
+                                     f"`{fn['qual']}` holds `{h}` and "
+                                     f"calls `{callee['qual']}`, which "
+                                     f"acquires `{acq['lock']}`")
+                            if acq["lock"] == h and \
+                                    kinds.get(h) == "Lock" and \
+                                    not acq["held"]:
+                                yield self._finding(
+                                    path, call,
+                                    f"`{fn['qual']}` holds non-reentrant "
+                                    f"Lock `{h}` and calls "
+                                    f"`{callee['qual']}`, which acquires "
+                                    f"it again — this deadlocks; pass "
+                                    f"the state or add a `_locked` "
+                                    f"variant the holder calls")
+
+        # ---- cycle detection over the order graph
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, []).append(b)
+        seen_cycles: Set[frozenset] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, trail = stack.pop()
+                for nxt in graph.get(node, ()):
+                    if nxt == start and len(trail) > 1:
+                        key = frozenset(trail)
+                        if key in seen_cycles:
+                            continue
+                        seen_cycles.add(key)
+                        chain = trail + [start]
+                        legs = []
+                        for a, b in zip(chain, chain[1:]):
+                            p, r, note = edges[(a, b)]
+                            legs.append(f"{note} ({p}:{r['line']})")
+                        p0, r0, _ = edges[(chain[0], chain[1])]
+                        yield self._finding(
+                            p0, r0,
+                            "lock-order cycle — two threads taking "
+                            "these chains concurrently deadlock: "
+                            + "; ".join(legs)
+                            + "; pick one global order and release "
+                              "before crossing modules")
+                    elif nxt not in trail and len(trail) < 6:
+                        stack.append((nxt, trail + [nxt]))
+
+
+# ---------------------------------------------------------- wal-discipline
+class WalDisciplineLogic(_ProtocolPass):
+    name = "wal-discipline"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, proto in self._files(pm):
+            wal = proto.get("wal")
+            if not wal:
+                continue
+            family = next((f for f in JOURNAL_FAMILIES
+                           if f["name"] == wal["family"]), None)
+            if family is None:
+                continue
+            fns = {f["qual"]: f for f in wal["functions"]}
+            # 1. the write/flush/fsync triple inside each writer
+            for writer in family["writers"]:
+                fn = fns.get(writer)
+                if fn is None:
+                    yield Finding(
+                        rule=self.name, path=path, line=1,
+                        symbol="<module>", snippet="",
+                        message=(
+                            f"journal family `{family['name']}` declares "
+                            f"writer `{writer}` but no such function "
+                            f"journals here — the registry in "
+                            f"tools/kfcheck/protocol.py is stale; fix "
+                            f"the name so the WAL discipline stays "
+                            f"proven"))
+                    continue
+                ev = fn["events"]
+                writes = [e for e in ev if e["kind"] == "write"]
+                if not writes:
+                    continue
+                w = writes[0]
+                flushes = [e for e in ev if e["kind"] == "flush"
+                           and e["recv"] == w["recv"]
+                           and e["line"] >= w["line"]]
+                if not flushes:
+                    yield self._finding(
+                        path, w,
+                        f"journal writer `{writer}` writes to "
+                        f"`{w['recv']}` without flushing it — the "
+                        f"record sits in userspace buffers and a crash "
+                        f"loses an acked entry; flush then "
+                        f"os.fsync(fd) before the side effect")
+                    continue
+                fsyncs = [e for e in ev if e["kind"] == "fsync"]
+                same = [e for e in fsyncs if e["recv"] == w["recv"]
+                        and e["line"] >= flushes[0]["line"]]
+                if same:
+                    continue
+                if fsyncs:
+                    yield self._finding(
+                        path, fsyncs[0],
+                        f"journal writer `{writer}` fsyncs "
+                        f"`{fsyncs[0]['recv'] or '<unknown fd>'}` but "
+                        f"the journal write went to `{w['recv']}` — the "
+                        f"durability barrier is on the wrong fd; fsync "
+                        f"the fd the record was written to")
+                else:
+                    yield self._finding(
+                        path, flushes[0],
+                        f"journal writer `{writer}` flushes "
+                        f"`{w['recv']}` but never fsyncs it — flush "
+                        f"only reaches the page cache, so a power cut "
+                        f"or SIGKILL can lose a record the caller "
+                        f"already acted on; add "
+                        f"os.fsync({w['recv']}.fileno())")
+            # 2. journal append must precede the guarded side effect
+            for fn in wal["functions"]:
+                journals = [e for e in fn["events"]
+                            if e["kind"] == "journal"]
+                actions = [e for e in fn["events"]
+                           if e["kind"] == "action"]
+                if not journals or not actions:
+                    continue
+                first_j = journals[0]["line"]
+                early = [a for a in actions if a["line"] < first_j]
+                if early:
+                    a = early[0]
+                    yield self._finding(
+                        path, a,
+                        f"`{fn['qual']}` applies the side effect "
+                        f"(`{a['what']}`) BEFORE the journal append at "
+                        f"line {first_j} — a crash in between leaves an "
+                        f"effect the journal never saw, so replay "
+                        f"diverges; append (write+flush+fsync) first, "
+                        f"then apply")
+
+
+# ----------------------------------------------------------- version-fence
+class VersionFenceLogic(_ProtocolPass):
+    name = "version-fence"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        call_specs = {t: m for m in FENCED_MUTATORS
+                      if m["kind"] == "call" for t in m["tails"]}
+        save_spec = next((m for m in FENCED_MUTATORS
+                          if m["kind"] == "store_save"), None)
+        for path, proto in self._files(pm):
+            fence = proto.get("fence") or {}
+            in_scope = bool(FENCE_SCOPE.match(path))
+            if in_scope:
+                for r in fence.get("mutators", ()):
+                    spec = call_specs.get(r["name"])
+                    if spec is None:
+                        continue
+                    fenced = any(k in r["kwargs"]
+                                 for k in spec["fence_kwargs"]) or \
+                        r["npos"] > spec["fence_pos"]
+                    if not fenced:
+                        yield self._finding(
+                            path, r,
+                            f"unfenced control-plane mutation: "
+                            f"`{r['name']}(...)` without "
+                            f"`{spec['fence_kwargs'][0]}=` — "
+                            f"{spec['hint']}")
+                if save_spec is not None:
+                    for r in fence.get("store_saves", ()):
+                        fenced = any(k in r["kwargs"]
+                                     for k in save_spec["fence_kwargs"]) \
+                            or r["npos"] > save_spec["fence_pos"]
+                        if not fenced:
+                            yield self._finding(
+                                path, r,
+                                f"versioned-key store `{r['name']}` "
+                                f"without `version=` — "
+                                f"{save_spec['hint']}")
+            if PUT_BUILDER_SCOPE.match(path):
+                for r in fence.get("builders", ()):
+                    if not r["has_if_match"]:
+                        yield self._finding(
+                            path, r,
+                            f"`{r['fn']}` builds a method=\"PUT\" "
+                            f"control-plane request but never sets an "
+                            f"`If-Match` fence header — every caller "
+                            f"becomes a blind overwrite; thread the "
+                            f"fetched version into If-Match so the "
+                            f"server can 409 a lost race")
+
+
+# ----------------------------------------------------------- seqlock-shape
+class SeqlockShapeLogic(_ProtocolPass):
+    name = "seqlock-shape"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, proto in self._files(pm):
+            for fname, sq in sorted((proto.get("seqlock") or {}).items()):
+                frec = {"line": sq["line"], "symbol": sq["symbol"],
+                        "snippet": sq["snippet"]}
+                ev = sq["events"]
+                if sq["role"] == "writer":
+                    yield from self._writer(path, fname, frec, ev)
+                else:
+                    yield from self._reader(path, fname, frec, ev)
+
+    def _writer(self, path: str, fname: str, frec: dict,
+                ev: List[dict]) -> Iterator[Finding]:
+        bumps = [e for e in ev if e["kind"] == "bump"]
+        if len(bumps) < 2:
+            yield self._finding(
+                path, frec,
+                f"seqlock writer `{fname}` must bump the generation to "
+                f"odd before the payload write and back to even after "
+                f"it (found {len(bumps)} bump(s)) — readers cannot "
+                f"detect a torn write without the odd window")
+            return
+        lo, hi = bumps[0]["line"], bumps[-1]["line"]
+        payload = [e for e in ev
+                   if e["kind"] in ("copy", "hdr_store")
+                   and lo < e["line"] < hi]
+        if not payload:
+            yield self._finding(
+                path, bumps[0],
+                f"seqlock writer `{fname}` bumps the generation twice "
+                f"with no payload store between the bumps — the odd "
+                f"window guards nothing and the real payload write is "
+                f"outside it (torn reads become invisible)")
+        section = bumps + payload
+        held_sets = [set(e["held"]) for e in section]
+        common = set.intersection(*held_sets) if held_sets else set()
+        if not common:
+            bad = next((e for e in section if not e["held"]),
+                       section[0])
+            yield self._finding(
+                path, bad,
+                f"seqlock writer `{fname}`'s bump→payload→bump section "
+                f"is not entirely under one lock — two writers can "
+                f"interleave generation bumps and publish a torn "
+                f"payload under an even generation; hold the segment "
+                f"lock across the whole section")
+
+    def _reader(self, path: str, fname: str, frec: dict,
+                ev: List[dict]) -> Iterator[Finding]:
+        reads = [e for e in ev if e["kind"] == "gen_read"]
+        for e in reads:
+            if e.get("loop") == "while_true":
+                yield self._finding(
+                    path, e,
+                    f"seqlock reader `{fname}` retries inside `while "
+                    f"True:` — a writer-heavy phase can starve the "
+                    f"reader forever; bound the retries and fall back "
+                    f"to the wire path on mismatch")
+                break
+        copies = [e for e in ev if e["kind"] == "copy"]
+        if not copies:
+            return  # view-minting readers pin gen only; nothing to copy
+        c = copies[0]
+        before = [e for e in reads if e["line"] <= c["line"]]
+        after = [e for e in reads if e["line"] > c["line"]]
+        if not before or not after:
+            yield self._finding(
+                path, c,
+                f"seqlock reader `{fname}` copies the payload without "
+                f"pinning the generation on "
+                f"{'both sides' if not before and not after else ('entry' if not before else 're-check')} "
+                f"— a concurrent writer tears the copy undetected; "
+                f"read gen before the copy AND compare it after, "
+                f"treating a mismatch as fallback")
+
+
+# -------------------------------------------------------- thread-lifecycle
+class ThreadLifecycleLogic(_ProtocolPass):
+    name = "thread-lifecycle"
+
+    def findings(self, pm) -> Iterator[Finding]:
+        for path, proto in self._files(pm):
+            for cls in proto.get("threads", ()):
+                methods = cls["methods"]
+                for th in cls["threads"]:
+                    if not th.get("daemon") or not th.get("target"):
+                        continue
+                    m = methods.get(th["target"])
+                    if not m or not m["unchecked_loop"]:
+                        continue
+                    if not m["mutated"]:
+                        continue
+                    yield self._finding(
+                        path, th,
+                        f"daemon thread target "
+                        f"`{cls['name']}.{th['target']}` loops forever "
+                        f"with no stop signal checked while mutating "
+                        f"`self.{'`/`self.'.join(m['mutated'])}` — "
+                        f"stop()/teardown cannot end it and it keeps "
+                        f"mutating shared state after the owner is "
+                        f"gone; check a threading.Event in the loop")
+                for st in cls["starts"]:
+                    if not st["later"]:
+                        continue
+                    late = st["later"][0]
+                    yield self._finding(
+                        path, st,
+                        f"`{cls['name']}.{st['method']}` starts "
+                        f"`{st['recv']}` before assigning "
+                        f"`self.{late['attr']}` (line {late['line']}) — "
+                        f"the thread body can observe a "
+                        f"half-constructed object; assign every shared "
+                        f"attr before start()")
+                for jn in cls["joins"]:
+                    if jn["has_timeout"] or \
+                            not STOP_PATH.search(jn["method"]):
+                        continue
+                    yield self._finding(
+                        path, jn,
+                        f"unbounded `{jn['recv']}.join()` on the stop "
+                        f"path `{cls['name']}.{jn['method']}` — a "
+                        f"wedged thread wedges the caller (and the "
+                        f"whole teardown); bound it with a deadline "
+                        f"the way HeartbeatSender.stop does")

@@ -294,7 +294,8 @@ class SilentExcept(Rule):
                    r"|(^|/)serving/slo\.py$|(^|/)tools/kfload\.py$"
                    r"|(^|/)tools/kfnet_report\.py$"
                    r"|(^|/)tools/kfpolicy\.py$"
-                   r"|(^|/)tools/bench_p2p\.py$")
+                   r"|(^|/)tools/bench_p2p\.py$"
+                   r"|(^|/)tools/kfcheck/protocol\.py$")
 
     BROAD = {"Exception", "BaseException"}
 

@@ -7,7 +7,7 @@ existing suppression (``# kfcheck: disable=<pass>``) and baseline
 machinery applies unchanged.  Rule-name = pass-name for all of a
 pass's findings; the message distinguishes the sub-check.
 
-The seven passes (docs/static-analysis.md has examples + failure modes):
+The twelve passes (docs/static-analysis.md has examples + failure modes):
 
   lock-discipline        attribute mutated on a thread body but touched
                          elsewhere without the object's lock
@@ -26,6 +26,21 @@ The seven passes (docs/static-analysis.md has examples + failure modes):
   host-roundtrip-traced  jit outputs escaping to host in hot loops /
                          host values fed back into a jit, proven from
                          def-use chains instead of name heuristics
+  lock-ordering          global lock-order graph (held-sets + one level
+                         of call-through); cycles and non-reentrant
+                         re-acquisition are deadlock findings (phase 4,
+                         tools/kfcheck/protocol.py)
+  wal-discipline         write/flush/fsync triple on one fd inside each
+                         registered journal writer, and the append
+                         ahead of its guarded side effect
+  version-fence          control-plane mutations in elastic/policy/
+                         launcher scope must thread the membership
+                         version (If-Match / fence kwarg / versioned key)
+  seqlock-shape          declared generation protocols: writer bumps
+                         bracket the payload under one lock; readers
+                         pin gen both sides of the copy, retries bounded
+  thread-lifecycle       daemon loops check a stop signal, start() after
+                         all shared attrs, stop-path joins bounded
 """
 from __future__ import annotations
 
@@ -37,6 +52,9 @@ from .dataflow import (HostRoundtripLogic, ShardingMismatchLogic,
                        UseAfterDonateLogic)
 from .engine import Finding
 from .facts import lockish
+from .protocol import (LockOrderingLogic, SeqlockShapeLogic,
+                       ThreadLifecycleLogic, VersionFenceLogic,
+                       WalDisciplineLogic)
 
 
 class ProgramModel:
@@ -370,9 +388,80 @@ class HostRoundtrip(ProgramPass, HostRoundtripLogic):
         yield from self.findings(pm)
 
 
+# ------------------------------------------------- protocol (phase 4)
+# Concurrency & durability protocols live in tools/kfcheck/protocol.py
+# (facts["protocol"]: lock acquisitions with held-sets, journal-family
+# events, fence call sites, seqlock events, thread lifecycle).  These
+# are the standing gates ROADMAP item 2's actuation executor lands
+# under: its ledger registers in JOURNAL_FAMILIES, its mutations in
+# FENCED_MUTATORS, and violating either turns CI step 0 red.
+
+class LockOrdering(ProgramPass, LockOrderingLogic):
+    name = "lock-ordering"
+    doc = ("the global lock-order graph (every acquisition with the "
+           "locks already held, plus one level of call-through into "
+           "same-repo callees) must be acyclic, and a non-reentrant "
+           "threading.Lock must never be re-acquired on a path that "
+           "may already hold it — both are deadlocks, not races")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class WalDiscipline(ProgramPass, WalDisciplineLogic):
+    name = "wal-discipline"
+    doc = ("each journal family registered in protocol.py's "
+           "JOURNAL_FAMILIES must write/flush/os.fsync on the SAME fd "
+           "inside its writer, and the journal append must precede the "
+           "guarded side effect in every function that does both — "
+           "flush-without-fsync or effect-before-append loses acked "
+           "state on a crash")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class VersionFence(ProgramPass, VersionFenceLogic):
+    name = "version-fence"
+    doc = ("control-plane mutations in elastic/policy/launcher scope "
+           "(config PUT/CAS, versioned-key store saves, registered "
+           "future actuators) must thread a membership/epoch version "
+           "(If-Match header / if_version= / version=) on every path — "
+           "an unfenced write silently overwrites a concurrent "
+           "membership change")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class SeqlockShape(ProgramPass, SeqlockShapeLogic):
+    name = "seqlock-shape"
+    doc = ("generation protocols declared in protocol.py's "
+           "SEQLOCK_SHAPES: the writer must bump the generation before "
+           "and after the payload store, entirely under one lock; "
+           "readers must pin the generation before AND after the copy, "
+           "bound their retries, and treat a mismatch as fallback")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
+class ThreadLifecycle(ProgramPass, ThreadLifecycleLogic):
+    name = "thread-lifecycle"
+    doc = ("daemon threads that mutate shared state must check a stop "
+           "signal in their loop; start() must come after every shared "
+           "attr is assigned; a join() on a stop/close/shutdown path "
+           "must carry a timeout (the HeartbeatSender wedge fix, "
+           "enforced whole-program)")
+
+    def check(self, pm: ProgramModel) -> Iterator[Finding]:
+        yield from self.findings(pm)
+
+
 ALL_PASSES = [LockDiscipline(), KnobRegistry(), MetricsConsistency(),
               ChaosCoverage(), UseAfterDonate(), ShardingMismatch(),
-              HostRoundtrip()]
+              HostRoundtrip(), LockOrdering(), WalDiscipline(),
+              VersionFence(), SeqlockShape(), ThreadLifecycle()]
 
 
 def run_passes(facts_by_path: Dict[str, dict],
