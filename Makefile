@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench p2p-smoke doctor-smoke prof-smoke sim-smoke sim-soak load-smoke slo-smoke net-smoke policy-smoke clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench p2p-smoke doctor-smoke prof-smoke sim-smoke sim-soak serve-sim-smoke load-smoke slo-smoke net-smoke policy-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -49,6 +49,17 @@ SEEDS ?= 1 2 3
 sim-soak:
 	python -m kungfu_tpu.chaos.runner --scenario none \
 	    $(foreach s,$(SEEDS),--sim-seed $(s))
+
+# kffleet smoke: a 4-replica fake serving fleet under the REAL watcher
+# + config server, driven by a seeded diurnal arrival trace with forced
+# preempt/re-admit — serving-journal conservation invariants, fleet
+# gauges, min_served floor.  Lite (no-jax) replicas: can NEVER
+# self-skip (docs/serving.md "Fleet observability").  The fleet doctor
+# proofs run as chaos scenarios: sim-serve-spike-20 /
+# sim-serve-imbalance-20 / sim-serve-imbalance-20-clean /
+# sim-serve-replica-kill.
+serve-sim-smoke:
+	python -m kungfu_tpu.chaos.runner --scenario sim-serve-smoke
 
 # kfdoctor smoke: metrics/trace plumbing plus the diagnosis plane —
 # a watcher /findings endpoint must attribute a 10x step-time skew to

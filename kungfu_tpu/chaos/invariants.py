@@ -270,6 +270,54 @@ def check_trajectory(events: Sequence[Event], oracle_wsum,
     return bad
 
 
+def check_serving_journal(events: Sequence[Event]) -> List[str]:
+    """The serving fleet's request-conservation contract (kffleet):
+    every replica's ``final`` event must account for every request it
+    ever admitted — ``finished + evicted == submitted`` with ``open ==
+    0`` after the shutdown eviction sweep.  A gap means the journal
+    leaked a request (it will never resolve into any SLO window) or
+    double-counted one (the fleet percentile join would weight it
+    twice).  All finals must also agree on one ``(version, size)``
+    membership — the serving analogue of :func:`check_single_winner`
+    WITHOUT its progress-counter clause: replicas serve independent
+    request streams, so their submitted/finished counters legitimately
+    differ."""
+    finals = [e for e in events if e.get("kind") == "final"]
+    if not finals:
+        return ["no replica reached the target (no final events)"]
+    bad = []
+    for e in finals:
+        sub = int(e.get("submitted", 0))
+        fin = int(e.get("finished", 0))
+        ev = int(e.get("evicted", 0))
+        op = int(e.get("open", 0))
+        if fin + ev != sub or op != 0:
+            bad.append(
+                f"{e.get('stream')}: request journal leaks — "
+                f"finished({fin}) + evicted({ev}) != submitted({sub}) "
+                f"or open({op}) != 0: a request vanished from (or was "
+                f"double-counted in) the SLO accounting")
+    vs = {(int(e["version"]), int(e["size"])) for e in finals}
+    if len(vs) != 1:
+        bad.append(f"final membership disagrees across replicas: "
+                   f"{sorted(vs)}")
+    return bad
+
+
+def run_serving(events: Sequence[Event], pids: Sequence[int] = (),
+                pid_marker: Optional[str] = None) -> List[str]:
+    """The checker sweep for serving-fleet scenarios.  No single-winner
+    or trajectory checks: replicas hold no training progress to agree
+    on — the contracts are journal conservation, membership agreement,
+    version fencing, and process hygiene."""
+    bad = []
+    bad += check_serving_journal(events)
+    bad += check_version_monotonic_across_epochs(events)
+    bad += check_no_orphans(pids, marker=pid_marker)
+    bad += check_no_shm_orphans(pids)
+    return bad
+
+
 def run_all(events: Sequence[Event], pids: Sequence[int] = (),
             oracle_wsum=None, init_wsum: float = 0.0,
             pid_marker: Optional[str] = None) -> List[str]:

@@ -305,8 +305,13 @@ class Scenario:
     # requires the doctor — scraping live worker /metrics during the run
     # — to raise a K finding naming rank R (and never misattribute it);
     # {"absent_kind": K} requires NO K finding on the whole run (the
-    # false-positive guard for the clean twin).  Enabling this exports
-    # KFT_CONFIG_ENABLE_MONITORING=1 so workers serve /metrics.
+    # false-positive guard for the clean twin); adding {"cleared":
+    # True} to a {"kind": K} expectation additionally requires K to be
+    # INACTIVE at the sampler's last diagnose — the finding must have
+    # been raised during the disturbance AND withdrawn once it passed
+    # (the raise-then-clear contract of transient findings).  Enabling
+    # this exports KFT_CONFIG_ENABLE_MONITORING=1 so workers serve
+    # /metrics.
     doctor_expect: Optional[Dict[str, object]] = None
     # kfpolicy shadow-proof loop (docs/policy.md): {"rule": R, "rank":
     # N} requires the policy sampler's ledger to contain EXACTLY ONE
@@ -349,6 +354,21 @@ class Scenario:
     # proves a grow's adoption pulls spread over the holders instead of
     # every joiner converging on one
     min_sync_donors: int = 0
+    # ---- kffleet (docs/serving.md "Fleet observability"): sim_serve
+    # swaps the fake-TRAINER payload for fake serving REPLICAS
+    # (sim/serving.py) under the same watcher, and the invariant sweep
+    # for the serving one (journal conservation instead of
+    # single-winner — replicas hold no shared progress counters)
+    sim_serve: bool = False
+    # synthetic load driven AT the fleet from the runner while it
+    # serves: a synth_diurnal_schedule(**serve_load) arrival plan
+    # round-robined over the replicas (keys: seed, duration_s,
+    # base_rps, peak_rps, spike_rps, spike_window, prompt_len, max_new)
+    serve_load: Optional[Dict[str, object]] = None
+    # proof floor: at least this many requests finished fleet-wide
+    # (summed over final events) — a serving scenario whose load never
+    # landed proved nothing
+    min_served: int = 0
     # extra worker-side environment (knob overrides) merged over the
     # runner's base env — e.g. KFT_SHM_MIN_KB=0 so the tiny chaos model
     # still rides the shm fast lane (kill-during-shm-pull)
@@ -810,15 +830,21 @@ class _DoctorSampler(threading.Thread):
         # the join below times out.
         self._seen_lock = threading.Lock()
         self.seen: Dict[Tuple[str, str], dict] = {}
+        # the keys active at the LAST diagnose — what the
+        # raise-then-clear contract ({"cleared": True}) checks against:
+        # a transient finding must appear in `seen` but not here
+        self.last_active: set = set()
 
     def run(self) -> None:
         from ..monitor import cluster as _mcluster
         while not self.stop_event.is_set():
             _mcluster.aggregate(self.targets, timeout=1.0,
                                 history=self.doctor.history)
-            for f in self.doctor.diagnose(ranks=self.ranks):
-                with self._seen_lock:
+            findings = self.doctor.diagnose(ranks=self.ranks)
+            with self._seen_lock:
+                for f in findings:
                     self.seen.setdefault(f.key(), f.to_dict())
+                self.last_active = {f.key() for f in findings}
             self.stop_event.wait(0.4)
 
     def stop(self) -> None:
@@ -941,10 +967,14 @@ def policy_violations(policy_expect: Dict[str, object],
 
 
 def doctor_violations(doctor_expect: Dict[str, object],
-                      found: List[dict]) -> List[str]:
+                      found: List[dict],
+                      active=None) -> List[str]:
     """Check a scenario's ``doctor_expect`` contract against the
     findings a :class:`_DoctorSampler` accumulated (shared by the real
-    and sim runners)."""
+    and sim runners).  ``active`` is the sampler's ``last_active`` key
+    set — required when the expectation carries ``{"cleared": True}``
+    (the raise-then-clear contract: the finding must have fired during
+    the disturbance and be withdrawn by the final diagnose)."""
     violations: List[str] = []
     exp_kind = doctor_expect.get("kind")
     absent = doctor_expect.get("absent_kind")
@@ -962,6 +992,14 @@ def doctor_violations(doctor_expect: Dict[str, object],
                 f"doctor: {exp_kind!r} misattributed to rank(s) "
                 f"{sorted(str(d.get('rank')) for d in wrong)} "
                 f"(only rank {exp_rank} was delayed)")
+        if doctor_expect.get("cleared"):
+            stuck = sorted(str(k) for k in (active or ())
+                           if k and k[0] == exp_kind)
+            if stuck:
+                violations.append(
+                    f"doctor: {exp_kind!r} finding(s) still active at "
+                    f"the last diagnose {stuck}: the disturbance "
+                    f"passed but the finding never cleared")
     if absent is not None:
         spurious = [d for d in found if d.get("kind") == absent]
         if spurious:
@@ -990,6 +1028,14 @@ def floor_violations(sc: Scenario, fired: List[dict],
                 f"only {len(seen)} distinct config version(s) observed "
                 f"{sorted(v for v in seen if v is not None)} (scenario "
                 f"requires >= {sc.min_config_versions})")
+    if sc.min_served:
+        served = sum(int(e.get("finished", 0)) for e in events
+                     if e.get("kind") == "final")
+        if served < sc.min_served:
+            violations.append(
+                f"fleet finished only {served} request(s) (scenario "
+                f"requires >= {sc.min_served}: the synthetic load "
+                f"never landed, so the scenario proved nothing)")
     if sc.min_sync_donors:
         donors = {e.get("donor") for e in events
                   if e.get("kind") == "sync" and e.get("donor")}

@@ -105,6 +105,11 @@ SITES: Dict[str, str] = {
     "launcher.watch.kill": (
         "watcher about to kill one removed worker"),
     # ------------------------------------------------ serving engine
+    "serve.tick": (
+        "kfsim fake serving replica (sim/serving.py), at the top of "
+        "every control tick before the heartbeat — a kill here is a "
+        "mid-sweep replica SIGKILL (lease escalation + worker_up "
+        "drop); a delay models a wedged control loop"),
     "serving.admit": (
         "decode engine admission (serving/engine.py _admit), after a "
         "prefill batch is picked and before its device dispatch — a "

@@ -120,6 +120,38 @@ _HELP = {
     "kungfu_tpu_serving_phase_share":
         "Serving: fraction of window request wall time spent per "
         "lifecycle phase (queue/prefill/decode; serving/slo.py).",
+    "kungfu_tpu_serving_ttft_seconds":
+        "Serving: client-visible time-to-first-token per FINISHED "
+        "request (observed once at finish, preemptions included — the "
+        "exactly-once weight of the fleet percentile join).",
+    "kungfu_tpu_serving_tpot_seconds":
+        "Serving: per-output-token decode slope per finished request "
+        "(observed once at finish).",
+    "kungfu_tpu_serving_admitted_total":
+        "Serving: slot admissions, re-admissions after preemption "
+        "included (the per-replica load share detect_imbalance "
+        "compares across the fleet).",
+    "kungfu_tpu_serving_queue_depth":
+        "Serving: requests currently waiting for a decode slot.",
+    "kungfu_tpu_fleet_slo_budget_burn":
+        "Fleet serving: finished-count-weighted aggregate error-budget "
+        "burn per objective across serving replicas "
+        "(monitor/cluster.py join).",
+    "kungfu_tpu_fleet_ttft_ms":
+        "Fleet serving: count-weighted fleet percentile of per-replica "
+        "TTFT quantiles (ms), per quantile label.",
+    "kungfu_tpu_fleet_tpot_ms":
+        "Fleet serving: count-weighted fleet percentile of per-replica "
+        "TPOT quantiles (ms), per quantile label.",
+    "kungfu_tpu_fleet_load_imbalance":
+        "Fleet serving: (max-min)/median spread of per-replica load, "
+        "per signal (admitted rps / queue-wait p50); 0 = balanced.",
+    "kungfu_tpu_fleet_prefix_hit_rate":
+        "Fleet serving: admission-weighted mean of per-replica prefix "
+        "cache hit rates.",
+    "kungfu_tpu_fleet_serving_replicas":
+        "Fleet serving: replicas whose scrape carried serving-journal "
+        "families this aggregation pass.",
     "kungfu_tpu_slo_compliance":
         "Serving SLO: fraction of requests in the compliance window "
         "meeting each objective (ttft/tpot/e2e; serving/slo.py).",

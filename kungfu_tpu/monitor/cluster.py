@@ -28,7 +28,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from . import MONITOR_PORT_OFFSET, _esc
 
 __all__ = ["scrape", "merge_metrics", "aggregate", "phase_shares",
-           "peer_rates", "MONITOR_PORT_OFFSET"]
+           "peer_rates", "serving_stats", "fleet_quantile",
+           "fleet_lines", "MONITOR_PORT_OFFSET"]
 
 # Self-observability: failed scrapes per instance since this process
 # started.  Process-wide (module-level) on purpose — the n=100 failure
@@ -144,6 +145,197 @@ def peer_rates(text: str) -> "dict":
     return rates
 
 
+# kffleet serving-role detection + per-replica digest out of a raw
+# exposition.  A target is a serving replica iff its scrape carries the
+# serving-journal families (trainers never publish them), so the
+# aggregator LEARNS roles from the data instead of being told.
+_SERVE_FAMILIES = ("kungfu_tpu_serving_ttft_seconds",
+                   "kungfu_tpu_serving_tpot_seconds",
+                   "kungfu_tpu_serving_queue_wait_seconds")
+# the digest keys the full family down to the short latency name
+# ("ttft"/"tpot"/"queue_wait") so callers index compactly
+_SERVE_KEY = {f: f.split("_serving_")[1].rsplit("_seconds", 1)[0]
+              for f in _SERVE_FAMILIES}
+_SERVE_Q_RE = re.compile(
+    r'^(' + '|'.join(_SERVE_FAMILIES) +
+    r')\{quantile="([^"]+)"\} ([0-9eE.+-]+)$')
+_SERVE_CNT_RE = re.compile(
+    r'^(' + '|'.join(_SERVE_FAMILIES) +
+    r')_count ([0-9eE.+-]+)$')
+_SERVE_ADM_RE = re.compile(
+    r'^kungfu_tpu_serving_admitted_total ([0-9eE.+-]+)$')
+_SERVE_PFX_RE = re.compile(
+    r'^kungfu_tpu_serving_prefix_hit_rate ([0-9eE.+-]+)$')
+_SERVE_BURN_RE = re.compile(
+    r'^kungfu_tpu_slo_budget_burn\{objective="([^"]+)"\}'
+    r' ([0-9eE.+-]+)$')
+
+
+def serving_stats(text: str) -> "dict":
+    """Digest one replica's /metrics text into the per-replica window
+    the fleet join consumes: latency quantiles + observation counts
+    (``ttft``/``tpot``/``queue_wait`` dicts and ``*_count``), the
+    ``admitted`` counter, ``prefix_hit_rate``, and per-objective
+    ``burn``.  Empty dict for non-serving workers (role detection)."""
+    st: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        m = _SERVE_Q_RE.match(line)
+        if m:
+            try:
+                st.setdefault(_SERVE_KEY[m.group(1)],
+                              {})[m.group(2)] = float(m.group(3))
+            except ValueError:
+                pass
+            continue
+        m = _SERVE_CNT_RE.match(line)
+        if m:
+            try:
+                st[f"{_SERVE_KEY[m.group(1)]}_count"] = \
+                    float(m.group(2))
+            except ValueError:
+                pass
+            continue
+        m = _SERVE_ADM_RE.match(line)
+        if m:
+            try:
+                st["admitted"] = float(m.group(1))
+            except ValueError:
+                pass
+            continue
+        m = _SERVE_PFX_RE.match(line)
+        if m:
+            try:
+                st["prefix_hit_rate"] = float(m.group(1))
+            except ValueError:
+                pass
+            continue
+        m = _SERVE_BURN_RE.match(line)
+        if m:
+            try:
+                st.setdefault("burn", {})[m.group(1)] = \
+                    float(m.group(2))
+            except ValueError:
+                pass
+    # role marker: the TTFT summary only exists on serving replicas,
+    # and its _count is the exactly-once per-FINISHED-request weight
+    # every fleet join below leans on
+    if "ttft_count" not in st:
+        return {}
+    return st
+
+
+def fleet_quantile(pairs: "List[Tuple[float, float]]",
+                   q: float) -> Optional[float]:
+    """Count-weighted quantile-of-quantiles: ``pairs`` are
+    ``(replica_quantile_value, replica_observation_count)``.  Weighting
+    by each replica's TTFT ``_count`` (one observation per FINISHED
+    request — preempted-then-finished requests land exactly once; the
+    per-admission families would double-count them) makes a busy
+    replica's tail dominate a mostly-idle one's instead of averaging
+    them away.  ``None`` when no replica carries weight."""
+    total = sum(w for _, w in pairs if w > 0)
+    if total <= 0:
+        return None
+    acc = 0.0
+    last = None
+    for v, w in sorted(p for p in pairs if p[1] > 0):
+        acc += w
+        last = v
+        if acc >= q * total - 1e-12:
+            return v
+    return last
+
+
+def _spread(values: "List[float]") -> float:
+    """Load-imbalance index: (max-min)/median, 0 when balanced or
+    degenerate (median 0 — nothing admitted anywhere yet)."""
+    if len(values) < 2:
+        return 0.0
+    vs = sorted(values)
+    med = vs[(len(vs) - 1) // 2]
+    if med <= 0:
+        return 0.0
+    return (vs[-1] - vs[0]) / med
+
+
+def fleet_lines(serving: "List[Tuple[str, dict]]") -> "List[str]":
+    """Join per-replica serving digests into the fleet exposition
+    lines appended to /cluster_metrics (HELP/TYPE included)."""
+    if not serving:
+        return []
+    out: List[str] = []
+    out.append("# HELP kungfu_tpu_fleet_serving_replicas replicas "
+               "whose scrape carried serving-journal families this "
+               "aggregation pass.")
+    out.append("# TYPE kungfu_tpu_fleet_serving_replicas gauge")
+    out.append(f"kungfu_tpu_fleet_serving_replicas {len(serving)}")
+
+    for fam, key in (("kungfu_tpu_fleet_ttft_ms", "ttft"),
+                     ("kungfu_tpu_fleet_tpot_ms", "tpot")):
+        qlines: List[str] = []
+        quantiles = sorted({q for _i, st in serving
+                            for q in st.get(key, ())})
+        for q in quantiles:
+            pairs = [(st[key][q], st.get(f"{key}_count", 0.0))
+                     for _i, st in serving if q in st.get(key, ())]
+            fv = fleet_quantile(pairs, float(q))
+            if fv is not None:
+                qlines.append(f'{fam}{{quantile="{_esc(q)}"}} '
+                              f'{fv * 1e3:.6g}')
+        if qlines:
+            out.append(f"# HELP {fam} count-weighted fleet percentile "
+                       f"of per-replica {key} quantiles (ms).")
+            out.append(f"# TYPE {fam} gauge")
+            out.extend(qlines)
+
+    objectives = sorted({o for _i, st in serving
+                         for o in st.get("burn", ())})
+    if objectives:
+        out.append("# HELP kungfu_tpu_fleet_slo_budget_burn finished-"
+                   "count-weighted aggregate error-budget burn per "
+                   "objective across serving replicas.")
+        out.append("# TYPE kungfu_tpu_fleet_slo_budget_burn gauge")
+        for obj in objectives:
+            num = den = 0.0
+            for _i, st in serving:
+                if obj in st.get("burn", {}):
+                    w = max(st.get("ttft_count", 0.0), 0.0)
+                    num += st["burn"][obj] * w
+                    den += w
+            if den > 0:
+                out.append(
+                    f'kungfu_tpu_fleet_slo_budget_burn{{'
+                    f'objective="{_esc(obj)}"}} {num / den:.6g}')
+
+    out.append("# HELP kungfu_tpu_fleet_load_imbalance (max-min)/"
+               "median spread of per-replica load per signal; 0 = "
+               "balanced.")
+    out.append("# TYPE kungfu_tpu_fleet_load_imbalance gauge")
+    adm = [st.get("admitted", 0.0) for _i, st in serving]
+    out.append(f'kungfu_tpu_fleet_load_imbalance{{'
+               f'signal="admitted"}} {_spread(adm):.6g}')
+    qw = [st["queue_wait"]["0.5"] for _i, st in serving
+          if "0.5" in st.get("queue_wait", {})]
+    out.append(f'kungfu_tpu_fleet_load_imbalance{{'
+               f'signal="queue_wait_p50"}} {_spread(qw):.6g}')
+
+    num = den = 0.0
+    for _i, st in serving:
+        if "prefix_hit_rate" in st:
+            w = max(st.get("admitted", 0.0), 0.0)
+            num += st["prefix_hit_rate"] * w
+            den += w
+    if den > 0:
+        out.append("# HELP kungfu_tpu_fleet_prefix_hit_rate admission-"
+                   "weighted mean of per-replica prefix cache hit "
+                   "rates.")
+        out.append("# TYPE kungfu_tpu_fleet_prefix_hit_rate gauge")
+        out.append(f"kungfu_tpu_fleet_prefix_hit_rate "
+                   f"{num / den:.6g}")
+    return out
+
+
 def aggregate(targets: Iterable[Tuple[str, int]],
               timeout: float = 2.0,
               history: Optional["object"] = None) -> str:
@@ -164,6 +356,7 @@ def aggregate(targets: Iterable[Tuple[str, int]],
     links: List[Tuple[str, str, str, float]] = []  # src, dst, dir, rate
     durs: List[Tuple[str, float]] = []
     errs: List[Tuple[str, int]] = []
+    serving: List[Tuple[str, "dict"]] = []
     for host, port in targets:
         instance = f"{host}:{port}"
         t0 = time.perf_counter()
@@ -176,6 +369,9 @@ def aggregate(targets: Iterable[Tuple[str, int]],
             sh = phase_shares(text)
             if sh:
                 shares.append((instance, sh))
+            sv = serving_stats(text)
+            if sv:
+                serving.append((instance, sv))
             for (direction, tgt), rate in sorted(peer_rates(text).items()):
                 # the measuring side is `instance`: its egress rate is
                 # the link instance->target, its ingress rate the link
@@ -259,4 +455,8 @@ def aggregate(targets: Iterable[Tuple[str, int]],
                 f'kungfu_tpu_peer_bandwidth_bytes_s{{'
                 f'direction="{_esc(direction)}",dst="{_esc(dst)}",'
                 f'src="{_esc(src)}"}} {rate:.9g}')
+    # kffleet: serving-role targets' windows joined into fleet gauges,
+    # pre-digested so one scrape of /cluster_metrics feeds the fleet
+    # detectors and kft-doctor --url
+    up_lines.extend(fleet_lines(serving))
     return body + "\n".join(up_lines) + "\n"

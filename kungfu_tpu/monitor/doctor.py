@@ -37,7 +37,19 @@ interprets them.  This module is that layer:
     real peer targets) sits below the cluster lower-median by
     ``KFT_DOCTOR_SLOWLINK``x for ``KFT_DOCTOR_WINDOWS`` scrape
     windows; the evidence carries the instance's bandwidth-matrix row
-    and egress-vs-ingress asymmetry naming the slow direction.
+    and egress-vs-ingress asymmetry naming the slow direction;
+  * **replica-outlier** (kffleet) — one serving replica whose TTFT p50
+    exceeds the fleet lower-median by ``KFT_FLEET_OUTLIER_SKEW``x for
+    ``KFT_DOCTOR_WINDOWS`` windows (the serving twin of the straggler
+    detector, same degenerate-safety discipline);
+  * **fleet-slo** (kffleet) — sustained finished-count-weighted
+    AGGREGATE budget burn above ``KFT_FLEET_BURN`` across all serving
+    replicas: a capacity problem, not a replica problem — the evidence
+    names the dominant replica and lifecycle phase to look at first;
+  * **imbalance** (kffleet) — one replica admitting
+    ``KFT_FLEET_IMBALANCE``x below the fleet-median rate under a
+    balanced front-end while its queue wait runs hot: a slow replica
+    soaking up latency, named for draining.
 
 - :class:`Doctor` wraps history + detectors + export: findings are
   kftrace-traced on raise/clear, exported as
@@ -72,7 +84,8 @@ from .history import MetricsHistory
 __all__ = ["Finding", "Doctor", "PeerLatencyProber", "render_report",
            "detect_stragglers", "detect_interference",
            "detect_control_plane", "detect_perf", "detect_slo",
-           "detect_slowlink", "RUNNER_INSTANCE"]
+           "detect_slowlink", "detect_replica_outlier",
+           "detect_fleet_slo", "detect_imbalance", "RUNNER_INSTANCE"]
 
 # the launcher's own metrics live in the history under this pseudo
 # instance (lease ages, rpc outage gauges — the control-plane signals)
@@ -552,6 +565,252 @@ def detect_slo(history: MetricsHistory, *,
     return findings
 
 
+def _serving_instances(history: MetricsHistory, stale_s: float,
+                       min_windows: int) -> Dict[str, List]:
+    """Fresh instances with a serving-journal window: the TTFT summary
+    only exists on serving replicas, so its presence IS the role (the
+    same detection monitor/cluster.py's fleet join uses).  Returns
+    ``{instance: ttft_p50_points}`` with at least ``min_windows``
+    points each."""
+    out: Dict[str, List] = {}
+    for inst in _fresh_instances(history, stale_s):
+        pts = history.series(inst, "kungfu_tpu_serving_ttft_seconds",
+                             {"quantile": "0.5"})
+        if len(pts) >= min_windows:
+            out[inst] = pts
+    return out
+
+
+def detect_replica_outlier(history: MetricsHistory, *,
+                           skew: float = 2.0, min_windows: int = 3,
+                           stale_s: float = 60.0,
+                           ranks: Optional[Dict[str, int]] = None,
+                           version: Optional[int] = None
+                           ) -> List[Finding]:
+    """kffleet: one serving replica's latency vs the fleet.
+
+    A replica whose TTFT p50 exceeded the fleet (lower-)median by
+    ``skew``x in each of the last ``min_windows`` scrape windows gets a
+    Finding — the serving twin of :func:`detect_stragglers`, with the
+    same degenerate-safety: >= 2 serving replicas required (a lone
+    replica has no fleet to lag behind), lower-median so at n=2 the
+    baseline is the FAST replica, stale instances excluded so a
+    departed replica's frozen window cannot drag the median.  Queue
+    wait p50 rides along as evidence: elevated wait on the same
+    replica says the slot pool is the bottleneck (overload/throttle),
+    flat wait says the service time itself grew (slow host)."""
+    series: Dict[str, List[float]] = {}
+    waits: Dict[str, float] = {}
+    for inst, pts in _serving_instances(history, stale_s,
+                                        min_windows).items():
+        series[inst] = [v for _ts, v in pts[-min_windows:]]
+        w = history.series(inst, "kungfu_tpu_serving_queue_wait_seconds",
+                           {"quantile": "0.5"})
+        if w:
+            waits[inst] = w[-1][1]
+    if len(series) < 2:
+        return []
+    medians = [_lower_median([vals[w] for vals in series.values()])
+               for w in range(min_windows)]
+    findings: List[Finding] = []
+    for inst, vals in sorted(series.items()):
+        ratios = [v / m for v, m in zip(vals, medians) if m > 0]
+        if len(ratios) < min_windows or not all(r > skew for r in ratios):
+            continue
+        mean_ratio = sum(ratios) / len(ratios)
+        wait_vals = [w for i, w in waits.items() if i != inst]
+        evidence: Dict[str, object] = {
+            "ttft_p50_s": round(vals[-1], 6),
+            "fleet_median_s": round(medians[-1], 6),
+            "skew_ratio": round(mean_ratio, 3),
+        }
+        if inst in waits:
+            evidence["queue_wait_p50_s"] = round(waits[inst], 6)
+        if wait_vals:
+            evidence["fleet_wait_p50_s"] = round(
+                _lower_median(wait_vals), 6)
+        findings.append(Finding(
+            kind="replica-outlier",
+            severity=SEV_CRITICAL if mean_ratio > 2 * skew else SEV_WARN,
+            instance=inst,
+            rank=(ranks or {}).get(inst),
+            windows=min_windows,
+            evidence=evidence,
+            action="inspect the replica's host (co-tenancy, thermal "
+                   "throttle); elevated queue_wait says slots are the "
+                   "bottleneck — add capacity or drain the replica "
+                   "behind the router; flat wait says the service time "
+                   "grew — profile it",
+            version=version, detected_ts=time.time()))
+    return findings
+
+
+def detect_fleet_slo(history: MetricsHistory, *,
+                     burn: float = 2.0, min_windows: int = 3,
+                     stale_s: float = 60.0,
+                     ranks: Optional[Dict[str, int]] = None,
+                     version: Optional[int] = None) -> List[Finding]:
+    """kffleet: sustained AGGREGATE error-budget burn, per objective.
+
+    Joins per-replica ``kungfu_tpu_slo_budget_burn{objective}`` windows
+    into a fleet burn — weighted by each replica's TTFT ``_count``
+    (one observation per FINISHED request, so preempted-then-finished
+    requests weigh exactly once) — and fires when the fleet burn sat
+    above ``burn`` in each of the last ``min_windows`` windows.  One
+    replica at 8x burn serving 10% of traffic is a replica problem
+    (:func:`detect_replica_outlier`); the FLEET burning its budget is a
+    capacity problem, so the Finding's instance is ``fleet`` and the
+    evidence names the dominant replica and its dominant lifecycle
+    phase so the operator knows where to look first."""
+    insts = _serving_instances(history, stale_s, min_windows)
+    if not insts:
+        return []
+    burns: Dict[str, Dict[str, List[float]]] = {}
+    weights: Dict[str, List[float]] = {}
+    objectives: set = set()
+    for inst in insts:
+        cnt = history.series(inst, "kungfu_tpu_serving_ttft_seconds_count",
+                             {})
+        if len(cnt) < min_windows:
+            continue
+        weights[inst] = [v for _ts, v in cnt[-min_windows:]]
+        for obj in sorted(history.label_values(
+                inst, "kungfu_tpu_slo_budget_burn", "objective")):
+            pts = history.series(inst, "kungfu_tpu_slo_budget_burn",
+                                 {"objective": obj})
+            if len(pts) < min_windows:
+                continue
+            burns.setdefault(inst, {})[obj] = \
+                [v for _ts, v in pts[-min_windows:]]
+            objectives.add(obj)
+    findings: List[Finding] = []
+    now = time.time()
+    for obj in sorted(objectives):
+        fleet: List[float] = []
+        for w in range(min_windows):
+            num = den = 0.0
+            for inst, per_obj in burns.items():
+                if obj not in per_obj:
+                    continue
+                wt = max(weights.get(inst, [0.0] * min_windows)[w], 0.0)
+                num += per_obj[obj][w] * wt
+                den += wt
+            if den <= 0:
+                break
+            fleet.append(num / den)
+        if len(fleet) < min_windows or not all(v > burn for v in fleet):
+            continue
+        mean_burn = sum(fleet) / len(fleet)
+        # dominant replica: highest last-window weighted contribution
+        dom, dom_burn = None, 0.0
+        for inst, per_obj in burns.items():
+            if obj in per_obj and per_obj[obj][-1] >= dom_burn:
+                dom, dom_burn = inst, per_obj[obj][-1]
+        shares: Dict[str, float] = {}
+        if dom is not None:
+            for phase in ("queue", "prefill", "decode"):
+                p = history.series(dom, "kungfu_tpu_serving_phase_share",
+                                   {"phase": phase})
+                if p:
+                    shares[phase] = p[-1][1]
+        dominant = (max(shares, key=lambda p: shares[p])
+                    if shares else "queue")
+        evidence: Dict[str, object] = {
+            "objective": obj,
+            "fleet_burn": round(mean_burn, 3),
+            "threshold": burn,
+            "replicas": len(burns),
+            "dominant_replica": dom or "?",
+            "dominant_replica_burn": round(dom_burn, 3),
+            "dominant_phase": dominant,
+        }
+        findings.append(Finding(
+            kind="fleet-slo",
+            severity=(SEV_CRITICAL if mean_burn > 2 * burn
+                      else SEV_WARN),
+            instance="fleet",
+            rank=None,
+            windows=min_windows,
+            evidence=evidence,
+            action="the fleet is burning its error budget, not one "
+                   "replica: add serving capacity (replicas/slots) or "
+                   "shed load upstream; start at the dominant replica "
+                   "and phase — " + _SLO_ACTIONS[dominant],
+            version=version, detected_ts=now))
+    return findings
+
+
+def detect_imbalance(history: MetricsHistory, *,
+                     factor: float = 2.0, min_windows: int = 3,
+                     stale_s: float = 60.0,
+                     ranks: Optional[Dict[str, int]] = None,
+                     version: Optional[int] = None) -> List[Finding]:
+    """kffleet: skewed admitted load under a balanced front-end.
+
+    A round-robin front-end offers every replica the same request
+    stream; a replica that ADMITS ``factor``x fewer than the fleet
+    median over the recent windows while its queue wait sits above the
+    fleet's is a slow replica soaking up latency — the Finding names
+    it.  Admission growth comes from consecutive-window deltas of the
+    ``kungfu_tpu_serving_admitted_total`` counter (absolute totals
+    only say who had a busy past).  Degenerate-safe: >= 2 serving
+    replicas, UPPER median (at n=2 the baseline must be the
+    fast/high-admitting replica, mirroring the lower-median trick in
+    :func:`detect_stragglers` for an inverted signal), an idle fleet
+    (zero median growth in any window) is inconclusive."""
+    deltas: Dict[str, List[float]] = {}
+    waits: Dict[str, float] = {}
+    for inst in _serving_instances(history, stale_s, 1):
+        pts = history.series(inst, "kungfu_tpu_serving_admitted_total",
+                             {})
+        if len(pts) < min_windows + 1:
+            continue
+        tail = [v for _ts, v in pts[-(min_windows + 1):]]
+        deltas[inst] = [b - a for a, b in zip(tail, tail[1:])]
+        w = history.series(inst, "kungfu_tpu_serving_queue_wait_seconds",
+                           {"quantile": "0.5"})
+        if w:
+            waits[inst] = w[-1][1]
+    if len(deltas) < 2:
+        return []
+    medians = []
+    for w in range(min_windows):
+        vals = sorted(d[w] for d in deltas.values())
+        medians.append(vals[len(vals) // 2])  # upper median
+    if any(m <= 0 for m in medians):
+        return []
+    findings: List[Finding] = []
+    for inst, vals in sorted(deltas.items()):
+        ratios = [v / m for v, m in zip(vals, medians)]
+        if not all(r < 1.0 / factor for r in ratios):
+            continue
+        peer_waits = [w for i, w in waits.items() if i != inst]
+        fleet_wait = _lower_median(peer_waits) if peer_waits else 0.0
+        mean_ratio = sum(ratios) / len(ratios)
+        evidence: Dict[str, object] = {
+            "admitted_per_window": round(vals[-1], 1),
+            "fleet_median_per_window": round(medians[-1], 1),
+            "ratio": round(mean_ratio, 4),
+        }
+        if inst in waits:
+            evidence["queue_wait_p50_s"] = round(waits[inst], 6)
+        evidence["fleet_wait_p50_s"] = round(fleet_wait, 6)
+        findings.append(Finding(
+            kind="imbalance",
+            severity=(SEV_CRITICAL if mean_ratio < 0.5 / factor
+                      else SEV_WARN),
+            instance=inst,
+            rank=(ranks or {}).get(inst),
+            windows=min_windows,
+            evidence=evidence,
+            action="the front-end offers this replica the same load it "
+                   "offers everyone, but it admits a fraction of the "
+                   "fleet rate — it is slow, not idle; drain it behind "
+                   "the router, inspect the host, or shrink its share",
+            version=version, detected_ts=time.time()))
+    return findings
+
+
 class Doctor:
     """History + detector suite + export.
 
@@ -578,6 +837,9 @@ class Doctor:
     KFT_DOCTOR_BURN        2.0      slo: sustained error-budget burn
     KFT_DOCTOR_SLOWLINK    4.0      slowlink: median / pull-bw required
     KFT_DOCTOR_SLOWLINK_MIN_BPS  1024.0  slowlink: idle-cluster floor
+    KFT_FLEET_OUTLIER_SKEW 2.0      replica-outlier: ttft / fleet median
+    KFT_FLEET_BURN         2.0      fleet-slo: aggregate burn alarm
+    KFT_FLEET_IMBALANCE    2.0      imbalance: median / admitted-rate
     =====================  =======  =====================================
     """
 
@@ -599,6 +861,9 @@ class Doctor:
         self.burn = knobs.get("KFT_DOCTOR_BURN")
         self.slowlink = knobs.get("KFT_DOCTOR_SLOWLINK")
         self.slowlink_min_bps = knobs.get("KFT_DOCTOR_SLOWLINK_MIN_BPS")
+        self.outlier_skew = knobs.get("KFT_FLEET_OUTLIER_SKEW")
+        self.fleet_burn = knobs.get("KFT_FLEET_BURN")
+        self.imbalance = knobs.get("KFT_FLEET_IMBALANCE")
         self._active: Dict[Tuple[str, str], Finding] = {}
         self._raised_ts: Dict[Tuple[str, str], float] = {}
         self.last: List[Finding] = []
@@ -638,7 +903,20 @@ class Doctor:
                               min_bps=self.slowlink_min_bps,
                               min_windows=self.min_windows,
                               stale_s=self.stale_s,
-                              ranks=ranks, version=version))
+                              ranks=ranks, version=version)
+            + detect_replica_outlier(self.history,
+                                     skew=self.outlier_skew,
+                                     min_windows=self.min_windows,
+                                     stale_s=self.stale_s,
+                                     ranks=ranks, version=version)
+            + detect_fleet_slo(self.history, burn=self.fleet_burn,
+                               min_windows=self.min_windows,
+                               stale_s=self.stale_s,
+                               ranks=ranks, version=version)
+            + detect_imbalance(self.history, factor=self.imbalance,
+                               min_windows=self.min_windows,
+                               stale_s=self.stale_s,
+                               ranks=ranks, version=version))
         self._export(findings)
         self.last = findings
         return findings

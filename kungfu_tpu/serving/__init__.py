@@ -9,10 +9,19 @@ the decode-serving gap called out as explicit future work in round 2.
     results = eng.run([Request(uid=0, prompt=[...], max_new=64), ...])
     print(eng.stats.summary())
 """
-from .cache import (init_paged_pools, paged_decode_attend, paged_gather,
-                    paged_write_prompt, paged_write_token)
-from .engine import DecodeEngine, EngineStats, Request
-from .server import ServingServer
+from ..utils import knobs as _knobs
+
+# kfsim lite mode (same gate as the top-level package): the fake
+# serving replicas of kungfu_tpu/sim/serving.py reuse serving/slo.py's
+# RequestJournal + SLO registry but must never pay the jax import the
+# engine/cache modules carry — that is what makes 20-replica fleets
+# affordable on one box (pinned by test).
+if not bool(_knobs.get("KFT_SIM_LITE")):
+    from .cache import (init_paged_pools, paged_decode_attend,
+                        paged_gather, paged_write_prompt,
+                        paged_write_token)
+    from .engine import DecodeEngine, EngineStats, Request
+    from .server import ServingServer
 
 __all__ = ["DecodeEngine", "EngineStats", "Request", "ServingServer",
            "init_paged_pools", "paged_decode_attend", "paged_gather",

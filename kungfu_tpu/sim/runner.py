@@ -21,10 +21,12 @@ import contextlib
 import glob
 import json
 import os
+import random
 import signal
 import sys
 import tempfile
 import threading
+import time
 import types
 from typing import List, Optional
 
@@ -47,6 +49,16 @@ SIM_WORKER = (
     "sys.exit(main())\n"
 )
 
+# The kffleet payload (``sim_serve`` scenarios): fake serving REPLICAS
+# (sim/serving.py) under the same watcher instead of fake trainers —
+# same env ABI, same lite-import contract, same pid-marker trick.
+SIM_SERVE_WORKER = (
+    "import os, sys\n"
+    "os.environ.setdefault('KFT_SIM_LITE', '1')\n"
+    "from kungfu_tpu.sim.serving import main\n"
+    "sys.exit(main())\n"
+)
+
 # Worker base port chosen so that BOTH the worker range and the metrics
 # range (port + MONITOR_PORT_OFFSET) sit below the kernel's default
 # ephemeral floor (net.ipv4.ip_local_port_range starts at 32768): a
@@ -64,6 +76,76 @@ SIM_BASE_PORT = 21100
 # serving no /metrics when its bind loses a race.
 _BASE_LOCK = threading.Lock()
 _BASE_CURSOR = [SIM_BASE_PORT]
+
+
+class _ServeLoadDriver(threading.Thread):
+    """Drive a :func:`~kungfu_tpu.sim.serving.synth_diurnal_schedule`
+    arrival plan AT a sim serving fleet, round-robin over the replicas
+    — the runner-side half of a ``sim_serve`` scenario.  Each arrival
+    fires a non-streaming ``POST /generate`` on its own daemon thread
+    (the replica holds the connection until the request finishes, so a
+    blocking dispatch loop would serialize the offered load down to one
+    slot).  Request failures are swallowed without retry: a replica
+    refusing mid-kill IS the scenario, and the journal invariants are
+    asserted over what the fleet actually recorded, not over what the
+    driver hoped to land."""
+
+    def __init__(self, cluster, serve_load):
+        super().__init__(daemon=True, name="kfsim-serve-load")
+        from .serving import synth_diurnal_schedule
+        spec = dict(serve_load)
+        # replicas bind their serve ports during the watcher's spawn
+        # storm; hold the first arrival until the fleet is listening
+        self.warmup_s = float(spec.pop("warmup_s", 1.5))
+        self.seed = int(spec.get("seed", 0))
+        self.offs, self.plens, self.outs = synth_diurnal_schedule(**spec)
+        self.urls = [f"http://{p.host}:{p.port}/generate"
+                     for p in cluster.workers]
+        self.stop_event = threading.Event()
+        self._lock = threading.Lock()
+        self.sent = 0
+        self.ok = 0
+        self._threads: List[threading.Thread] = []
+
+    def _one(self, i: int) -> None:
+        import urllib.request
+        # deterministic prompt content per arrival index: same seed =>
+        # same prompts => the replicas' prefix caches see one stream
+        rng = random.Random((self.seed << 21) ^ i)
+        prompt = [rng.randrange(1, 30000) for _ in range(self.plens[i])]
+        body = json.dumps({"prompt": prompt,
+                           "max_new": self.outs[i]}).encode()
+        req = urllib.request.Request(
+            self.urls[i % len(self.urls)], data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60.0) as r:
+                r.read()
+        except OSError:
+            return            # killed/draining replica: expected
+        with self._lock:
+            self.ok += 1
+
+    def run(self) -> None:
+        t0 = time.monotonic() + self.warmup_s
+        for i, off in enumerate(self.offs):
+            delay = t0 + off - time.monotonic()
+            if delay > 0 and self.stop_event.wait(delay):
+                return
+            th = threading.Thread(target=self._one, args=(i,),
+                                  daemon=True, name=f"kfsim-load-{i}")
+            th.start()
+            with self._lock:
+                self.sent += 1
+                self._threads.append(th)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        self.join(timeout=10)
+        with self._lock:
+            threads = list(self._threads)
+        for th in threads:
+            th.join(timeout=10)
 
 
 def _alloc_base_port(nprocs: int) -> int:
@@ -109,7 +191,7 @@ class SimClusterRunner:
                                    dir=self.out_root)
         script = os.path.join(out_dir, "sim_worker.py")
         with open(script, "w") as f:
-            f.write(SIM_WORKER)
+            f.write(SIM_SERVE_WORKER if sc.sim_serve else SIM_WORKER)
         plan_path = os.path.join(out_dir, "plan.json")
         sc.plan.save(plan_path)
         log_prefix = os.path.join(out_dir, "chaos-log")
@@ -140,6 +222,10 @@ class SimClusterRunner:
             # watch_run directly (lease_ttl_s), not through env
             "KFT_HEARTBEAT_S": str(sc.sim_heartbeat_s),
         }
+        # scenario knob overrides ride the worker env exactly like the
+        # real tier (chaos/runner.py): SLO targets, serve slots,
+        # service-time scales for the sim_serve scenarios
+        env.update(sc.env)
         if self.verbose:
             print(f"kfsim: scenario {sc.name}: {sc.nprocs} fake "
                   f"workers, target {target} samples, "
@@ -159,6 +245,7 @@ class SimClusterRunner:
             sc, types.SimpleNamespace(url=url), out_dir)
         sampler = None
         psampler = None
+        driver = None
         watchdog = threading.Timer(sc.timeout_s,
                                    self._kill_fleet, args=(out_dir,))
         watchdog.daemon = True
@@ -171,6 +258,9 @@ class SimClusterRunner:
             if sc.policy_expect is not None:
                 psampler = _PolicySampler(cluster, out_dir)
                 psampler.start()
+            if sc.serve_load is not None:
+                driver = _ServeLoadDriver(cluster, sc.serve_load)
+                driver.start()
             watchdog.start()
             # worker settings ride the Job (NOT os.environ): two
             # concurrent runs in one process must not bleed plans,
@@ -184,6 +274,8 @@ class SimClusterRunner:
                            lease_ttl_s=sc.sim_lease_ttl_s)
         finally:
             watchdog.cancel()
+            if driver is not None:
+                driver.stop()
             if sampler is not None:
                 sampler.stop()
             if psampler is not None:
@@ -203,11 +295,21 @@ class SimClusterRunner:
                 f"SIGKILLed by the watchdog)")
         elif rc != 0:
             violations.append(f"job exited rc={rc} (expected 0)")
-        violations += invariants.run_all(
-            events, pids=pids,
-            oracle_wsum=lambda samples: sim_wsum(
-                sc.sim_seed, samples // sc.batch),
-            pid_marker=script)
+        if sc.sim_serve:
+            # serving fleets hold no shared training progress: journal
+            # conservation + membership agreement instead of
+            # single-winner/trajectory
+            violations += invariants.run_serving(
+                events, pids=pids, pid_marker=script)
+            if driver is not None and self.verbose:
+                print(f"kfsim: load driver: {driver.sent} sent, "
+                      f"{driver.ok} ok", flush=True)
+        else:
+            violations += invariants.run_all(
+                events, pids=pids,
+                oracle_wsum=lambda samples: sim_wsum(
+                    sc.sim_seed, samples // sc.batch),
+                pid_marker=script)
         if sc.expect_violation:
             import re as _re
             matched = [v for v in violations
@@ -220,7 +322,9 @@ class SimClusterRunner:
         if sc.doctor_expect:
             found = (list(sampler.seen.values())
                      if sampler is not None else [])
-            violations += doctor_violations(sc.doctor_expect, found)
+            active = sampler.last_active if sampler is not None else set()
+            violations += doctor_violations(sc.doctor_expect, found,
+                                            active=active)
         if sc.policy_expect:
             decisions = (psampler.decisions
                          if psampler is not None else [])

@@ -273,6 +273,150 @@ def sim_scenarios() -> Dict[str, Scenario]:
             sim_drain_s=300.0,
             policy_expect={"zero_would_act": True},
             timeout_s=480.0),
+        # ---- kffleet: fake serving replicas (sim/serving.py) under the
+        # same watcher, runner-driven synthetic load, journal-
+        # conservation invariants (docs/serving.md "Fleet
+        # observability").  serve_load feeds synth_diurnal_schedule;
+        # warmup_s holds the first arrival until the spawn storm binds
+        # every serve port.
+        Scenario(
+            name="sim-serve-smoke",
+            desc="4 fake serving replicas, ~3s of diurnal load with a "
+                 "forced preempt/re-admit every 3rd request: every "
+                 "replica's final must conserve its request journal "
+                 "(finished + evicted == submitted, open == 0 — "
+                 "preempted-then-finished requests count exactly once) "
+                 "and all finals agree on one membership — the CI "
+                 "floor, no data plane, runs everywhere",
+            plan=Plan(seed=None),
+            tier="sim",
+            sim_serve=True,
+            nprocs=4,
+            target_steps=12,
+            sim_step_s=0.25,
+            serve_load={"seed": 7, "duration_s": 2.5, "base_rps": 10.0,
+                        "peak_rps": 20.0, "prompt_len": 8, "max_new": 8,
+                        "warmup_s": 1.25},
+            env={"KFT_SIM_SERVE_PREEMPT_EVERY": "3"},
+            min_served=15,
+            timeout_s=120.0),
+        Scenario(
+            name="sim-serve-spike-20",
+            desc="20 fake serving replicas sized to ~2.4 rps each "
+                 "(1 slot, 50ms decode tick), a 100 rps square spike "
+                 "mid-diurnal: queue waits blow the 100ms TTFT SLO "
+                 "fleet-wide, the doctor's fleet-slo finding must RAISE "
+                 "during the spike and CLEAR once the post-spike "
+                 "traffic flushes the per-replica SLO windows "
+                 "(raise-then-clear, the transient-finding contract)",
+            plan=Plan(seed=None),
+            tier="sim",
+            sim_serve=True,
+            nprocs=20,
+            # serving window (~24s) must outlast warmup + the 20s load
+            # so the flushed (compliant) windows are scraped LIVE
+            target_steps=60,
+            sim_step_s=0.4,
+            serve_load={"seed": 11, "duration_s": 20.0,
+                        "base_rps": 15.0, "peak_rps": 30.0,
+                        "spike_rps": 100.0,
+                        "spike_window": (0.3, 0.375),
+                        "prompt_len": 8, "max_new": 8,
+                        "warmup_s": 3.0},
+            # 1 slot x ~408ms service => overload needs only a modest
+            # spike; a 6-request SLO window clears within the tail
+            env={"KFT_SIM_SERVE_SLOTS": "1",
+                 "KFT_SIM_SERVE_DECODE_MS": "50.0",
+                 "KFT_SLO_TTFT_MS": "100",
+                 "KFT_SLO_WINDOW": "6"},
+            sim_lease_ttl_s=30.0,
+            sim_drain_s=180.0,
+            doctor_expect={"kind": "fleet-slo", "rank": None,
+                           "cleared": True},
+            min_served=150,
+            timeout_s=300.0),
+        Scenario(
+            name="sim-serve-imbalance-20",
+            desc="20 fake serving replicas behind the deterministic "
+                 "round-robin front-end, rank 0 throttled 4x "
+                 "(prefill+decode): detect_replica_outlier over the "
+                 "doctor's scrape windows must name exactly rank 0 "
+                 "(TTFT p50 vs the fleet lower-median) and no other",
+            plan=Plan(seed=None),
+            tier="sim",
+            sim_serve=True,
+            nprocs=20,
+            target_steps=24,
+            sim_step_s=0.3,
+            serve_load={"seed": 13, "duration_s": 6.0,
+                        "base_rps": 24.0, "peak_rps": 40.0,
+                        "prompt_len": 8, "max_new": 8,
+                        "warmup_s": 3.0},
+            # 2ms/token prefill widens the TTFT gap (16ms vs 64ms) far
+            # past the 2x skew threshold without saturating any slots
+            env={"KFT_SIM_SERVE_PREFILL_MS": "2.0",
+                 "KFT_SIM_SERVE_SLOW_RANKS": "0",
+                 "KFT_SIM_SERVE_SLOW_FACTOR": "4.0"},
+            sim_lease_ttl_s=30.0,
+            sim_drain_s=180.0,
+            doctor_expect={"kind": "replica-outlier", "rank": 0},
+            min_served=60,
+            timeout_s=240.0),
+        Scenario(
+            name="sim-serve-imbalance-20-clean",
+            desc="the outlier clean twin: 8 identical fake serving "
+                 "replicas, same load shape, NO throttled rank — the "
+                 "doctor must raise no replica-outlier finding on the "
+                 "whole run (false-positive guard for the skew "
+                 "threshold)",
+            plan=Plan(seed=None),
+            tier="sim",
+            sim_serve=True,
+            nprocs=8,
+            target_steps=24,
+            sim_step_s=0.3,
+            serve_load={"seed": 13, "duration_s": 6.0,
+                        "base_rps": 12.0, "peak_rps": 20.0,
+                        "prompt_len": 8, "max_new": 8,
+                        "warmup_s": 1.5},
+            env={"KFT_SIM_SERVE_PREFILL_MS": "2.0"},
+            sim_lease_ttl_s=30.0,
+            sim_drain_s=180.0,
+            doctor_expect={"absent_kind": "replica-outlier"},
+            min_served=30,
+            timeout_s=240.0),
+        Scenario(
+            name="sim-serve-replica-kill",
+            desc="6 fake serving replicas under load, rank 2 SIGKILLed "
+                 "at its 6th control tick (serve.tick): the watcher "
+                 "must absorb the death as a shrink (reap or lease "
+                 "escalation, whichever lands first — worker_up drops "
+                 "either way), survivors' finals must converge on the "
+                 "post-shrink membership, and every survivor's request "
+                 "journal must still conserve (the killed replica's "
+                 "in-flight requests die with it; the driver absorbs "
+                 "the refusals)",
+            # version=1 fences the kill to the ORIGINAL membership:
+            # faults are armed per-process, and after the shrink the
+            # renumbered holder of rank 2 would otherwise fire its own
+            # copy at its own 6th tick (see _wave_plan's windows)
+            plan=Plan(seed=None).add("serve.tick", "kill", rank=2,
+                                     step=6, version=1),
+            tier="sim",
+            sim_serve=True,
+            nprocs=6,
+            target_steps=20,
+            sim_step_s=0.3,
+            sim_heartbeat_s=0.3,
+            sim_lease_ttl_s=2.5,
+            serve_load={"seed": 17, "duration_s": 5.5,
+                        "base_rps": 12.0, "peak_rps": 20.0,
+                        "prompt_len": 8, "max_new": 8,
+                        "warmup_s": 1.25},
+            min_fired=1,
+            min_config_versions=2,
+            min_served=30,
+            timeout_s=180.0),
         Scenario(
             name="sim-spot-trace",
             desc="30 fake workers under a replayed spot-preemption "

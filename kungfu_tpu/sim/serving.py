@@ -1,0 +1,690 @@
+"""The kfsim fake serving replica (``python -m kungfu_tpu.sim.serving``).
+
+One OS process per fake replica, spawned by the production watcher
+exactly like :mod:`kungfu_tpu.sim.trainer`'s fake workers.  It speaks
+the REAL host plane:
+
+- config-server membership + epoch fencing through
+  :func:`~kungfu_tpu.elastic.config_server.fetch_config` (an excluded
+  replica detaches instead of serving ghost traffic);
+- liveness leases through the real
+  :class:`~kungfu_tpu.elastic.heartbeat.HeartbeatSender`
+  (tick-pumped ``POST /heartbeat``);
+- a real ``/metrics`` endpoint (worker port + ``MONITOR_PORT_OFFSET``)
+  that :func:`kungfu_tpu.monitor.cluster.aggregate` scrapes into the
+  fleet gauges;
+- the REAL :class:`~kungfu_tpu.serving.slo.RequestJournal` + SLO
+  objective registry over its request lifecycles — the burn/compliance
+  gauges are the production code path, not a simulation of it.
+
+Only the data plane is synthetic: ``/generate`` (the production HTTP
+contract of :class:`~kungfu_tpu.serving.ServingServer`, chunked-ndjson
+streaming included) is served by a deterministic service-time model —
+prefill proportional to prompt tokens, a per-token decode tick, a
+bounded seeded prefix cache whose hits shorten prefill and feed the
+``kungfu_tpu_serving_prefix_*`` gauges — so ``tools/kfload.py`` can
+drive a 20-replica fleet on one box with no jax import at all
+(``KFT_SIM_LITE=1``; the lite-import contract is pinned by test).
+
+Per-replica service times scale with ``KFT_SIM_SERVE_SLOW_RANKS`` /
+``KFT_SIM_SERVE_SLOW_FACTOR`` — the throttled-replica signal the
+imbalance/outlier detectors (monitor/doctor.py) must attribute.
+
+Termination mirrors the fake trainer: a replica serves for
+``KFT_CHAOS_TARGET / KFT_CHAOS_B`` ticks of ``KFT_SIM_STEP_S`` each,
+then drains on ``/health`` lease consensus so every survivor's
+``final`` event converges on one (version, size).  ``--port`` runs a
+STANDALONE replica (no launcher env ABI, no leases) that serves until
+SIGTERM — the shape ``kfload --fleet`` spawns for the committed
+FLEET_SERVING_BENCH.json.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import math
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import List, Optional, Tuple
+
+from ..chaos import point as _chaos_point
+from ..elastic.config_server import fetch_config, fetch_health
+from ..elastic.heartbeat import HeartbeatSender
+from ..launcher import env as E
+from ..monitor import MONITOR_PORT_OFFSET, get_monitor
+from ..serving.slo import RequestJournal
+from ..utils import knobs
+from ..utils.http import BackgroundHTTPServer
+
+_PREFIX_CACHE_CAP = 1024      # bounded seeded prefix-cache emulation
+_MAX_NEW_CAP = 256            # keep a hostile request from wedging a tick
+
+
+# ------------------------------------------------------- synthetic traces
+def synth_diurnal_schedule(seed: int, duration_s: float = 10.0,
+                           base_rps: float = 2.0, peak_rps: float = 8.0,
+                           prompt_len: int = 8, max_new: int = 8,
+                           spike_rps: float = 0.0,
+                           spike_window: Tuple[float, float] = (0.4, 0.65),
+                           ) -> Tuple[List[float], List[int], List[int]]:
+    """Seeded diurnal/bursty arrival schedule for kfload replay mode
+    (``--trace synth:diurnal:<seed>``) and the sim serving scenarios.
+
+    A non-homogeneous Poisson process by thinning: the rate follows one
+    diurnal sinusoid from ``base_rps`` up to ``peak_rps`` over
+    ``duration_s``, optionally overridden by a square ``spike_rps``
+    burst inside ``spike_window`` (fractions of the duration) — the
+    SLO-burn window sim-serve-spike-20 raises and then clears.
+
+    Returns ``(arrival offsets, prompt lengths, output budgets)``.
+    PURE function of its arguments — no wall clock, no global RNG — so
+    two calls with one seed offer a bit-identical schedule (pinned by
+    test: replay determinism is what makes a red run reproducible).
+    """
+    rng = random.Random((int(seed) << 9) ^ 0x5EED)
+    cap = max(base_rps, peak_rps, spike_rps, 1e-9)
+    offs: List[float] = []
+    plens: List[int] = []
+    outs: List[int] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(cap)
+        if t >= duration_s:
+            break
+        frac = t / duration_s
+        rate = base_rps + (peak_rps - base_rps) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * frac))
+        if spike_rps > 0.0 and spike_window[0] <= frac < spike_window[1]:
+            rate = max(rate, spike_rps)
+        if rng.random() * cap > rate:
+            continue          # thinned away: off-peak arrival
+        offs.append(t)
+        plens.append(max(1, min(4 * prompt_len,
+                                int(rng.gauss(prompt_len,
+                                              max(1.0, prompt_len / 4))))))
+        outs.append(max(1, min(4 * max_new,
+                               int(rng.gauss(max_new,
+                                             max(1.0, max_new / 4))))))
+    if not offs:              # degenerate inputs still offer one request
+        return [0.0], [max(1, prompt_len)], [max(1, max_new)]
+    return offs, plens, outs
+
+
+# ----------------------------------------------------------- HTTP surface
+def _serve_handler(rep: "FakeServingReplica"):
+    def factory(_srv):
+        class Handler(BaseHTTPRequestHandler):
+            # chunked transfer is an HTTP/1.1 construct (see
+            # serving/server.py): a 1.0 status line makes clients read
+            # raw chunk framing as body
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    self._reply(200, rep.stats())
+                elif self.path.startswith("/metrics"):
+                    body = get_monitor().render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.startswith("/requests"):
+                    from urllib.parse import parse_qs, urlsplit
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        n = int(qs.get("n", ["64"])[0])
+                    except ValueError:
+                        n = 64
+                    self._reply(200, rep.journal.snapshot(n))
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": "unknown path"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    prompt = [int(t) for t in req["prompt"]]
+                    max_new = int(req["max_new"])
+                except (KeyError, TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if not prompt or max_new < 1:
+                    self._reply(422, {"error": "empty prompt or "
+                                               "non-positive max_new"})
+                    return
+                if rep.closed():
+                    self._reply(503, {"error": "replica is draining"})
+                    return
+                if bool(req.get("stream", False)):
+                    self._stream_reply(prompt, max_new)
+                else:
+                    uid, tokens = rep.serve_request(prompt, max_new)
+                    self._reply(200, {"uid": uid, "tokens": tokens})
+
+            def _chunk(self, payload: bytes):
+                self.wfile.write(f"{len(payload):x}\r\n".encode()
+                                 + payload + b"\r\n")
+
+            def _stream_reply(self, prompt, max_new):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                total = [0]
+
+                def emit(uid, toks):
+                    total[0] += len(toks)
+                    self._chunk(json.dumps(
+                        {"uid": uid, "tokens": toks}).encode() + b"\n")
+
+                uid, _ = rep.serve_request(prompt, max_new, emit=emit)
+                self._chunk(json.dumps(
+                    {"uid": uid, "done": True,
+                     "tokens_total": total[0]}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+
+        return Handler
+    return factory
+
+
+def _metrics_handler(rep: "FakeServingReplica"):
+    def factory(_srv):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = get_monitor().render_metrics().encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+        return Handler
+    return factory
+
+
+class FakeServingReplica:
+    """One fake serving replica: real host plane + real request journal
+    over a deterministic synthetic service-time model."""
+
+    def __init__(self, we: Optional["E.WorkerEnv"], *,
+                 host: str = "127.0.0.1", port: Optional[int] = None):
+        self.standalone = we is None
+        if not self.standalone:
+            if we.self_spec is None or not we.config_server:
+                raise RuntimeError(
+                    "kfsim serving replica needs the launcher env ABI "
+                    "(KFT_SELF_SPEC + KFT_CONFIG_SERVER) or --port")
+            self.host = we.self_spec.host
+            self.port = we.self_spec.port
+            self.url = we.config_server
+            self.version = we.cluster_version
+            self.workers = list(we.peers)
+            self.init_rank = we.rank()
+        else:
+            if port is None:
+                raise RuntimeError("standalone replica needs --port")
+            self.host, self.port = host, int(port)
+            self.url = None
+            self.version = 0
+            self.workers = []
+            self.init_rank = 0
+        self.we = we
+        self.rank = self.init_rank
+
+        # standalone replicas (kfload fleet benches) run outside the
+        # scenario runner: no events journal, no tick target
+        self.out_dir = knobs.raw("KFT_CHAOS_OUT") or None
+        if self.standalone:
+            self.target_tick = 0
+        else:
+            batch = max(1, knobs.get("KFT_CHAOS_B"))
+            self.target_tick = max(
+                1, knobs.get("KFT_CHAOS_TARGET") // batch)
+        self.seed = knobs.get("KFT_SIM_SEED")
+        self.tick_s = knobs.get("KFT_SIM_STEP_S")
+        self.poll_s = knobs.get("KFT_SIM_POLL_S")
+        self.drain_s = knobs.get("KFT_SIM_DRAIN_S")
+
+        self.slots = max(1, knobs.get("KFT_SIM_SERVE_SLOTS"))
+        self.prefill_ms = knobs.get("KFT_SIM_SERVE_PREFILL_MS")
+        self.decode_ms = knobs.get("KFT_SIM_SERVE_DECODE_MS")
+        slow = knobs.get("KFT_SIM_SERVE_SLOW_RANKS")
+        self.slow_factor = (knobs.get("KFT_SIM_SERVE_SLOW_FACTOR")
+                            if self.init_rank in slow else 1.0)
+        self.preempt_every = knobs.get("KFT_SIM_SERVE_PREEMPT_EVERY")
+        # deterministic per-(seed, port) jitter, sim/trainer.py idiom
+        self._jitter = random.Random((self.seed << 17) ^ self.port)
+
+        self.tick = 0
+        self._last_poll = -float("inf")
+        self._stop = threading.Event()
+
+        # engine state: an admission semaphore models the decode slots;
+        # queue wait IS the semaphore wait, so overload surfaces as
+        # queue-dominated TTFT exactly like the real engine's admission
+        self._sem = threading.Semaphore(self.slots)
+        self._lock = threading.Lock()
+        self._qdepth = 0
+        self._next_uid = 1
+        self.submitted = 0
+        self.admitted = 0
+        self.finished = 0
+        self.preempted = 0
+        self._prefix_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._prefix_hits = 0
+        self._prefix_lookups = 0
+        self._tokens_reused = 0
+        self._tokens_prompted = 0
+
+        # the REAL journal: burn/compliance/phase-share gauges publish
+        # through the production path into the process-global monitor
+        # this replica's /metrics renders (serving/server.py does the
+        # same) — the fleet plane aggregates production families
+        self.monitor = get_monitor()
+        self.journal = RequestJournal()
+
+        self.stream = f"{self.port}.{os.getpid()}"
+        if self.out_dir:
+            self._ev_path = os.path.join(self.out_dir,
+                                         f"events.{self.stream}.jsonl")
+            with open(os.path.join(self.out_dir, f"pid.{self.stream}"),
+                      "w") as f:
+                f.write(str(os.getpid()))
+        else:
+            self._ev_path = None
+        self.hb = HeartbeatSender.from_env(we) if we is not None else None
+
+        # the serve front-end is the replica's reason to exist: a bind
+        # failure here is fatal (exits preemption-class, the watcher
+        # absorbs it as a shrink), unlike /metrics which degrades
+        self.server = BackgroundHTTPServer(_serve_handler(self),
+                                           self.host, self.port).start()
+        # /metrics at port+offset so cluster.aggregate and the doctor
+        # sampler scrape serving replicas exactly like trainers; an
+        # ephemeral-port squatter may transiently hold it, so retry
+        # then degrade (sim/trainer.py contract)
+        self.metrics_server = None
+        mport = self.port + MONITOR_PORT_OFFSET
+        for attempt in range(5 if mport <= 65535 else 0):
+            try:
+                self.metrics_server = BackgroundHTTPServer(
+                    _metrics_handler(self), self.host,
+                    self.port + MONITOR_PORT_OFFSET).start()
+                break
+            except OSError as e:
+                print(f"kfsim-serve: metrics bind "
+                      f"{self.port + MONITOR_PORT_OFFSET} failed "
+                      f"({e}); retry {attempt + 1}/5", file=sys.stderr)
+                time.sleep(0.2)
+        if self.metrics_server is None:
+            print(f"kfsim-serve: no scrape /metrics on rank {self.rank} "
+                  f"(port {mport} unavailable); the serve-port /metrics "
+                  f"mirror still works", file=sys.stderr)
+
+    # ----------------------------------------------------------- events
+    def emit(self, kind: str, **kw) -> None:
+        if self._ev_path is None:
+            return
+        kw.update(kind=kind, stream=self.stream)
+        with open(self._ev_path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    # ----------------------------------------------------- request path
+    def closed(self) -> bool:
+        return self._stop.is_set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"rank": self.rank, "version": self.version,
+                    "tick": self.tick, "slots": self.slots,
+                    "pending": self._qdepth,
+                    "submitted": self.submitted,
+                    "admitted": self.admitted,
+                    "finished": self.finished,
+                    "preempted": self.preempted,
+                    "prefix_hits": self._prefix_hits,
+                    "prefix_lookups": self._prefix_lookups}
+
+    def _prefix_probe(self, prompt: List[int]) -> int:
+        """Seeded prefix-cache emulation: the first half of the prompt
+        is the cache key; a hit serves those tokens 'from cache' (they
+        skip prefill).  Deterministic in the request content, bounded
+        LRU — the hit-rate gauges move exactly with kfload's
+        ``--prefix-frac`` shared-prefix mix."""
+        half = max(1, len(prompt) // 2)
+        key = tuple(prompt[:half])
+        with self._lock:
+            self._prefix_lookups += 1
+            self._tokens_prompted += len(prompt)
+            hit = key in self._prefix_cache
+            if hit:
+                self._prefix_cache.move_to_end(key)
+                self._prefix_hits += 1
+                self._tokens_reused += half
+            else:
+                self._prefix_cache[key] = True
+                while len(self._prefix_cache) > _PREFIX_CACHE_CAP:
+                    self._prefix_cache.popitem(last=False)
+            hits, looks = self._prefix_hits, self._prefix_lookups
+            reused, prompted = self._tokens_reused, self._tokens_prompted
+        self.monitor.set_gauge("kungfu_tpu_serving_prefix_hit_rate",
+                               hits / looks)
+        self.monitor.set_gauge("kungfu_tpu_serving_prefix_token_reuse",
+                               reused / max(1, prompted))
+        return half if hit else 0
+
+    def _acquire_slot(self) -> float:
+        """Blocking slot admission; returns the queue wait in seconds.
+        Polls so a draining replica can release its queued handlers
+        instead of leaving them parked on the semaphore forever."""
+        with self._lock:
+            self._qdepth += 1
+        t0 = time.monotonic()
+        try:
+            while not self._sem.acquire(timeout=0.5):
+                if self._stop.is_set():
+                    raise _Draining()
+        finally:
+            with self._lock:
+                self._qdepth -= 1
+        return time.monotonic() - t0
+
+    def serve_request(self, prompt: List[int], max_new: int,
+                      emit=None) -> Tuple[int, List[int]]:
+        """One synthetic request lifecycle over the REAL journal:
+        submit -> (queue) -> admit -> prefill sleep proportional to the
+        non-reused prompt tokens -> first token -> per-token decode
+        ticks (optionally one forced preempt/re-admit) -> finish.
+        Every duration is a pure function of (knobs, slow factor,
+        request shape); only the queue wait is emergent."""
+        max_new = min(max_new, _MAX_NEW_CAP)
+        with self._lock:
+            uid = self._next_uid
+            self._next_uid += 1
+            self.submitted += 1
+        t_sub = time.monotonic()
+        self.journal.on_submit(uid, t_sub, len(prompt))
+        try:
+            wait_s = self._acquire_slot()
+        except _Draining:
+            return uid, []          # journal closes it via evict_open
+        holding = True              # exactly one release per hold
+        reused = self._prefix_probe(prompt)
+        self.journal.on_admit(uid, time.monotonic(),
+                              slot=uid % self.slots,
+                              prefix_reused=reused > 0, wait_s=wait_s)
+        with self._lock:
+            self.admitted += 1
+        self.monitor.inc("kungfu_tpu_serving_admitted_total")
+        self.monitor.observe("kungfu_tpu_serving_queue_wait_seconds",
+                             wait_s)
+        toks_rng = random.Random((self.seed << 13) ^ uid)
+        tokens: List[int] = []
+        try:
+            prefill_s = (max(0, len(prompt) - reused)
+                         * self.prefill_ms * self.slow_factor / 1e3)
+            time.sleep(prefill_s)
+            t_first = time.monotonic()
+            self.journal.on_first_token(uid, t_first)
+            self.monitor.observe("kungfu_tpu_serving_prefill_seconds",
+                                 max(prefill_s, 1e-9))
+            tokens.append(toks_rng.randrange(1, 256))
+            if emit is not None:
+                emit(uid, tokens[-1:])
+            preempt_at = (1 if self.preempt_every
+                          and uid % self.preempt_every == 0 else None)
+            for i in range(1, max_new):
+                if i == preempt_at:
+                    # forced preempt/finish sequence: the slot is lost
+                    # and re-acquired, the journal records a second
+                    # admission — but TTFT stays set-once and the
+                    # request contributes exactly once to the fleet
+                    # percentile joins (pinned by test)
+                    self.journal.on_preempt(uid)
+                    self._sem.release()
+                    holding = False
+                    with self._lock:
+                        self.preempted += 1
+                    self.monitor.inc(
+                        "kungfu_tpu_serving_preemptions_total")
+                    try:
+                        re_wait = self._acquire_slot()
+                    except _Draining:
+                        return uid, tokens
+                    holding = True
+                    self.journal.on_admit(uid, time.monotonic(),
+                                          slot=uid % self.slots,
+                                          prefix_reused=reused > 0,
+                                          wait_s=re_wait)
+                    # per-ADMISSION family: a preempted request waits
+                    # twice and is counted twice here — which is why
+                    # the fleet TTFT join must weight by the TTFT
+                    # summary's own count, never by admissions
+                    self.monitor.observe(
+                        "kungfu_tpu_serving_queue_wait_seconds",
+                        re_wait)
+                    with self._lock:
+                        self.admitted += 1
+                    self.monitor.inc(
+                        "kungfu_tpu_serving_admitted_total")
+                time.sleep(self.decode_ms * self.slow_factor / 1e3)
+                tokens.append(toks_rng.randrange(1, 256))
+                if emit is not None:
+                    emit(uid, tokens[-1:])
+                self.monitor.observe(
+                    "kungfu_tpu_serving_decode_token_seconds",
+                    self.decode_ms * self.slow_factor / 1e3)
+            t_end = time.monotonic()
+            self.journal.on_finish(uid, t_end,
+                                   output_tokens=len(tokens))
+            with self._lock:
+                self.finished += 1
+            # TTFT/TPOT observed ONCE per request at finish (never per
+            # admission): these counts are the exactly-once weights the
+            # fleet percentile join leans on (monitor/cluster.py)
+            self.monitor.observe("kungfu_tpu_serving_ttft_seconds",
+                                 t_first - t_sub)
+            if len(tokens) > 1:
+                self.monitor.observe(
+                    "kungfu_tpu_serving_tpot_seconds",
+                    (t_end - t_first) / (len(tokens) - 1))
+        finally:
+            if holding:
+                self._sem.release()
+        return uid, tokens
+
+    # ----------------------------------------------------------- resize
+    def _apply_config(self, version: int, cluster) -> bool:
+        workers = list(cluster.workers)
+        rank = None
+        for i, p in enumerate(workers):
+            if p.host == self.host and p.port == self.port:
+                rank = i
+                break
+        if rank is None:
+            return False
+        self.version = version
+        self.workers = workers
+        self.rank = rank
+        self.emit("resize", size=len(workers), version=version,
+                  tick=self.tick)
+        return True
+
+    def _poll_config(self, force: bool = False) -> bool:
+        if self.url is None:
+            return True                 # standalone: no membership
+        now = time.monotonic()
+        if not force and now - self._last_poll < self.poll_s:
+            return True
+        self._last_poll = now
+        try:
+            version, cluster = fetch_config(self.url, timeout=2.0)
+        except (OSError, ValueError):
+            # config-server outage: keep serving on the last-known
+            # membership (the watcher owns escalation)
+            self.monitor.inc("kungfu_tpu_sim_config_misses_total")
+            return True
+        if version != self.version:
+            return self._apply_config(version, cluster)
+        return True
+
+    # ------------------------------------------------------------- loop
+    def _beat(self) -> None:
+        if self.hb is not None:
+            self.hb.beat(rank=self.rank, step=self.tick,
+                         version=self.version)
+
+    def _publish_tick(self) -> None:
+        with self._lock:
+            depth = self._qdepth
+        self.monitor.set_gauge("kungfu_tpu_serving_queue_depth", depth)
+        self.journal.publish()
+
+    def run(self) -> int:
+        self.emit("start", rank=self.rank, size=len(self.workers),
+                  version=self.version, step=self.tick)
+        while self.standalone or self.tick < self.target_tick:
+            if self._stop.is_set():     # standalone SIGTERM
+                break
+            if not self._poll_config():
+                return self._detach()
+            _chaos_point("serve.tick", rank=self.rank,
+                         step=self.tick + 1, version=self.version)
+            self._beat()
+            time.sleep(self.tick_s * self._jitter.uniform(0.85, 1.15))
+            self.tick += 1
+            self._publish_tick()
+            self.emit("step", rank=self.rank, size=len(self.workers),
+                      version=self.version, step=self.tick,
+                      submitted=self.submitted, finished=self.finished)
+        if self.standalone:
+            return self._finalize()
+        return self._drain()
+
+    # ------------------------------------------------------------ drain
+    def _drain(self) -> int:
+        """Hold the lease at the final tick until the whole current
+        membership is at target (sim/trainer.py termination protocol);
+        keep serving meanwhile so in-flight requests finish."""
+        deadline = time.monotonic() + self.drain_s
+        pause = max(self.poll_s, 0.015 * len(self.workers))
+        while time.monotonic() < deadline:
+            self._beat()
+            if not self._poll_config(force=True):
+                return self._detach()
+            try:
+                health = fetch_health(self.url, timeout=2.0)
+            except (OSError, ValueError):
+                time.sleep(pause)
+                continue
+            leases = health.get("leases", {})
+            need = [f"{p.host}:{p.port}" for p in self.workers]
+            done = all(
+                isinstance(leases.get(k), dict)
+                and (leases[k].get("step") or 0) >= self.target_tick
+                for k in need)
+            if done:
+                return self._finalize()
+            time.sleep(pause * self._jitter.uniform(0.8, 1.3))
+        self.emit("drain_timeout", step=self.tick,
+                  version=self.version)
+        return self._finalize()
+
+    def _finalize(self) -> int:
+        self._stop.set()
+        evicted = len(self.journal.evict_open("replica-shutdown"))
+        self._publish_tick()
+        with self._lock:
+            open_n = len(self.journal.snapshot(0)["open"])
+            self.emit("final", rank=self.rank, size=len(self.workers),
+                      version=self.version, step=self.tick,
+                      submitted=self.submitted, finished=self.finished,
+                      evicted=evicted, open=open_n,
+                      preempted=self.preempted)
+        self._shutdown()
+        return 0
+
+    def _detach(self) -> int:
+        self._stop.set()
+        self.journal.evict_open("replica-detached")
+        self.emit("detached", step=self.tick, version=self.version)
+        self._shutdown()
+        return 0
+
+    def _shutdown(self) -> None:
+        if self.hb is not None:
+            self.hb.stop(join_timeout=1.0)
+        self.server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+
+
+class _Draining(Exception):
+    """Raised out of slot admission when the replica is shutting down:
+    the queued request stays open and is closed by evict_open."""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kft-sim-serve", description=__doc__.split("\n")[0])
+    ap.add_argument("--port", type=int, default=None,
+                    help="standalone mode: serve on this port without "
+                         "the launcher env ABI (no leases, SIGTERM to "
+                         "stop) — the shape kfload --fleet spawns")
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    try:
+        we = E.from_env()
+        if we.self_spec is not None and we.config_server:
+            rep = FakeServingReplica(we)
+        else:
+            rep = FakeServingReplica(None, host=args.host,
+                                     port=args.port)
+    except (OSError, RuntimeError, ValueError, KeyError) as e:
+        # mirror the worker template: a replica that cannot even join
+        # exits preemption-class so the watcher absorbs it as a shrink
+        print(f"kfsim-serve: replica failed to start: {e!r}",
+              file=sys.stderr)
+        return 143
+    if rep.standalone:
+        signal.signal(signal.SIGTERM, lambda *_: rep._stop.set())
+    try:
+        return rep.run()
+    except Exception as e:  # fuzz "exception" faults land here
+        rep.emit("crashed", step=rep.tick, error=repr(e))
+        return 143
+
+
+if __name__ == "__main__":
+    sys.exit(main())

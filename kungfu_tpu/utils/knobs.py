@@ -332,6 +332,17 @@ _def("KFT_DOCTOR_SLOWLINK", "float", 4.0,
 _def("KFT_DOCTOR_SLOWLINK_MIN_BPS", "float", 1024.0,
      "Slowlink: idle-cluster floor — windows whose median pull "
      "bandwidth sits below this are inconclusive.", group=_DOCTOR)
+_def("KFT_FLEET_OUTLIER_SKEW", "float", 2.0,
+     "Replica outlier: one serving replica's TTFT/queue-wait p50 over "
+     "the fleet lower-median, required in every evidence window.",
+     group=_DOCTOR)
+_def("KFT_FLEET_BURN", "float", 2.0,
+     "Fleet SLO: sustained count-weighted aggregate budget-burn rate "
+     "that raises a fleet-slo finding.", group=_DOCTOR)
+_def("KFT_FLEET_IMBALANCE", "float", 2.0,
+     "Imbalance: fleet-median admitted-load growth over a replica's, "
+     "required in every evidence window (with the replica's queue "
+     "wait above the fleet median — slow, not idle).", group=_DOCTOR)
 
 _POLICY = "Policy engine (kfpolicy, shadow mode)"
 _def("KFT_POLICY_HYSTERESIS", "int", 2,
@@ -425,6 +436,26 @@ _def("KFT_SIM_NET_SLOW_RANKS", "intset", frozenset(),
 _def("KFT_SIM_NET_SLOW_FACTOR", "float", 8.0,
      "kfnet sim: ingress-byte divisor applied to the scripted "
      "slowlink ranks.", group=_SIM)
+_def("KFT_SIM_SERVE_SLOTS", "int", 4,
+     "Serving sim: concurrent decode slots of a fake replica (queue "
+     "wait is the admission-semaphore wait).", group=_SIM)
+_def("KFT_SIM_SERVE_PREFILL_MS", "float", 0.5,
+     "Serving sim: synthetic prefill milliseconds per non-reused "
+     "prompt token.", group=_SIM)
+_def("KFT_SIM_SERVE_DECODE_MS", "float", 5.0,
+     "Serving sim: synthetic decode milliseconds per output token.",
+     group=_SIM)
+_def("KFT_SIM_SERVE_SLOW_RANKS", "intset", frozenset(),
+     "Serving sim: comma list of replica ranks scripted with "
+     "throttled service times (the imbalance/outlier signal).",
+     group=_SIM)
+_def("KFT_SIM_SERVE_SLOW_FACTOR", "float", 4.0,
+     "Serving sim: service-time multiplier applied to the scripted "
+     "slow replicas.", group=_SIM)
+_def("KFT_SIM_SERVE_PREEMPT_EVERY", "int", 0,
+     "Serving sim: force one preempt/re-admit on every Nth request "
+     "(0 disables) — exercises the exactly-once fleet-join contract.",
+     group=_SIM)
 
 _BENCH = "Benchmarks"
 _def("KFT_SCALING_OUT", "str", None,

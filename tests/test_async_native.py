@@ -81,6 +81,21 @@ def _async_allreduce_worker(rank, peers, q):
 
 
 def test_async_allreduce_future_resolves():
+    """Correctness always; the submit-latency bound is a timing claim,
+    so it follows the serial-perf-tier idiom (test_prefetch): under
+    KFT_PERF_ENFORCE=1 poll-with-deadline for a quiet box and enforce;
+    on a loaded shard box enforce only the correctness half — a
+    descheduled submit thread is scheduler noise, not a blocking
+    dispatch."""
+    if os.environ.get("KFT_PERF_ENFORCE") == "1":
+        deadline = time.monotonic() + 300
+        while os.getloadavg()[0] > 2.0:
+            assert time.monotonic() < deadline, (
+                f"box never quieted (loadavg {os.getloadavg()[0]:.1f}); "
+                "submit latency unmeasurable")
+            time.sleep(5)
+    enforce_submit = (os.environ.get("KFT_PERF_ENFORCE") == "1"
+                      or os.getloadavg()[0] <= 2.0)
     n = 3
     results = _spawn(_async_allreduce_worker, n)
     want_sum = [(0 + 1 + 2) + 3 * i for i in range(5)]
@@ -89,7 +104,8 @@ def test_async_allreduce_future_resolves():
         assert s == want_sum, (rank, s)
         assert m == want_max, (rank, m)
         # issuing the op must not block on the collective itself
-        assert submit_dt < 1.0
+        if enforce_submit:
+            assert submit_dt < 1.0, (rank, submit_dt)
 
 
 def _async_error_worker(rank, peers, q):

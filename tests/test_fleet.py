@@ -1,0 +1,475 @@
+"""kffleet: the serving-fleet observability plane (docs/serving.md
+"Fleet observability").
+
+Unit tier over hand-built fixtures: the seeded diurnal trace generator
+must be bit-identical per seed (replay determinism), the fleet joins
+in monitor/cluster.py must weight every finished request exactly once
+(a preempted-then-finished request is admitted twice but must move the
+fleet percentile once — pinned against the hand-computed quantile),
+the three fleet detectors (replica-outlier / fleet-slo / imbalance)
+must name exactly the degraded replica with clean twins silent and
+stale instances excluded, the serving-journal invariant sweep must
+flag conservation leaks, and the raise-then-clear (``cleared``)
+scenario contract must hold.  End-to-end: ``aggregate`` over live
+/metrics endpoints and ``kft-doctor --url`` rendering a fleet finding.
+"""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kungfu_tpu.chaos.invariants import (check_serving_journal,  # noqa: E402
+                                         run_serving)
+from kungfu_tpu.chaos.runner import doctor_violations  # noqa: E402
+from kungfu_tpu.monitor import (MONITOR_PORT_OFFSET, MetricsServer,  # noqa: E402
+                                Monitor)
+from kungfu_tpu.monitor.cluster import (aggregate, fleet_lines,  # noqa: E402
+                                        fleet_quantile, serving_stats)
+from kungfu_tpu.monitor.doctor import (Doctor, detect_fleet_slo,  # noqa: E402
+                                       detect_imbalance,
+                                       detect_replica_outlier)
+from kungfu_tpu.monitor.history import MetricsHistory  # noqa: E402
+from kungfu_tpu.sim.serving import synth_diurnal_schedule  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- fixtures
+def _serve_expo(ttft_p50, count=3.0, wait_p50=0.0, admitted=None,
+                burn=None, phases=None, tpot_p50=None):
+    """One serving replica's /metrics text, the families the fleet join
+    and detectors consume."""
+    ttft = "kungfu_tpu_serving_ttft_seconds"
+    wait = "kungfu_tpu_serving_queue_wait_seconds"
+    t = (f'{ttft}{{quantile="0.5"}} {ttft_p50}\n'
+         f'{ttft}{{quantile="0.9"}} {ttft_p50 * 1.1}\n'
+         f'{ttft}_count {count}\n'
+         f'{wait}{{quantile="0.5"}} {wait_p50}\n')
+    if tpot_p50 is not None:
+        tpot = "kungfu_tpu_serving_tpot_seconds"
+        t += (f'{tpot}{{quantile="0.5"}} {tpot_p50}\n'
+              f'{tpot}_count {count}\n')
+    if admitted is not None:
+        t += f'kungfu_tpu_serving_admitted_total {admitted}\n'
+    if burn is not None:
+        t += f'kungfu_tpu_slo_budget_burn{{objective="ttft"}} {burn}\n'
+    for p, v in (phases or {}).items():
+        t += f'kungfu_tpu_serving_phase_share{{phase="{p}"}} {v}\n'
+    return t
+
+
+def _trainer_expo(p50=0.1):
+    return (f'kungfu_tpu_step_seconds{{quantile="0.5"}} {p50}\n'
+            f'kungfu_tpu_step_seconds_count 3\n')
+
+
+def _feed(hist, rounds):
+    """rounds: list of {instance: expo_text}, oldest first."""
+    for i, r in enumerate(rounds):
+        for inst, text in r.items():
+            hist.observe_text(inst, text, ts=1000.0 + i)
+
+
+# ------------------------------------------------- synthetic trace gen
+def test_synth_diurnal_bit_identical_per_seed():
+    a = synth_diurnal_schedule(5, duration_s=8.0, base_rps=3.0,
+                               peak_rps=12.0, spike_rps=40.0)
+    b = synth_diurnal_schedule(5, duration_s=8.0, base_rps=3.0,
+                               peak_rps=12.0, spike_rps=40.0)
+    assert a == b                 # replay determinism, bit-identical
+    c = synth_diurnal_schedule(6, duration_s=8.0, base_rps=3.0,
+                               peak_rps=12.0, spike_rps=40.0)
+    assert a != c                 # the seed actually steers it
+
+
+def test_synth_diurnal_spike_window_concentrates_arrivals():
+    offs, plens, outs = synth_diurnal_schedule(
+        3, duration_s=10.0, base_rps=2.0, peak_rps=4.0,
+        spike_rps=60.0, spike_window=(0.4, 0.6))
+    assert len(offs) == len(plens) == len(outs)
+    assert all(0.0 <= t < 10.0 for t in offs)
+    in_spike = [t for t in offs if 4.0 <= t < 6.0]
+    out_spike = [t for t in offs if not 4.0 <= t < 6.0]
+    # 60 rps over 2s vs <=4 rps over 8s: the spike dominates
+    assert len(in_spike) > 3 * len(out_spike)
+    assert all(p >= 1 for p in plens) and all(o >= 1 for o in outs)
+
+
+def test_synth_diurnal_degenerate_inputs_offer_one_request():
+    offs, plens, outs = synth_diurnal_schedule(
+        0, duration_s=0.0, base_rps=0.0, peak_rps=0.0)
+    assert (offs, plens, outs) == ([0.0], [8], [8])
+
+
+def test_kfload_synth_trace_spec_round_trip():
+    """The CLI parser side of --trace synth:diurnal:<seed>: same spec
+    => same schedule, and the k=v overrides reach the generator."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import kfload
+    finally:
+        sys.path.pop(0)
+    a = kfload._synth_trace("synth:diurnal:9:base=3,peak=9", 6.0)
+    b = kfload._synth_trace("synth:diurnal:9:base=3,peak=9", 6.0)
+    assert a == b
+    assert a == synth_diurnal_schedule(9, duration_s=6.0, base_rps=3.0,
+                                       peak_rps=9.0)
+
+
+# ------------------------------------------------------- serving_stats
+def test_serving_stats_learns_roles_from_the_exposition():
+    # a trainer never publishes the TTFT summary: not a serving replica
+    assert serving_stats(_trainer_expo()) == {}
+    st = serving_stats(_serve_expo(0.01, count=3, wait_p50=0.002,
+                                   admitted=7, burn=1.5))
+    assert st["ttft"]["0.5"] == 0.01
+    assert st["ttft_count"] == 3.0
+    assert st["queue_wait"]["0.5"] == 0.002
+    assert st["admitted"] == 7.0
+    assert st["burn"]["ttft"] == 1.5
+
+
+# ------------------------------------------------------ fleet_quantile
+def test_fleet_quantile_hand_computed():
+    pairs = [(0.010, 3.0), (0.100, 1.0)]
+    # p50 cut = 0.5*4 = 2.0: the 3-count replica covers it
+    assert fleet_quantile(pairs, 0.5) == 0.010
+    # p90 cut = 3.6: crosses into the slow replica
+    assert fleet_quantile(pairs, 0.9) == 0.100
+    assert fleet_quantile([(0.5, 0.0)], 0.5) is None
+    assert fleet_quantile([], 0.5) is None
+
+
+def test_fleet_join_counts_preempted_requests_exactly_once():
+    """The window-merge pin (guards the exactly-once weight): replica
+    r1 finished ONE request that was preempted and re-admitted, so its
+    per-ADMISSION families read 2 while its TTFT count reads 1.  The
+    fleet p50 over {r0: ttft 10ms x1, r1: ttft 100ms x1} is 10ms by
+    hand; weighting by admissions (1 vs 2) would shift the cut past
+    the fast replica and read 100ms."""
+    r0 = serving_stats(_serve_expo(0.010, count=1, admitted=1))
+    r1 = serving_stats(_serve_expo(0.100, count=1, admitted=2,
+                                   burn=3.0))
+    lines = fleet_lines([("r0", r0), ("r1", r1)])
+    assert 'kungfu_tpu_fleet_ttft_ms{quantile="0.5"} 10' in lines
+    assert "kungfu_tpu_fleet_serving_replicas 2" in lines
+
+
+def test_fleet_lines_burn_and_imbalance_gauges():
+    r0 = serving_stats(_serve_expo(0.010, count=3, wait_p50=0.001,
+                                   admitted=12, burn=1.0))
+    r1 = serving_stats(_serve_expo(0.100, count=1, wait_p50=0.004,
+                                   admitted=4, burn=3.0))
+    lines = fleet_lines([("r0", r0), ("r1", r1)])
+    # finished-count-weighted burn: (1*3 + 3*1) / 4 = 1.5
+    assert ('kungfu_tpu_fleet_slo_budget_burn{objective="ttft"} 1.5'
+            in lines)
+    # admitted spread: (12-4)/median(=4... upper? sorted [4,12],
+    # median index (2-1)//2 = 0 -> 4) = 2
+    assert ('kungfu_tpu_fleet_load_imbalance{signal="admitted"} 2'
+            in lines)
+    assert fleet_lines([]) == []
+
+
+def test_aggregate_serves_fleet_gauges_from_live_endpoints():
+    """End-to-end: two live /metrics endpoints, one serving-shaped —
+    aggregate() must learn the role and append the fleet families."""
+    serve_mon = Monitor()
+    for v in (0.01, 0.01, 0.02):
+        serve_mon.observe("kungfu_tpu_serving_ttft_seconds", v)
+    serve_mon.inc("kungfu_tpu_serving_admitted_total", 3)
+    train_mon = Monitor()
+    train_mon.observe("kungfu_tpu_step_seconds", 0.1)
+    servers = [MetricsServer(serve_mon).start(),
+               MetricsServer(train_mon).start()]
+    try:
+        targets = [("127.0.0.1", s.port - MONITOR_PORT_OFFSET)
+                   for s in servers]
+        text = aggregate(targets, timeout=5.0)
+    finally:
+        for s in servers:
+            s.stop()
+    assert "kungfu_tpu_fleet_serving_replicas 1" in text
+    assert 'kungfu_tpu_fleet_ttft_ms{quantile="0.5"}' in text
+
+
+# ----------------------------------------------------- replica outlier
+def test_replica_outlier_named_with_rank_and_wait_evidence():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.01, wait_p50=0.001),
+               "h1:2": _serve_expo(0.01, wait_p50=0.001),
+               "h2:3": _serve_expo(0.08, wait_p50=0.05)}] * 3)
+    fs = detect_replica_outlier(
+        h, ranks={"h0:1": 0, "h1:2": 1, "h2:3": 2}, version=4)
+    assert len(fs) == 1
+    f = fs[0]
+    assert (f.kind, f.instance, f.rank) == ("replica-outlier", "h2:3", 2)
+    assert f.severity == "critical"          # 8x >> 2*skew
+    assert f.version == 4
+    assert f.evidence["skew_ratio"] == pytest.approx(8.0, rel=0.01)
+    assert f.evidence["queue_wait_p50_s"] == pytest.approx(0.05)
+
+
+def test_replica_outlier_clean_fleet_silent():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.010),
+               "h1:2": _serve_expo(0.011),
+               "h2:3": _serve_expo(0.009)}] * 4)
+    assert detect_replica_outlier(h) == []
+
+
+def test_replica_outlier_needs_persistence_not_one_bad_window():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.01), "h1:2": _serve_expo(0.01)},
+              {"h0:1": _serve_expo(0.01), "h1:2": _serve_expo(0.01)},
+              {"h0:1": _serve_expo(0.01), "h1:2": _serve_expo(0.1)}])
+    assert detect_replica_outlier(h) == []
+
+
+def test_replica_outlier_lone_replica_has_no_fleet():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(9.9)}] * 4)
+    assert detect_replica_outlier(h) == []
+    # trainers alongside do not make a fleet either (role detection)
+    _feed(h, [{"t0:9": _trainer_expo()}] * 4)
+    assert detect_replica_outlier(h) == []
+
+
+def test_replica_outlier_ignores_stale_ghost_instance():
+    h = MetricsHistory()
+    for i in range(3):
+        h.observe_text("ghost:9", _serve_expo(1.0), ts=float(i))
+    _feed(h, [{"h0:1": _serve_expo(0.01),
+               "h1:2": _serve_expo(0.01)}] * 3)
+    assert detect_replica_outlier(h, stale_s=60.0) == []
+
+
+# ---------------------------------------------------------- fleet slo
+def test_fleet_slo_sustained_burn_names_dominant_replica():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.01, count=3, burn=4.0,
+                                   phases={"queue": 0.7,
+                                           "prefill": 0.2,
+                                           "decode": 0.1}),
+               "h1:2": _serve_expo(0.01, count=1, burn=1.0)}] * 3)
+    fs = detect_fleet_slo(h, ranks={"h0:1": 0, "h1:2": 1})
+    assert len(fs) == 1
+    f = fs[0]
+    assert (f.kind, f.instance, f.rank) == ("fleet-slo", "fleet", None)
+    # finished-count-weighted: (4*3 + 1*1) / 4 = 3.25
+    assert f.evidence["fleet_burn"] == pytest.approx(3.25)
+    assert f.evidence["dominant_replica"] == "h0:1"
+    assert f.evidence["dominant_phase"] == "queue"
+    assert f.evidence["objective"] == "ttft"
+
+
+def test_fleet_slo_one_burning_window_not_enough():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.01, burn=0.5)},
+              {"h0:1": _serve_expo(0.01, burn=0.5)},
+              {"h0:1": _serve_expo(0.01, burn=9.0)}])
+    assert detect_fleet_slo(h) == []
+
+
+def test_fleet_slo_compliant_fleet_silent():
+    h = MetricsHistory()
+    _feed(h, [{"h0:1": _serve_expo(0.01, burn=0.5),
+               "h1:2": _serve_expo(0.01, burn=1.2)}] * 4)
+    assert detect_fleet_slo(h) == []
+
+
+def test_fleet_slo_stale_replica_cannot_keep_burning():
+    h = MetricsHistory()
+    for i in range(3):
+        h.observe_text("ghost:9", _serve_expo(0.5, burn=9.0),
+                       ts=float(i))
+    _feed(h, [{"h0:1": _serve_expo(0.01, burn=0.1),
+               "h1:2": _serve_expo(0.01, burn=0.1)}] * 3)
+    assert detect_fleet_slo(h, stale_s=60.0) == []
+
+
+# ----------------------------------------------------------- imbalance
+def _admitted_rounds(growth):
+    """growth: {instance: per-window admitted delta}; 4 cumulative
+    points -> 3 consecutive-window deltas."""
+    rounds = []
+    for w in range(4):
+        rounds.append({inst: _serve_expo(0.01, wait_p50=(0.05 if g < 5
+                                                         else 0.001),
+                                         admitted=g * w)
+                       for inst, g in growth.items()})
+    return rounds
+
+
+def test_imbalance_names_slow_replica_under_balanced_frontend():
+    h = MetricsHistory()
+    _feed(h, _admitted_rounds({"h0:1": 10, "h1:2": 10, "h2:3": 2}))
+    fs = detect_imbalance(h, ranks={"h0:1": 0, "h1:2": 1, "h2:3": 2})
+    assert [(f.kind, f.instance, f.rank) for f in fs] == \
+        [("imbalance", "h2:3", 2)]
+    f = fs[0]
+    assert f.severity == "critical"     # ratio 0.2 < 0.5/factor
+    assert f.evidence["ratio"] == pytest.approx(0.2)
+    assert f.evidence["queue_wait_p50_s"] == pytest.approx(0.05)
+
+
+def test_imbalance_upper_median_keeps_the_fast_baseline_at_n2():
+    """Mirror of the stragglers' lower-median trick, inverted signal:
+    at n=2 the baseline must be the FAST/high-admitting replica, so
+    the slow one cannot drag the median down and hide."""
+    h = MetricsHistory()
+    _feed(h, _admitted_rounds({"h0:1": 10, "h1:2": 2}))
+    fs = detect_imbalance(h)
+    assert [f.instance for f in fs] == ["h1:2"]
+
+
+def test_imbalance_idle_fleet_is_inconclusive():
+    h = MetricsHistory()
+    _feed(h, _admitted_rounds({"h0:1": 0, "h1:2": 0, "h2:3": 0}))
+    assert detect_imbalance(h) == []
+
+
+def test_imbalance_balanced_fleet_silent():
+    h = MetricsHistory()
+    _feed(h, _admitted_rounds({"h0:1": 10, "h1:2": 9, "h2:3": 11}))
+    assert detect_imbalance(h) == []
+
+
+# ----------------------------------------------------- doctor plumbing
+def test_doctor_chains_fleet_detectors_and_resolves_knobs(monkeypatch):
+    monkeypatch.setenv("KFT_FLEET_OUTLIER_SKEW", "3.5")
+    monkeypatch.setenv("KFT_FLEET_BURN", "4.5")
+    monkeypatch.setenv("KFT_FLEET_IMBALANCE", "5.5")
+    doc = Doctor(monitor=Monitor())
+    assert doc.outlier_skew == 3.5
+    assert doc.fleet_burn == 4.5
+    assert doc.imbalance == 5.5
+    for _ in range(3):
+        doc.observe("h0:1", _serve_expo(0.01, wait_p50=0.001))
+        doc.observe("h1:2", _serve_expo(0.2, wait_p50=0.1))
+    fs = doc.diagnose(ranks={"h0:1": 0, "h1:2": 1})
+    assert [(f.kind, f.rank) for f in fs
+            if f.kind == "replica-outlier"] == [("replica-outlier", 1)]
+
+
+def test_kft_doctor_url_renders_fleet_finding(capsys):
+    """kft-doctor --url against a watcher debug endpoint whose fleet
+    holds one slow serving replica: the report must carry the
+    replica-outlier finding (the CLI path operators actually run)."""
+    from kungfu_tpu.launcher.job import Job
+    from kungfu_tpu.launcher.watch import Watcher, _start_debug_server
+    from kungfu_tpu.monitor import doctor as D
+    from kungfu_tpu.plan import PeerID
+
+    class _AliveProc:
+        def poll(self):
+            return None
+
+    servers = []
+    for i in (0, 1):
+        mon = Monitor()
+        for _ in range(6):
+            mon.observe("kungfu_tpu_serving_ttft_seconds",
+                        0.2 if i == 1 else 0.01)
+        servers.append(MetricsServer(mon).start())
+    dbg = None
+    try:
+        job = Job(prog=sys.executable, args=["-c", "pass"])
+        w = Watcher(job, "127.0.0.1", PeerID("127.0.0.1", 1))
+        w.current = {
+            PeerID("127.0.0.1", s.port - MONITOR_PORT_OFFSET, i):
+                _AliveProc()
+            for i, s in enumerate(servers)}
+        dbg = _start_debug_server(w, 0)
+        url = f"http://127.0.0.1:{dbg.port}"
+        for _ in range(3):       # each GET is one scrape window
+            urllib.request.urlopen(url + "/findings",
+                                   timeout=10).read()
+        assert D.main(["--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "replica-outlier" in out
+    finally:
+        if dbg is not None:
+            dbg.stop()
+        for s in servers:
+            s.stop()
+    slow = f"127.0.0.1:{servers[1].port - MONITOR_PORT_OFFSET}"
+    assert slow in out
+
+
+# -------------------------------------------------- journal invariants
+def _final(stream, submitted, finished, evicted, open_n=0,
+           version=2, size=4):
+    return {"kind": "final", "stream": stream, "submitted": submitted,
+            "finished": finished, "evicted": evicted, "open": open_n,
+            "version": version, "size": size}
+
+
+def test_check_serving_journal_conservation_holds():
+    evs = [_final("w0", 10, 8, 2), _final("w1", 5, 5, 0)]
+    assert check_serving_journal(evs) == []
+
+
+def test_check_serving_journal_flags_leaks_and_split_membership():
+    evs = [_final("w0", 10, 8, 1),            # 8+1 != 10: leaked
+           _final("w1", 5, 5, 0, open_n=1),   # open after eviction
+           _final("w2", 5, 5, 0, version=3)]  # split membership
+    bad = check_serving_journal(evs)
+    assert len(bad) == 3
+    assert any("w0" in b and "leaks" in b for b in bad)
+    assert any("w1" in b for b in bad)
+    assert any("membership disagrees" in b for b in bad)
+
+
+def test_check_serving_journal_requires_a_final():
+    assert check_serving_journal([{"kind": "step"}]) != []
+
+
+def test_run_serving_has_no_progress_counters_clause():
+    """Replicas serve independent request streams: differing
+    submitted/finished counters across finals must NOT violate (the
+    single-winner progress clause does not apply to serving)."""
+    evs = [_final("w0", 10, 8, 2), _final("w1", 99, 99, 0)]
+    assert run_serving(evs) == []
+
+
+# -------------------------------------------- raise-then-clear contract
+def test_doctor_violations_cleared_requires_inactive_at_stop():
+    expect = {"kind": "fleet-slo", "rank": None, "cleared": True}
+    found = [{"kind": "fleet-slo", "rank": None, "instance": "fleet"}]
+    # raised and cleared: ok
+    assert doctor_violations(expect, found, active=set()) == []
+    # raised but still active at the last diagnose: violation
+    v = doctor_violations(expect, found,
+                          active={("fleet-slo", "fleet")})
+    assert v and "never cleared" in v[0]
+    # never raised at all: violation regardless of active
+    v = doctor_violations(expect, [], active=set())
+    assert v and "expected" in v[0]
+    # other active kinds do not block the clear
+    assert doctor_violations(
+        expect, found, active={("slo-violation", "0")}) == []
+
+
+# ------------------------------------------------------- lite imports
+def test_sim_serving_imports_no_jax():
+    """The fleet twin of the fake-trainer lite pin: a serving replica
+    process must never pull jax under KFT_SIM_LITE (what makes a
+    20-replica fleet affordable on one box)."""
+    code = (
+        "import json, os, sys\n"
+        "os.environ['KFT_SIM_LITE'] = '1'\n"
+        "import kungfu_tpu.sim.serving\n"
+        "bad = [m for m in sys.modules if m.split('.')[0] in "
+        "('jax', 'jaxlib')]\n"
+        "print(json.dumps(bad))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip()) == []

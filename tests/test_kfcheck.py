@@ -812,6 +812,23 @@ def test_metrics_summary_suffixes_normalize(tmp_path):
     assert run_program(tmp_path, files) == []
 
 
+def test_kfload_is_a_metrics_consumer(tmp_path):
+    """tools/kfload.py parses /metrics expositions (fleet bench knee
+    detection): any metric literal there must resolve against a real
+    published family, even outside a series() call."""
+    files = dict(METRICS_OK)
+    files["tools/kfload.py"] = """
+        THRESH = {"kungfu_tpu_fleet_phantom_gauge": 2.0}
+    """
+    fs = run_program(tmp_path, files)
+    assert rules_fired(fs) == {"metrics-consistency"}
+    assert "kungfu_tpu_fleet_phantom_gauge" in fs[0].message
+    files["tools/kfload.py"] = """
+        THRESH = {"kungfu_tpu_step_seconds": 2.0}
+    """
+    assert run_program(tmp_path, files) == []
+
+
 def test_misspelled_doctor_metric_fails_ci(tmp_path):
     """Acceptance gate: misspell one doctor-consumed metric name in the
     REAL sources and CI step 0 goes red."""

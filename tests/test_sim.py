@@ -86,7 +86,10 @@ def test_sim_scenarios_merged_into_cli_matrix():
             "sim-straggler-doctor-100", "sim-slowlink-doctor-100",
             "sim-slowlink-doctor-clean", "sim-policy-shadow-100",
             "sim-policy-shadow-clean", "sim-spot-trace",
-            "sim-grow-join", "sim-grow-fanout"} <= sims
+            "sim-grow-join", "sim-grow-fanout",
+            "sim-serve-smoke", "sim-serve-spike-20",
+            "sim-serve-imbalance-20", "sim-serve-imbalance-20-clean",
+            "sim-serve-replica-kill"} <= sims
     for n in sims:
         sc = m[n]
         assert sc.parent_port is None  # concurrency: OS-assigned ports
@@ -111,6 +114,16 @@ def test_min_fired_floor():
     v = floor_violations(sc, fired, [])
     assert v and "fault(s) fired" in v[0]
     assert floor_violations(sc, fired * 2, []) == []
+
+
+def test_min_served_floor():
+    sc = _floor_sc(min_served=10)
+    ev = [{"kind": "final", "stream": "w0", "finished": 4},
+          {"kind": "final", "stream": "w1", "finished": 3}]
+    v = floor_violations(sc, [], ev)
+    assert v and "finished only 7" in v[0]
+    ev.append({"kind": "final", "stream": "w2", "finished": 3})
+    assert floor_violations(sc, [], ev) == []
 
 
 def test_min_config_versions_floor():

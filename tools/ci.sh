@@ -121,6 +121,17 @@ python tools/kfpolicy.py --smoke || exit 1
 # step that owns the contract (warm fact cache: ~0.3 s)
 python -m tools.kfcheck --program --pass version-fence || exit 1
 
+# kffleet smoke (`make serve-sim-smoke`): a 4-replica fake serving
+# fleet under the REAL watcher + config server, driven by a seeded
+# diurnal arrival trace with forced preempt/re-admit — asserts the
+# serving-journal conservation invariants (finished + evicted ==
+# submitted, no open requests at drain), the fleet gauges on the
+# aggregator, and the min_served floor.  Lite (no-jax) replicas: NO
+# data-plane gate, must never self-skip (~15 s; docs/serving.md
+# "Fleet observability")
+say "0i/3 kffleet sim-serving fleet smoke"
+python -m kungfu_tpu.chaos.runner --scenario sim-serve-smoke || exit 1
+
 say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1

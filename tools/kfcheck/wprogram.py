@@ -211,7 +211,7 @@ class MetricsConsistency(ProgramPass):
     CONSUMERS = re.compile(
         r"^kungfu_tpu/monitor/(doctor|history|cluster)\.py$"
         r"|^kungfu_tpu/policy/(engine|rules)\.py$"
-        r"|^tools/(kfprof_report|kfnet_report|kfpolicy"
+        r"|^tools/(kfprof_report|kfnet_report|kfpolicy|kfload"
         r"|metrics_trace_smoke)\.py$")
     SUFFIXES = ("_sum", "_count", "_bucket")
 

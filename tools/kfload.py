@@ -123,16 +123,27 @@ def _make_prompt(rng: random.Random, length: int, vocab: int,
 
 
 # ------------------------------------------------------- generators
-def _run_arrivals(url: str, offsets: List[float],
+def _run_arrivals(urls, offsets: List[float],
                   prompts: List[List[int]], max_news: List[int],
                   timeout: float):
     """Open-loop core: fire request i at ``offsets[i]`` seconds after
-    start, on its own thread, regardless of how the server is doing."""
+    start, on its own thread, regardless of how the server is doing.
+
+    ``urls`` is one base URL or a fleet of them: request i goes to
+    ``urls[i % len(urls)]`` — a DETERMINISTIC round-robin stand-in for
+    a front-end dispatcher (each replica sees the same offered share,
+    which is exactly the balanced-front-end premise the kffleet
+    ``imbalance`` detector diagnoses against), NOT a load-aware
+    router."""
+    if isinstance(urls, str):
+        urls = [urls]
     results: List[Optional[Dict[str, object]]] = [None] * len(offsets)
 
     def one(i: int) -> None:
-        results[i] = _request_once(url, prompts[i], max_news[i],
-                                   timeout)
+        r = _request_once(urls[i % len(urls)], prompts[i], max_news[i],
+                          timeout)
+        r["replica"] = i % len(urls)
+        results[i] = r
 
     t0 = time.perf_counter()
     threads = []
@@ -158,6 +169,44 @@ def _poisson_offsets(rng: random.Random, rate: float,
         if t >= duration:
             return offs or [0.0]
         offs.append(t)
+
+
+def _synth_trace(spec: str, duration: float):
+    """``--trace synth:diurnal:<seed>[:k=v,...]`` — a seeded synthetic
+    diurnal/bursty schedule instead of a recorded journal, same
+    ``(offsets, prompt_lens, output_budgets)`` contract.  The generator
+    (kungfu_tpu.sim.serving.synth_diurnal_schedule) is a pure function
+    of its arguments: two runs with the same spec are bit-identical.
+    Optional keys: ``base``/``peak`` (rps), ``spike`` (rps, square
+    burst over the 40-65% window), ``plen``/``new`` (tokens)."""
+    from kungfu_tpu.sim.serving import synth_diurnal_schedule
+    parts = spec.split(":")
+    if len(parts) < 3 or parts[0] != "synth" or parts[1] != "diurnal":
+        raise SystemExit(
+            f"kfload: bad synthetic trace spec {spec!r} "
+            f"(want synth:diurnal:<seed>[:k=v,...])")
+    try:
+        seed = int(parts[2])
+    except ValueError:
+        raise SystemExit(f"kfload: non-integer seed in {spec!r}")
+    kw = {"base_rps": 2.0, "peak_rps": 8.0, "spike_rps": 0.0,
+          "prompt_len": 8, "max_new": 8}
+    keymap = {"base": "base_rps", "peak": "peak_rps",
+              "spike": "spike_rps", "plen": "prompt_len",
+              "new": "max_new"}
+    for kv in ",".join(parts[3:]).split(","):
+        if not kv:
+            continue
+        k, _, v = kv.partition("=")
+        if k not in keymap or not v:
+            raise SystemExit(f"kfload: bad synth key {kv!r} in {spec!r} "
+                             f"(known: {sorted(keymap)})")
+        try:
+            kw[keymap[k]] = (int(v) if keymap[k] in
+                             ("prompt_len", "max_new") else float(v))
+        except ValueError:
+            raise SystemExit(f"kfload: bad synth value {kv!r}")
+    return synth_diurnal_schedule(seed, duration_s=duration, **kw)
 
 
 def _load_journal(path: str):
@@ -219,6 +268,23 @@ def _rung_stats(tag: str, offered_rps: Optional[float],
                           else 0.0)
     out["goodput_frac"] = (round(len(good) / len(results), 4)
                            if results else 0.0)
+    replicas = sorted({r.get("replica") for r in results
+                       if r.get("replica") is not None})
+    if len(replicas) > 1:
+        # fleet fan-out: the per-replica split of the same rung, so
+        # the committed bench shows who absorbed what
+        by_rep = {}
+        for idx in replicas:
+            rs = [r for r in results if r.get("replica") == idx]
+            rok = [r for r in rs if r.get("ok")]
+            by_rep[str(idx)] = {
+                "requests": len(rs), "completed": len(rok),
+                "ttft_p50_ms": round(
+                    _pctl([r["ttft_ms"] for r in rok], 0.50), 2),
+                "ttft_p99_ms": round(
+                    _pctl([r["ttft_ms"] for r in rok], 0.99), 2),
+            }
+        out["by_replica"] = by_rep
     return out
 
 
@@ -282,13 +348,112 @@ def _stop_server(proc, log) -> None:
     log.close()
 
 
+# ------------------------------------------------------- fleet bench
+# service-time shape for the spawned sim replicas: slow enough that
+# one replica's knee sits INSIDE the swept rates (2 slots x ~200ms
+# per request ≈ 10 rps capacity), so the single-vs-fleet knee ratio
+# is a measurement, not a ceiling artifact
+_SIM_REPLICA_ENV = {"KFT_SIM_LITE": "1", "KFT_SIM_SERVE_SLOTS": "2",
+                    "KFT_SIM_SERVE_PREFILL_MS": "1.0",
+                    "KFT_SIM_SERVE_DECODE_MS": "25.0"}
+
+
+def _spawn_sim_replica(log_path: str):
+    """One standalone kfsim serving replica (sim/serving.py): the
+    production HTTP contract over a deterministic synthetic service
+    model, jax-free under KFT_SIM_LITE — what makes the fleet bench
+    runnable data-plane-free on any box."""
+    port = _free_port()
+    env = dict(os.environ, **_SIM_REPLICA_ENV)
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kungfu_tpu.sim.serving",
+         "--port", str(port)],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            log.close()
+            raise SystemExit(f"kfload: sim replica died "
+                             f"(rc={proc.returncode}, see {log_path})")
+        try:
+            with urllib.request.urlopen(url + "/stats",
+                                        timeout=2.0) as r:
+                if r.status == 200:
+                    return proc, url, log
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    log.close()
+    raise SystemExit("kfload: sim replica never became ready")
+
+
+def _fleet_bench(args) -> int:
+    """``--fleet-bench N``: spawn N sim serving replicas, sweep ONE
+    replica, then sweep the round-robin fleet of all N, and commit
+    both knees + their ratio to ``FLEET_SERVING_BENCH.json`` — the
+    scaling headroom a front-end dispatcher buys, measured with the
+    same open-loop generator both times."""
+    n = args.fleet_bench
+    # tight TTFT budget so the single replica's knee is a sharp
+    # queueing cliff inside the swept rates (the default 2s budget
+    # absorbs seconds of queue and blurs the knee); setdefault so an
+    # operator's own KFT_SLO_* wins
+    for k, v in (("KFT_SLO_TTFT_MS", "250"),
+                 ("KFT_SLO_TPOT_MS", "100"),
+                 ("KFT_SLO_E2E_MS", "2000")):
+        os.environ.setdefault(k, v)
+    out_dir = tempfile.mkdtemp(prefix="kfload-fleet-")
+    fleet = [_spawn_sim_replica(os.path.join(out_dir, f"rep{i}.log"))
+             for i in range(n)]
+    urls = [u for _p, u, _l in fleet]
+    try:
+        args.fleet = None
+        args.url = urls[0]
+        single = run_bench(args)
+        args.fleet = urls
+        fleet_doc = run_bench(args)
+    finally:
+        for proc, _u, log in fleet:
+            _stop_server(proc, log)
+    k1 = single["saturation_knee_rps"]
+    kn = fleet_doc["saturation_knee_rps"]
+    doc = {
+        "bench": "kfload-fleet",
+        "replicas": n,
+        "seed": args.seed,
+        "rates": args.rates,
+        "duration_s": args.duration,
+        "sim_replica_env": dict(_SIM_REPLICA_ENV),
+        "slo": {obj: os.environ.get(f"KFT_SLO_{obj.upper()}_MS")
+                for obj in ("ttft", "tpot", "e2e")},
+        "single": {"url": single["url"], "rungs": single["rungs"],
+                   "saturation_knee_rps": k1},
+        "fleet": {"urls": urls, "rungs": fleet_doc["rungs"],
+                  "saturation_knee_rps": kn},
+        "knee_ratio": (round(kn / k1, 3)
+                       if k1 and kn is not None else None),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"kfload: fleet bench -> {args.out} (single knee {k1} rps, "
+          f"{n}-replica fleet knee {kn} rps, "
+          f"ratio {doc['knee_ratio']})")
+    return 0
+
+
 # -------------------------------------------------------------- main
 def run_bench(args) -> Dict[str, object]:
     from kungfu_tpu.serving.slo import load_slos
     rng = random.Random(args.seed)
     slos = load_slos()
     timeout = knobs.get("KFT_LOAD_TIMEOUT_S")
-    url = args.url.rstrip("/")
+    urls = [u.rstrip("/") for u in
+            (args.fleet if getattr(args, "fleet", None)
+             else [args.url])]
+    url = urls[0]
     prefix = [rng.randrange(1, args.vocab)
               for _ in range(max(1, args.prompt_len // 2))]
 
@@ -300,15 +465,20 @@ def run_bench(args) -> Dict[str, object]:
     rungs: List[Dict[str, object]] = []
     if args.mode == "sweep":
         # warm-up absorbs the jit compiles so rung 1 is steady-state
-        warm = prompts_for(2)
-        for p in warm:
-            _request_once(url, p, args.max_new, timeout)
+        # (every fleet member gets one)
+        for u in urls:
+            for p in prompts_for(2):
+                _request_once(u, p, args.max_new, timeout)
         for rate in args.rates:
             offs = _poisson_offsets(rng, rate, args.duration)
             ps = prompts_for(len(offs))
             res, span = _run_arrivals(
-                url, offs, ps, [args.max_new] * len(offs), timeout)
-            rungs.append(_rung_stats(f"poisson-{rate:g}rps", rate,
+                urls, offs, ps, [args.max_new] * len(offs), timeout)
+            # the rung is judged against what this Poisson draw
+            # actually offered, not the nominal rate — a short draw
+            # must not fail the knee test for load it never sent
+            realized = round(len(offs) / args.duration, 3)
+            rungs.append(_rung_stats(f"poisson-{rate:g}rps", realized,
                                      res, span, slos))
             print(f"kfload: {rungs[-1]['rung']}: "
                   f"{rungs[-1]['completed']}/{rungs[-1]['requests']} "
@@ -345,10 +515,13 @@ def run_bench(args) -> Dict[str, object]:
         rungs.append(_rung_stats(
             f"closed-c{args.concurrency}", None, results, span, slos))
     else:   # replay
-        offs, plens, outs = _load_journal(args.trace)
+        if str(args.trace).startswith("synth:"):
+            offs, plens, outs = _synth_trace(args.trace, args.duration)
+        else:
+            offs, plens, outs = _load_journal(args.trace)
         offs = [o / args.speed for o in offs]
         ps = prompts_for(len(offs), plens)
-        res, span = _run_arrivals(url, offs, ps, outs, timeout)
+        res, span = _run_arrivals(urls, offs, ps, outs, timeout)
         offered = len(offs) / max(offs[-1], 1e-9) if offs else None
         rungs.append(_rung_stats(
             f"replay-x{args.speed:g}", round(offered, 3), res, span,
@@ -358,6 +531,7 @@ def run_bench(args) -> Dict[str, object]:
         "bench": "kfload",
         "mode": args.mode,
         "url": url,
+        "fleet": urls if len(urls) > 1 else None,
         "prompt_len": args.prompt_len,
         "max_new": args.max_new,
         "prefix_frac": args.prefix_frac,
@@ -421,6 +595,13 @@ def _parse(argv):
     ap.add_argument("--url", default=None,
                     help="serving server base URL (default: spawn a "
                          "tiny seed-initialized CPU server)")
+    ap.add_argument("--fleet", nargs="+", default=None, metavar="URL",
+                    help="fan requests out round-robin over several "
+                         "serving replicas (deterministic stand-in "
+                         "dispatcher, not a load-aware router)")
+    ap.add_argument("--fleet-bench", type=int, default=0, metavar="N",
+                    help="spawn N sim serving replicas, sweep one vs "
+                         "the fleet, write FLEET_SERVING_BENCH.json")
     ap.add_argument("--mode", choices=("sweep", "closed", "replay"),
                     default="sweep")
     ap.add_argument("--rates", default="2,4,8",
@@ -452,6 +633,11 @@ def _parse(argv):
     args.rates = [float(r) for r in str(args.rates).split(",") if r]
     if args.mode == "replay" and not args.smoke and not args.trace:
         ap.error("--mode replay requires --trace")
+    if args.fleet_bench:
+        if args.fleet_bench < 2:
+            ap.error("--fleet-bench needs N >= 2 replicas")
+        if args.out == "SERVING_BENCH.json":
+            args.out = "FLEET_SERVING_BENCH.json"
     return args
 
 
@@ -459,8 +645,10 @@ def main(argv=None) -> int:
     args = _parse(argv)
     if args.smoke:
         return _smoke()
+    if args.fleet_bench:
+        return _fleet_bench(args)
     proc = log = None
-    if args.url is None:
+    if args.url is None and not args.fleet:
         trace_dir = tempfile.mkdtemp(prefix="kfload-")
         proc, args.url, log = _spawn_server(
             trace_dir, os.path.join(trace_dir, "server.log"))
