@@ -30,12 +30,16 @@ knobs-docs:
 # kfchaos tier-1 scenarios: SIGKILL a rank inside the collective commit,
 # then SIGKILL+restart the WAL-backed config server mid-resize (kfguard;
 # --replay-check runs it twice and requires identical fault journals),
-# asserting every elastic contract each time (docs/chaos.md).  Self-skips
-# on images whose jax cannot run the multiprocess data plane.
+# asserting every elastic contract each time (docs/chaos.md).  The
+# first two self-skip on images whose jax cannot run the multiprocess
+# data plane; kill-relay-mid-wave (kftree: SIGKILL an interior relay
+# the moment it re-serves — its subtree must fall back to direct
+# holder pulls) is sim-tier and never self-skips.
 chaos-smoke: native
 	python -m kungfu_tpu.chaos.runner --scenario smoke
 	python -m kungfu_tpu.chaos.runner \
 	    --scenario config-server-crash-restart-mid-resize --replay-check
+	python -m kungfu_tpu.chaos.runner --scenario kill-relay-mid-wave
 
 # kfsim smoke: a 20-fake-worker rolling preemption wave under the REAL
 # watcher + config server — no jax, no data plane, so it can NEVER
@@ -115,10 +119,12 @@ policy-smoke:
 snapshot-bench:
 	python tools/bench_snapshot.py
 
-# kffast smoke: one small 2-worker p2p bench pass over the native
-# plane — shm lane engaged, segment-mapped copy vs socket wire, chunk
-# streaming vs per-chunk RPCs, buffer-pool fresh-alloc pin
-# (docs/elastic.md "Store fast lane").  Regenerate the committed
+# kffast + kftree smoke: one small 2-worker p2p bench pass over the
+# native plane — shm lane engaged, segment-mapped copy vs socket wire,
+# chunk streaming vs per-chunk RPCs, buffer-pool fresh-alloc pin —
+# plus one 4-puller fanout wave pinning the kftree relay tree at
+# >= 1.5x faster than the direct star (docs/elastic.md "Store fast
+# lane" / "Distribution trees").  Regenerate the committed
 # P2P_BENCH.json with tools/bench_p2p.py (see its docstring).
 p2p-smoke: native
 	python tools/bench_p2p.py --smoke

@@ -22,8 +22,26 @@ Two numbers per worker:
   Reported as the fraction of pulls that completed within the step,
   i.e. how much of the exchange the compute actually hides.
 
+Since kftree the artifact also carries a **fanout tier** (``schema:
+p2p-phase-v3``, the ``fanout`` block): one holder distributes the
+model to k pullers over an emulated finite egress link (cooperative
+puller-side pacing — every edge's rate is the link divided by how
+many children share the serving peer's egress), once as a star
+(every puller direct from the holder: today's O(k) wall) and once
+through :func:`kungfu_tpu.comm.tree.plan_tree`'s relay tree with the
+pullers re-serving chunks as they land (``relay_pull_chunked``).
+Both modes run the SAME chunk engine — only the plan differs — so
+the speedup isolates the topology.  ``--fanout 2,4,8,16`` adds the
+tier; the committed full run uses a 1728 MB model over a 64 MiB/s
+link.  Pick ``--link-mib-s`` well below the host's real copy
+bandwidth divided by the largest wave's process count: the pacing
+sleeps must dominate, or the measurement degrades into the host's
+memcpy ceiling (on this 1-core container, k+1 processes all copying
+concurrently) and the tree — whose ideal wall is the SHORTEST — is
+the mode that hits it first.
+
 Since kfnet the artifact also carries a per-phase breakdown
-(``schema: p2p-phase-v2``): serialize / wire / deserialize GiB/s for
+(serialize / wire / deserialize GiB/s for
 the whole-blob pull and the chunked ``{key}.cN`` tier — measured with
 the shm lane OFF so they stay comparable to the committed socket-path
 baseline — plus the kffast lanes the optimisation work added:
@@ -301,6 +319,134 @@ def _worker(args) -> None:
     p.close()
 
 
+def _make_pace(rate_bytes_s: float):
+    """Cooperative link emulation: a ``pace(nbytes)`` callback that
+    sleeps this puller to ``rate_bytes_s`` — its share of the serving
+    peer's finite egress.  Token-bucket over the whole run, so bursts
+    borrow from earlier slack instead of compounding sleep error."""
+    state = {"due": None}
+
+    def pace(nbytes: int) -> None:
+        now = time.perf_counter()
+        if state["due"] is None:
+            state["due"] = now
+        state["due"] += nbytes / rate_bytes_s
+        if state["due"] > now:
+            time.sleep(state["due"] - now)
+    return pace
+
+
+def _fanout_worker(args) -> None:
+    """One fanout wave: rank 0 holds the chunked model; the other
+    ``size - 1`` ranks pull it twice over an emulated ``--link-mib-s``
+    egress — once as a star (direct: every puller shares the holder's
+    link 1/k), once through the planned relay tree (each edge shares
+    its serving peer's link only with that peer's children, and
+    relays re-publish chunks as they land).  Rank 0's barrier-to-
+    barrier wall is the wave's time-to-synced."""
+    from .. import native
+    from ..comm import tree as _tree
+
+    p = native.default_peer()
+    rank, size = p.rank, p.size
+    k = size - 1
+    n_f32 = args.size_mb * (1 << 20) // 4
+    nchunks = 32
+    per = -(-n_f32 // nchunks)
+    link = args.link_mib_s * (1 << 20)
+    if rank == 0:
+        model = np.full(n_f32, 7.0, np.float32)
+        for j in range(nchunks):
+            span = model[j * per:(j + 1) * per]
+            if span.size:
+                p.save(f"fan.c{j}", span, version=0)
+    pullers = list(range(1, size))
+    star = _tree.TreePlan(
+        roots=(0,), parent={r: 0 for r in pullers},
+        children={0: tuple(pullers), **{r: () for r in pullers}},
+        depth={0: 0, **{r: 1 for r in pullers}},
+        lane={r: "wire" for r in pullers})
+    tree = _tree.plan_tree(pullers, [0])
+    out = None
+    if rank != 0:
+        out = np.empty(n_f32, np.float32)
+        out[:] = 0.0                          # fault pages untimed
+    walls = {}
+    for mode, plan in (("direct", star), ("tree", tree)):
+        p.barrier(name=f"fan-{mode}-start")
+        t0 = time.perf_counter()
+        if rank != 0:
+            share = link / max(
+                1, len(plan.children_of(plan.parent[rank])))
+            got = _tree.relay_pull_chunked(
+                p, plan, "fan", nchunks, per, np.float32, (n_f32,),
+                version=0, wait_s=600.0, pace=_make_pace(share),
+                out=out)
+            assert got[0] == 7.0 and got[-1] == 7.0
+            out[:] = 0.0
+        p.barrier(name=f"fan-{mode}-end")
+        walls[mode] = time.perf_counter() - t0
+    if rank == 0:
+        doc = {
+            "bench": "p2p-fanout",
+            "pullers": k,
+            "model_mb": args.size_mb,
+            "link_mib_s": args.link_mib_s,
+            "direct_s": round(walls["direct"], 3),
+            "tree_s": round(walls["tree"], 3),
+            "speedup": round(walls["direct"] / walls["tree"], 3),
+            "tree_depth": tree.max_depth(),
+            "tree_fanout": tree.max_fanout(),
+        }
+        print("RESULT " + json.dumps(doc), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+    p.close()
+
+
+def _run_fanout_tier(args) -> dict:
+    """Parent side of the fanout tier: one launcher run per puller
+    count, each wave's rank-0 doc collected via a temp file.  The
+    waves run with the shm lane off — the tier measures the WIRE
+    topology, and k relays each shm-publishing a full model copy
+    would put the 16-puller wave's footprint into tmpfs."""
+    import tempfile
+    mb = args.fanout_size_mb or args.size_mb
+    block = {"model_mb": mb, "link_mib_s": args.link_mib_s,
+             "pullers": {}}
+    for k in [int(x) for x in str(args.fanout).split(",") if x]:
+        td = tempfile.mkdtemp(prefix="kfp2p-fanout-")
+        wave_out = os.path.join(td, f"fanout{k}.json")
+        env = dict(os.environ)
+        env["KFT_SHM_LANE"] = "0"
+        # the holder blocks in the end-of-wave barrier for the whole
+        # paced direct wall (~ mb*k/link seconds) — the plane's default
+        # 120 s recv timeout would call a slow-by-design wave a hang
+        wall = mb * max(1, k) / max(1.0, args.link_mib_s)
+        env["KFT_RECV_TIMEOUT_S"] = str(max(120.0, 2.0 * wall + 120.0))
+        cmd = [sys.executable, "-m", "kungfu_tpu.launcher", "-np",
+               str(k + 1), "--", sys.executable, "-m",
+               "kungfu_tpu.benchmarks.p2p", "-np", str(k + 1),
+               "--fanout-run", str(k), "--size-mb", str(mb),
+               "--link-mib-s", str(args.link_mib_s),
+               "--out", wave_out]
+        r = subprocess.run(cmd, env=env)
+        if r.returncode != 0 or not os.path.exists(wave_out):
+            raise RuntimeError(
+                f"fanout wave k={k} failed (rc={r.returncode})")
+        with open(wave_out) as f:
+            wave = json.load(f)
+        block["pullers"][str(k)] = {
+            kk: wave[kk] for kk in ("direct_s", "tree_s", "speedup",
+                                    "tree_depth", "tree_fanout")}
+        print(f"fanout k={k}: direct {wave['direct_s']}s vs tree "
+              f"{wave['tree_s']}s ({wave['speedup']}x, depth "
+              f"{wave['tree_depth']})", flush=True)
+    return block
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m kungfu_tpu.benchmarks.p2p")
     ap.add_argument("-np", type=int, default=4, dest="nproc")
@@ -309,6 +455,18 @@ def main(argv=None):
     ap.add_argument("--secs", type=float, default=3.0)
     ap.add_argument("--compute-ms", type=float, default=50.0,
                     help="simulated local step for the hidden loop")
+    ap.add_argument("--fanout", default=None,
+                    help="comma list of puller counts for the kftree "
+                         "fanout tier (e.g. 2,4,8,16); each count is "
+                         "its own launcher wave")
+    ap.add_argument("--fanout-size-mb", type=int, default=None,
+                    help="model size for the fanout tier "
+                         "(default: --size-mb)")
+    ap.add_argument("--link-mib-s", type=float, default=160.0,
+                    help="emulated per-peer egress link for the "
+                         "fanout tier")
+    ap.add_argument("--fanout-run", type=int, default=None,
+                    help=argparse.SUPPRESS)   # internal: one wave
     ap.add_argument("--out", default=None,
                     help="write the rank-0 JSON doc here "
                          "(e.g. P2P_BENCH.json)")
@@ -316,7 +474,10 @@ def main(argv=None):
 
     from ..utils import knobs
     if knobs.raw("KFT_SELF_SPEC"):
-        _worker(args)
+        if args.fanout_run is not None:
+            _fanout_worker(args)
+        else:
+            _worker(args)
         return 0
 
     # parent: spawn through the launcher so workers get the env ABI
@@ -328,6 +489,20 @@ def main(argv=None):
     if args.out:
         cmd += ["--out", args.out]
     r = subprocess.run(cmd)
+    if r.returncode != 0:
+        return r.returncode
+    if args.fanout:
+        fan = _run_fanout_tier(args)
+        if args.out:
+            with open(args.out) as f:
+                doc = json.load(f)
+            doc["schema"] = "p2p-phase-v3"
+            doc["fanout"] = fan
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+        else:
+            print("FANOUT " + json.dumps(fan), flush=True)
     return r.returncode
 
 

@@ -350,10 +350,23 @@ class Scenario:
     min_fired: int = 0
     min_config_versions: int = 0
     # kffast fan-out proof floor: at least this many DISTINCT donors in
-    # the join ledger (``sync`` events carrying a ``donor`` field) —
-    # proves a grow's adoption pulls spread over the holders instead of
-    # every joiner converging on one
+    # the join ledger (``sync`` events carrying a ``donor`` field), AND
+    # at least one pair of distinct-donor pulls whose [t0, t1] journal
+    # windows OVERLAP — distinct donors alone also pass when every
+    # joiner pulls from the same pair in sequence, which proves nothing
+    # about fan-out
     min_sync_donors: int = 0
+    # kftree proof floors (docs/elastic.md "Distribution trees"):
+    # wave speedup — the measured sequential-pull baseline (sum of the
+    # sync events' service-only pull_s) divided by the wave wall
+    # (max t1 - min t0) must reach this, and every adopting joiner's
+    # wsum must be BIT-identical to the seeded oracle at its adopted
+    # step (0 = unchecked)
+    min_sync_speedup: float = 0.0
+    # ranks the planner must have parked at the leaves: their ``relay``
+    # events must exist and show children == 0 (the slowlink-to-leaf
+    # contract)
+    relay_leaf_ranks: Sequence[int] = ()
     # ---- kffleet (docs/serving.md "Fleet observability"): sim_serve
     # swaps the fake-TRAINER payload for fake serving REPLICAS
     # (sim/serving.py) under the same watcher, and the invariant sweep
@@ -1036,15 +1049,76 @@ def floor_violations(sc: Scenario, fired: List[dict],
                 f"fleet finished only {served} request(s) (scenario "
                 f"requires >= {sc.min_served}: the synthetic load "
                 f"never landed, so the scenario proved nothing)")
+    syncs = [e for e in events
+             if e.get("kind") == "sync" and e.get("donor")]
     if sc.min_sync_donors:
-        donors = {e.get("donor") for e in events
-                  if e.get("kind") == "sync" and e.get("donor")}
+        donors = {e["donor"] for e in syncs}
         if len(donors) < sc.min_sync_donors:
             violations.append(
                 f"join ledger shows only {len(donors)} distinct sync "
                 f"donor(s) {sorted(donors)} (scenario requires >= "
                 f"{sc.min_sync_donors}: the kffast fan-out pull pattern "
                 f"must spread joiners across holders)")
+        # distinct donors alone also pass when the joiners pull from
+        # the same pair one-at-a-time; CONCURRENT fan-out means two
+        # pulls from different donors whose journal windows overlap
+        timed = [e for e in syncs
+                 if e.get("t0") is not None and e.get("t1") is not None]
+        overlapped = any(
+            a["donor"] != b["donor"]
+            and float(a["t0"]) < float(b["t1"])
+            and float(b["t0"]) < float(a["t1"])
+            for i, a in enumerate(timed) for b in timed[i + 1:])
+        if not overlapped:
+            violations.append(
+                f"no pair of distinct-donor sync pulls overlapped "
+                f"({len(timed)} timed pull(s)): the joiners drew from "
+                f"their donors in sequence, which is serial fan-in, "
+                f"not concurrent fan-out")
+    if sc.min_sync_speedup:
+        timed = [e for e in syncs
+                 if e.get("t0") is not None and e.get("t1") is not None
+                 and int(e.get("samples", 0)) > 0]
+        baseline = sum(float(e.get("pull_s") or 0.0) for e in timed)
+        wall = (max(float(e["t1"]) for e in timed)
+                - min(float(e["t0"]) for e in timed)) if timed else 0.0
+        if baseline <= 0.0 or wall <= 0.0:
+            violations.append(
+                f"wave speedup unmeasurable ({len(timed)} timed "
+                f"sync(s), baseline {baseline:.2f}s, wall {wall:.2f}s) "
+                f"— the scenario needs KFT_SIM_STATE_SERVE_S so the "
+                f"sequential baseline exists")
+        elif baseline / wall < sc.min_sync_speedup:
+            violations.append(
+                f"grow wave reached only {baseline / wall:.2f}x over "
+                f"the measured sequential-pull baseline "
+                f"({len(timed)} adoptions, sum(pull_s) "
+                f"{baseline:.1f}s sequential vs {wall:.1f}s wave wall; "
+                f"scenario requires >= {sc.min_sync_speedup}x)")
+        from ..sim import sim_wsum
+        for e in timed:
+            want = sim_wsum(sc.sim_seed, int(e["samples"]) // sc.batch)
+            if float(e.get("wsum", float("nan"))) != want:
+                violations.append(
+                    f"adopted state diverges from the oracle: sync at "
+                    f"samples={e['samples']} carries wsum={e.get('wsum')}"
+                    f" but the seeded trajectory says {want} (relay "
+                    f"adoption must be bit-identical)")
+    if sc.relay_leaf_ranks:
+        relays = {e.get("rank"): e for e in events
+                  if e.get("kind") == "relay"}
+        for r in sc.relay_leaf_ranks:
+            ev = relays.get(r)
+            if ev is None:
+                violations.append(
+                    f"rank {r} emitted no relay event (scenario "
+                    f"requires the planner to place it, as a leaf)")
+            elif int(ev.get("children", -1)) != 0:
+                violations.append(
+                    f"slowlink rank {r} was planned "
+                    f"{ev.get('children')} relay children (depth "
+                    f"{ev.get('depth')}): slow links must be pushed to "
+                    f"the leaves where they serve nobody")
     return violations
 
 

@@ -96,6 +96,12 @@ SITES: Dict[str, str] = {
         "/state endpoints for committed synthetic state — a drop-rpc "
         "or exception here models a joiner that cannot reach any "
         "donor and must found from zero"),
+    "comm.relay.serve": (
+        "kftree relay node (comm/tree.py, sim/trainer.py), the moment "
+        "a node with planned children starts re-serving pulled state — "
+        "a kill here SIGKILLs an interior relay while its subtree "
+        "depends on it (kill-relay-mid-wave): the children must fall "
+        "back to direct holder pulls, never wedge the wave"),
     # ------------------------------------------------ launcher / watcher
     "launcher.watch.update": (
         "watcher applying a Stage{version, cluster} diff, before any "

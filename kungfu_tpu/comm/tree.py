@@ -1,0 +1,493 @@
+"""kftree — pipelined relay/broadcast trees for one-to-many distribution.
+
+A grow wave of k joiners (or k serving replicas adopting one model)
+pulling the same key-set from the same holders costs k independent
+transfers through the holders' egress: time-to-synced is O(k).  This
+module turns the pullers themselves into relays:
+
+* :func:`plan_tree` — the **distribution planner**.  Given the puller
+  set, the holder set, the host topology and (optionally) kfnet's
+  per-peer bandwidth evidence, it emits a deterministic relay tree:
+  holders at the roots, degree bounded by ``KFT_TREE_FANOUT``, one
+  wire edge per host (intra-host fan-out continues under that host's
+  leader over the shm lane), and slow ranks — per the slowlink
+  detector's evidence — pushed to the leaves where they can delay
+  nobody but themselves.
+
+* :func:`relay_pull_chunked` — the **chunk-relay engine**.  The
+  ``{key}.cN`` streamed tier is already chunk-addressed, so a relay
+  re-publishes every chunk the moment it lands and its children pull
+  from *it* rather than the root: a cut-through pipeline (not
+  store-and-forward), ``KFT_STREAM_DEPTH`` requests in flight per
+  edge.  Total wall is one transfer-time plus O(depth) chunk
+  latencies — ~O(log k) for k pullers instead of O(k).
+
+Failure is first-class: a chunk a parent does not have *yet* fails
+fast at the native layer ("peer has no blob"), so the engine retries
+those with backoff until ``KFT_TREE_WAIT_S``; a dead parent (or the
+deadline) degrades that subtree to a direct pull from a holder root —
+today's O(k) behavior, never a wedged wave.  The first re-publish
+passes the ``comm.relay.serve`` chaos site so the kill-relay-mid-wave
+scenario can SIGKILL an interior relay exactly when its children
+depend on it.
+
+Every relayed byte lands in the kfnet ledger under ``op="relay"``
+(``kungfu_tpu_state_move_gib_s{op="relay"}``) and the
+``kungfu_tpu_relay_depth`` / ``kungfu_tpu_relay_fanout`` gauges record
+this rank's position in the tree — ``tools/kfnet_report.py`` renders
+the tree shape and per-edge bandwidth from them.
+
+docs/elastic.md ("Distribution trees") documents the planner rules,
+the relay wire format and the fallback ladder.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..chaos import point as _chaos_point
+from ..native import NativeError
+from ..store.pool import default_pool
+from ..utils import knobs
+
+log = logging.getLogger("kungfu_tpu.comm.tree")
+
+__all__ = ["TreePlan", "plan_tree", "enabled", "relay_pull_chunked",
+           "relay_pull_blobs", "record_relay_shape"]
+
+#: lane tags on a node's parent edge (rendering / docs only)
+LANE_WIRE = "wire"
+LANE_SHM = "shm"
+
+#: backoff between "parent has no blob yet" retries (seconds); doubles
+#: up to _RETRY_MAX_S.  Chunk service times are ms-scale, so the first
+#: retry usually lands.
+_RETRY_BASE_S = 0.005
+_RETRY_MAX_S = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class TreePlan:
+    """A planned relay tree over concrete ranks.
+
+    ``parent`` has an entry for every puller; roots (the holders) have
+    none.  ``children`` and ``depth`` cover every node in the tree,
+    holders included (holders sit at depth 0).  ``lane`` tags each
+    puller's parent edge ``"wire"`` or ``"shm"``.
+    """
+
+    roots: Tuple[int, ...]
+    parent: Dict[int, int]
+    children: Dict[int, Tuple[int, ...]]
+    depth: Dict[int, int]
+    lane: Dict[int, str]
+
+    def children_of(self, rank: int) -> Tuple[int, ...]:
+        return self.children.get(rank, ())
+
+    def depth_of(self, rank: int) -> int:
+        return self.depth.get(rank, 0)
+
+    def max_depth(self) -> int:
+        return max(self.depth.values(), default=0)
+
+    def max_fanout(self) -> int:
+        return max((len(c) for c in self.children.values()), default=0)
+
+    def fallback_root(self, rank: int) -> int:
+        """Deterministic holder for this rank's direct-pull fallback
+        (spread across the roots so a mass fallback is still fanned)."""
+        return self.roots[rank % len(self.roots)]
+
+    def describe(self) -> str:
+        """One-line shape summary for logs and events."""
+        return (f"tree roots={list(self.roots)} pullers={len(self.parent)} "
+                f"depth={self.max_depth()} fanout={self.max_fanout()}")
+
+
+def enabled(npullers: int) -> bool:
+    """Gate shared by every call site: the tree lane is worth its
+    bookkeeping only when enabled and enough pullers want one key-set."""
+    return bool(knobs.get("KFT_TREE_ENABLE")) and \
+        npullers >= int(knobs.get("KFT_TREE_MIN_PULLERS"))
+
+
+def _ordered(pullers: Sequence[int], slow: frozenset,
+             bandwidth: Optional[Dict[int, float]]) -> List[int]:
+    """Attach order: fast ranks first (highest evidence bandwidth,
+    then rank for determinism), slow ranks last — BFS attach then
+    leaves them at the deepest layer with no children unless the tree
+    cannot be built otherwise."""
+    bw = bandwidth or {}
+    return sorted(pullers,
+                  key=lambda r: (r in slow, -float(bw.get(r, 0.0)), r))
+
+
+def plan_tree(pullers: Sequence[int], holders: Sequence[int], *,
+              host_of: Optional[Callable[[int], str]] = None,
+              bandwidth: Optional[Dict[int, float]] = None,
+              slow: Sequence[int] = (),
+              fanout: Optional[int] = None) -> TreePlan:
+    """Plan the relay tree for one distribution wave.
+
+    Determinism contract: every rank plans locally and must get the
+    same tree, so call sites may only pass inputs that are shared
+    knowledge — the membership-derived puller/holder sets, the cluster
+    host map, the (env-identical) slow set and the fanout knob.
+    ``bandwidth`` (rank -> GiB/s evidence) is for single-site planners
+    only: unit tests and the central kfnet_report renderer.
+
+    Rules, in order:
+
+    * holders are the roots (depth 0, capacity ``fanout`` each);
+    * attach is breadth-first into the shallowest free slot, so depth
+      is ``O(log_fanout k)``;
+    * with ``host_of``, each host elects one leader (its fastest
+      member) to take the single wire edge; the rest of the host
+      attaches under the leader over the shm lane;
+    * ``slow`` ranks attach last and offer capacity only after every
+      other slot is exhausted — a throttled link serves no children
+      unless the tree is impossible without it.
+    """
+    if fanout is None:
+        fanout = int(knobs.get("KFT_TREE_FANOUT"))
+    fanout = max(1, int(fanout))
+    roots = tuple(sorted(set(int(h) for h in holders)))
+    if not roots:
+        raise ValueError("plan_tree: holder set is empty")
+    want = sorted(set(int(p) for p in pullers) - set(roots))
+    slowset = frozenset(int(s) for s in slow)
+
+    parent: Dict[int, int] = {}
+    children: Dict[int, List[int]] = {r: [] for r in roots}
+    depth: Dict[int, int] = {r: 0 for r in roots}
+    lane: Dict[int, str] = {}
+    free: Dict[int, int] = {r: fanout for r in roots}
+    queue: deque = deque(roots)        # nodes that may still have slots
+    parked: List[int] = []             # slow nodes held out of the queue
+
+    def attach(n: int, lane_tag: str) -> None:
+        while queue and free[queue[0]] <= 0:
+            queue.popleft()
+        if not queue:
+            # every fast slot is spoken for: release parked slow nodes
+            # (tree beats no tree, even through a throttled link)
+            while parked and (not queue or free[queue[0]] <= 0):
+                queue.append(parked.pop(0))
+            while queue and free[queue[0]] <= 0:
+                queue.popleft()
+        if not queue:
+            # last resort: the BFS queue tracks roots and wire-attached
+            # nodes only, so a host layer that soaked up the fast ranks
+            # over shm can exhaust it — rescan every planned node with a
+            # free slot (fast first, shallow first, then rank)
+            queue.extend(sorted(
+                (r for r, f in free.items() if f > 0),
+                key=lambda r: (r in slowset, depth.get(r, 0), r)))
+        p = queue[0]
+        parent[n] = p
+        children.setdefault(p, []).append(n)
+        children.setdefault(n, [])
+        depth[n] = depth[p] + 1
+        lane[n] = lane_tag
+        free[p] -= 1
+        free[n] = fanout
+        if n in slowset:
+            parked.append(n)
+        else:
+            queue.append(n)
+
+    if host_of is None:
+        for n in _ordered(want, slowset, bandwidth):
+            attach(n, LANE_WIRE)
+    else:
+        by_host: Dict[str, List[int]] = {}
+        for n in _ordered(want, slowset, bandwidth):
+            by_host.setdefault(str(host_of(n)), []).append(n)
+        root_hosts = {str(host_of(r)) for r in roots}
+        # hosts in leader order (fastest member first) for determinism
+        hosts = sorted(by_host,
+                       key=lambda h: (by_host[h][0] in slowset,
+                                      by_host[h][0]))
+        # wire layer: one leader per non-root host
+        for h in hosts:
+            if h in root_hosts:
+                continue
+            attach(by_host[h][0], LANE_WIRE)
+        # shm layer: the rest of each host under its local anchor
+        for h in hosts:
+            members = by_host[h]
+            anchor = [r for r in roots if str(host_of(r)) == h]
+            local: deque = deque(anchor or members[:1])
+            rest = members if anchor else members[1:]
+            lfree = {a: free.get(a, fanout) for a in local}
+            for n in rest:
+                while local and lfree[local[0]] <= 0:
+                    local.popleft()
+                if not local:
+                    # every local slot is spoken for (degree bound
+                    # beats one-edge-per-host): overflow onto the wire
+                    attach(n, LANE_WIRE)
+                else:
+                    p = local[0]
+                    parent[n] = p
+                    children.setdefault(p, []).append(n)
+                    children.setdefault(n, [])
+                    depth[n] = depth.get(p, 0) + 1
+                    lane[n] = LANE_SHM
+                    lfree[p] = lfree.get(p, fanout) - 1
+                    free[p] = free.get(p, fanout) - 1
+                    free[n] = fanout
+                if n not in slowset:
+                    local.append(n)
+                    lfree[n] = fanout
+    return TreePlan(roots=roots, parent=parent,
+                    children={k: tuple(v) for k, v in children.items()},
+                    depth=depth, lane=lane)
+
+
+def record_relay_shape(plan: TreePlan, rank: int, monitor=None) -> None:
+    """Publish this rank's tree position to the relay gauges."""
+    from ..monitor import get_monitor
+    mon = monitor if monitor is not None else get_monitor()
+    mon.set_gauge("kungfu_tpu_relay_depth", float(plan.depth_of(rank)))
+    mon.set_gauge("kungfu_tpu_relay_fanout",
+                  float(len(plan.children_of(rank))))
+
+
+def _retryable(exc: BaseException) -> bool:
+    """A pull that failed because the parent does not have the chunk
+    *yet* — the native store fails missing blobs fast instead of
+    blocking, so in-flight relay is a retry loop by design."""
+    msg = str(exc)
+    return "no blob" in msg or "not found" in msg
+
+
+def relay_pull_chunked(peer, plan: TreePlan, key: str, nchunks: int,
+                       per: int, dtype, shape, version: int = -1, *,
+                       wait_s: Optional[float] = None,
+                       pace: Optional[Callable[[int], None]] = None,
+                       out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pull a ``{key}.cN``-chunked blob through the relay tree.
+
+    The caller's rank pulls every chunk from its planned parent with
+    ``KFT_STREAM_DEPTH`` requests in flight; chunks drain in order and
+    — when this rank has children — are re-published under the same
+    chunk names the moment they land (cut-through), so the subtree
+    streams concurrently with this rank's own ingest.
+
+    Fallback ladder, per the planner contract: a chunk the parent
+    lacks is retried with backoff until ``wait_s`` (default
+    ``KFT_TREE_WAIT_S``); any other error, or the deadline, abandons
+    the parent and pulls every remaining chunk directly from
+    ``plan.fallback_root(rank)`` — a real holder, which always has the
+    full set.  Children of a dead relay degrade the same way, so a
+    killed interior node costs O(k) for its subtree, never a wedge.
+
+    ``pace`` (optional, ``pace(nbytes) -> None`` after each landed
+    chunk) lets the fanout benchmark model a finite egress link;
+    production call sites pass ``None``.
+    """
+    from ..monitor import net as _net
+    rank = peer.rank
+    if wait_s is None:
+        wait_s = float(knobs.get("KFT_TREE_WAIT_S"))
+    src = plan.parent.get(rank)
+    if src is None:
+        src = plan.fallback_root(rank)
+    dt = np.dtype(dtype)
+    size = int(np.prod(tuple(int(s) for s in shape), dtype=np.int64))
+    if out is None:
+        out = default_pool().take(dt, (size,))
+    else:
+        out = out.reshape(-1)
+    names, spans = [], []
+    for j in range(nchunks):
+        lo, hi = j * per, min((j + 1) * per, size)
+        if hi <= lo:
+            break
+        names.append(f"{key}.c{j}")
+        spans.append(out[lo:hi])
+    kids = plan.children_of(rank)
+    record_relay_shape(plan, rank)
+    depth = int(knobs.get("KFT_STREAM_DEPTH"))
+    deadline = time.monotonic() + wait_s
+    served_point = False
+
+    with _net.Transfer("relay", peer=peer._peer_spec(src),
+                       rank=rank, version=version) as xf:
+        inflight: deque = deque()
+        nxt = 0
+        landed = 0
+        tries = 0
+        fellback = False
+        while landed < len(names):
+            while (not fellback and nxt < len(names)
+                   and len(inflight) < max(1, depth)):
+                inflight.append(
+                    (nxt, peer.request_async(src, names[nxt],
+                                             spans[nxt], version=version,
+                                             out=spans[nxt])))
+                nxt += 1
+            if fellback or not inflight:
+                # parent abandoned: drain the rest straight from a
+                # holder root (it committed the full chunk set)
+                root = plan.fallback_root(rank)
+                for j in range(landed, len(names)):
+                    with xf.phase("wire"):
+                        peer.request(root, names[j], spans[j],
+                                     version=version, out=spans[j])
+                    # re-publish before the pacing sleep: the serve is
+                    # local, and children are already waiting on it
+                    _relay_serve(peer, kids, names[j], spans[j], version,
+                                 key, j, served_point)
+                    served_point = True
+                    xf.add(spans[j].nbytes)
+                    if pace is not None:
+                        pace(spans[j].nbytes)
+                landed = len(names)
+                break
+            j, fut = inflight.popleft()
+            try:
+                with xf.phase("wire"):
+                    fut.result()
+            except NativeError as exc:
+                now = time.monotonic()
+                if _retryable(exc) and now < deadline:
+                    # parent doesn't have chunk j yet: in-flight relay.
+                    # back off and re-issue; the window behind j stays
+                    # posted so cut-through resumes instantly.
+                    time.sleep(min(_RETRY_MAX_S,
+                                   _RETRY_BASE_S * (2 ** min(tries, 6))))
+                    tries += 1
+                    inflight.appendleft(
+                        (j, peer.request_async(src, names[j], spans[j],
+                                               version=version,
+                                               out=spans[j])))
+                    continue
+                log.warning("relay: parent %d unusable for %s (%s); "
+                            "falling back to direct holder pull",
+                            src, names[j], exc)
+                # the posted window still writes into spans as ops
+                # complete on the native thread — drain it before the
+                # fallback reuses those destinations
+                for _k, f in inflight:
+                    try:
+                        f.result()
+                    except Exception as drain_exc:
+                        log.debug("relay: drained in-flight chunk "
+                                  "after parent loss: %s", drain_exc)
+                inflight.clear()
+                fellback = True
+                # chunk j itself is re-pulled by the fallback drain
+                nxt = landed = j
+                continue
+            tries = 0
+            landed = j + 1
+            xf.add(spans[j].nbytes)
+            # re-publish BEFORE the pacing sleep: the serve is a local
+            # store write, and every level of pace-then-serve would add
+            # one full pace quantum of latency per tree level
+            _relay_serve(peer, kids, names[j], spans[j], version,
+                         key, j, served_point)
+            served_point = True
+            if pace is not None:
+                pace(spans[j].nbytes)
+    return out.reshape(shape)
+
+
+def relay_pull_blobs(peer, plan: TreePlan, specs,
+                     version: int = -1, *,
+                     wait_s: Optional[float] = None) -> List[np.ndarray]:
+    """Pull a batch of WHOLE blobs through the relay tree.
+
+    The block-granular sibling of :func:`relay_pull_chunked`, for call
+    sites whose unit of transfer is already a whole store blob (the
+    sharded resync's per-old-rank blocks, ``broadcast_host_tree``'s
+    pytree leaves).  ``specs`` is ``[(name, dtype, shape), ...]``; each
+    blob is pulled from this rank's planned parent and — when the plan
+    gives this rank children — re-saved under the same name the moment
+    it lands, so the subtree streams blob ``i`` while this rank pulls
+    blob ``i+1`` (cut-through at blob granularity).
+
+    Same fallback ladder as the chunk engine: a blob the parent has
+    not re-published yet retries with backoff until ``wait_s``
+    (default ``KFT_TREE_WAIT_S``); a hard error or the deadline
+    abandons the parent and this rank — and transitively its subtree,
+    through their own deadlines — pulls direct from
+    ``plan.fallback_root(rank)``, a real holder.
+    """
+    from ..monitor import net as _net
+    rank = peer.rank
+    if wait_s is None:
+        wait_s = float(knobs.get("KFT_TREE_WAIT_S"))
+    src = plan.parent.get(rank)
+    if src is None:
+        src = plan.fallback_root(rank)
+    kids = plan.children_of(rank)
+    record_relay_shape(plan, rank)
+    deadline = time.monotonic() + wait_s
+    served_point = False
+    out: List[np.ndarray] = []
+    with _net.Transfer("relay", peer=peer._peer_spec(src),
+                       rank=rank, version=version) as xf:
+        fellback = False
+        for name, dtype, shape in specs:
+            buf = default_pool().take(np.dtype(dtype), tuple(shape))
+            tries = 0
+            while True:
+                tgt = plan.fallback_root(rank) if fellback else src
+                try:
+                    with xf.phase("wire"):
+                        peer.request(tgt, name, buf, version=version,
+                                     out=buf)
+                    break
+                except NativeError as exc:
+                    now = time.monotonic()
+                    if (not fellback and _retryable(exc)
+                            and now < deadline):
+                        # parent hasn't re-published this blob yet:
+                        # in-flight relay is a retry loop by design
+                        time.sleep(min(
+                            _RETRY_MAX_S,
+                            _RETRY_BASE_S * (2 ** min(tries, 6))))
+                        tries += 1
+                        continue
+                    if fellback:
+                        raise  # a holder root missing a blob is real
+                    log.warning(
+                        "relay: parent %d unusable for %s (%s); "
+                        "falling back to direct holder pull", src,
+                        name, exc)
+                    fellback = True
+            xf.add(buf.nbytes)
+            if kids:
+                if not served_point:
+                    _chaos_point("comm.relay.serve", rank=rank,
+                                 step=len(out),
+                                 version=version if version >= 0
+                                 else None)
+                    served_point = True
+                peer.save(name, buf, version=version)
+            out.append(buf)
+    return out
+
+
+def _relay_serve(peer, kids: Tuple[int, ...], name: str,
+                 span: np.ndarray, version: int, key: str, j: int,
+                 already_fired: bool) -> None:
+    """Re-publish one landed chunk for this rank's children (no-op for
+    leaves).  The first re-publish of a wave crosses the
+    ``comm.relay.serve`` chaos site — the window where killing this
+    process orphans a live subtree."""
+    if not kids:
+        return
+    if not already_fired:
+        _chaos_point("comm.relay.serve", rank=peer.rank, step=j,
+                     version=version if version >= 0 else None)
+    peer.save(name, span, version=version)

@@ -271,7 +271,17 @@ def broadcast_host_tree(tree, peer=None, root: int = 0,
 
     Every process must pass a tree of identical structure/shapes (the
     receiver's values are overwritten).  Returns the synced tree as
-    numpy arrays."""
+    numpy arrays.
+
+    With ``KFT_TREE_ENABLE`` and at least ``KFT_TREE_MIN_PULLERS``
+    receivers, the payload rides the kftree relay lane instead of
+    leaf-by-leaf native broadcasts: the root publishes each leaf to
+    its store, every receiver pulls from its planned parent in the
+    relay tree and re-serves as leaves land (comm/tree.py) — the
+    resize-sync fan-out goes O(log k) in the receiver count.  Failure
+    inside the lane never mixes with a collective: a receiver whose
+    parent dies falls back to a direct store pull from the root, and
+    the closing barrier keeps the call collective either way."""
     import jax
     if peer is None:
         from . import native as _native
@@ -279,9 +289,31 @@ def broadcast_host_tree(tree, peer=None, root: int = 0,
     if peer is None or peer.size <= 1:
         return jax.tree_util.tree_map(np.asarray, tree)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.ascontiguousarray(np.asarray(leaf)) for leaf in leaves]
+    from .comm import tree as _tree
+    if _tree.enabled(peer.size - 1):
+        plan = _tree.plan_tree(
+            [r for r in range(peer.size) if r != root], [root],
+            host_of=peer._host_of)
+        if peer.rank == root:
+            for i, a in enumerate(arrs):
+                peer.save(f"kfbc:{name}:{i}", a)
+            out = arrs
+            _tree.record_relay_shape(plan, peer.rank)
+        else:
+            got = _tree.relay_pull_blobs(
+                peer, plan,
+                [(f"kfbc:{name}:{i}", a.dtype, a.shape)
+                 for i, a in enumerate(arrs)])
+            out = [g.reshape(a.shape) for g, a in zip(got, arrs)]
+        # receivers may still be relaying each other's pulls: nobody
+        # (the root above all) may tear its store down or move on to a
+        # conflicting re-publish until the wave lands everywhere
+        peer.barrier(name=f"kfbc-done:{name}")
+        return jax.tree_util.tree_unflatten(treedef, out)
     out = []
-    for i, leaf in enumerate(leaves):
-        arr = np.ascontiguousarray(np.asarray(leaf))
-        got = peer.broadcast(arr, root=root, name=f"{name}:{i}")
-        out.append(got.reshape(arr.shape))
+    for a in arrs:
+        got = peer.broadcast(a, root=root,
+                             name=f"{name}:{len(out)}")
+        out.append(got.reshape(a.shape))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -51,6 +51,7 @@ from .. import native
 from ..chaos import point as _chaos_point
 from ..parallel.fsdp import FSDP_AXIS, make_fsdp_step
 from ..trace import span as _trace_span
+from ..utils import knobs
 from ..plan.cluster import Cluster
 from . import snapshot as _kfsnap
 from .config_server import fetch_config
@@ -507,6 +508,30 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
         dt = self._vec_dtypes()
         pulled: Dict[str, Dict[int, np.ndarray]] = {
             name: {} for name in self._vec_names()}
+        # kftree: when >=2 pullers want the same old-rank block and do
+        # not hold it (a grow wave), route that block through a planned
+        # relay tree — the pullers re-serve it to each other — instead
+        # of converging everyone on the holders.  The plan inputs are
+        # shared knowledge (the all_gathered need-ranges + availability
+        # matrix + host map), so every member derives identical trees
+        # without another round of coordination.
+        from ..comm import tree as _tree
+        tree_of: Dict[int, _tree.TreePlan] = {}
+        if (p is not None and nproc > 1
+                and bool(knobs.get("KFT_TREE_ENABLE"))):
+            ranges = p.all_gather(np.asarray([lo, hi], np.int64),
+                                  name=f"kftsh-range@{self.version}")
+            for r in range(old_nproc):
+                pullers = [
+                    j for j in range(nproc)
+                    if not avail[j, r]
+                    and int(ranges[j, 0]) < (r + 1) * old_block
+                    and int(ranges[j, 1]) > r * old_block]
+                if _tree.enabled(len(pullers)):
+                    tree_of[r] = _tree.plan_tree(
+                        pullers,
+                        [j for j in range(nproc) if avail[j, r]],
+                        host_of=p._host_of)
         # kffast: group remote blocks by source and pull each group down
         # one lane decision — colocated sources serve over shm, remote
         # ones stream every block pipelined on one connection instead of
@@ -518,6 +543,17 @@ class ShardedElasticTrainer(DistributedElasticTrainer):
             if local is not None:
                 for name in self._vec_names():
                     pulled[name][r] = local[name]
+            elif r in tree_of and me in tree_of[r].parent:
+                # tree-routed block: pull from the planned parent (a
+                # sibling puller re-serving as it lands), re-serve for
+                # our own children; per-edge failure degrades to a
+                # direct pull from a holder inside relay_pull_blobs
+                got = _tree.relay_pull_blobs(
+                    p, tree_of[r],
+                    [(f"kftre:{name}:{r}", dt[name], (old_block,))
+                     for name in self._vec_names()], version=M)
+                for name, b in zip(self._vec_names(), got):
+                    pulled[name][r] = b
             else:
                 by_src.setdefault(src[r], []).append(r)
         for tgt, rs in sorted(by_src.items()):

@@ -185,7 +185,17 @@ _HELP = {
         "kfnet ledger: effective GiB/s of the last completed state "
         "movement, per op (op=pull_shm is the same-host segment lane; "
         "op=pull_streamed the pipelined chunk lane — kffast, "
-        "docs/elastic.md 'Store fast lane').",
+        "docs/elastic.md 'Store fast lane'; op=relay the tree-routed "
+        "relay edge from this rank's planned parent — kftree, "
+        "docs/elastic.md 'Distribution trees').",
+    "kungfu_tpu_relay_depth":
+        "kftree: this rank's depth in the last planned relay tree "
+        "(holders sit at 0; wall time grows by one chunk latency per "
+        "level, not one transfer).",
+    "kungfu_tpu_relay_fanout":
+        "kftree: how many children this rank re-served chunks to in "
+        "the last planned relay tree (0 = leaf; bounded by "
+        "KFT_TREE_FANOUT).",
     "kungfu_tpu_shm_lane_bytes_total":
         "kffast: payload bytes served through the same-host "
         "shared-memory lane instead of the socket (python segment "

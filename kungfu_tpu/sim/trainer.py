@@ -37,6 +37,7 @@ import json
 import os
 import random
 import sys
+import threading
 import time
 from http.server import BaseHTTPRequestHandler
 from typing import List, Optional, Tuple
@@ -67,7 +68,26 @@ def _metrics_handler(trainer: "FakeTrainer"):
                 if self.path.startswith("/metrics"):
                     body = trainer.monitor.render_metrics().encode()
                 elif self.path.startswith("/state"):
-                    body = json.dumps(trainer.committed_state()).encode()
+                    d = trainer.committed_state()
+                    # scripted egress cost: each served adoption holds
+                    # this donor's "NIC" for KFT_SIM_STATE_SERVE_S,
+                    # serialized by the lock (the ThreadingHTTPServer
+                    # would otherwise serve pullers concurrently for
+                    # free, and sequential-vs-tree wave timing would
+                    # measure nothing).  The served time rides the
+                    # response so the puller's sync event can record a
+                    # service-only pull_s — the honest per-pull term of
+                    # the sequential baseline, excluding queue wait.
+                    # An EMPTY state costs nothing: no payload, no NIC
+                    # time — so not-yet-synced relays answer their
+                    # children's readiness polls instantly and the
+                    # founding cohort's mutual probes stay free.
+                    if trainer.state_serve_s > 0 \
+                            and int(d.get("samples", 0)) > 0:
+                        with trainer._serve_lock:
+                            time.sleep(trainer.state_serve_s)
+                        d["serve_s"] = trainer.state_serve_s
+                    body = json.dumps(d).encode()
                     # kfnet: the adoption path's server side.  "state"
                     # has no colon so it is ledger-only, never a peer
                     # row in the bandwidth matrix.
@@ -130,6 +150,13 @@ class FakeTrainer:
         net_slow = knobs.get("KFT_SIM_NET_SLOW_RANKS")
         self.net_slow_div = (knobs.get("KFT_SIM_NET_SLOW_FACTOR")
                              if self.init_rank in net_slow else 1.0)
+        # kftree: the /state relay wave (docs/elastic.md "Distribution
+        # trees").  The slow set doubles as the planner's slowlink
+        # evidence — env-identical across ranks, so every joiner plans
+        # the same tree.
+        self.tree_slow = sorted(net_slow)
+        self.state_serve_s = knobs.get("KFT_SIM_STATE_SERVE_S")
+        self._serve_lock = threading.Lock()
         self._net_last = time.monotonic()
         # scripted per-worker jitter: deterministic per (seed, port)
         self._jitter = random.Random((self.seed << 17) ^ self.port)
@@ -201,13 +228,111 @@ class FakeTrainer:
                            "w": self.w, "version": self.version,
                            "seed": self.seed}
 
+    def _state_timeout(self) -> float:
+        """Per-attempt /state timeout: must cover the scripted serve
+        cost plus one lock wait, or every probe of a busy donor reads
+        as dead."""
+        return max(0.5, self.state_serve_s * 3.0 + 1.0)
+
+    def _fetch_state(self, p) -> Optional[dict]:
+        """One /state pull from peer ``p``, kfnet-accounted under
+        ``op="relay"`` when tree-routed adoption is asking (the caller
+        labels it), None on any transport/shape failure."""
+        raw = _rpc.call(
+            f"http://{p.host}:{p.port + MONITOR_PORT_OFFSET}/state",
+            attempt_timeout=self._state_timeout())
+        d = json.loads(raw.decode())
+        return d if isinstance(d, dict) else None
+
     def _adopt_peer_state(self) -> None:
-        """Joiner bootstrap: fetch the best committed synthetic state
-        from peers' ``/state`` endpoints (the sim analogue of the real
-        tier's collective state resync).  Nothing reachable => fresh
-        start at zero, which is correct for the founding cohort."""
+        """Joiner bootstrap: adopt committed synthetic state from
+        peers' ``/state`` endpoints (the sim analogue of the real
+        tier's collective state resync).  A joiner of an already-grown
+        membership (version >= 2) first tries the kftree relay wave —
+        poll its PLANNED PARENT until that parent has synced, so state
+        cascades down the tree in O(log k) instead of k joiners
+        hammering the founding cohort.  Any failure (dead parent,
+        deadline) degrades to the direct rank-rotated probe below.
+        Nothing reachable => fresh start at zero, which is correct for
+        the founding cohort."""
         _chaos_point("sim.state.fetch", rank=self.rank, step=self.step,
                      version=self.version)
+        from ..comm import tree as _tree
+        if (self.version >= 2 and len(self.workers) >= 2
+                and _tree.enabled(len(self.workers) - 1)
+                and self._adopt_via_tree()):
+            return
+        self._adopt_direct()
+
+    def _adopt_via_tree(self) -> bool:
+        """The kftree lane: plan the relay tree every joiner of this
+        membership agrees on (rank 0 — the proposal driver, never a
+        fresh joiner — is the root; low ranks, the founding cohort,
+        fill the shallow layers; scripted-slow ranks land at the
+        leaves), then poll this rank's parent until the parent itself
+        is synced.  A parent that is a later joiner becomes ready the
+        moment ITS parent served it — that cascade is the relay."""
+        from ..comm import tree as _tree
+        n = len(self.workers)
+        plan = _tree.plan_tree(range(1, n), [0], slow=self.tree_slow)
+        parent = plan.parent.get(self.rank)
+        if parent is None or parent >= n:
+            return False
+        kids = plan.children_of(self.rank)
+        self.emit("relay", rank=self.rank, parent=parent,
+                  children=len(kids), depth=plan.depth_of(self.rank),
+                  size=n, version=self.version)
+        _tree.record_relay_shape(plan, self.rank,
+                                 monitor=self.monitor)
+        p = self.workers[parent]
+        spec = f"{p.host}:{p.port}"
+        t0 = time.monotonic()
+        deadline = t0 + knobs.get("KFT_TREE_WAIT_S")
+        while time.monotonic() < deadline:
+            self._beat()        # a waiting joiner must not age its lease
+            try:
+                with _net.Transfer("relay", peer=spec,
+                                   direction="ingress", rank=self.rank,
+                                   version=self.version,
+                                   monitor=self.monitor) as xf:
+                    with xf.phase("wire"):
+                        d = self._fetch_state(p)
+                    xf.add(256 if d is None else len(json.dumps(d)))
+            except (OSError, ValueError):
+                # parent not bound yet / mid-kill: keep polling; the
+                # deadline owns the downgrade decision
+                time.sleep(0.2)
+                continue
+            if (d is not None and d.get("seed") == self.seed
+                    and int(d.get("samples", 0)) > 0):
+                t1 = time.monotonic()
+                self.samples = int(d["samples"])
+                self.step = int(d["step"])
+                self.w = float(d["w"])
+                self.emit("sync", step=self.step, samples=self.samples,
+                          size=n, version=self.version, wsum=self.w,
+                          donor=spec, t0=t0, t1=t1,
+                          pull_s=float(d.get("serve_s", 0.0)),
+                          depth=plan.depth_of(self.rank), lane="tree")
+                if kids:
+                    # from here this rank serves its subtree — the
+                    # window kill-relay-mid-wave SIGKILLs into
+                    _chaos_point("comm.relay.serve", rank=self.rank,
+                                 step=self.step, version=self.version)
+                # cut-through: commit NOW so /state serves the adopted
+                # state to this rank's children immediately instead of
+                # after the first local step lands
+                self._commit()
+                return True
+            time.sleep(min(0.25, max(0.05, self.step_s)))
+        self.emit("relay_fallback", rank=self.rank, parent=parent,
+                  version=self.version)
+        return False
+
+    def _adopt_direct(self) -> None:
+        """The pre-tree path and the per-edge fallback: direct
+        rank-rotated probes of up to 8 peers, best committed state
+        wins."""
         best: Optional[dict] = None
         probed = 0
         # kffast fan-out: rotate the probe order by rank so a grow's
@@ -226,6 +351,8 @@ class FakeTrainer:
             if probed >= 8:
                 break
             probed += 1
+            self._beat()     # serve-cost probes can outlast a lease TTL
+            t0 = time.monotonic()
             try:
                 with _net.Transfer("state.adopt",
                                    peer=f"{p.host}:{p.port}",
@@ -233,29 +360,28 @@ class FakeTrainer:
                                    version=self.version,
                                    monitor=self.monitor) as xf:
                     with xf.phase("wire"):
-                        raw = _rpc.call(
-                            f"http://{p.host}"
-                            f":{p.port + MONITOR_PORT_OFFSET}"
-                            f"/state", attempt_timeout=0.5)
-                    with xf.phase("deserialize"):
-                        d = json.loads(raw.decode())
-                    xf.add(len(raw))
+                        d = self._fetch_state(p)
+                    xf.add(256 if d is None else len(json.dumps(d)))
             except (OSError, ValueError):
                 continue  # peer not up yet / dying: fresh start is fine
-            if (isinstance(d, dict) and d.get("seed") == self.seed
+            if (d is not None and d.get("seed") == self.seed
                     and int(d.get("samples", 0)) > 0
                     and (best is None
                          or int(d["samples"]) > best["samples"])):
                 best = {"samples": int(d["samples"]),
                         "step": int(d["step"]), "w": float(d["w"]),
-                        "donor": f"{p.host}:{p.port}"}
+                        "donor": f"{p.host}:{p.port}",
+                        "t0": t0, "t1": time.monotonic(),
+                        "pull_s": float(d.get("serve_s", 0.0))}
         if best is not None:
             self.samples = best["samples"]
             self.step = best["step"]
             self.w = best["w"]
             self.emit("sync", step=self.step, samples=self.samples,
                       size=len(self.workers), version=self.version,
-                      wsum=self.w, donor=best["donor"])
+                      wsum=self.w, donor=best["donor"],
+                      t0=best["t0"], t1=best["t1"],
+                      pull_s=best["pull_s"], lane="direct")
 
     # ------------------------------------------------------------ kfnet
     def _emit_net_traffic(self) -> None:

@@ -280,6 +280,25 @@ _def("KFT_POOL_SLOTS", "int", 4,
      "store pulls; 0 disables reuse (every pull allocates fresh).",
      group=_FAST)
 
+_TREE = "Distribution trees (kftree)"
+_def("KFT_TREE_ENABLE", "bool", True,
+     "Relay-tree lane for one-to-many model distribution: when >= "
+     "KFT_TREE_MIN_PULLERS pullers want the same key-set, the planner "
+     "routes them through a pipelined relay tree (holders at the "
+     "roots, chunks re-published cut-through) instead of k direct "
+     "pulls. 0 keeps every puller on the direct path.", group=_TREE)
+_def("KFT_TREE_FANOUT", "int", 2,
+     "Maximum children per relay node. Higher fans shallower but "
+     "splits each node's egress more ways; 2 keeps per-edge bandwidth "
+     "at half a node's egress with O(log2 k) depth.", group=_TREE)
+_def("KFT_TREE_MIN_PULLERS", "int", 2,
+     "Fewer concurrent pullers than this and the tree lane is skipped "
+     "(a lone puller gains nothing from relaying).", group=_TREE)
+_def("KFT_TREE_WAIT_S", "float", 20.0,
+     "Relay patience: how long a child retries a chunk its parent "
+     "does not have yet before abandoning the parent and pulling the "
+     "remainder directly from a holder root.", group=_TREE)
+
 _TRACE = "Tracing, metrics & profiling"
 _def("KFT_TRACE", "bool", False,
      "Arm the kftrace flight-recorder ring at import.", group=_TRACE)
@@ -456,6 +475,11 @@ _def("KFT_SIM_SERVE_PREEMPT_EVERY", "int", 0,
      "Serving sim: force one preempt/re-admit on every Nth request "
      "(0 disables) — exercises the exactly-once fleet-join contract.",
      group=_SIM)
+_def("KFT_SIM_STATE_SERVE_S", "float", 0.0,
+     "Grow-wave sim: synthetic service time a fake trainer spends per "
+     "/state adoption it serves, serialized per donor (models a "
+     "single egress NIC). Makes sequential-vs-tree wave timing "
+     "measurable; 0 disables.", group=_SIM)
 
 _BENCH = "Benchmarks"
 _def("KFT_SCALING_OUT", "str", None,

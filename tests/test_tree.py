@@ -1,0 +1,360 @@
+"""kftree: the distribution planner, the chunk/blob relay engines and
+the grow-wave proof floors (kungfu_tpu/comm/tree.py, docs/elastic.md
+"Distribution trees")."""
+import math
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.chaos.plan import Plan
+from kungfu_tpu.chaos.runner import Scenario, floor_violations
+from kungfu_tpu.comm import tree as kftree
+from kungfu_tpu.native import NativeError
+from kungfu_tpu.sim import sim_wsum
+
+
+# ---------------------------------------------------------------- planner
+def test_plan_tree_fanout_and_log_depth():
+    plan = kftree.plan_tree(range(1, 32), [0], fanout=2)
+    assert plan.roots == (0,)
+    assert set(plan.parent) == set(range(1, 32))
+    assert plan.max_fanout() <= 2
+    # BFS attach: depth stays logarithmic in the puller count
+    assert plan.max_depth() <= math.ceil(math.log2(32)) + 1
+    # every parent edge terminates at the roots (no cycles, no orphans)
+    for n in range(1, 32):
+        seen, cur = set(), n
+        while cur not in plan.roots:
+            assert cur not in seen
+            seen.add(cur)
+            cur = plan.parent[cur]
+
+
+def test_plan_tree_deterministic():
+    a = kftree.plan_tree(range(1, 20), [0, 7], slow=(5,), fanout=3)
+    b = kftree.plan_tree(range(1, 20), [0, 7], slow=(5,), fanout=3)
+    assert a == b
+
+
+def test_plan_tree_multiple_holders_spread_fallback():
+    plan = kftree.plan_tree(range(2, 12), [0, 1], fanout=2)
+    assert plan.roots == (0, 1)
+    # both holders take children (the wave fans over every root)
+    assert plan.children_of(0) and plan.children_of(1)
+    # fallback_root spreads subtrees over the holders deterministically
+    roots = {plan.fallback_root(r) for r in range(2, 12)}
+    assert roots == {0, 1}
+
+
+def test_plan_tree_slow_rank_parks_at_leaf():
+    plan = kftree.plan_tree(range(1, 10), [0], slow=(4,), fanout=2)
+    # the throttled link serves nobody and sits at the deepest layer
+    assert plan.children_of(4) == ()
+    assert plan.depth_of(4) == plan.max_depth()
+
+
+def test_plan_tree_slow_capacity_released_only_when_needed():
+    # 1 holder, fanout 1, pullers {1 (slow), 2}: the chain NEEDS the
+    # slow rank's capacity once the root's single slot is used
+    plan = kftree.plan_tree([1, 2], [0], slow=(1,), fanout=1)
+    assert plan.max_fanout() == 1
+    assert {plan.parent[1], plan.parent[2]} <= {0, 1, 2}
+    assert len(plan.parent) == 2
+
+
+def test_plan_tree_bandwidth_orders_shallow():
+    bw = {r: float(r) for r in range(1, 9)}   # rank 8 fastest
+    plan = kftree.plan_tree(range(1, 9), [0], bandwidth=bw, fanout=2)
+    # the fastest evidence attaches first (shallowest)
+    assert plan.depth_of(8) <= plan.depth_of(1)
+    assert 8 in plan.children_of(0)
+
+
+def test_plan_tree_host_grouping_one_wire_edge_per_host():
+    host = {r: f"h{r // 4}" for r in range(12)}   # 3 hosts of 4
+    plan = kftree.plan_tree(range(1, 12), [0], host_of=host.get,
+                            fanout=4)
+    # non-root hosts take exactly one wire edge; the rest ride shm
+    for h in ("h1", "h2"):
+        members = [r for r in range(1, 12) if host[r] == h]
+        wire = [r for r in members if plan.lane[r] == kftree.LANE_WIRE]
+        assert len(wire) == 1, (h, wire)
+        for r in members:
+            if r not in wire:
+                assert plan.lane[r] == kftree.LANE_SHM
+                assert host[plan.parent[r]] == h
+    assert plan.max_fanout() <= 4
+
+
+def test_plan_tree_single_host_fanout1_builds_chain():
+    # one host, fanout 1: the shm layer degenerates to a chain and
+    # every puller still attaches under the degree bound
+    host = {r: "a" for r in range(8)}
+    plan = kftree.plan_tree(range(1, 8), [0], host_of=host.get,
+                            fanout=1)
+    assert set(plan.parent) == set(range(1, 8))
+    assert plan.max_fanout() <= 1
+
+
+def test_plan_tree_host_shm_exhaustion_overflows_to_wire():
+    # one shared host, fanout 1, most members slow: the local shm
+    # chain exhausts (slow members offer no shm capacity) and later
+    # members must still attach via the wire escape hatch
+    host = {r: "a" for r in range(6)}
+    plan = kftree.plan_tree(range(1, 6), [0], host_of=host.get,
+                            slow=(1, 2, 3, 4), fanout=1)
+    assert set(plan.parent) == set(range(1, 6))
+    assert plan.max_fanout() <= 1
+
+
+def test_plan_tree_empty_holders_raises():
+    with pytest.raises(ValueError):
+        kftree.plan_tree([1, 2], [])
+
+
+def test_enabled_gates(monkeypatch):
+    monkeypatch.setenv("KFT_TREE_ENABLE", "1")
+    monkeypatch.setenv("KFT_TREE_MIN_PULLERS", "2")
+    assert kftree.enabled(2) and not kftree.enabled(1)
+    monkeypatch.setenv("KFT_TREE_ENABLE", "0")
+    assert not kftree.enabled(50)
+
+
+# ----------------------------------------------------------- relay engine
+class _Future:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def result(self):
+        return self._fn()
+
+    def done(self):
+        return True
+
+
+class FakePeer:
+    """In-process stand-in for NativePeer: per-rank blob stores, with
+    request/request_async hitting the TARGET's store (missing blobs
+    fail fast like the native layer) and save publishing to OWN."""
+
+    def __init__(self, rank, stores, fail=None):
+        self.rank = rank
+        self.stores = stores            # rank -> {name: np.ndarray}
+        self.fail = fail or {}          # (target, name) -> exception
+
+    def _peer_spec(self, j):
+        return f"127.0.0.1:{21100 + j}"
+
+    def _pull(self, target, name, out):
+        exc = self.fail.pop((target, name), None)
+        if exc is not None:
+            raise exc
+        blob = self.stores.get(target, {}).get(name)
+        if blob is None:
+            raise NativeError(f"peer {target} has no blob {name!r}")
+        out_flat = out.reshape(-1)
+        out_flat[:] = blob.reshape(-1)[:out_flat.size]
+        return out
+
+    def request(self, target, name, like, version=-1, out=None):
+        dst = out if out is not None else np.empty_like(like)
+        return self._pull(target, name, dst)
+
+    def request_async(self, target, name, like, version=-1, out=None):
+        dst = out if out is not None else np.empty_like(like)
+        return _Future(lambda: self._pull(target, name, dst))
+
+    def save(self, name, x, version=-1):
+        self.stores.setdefault(self.rank, {})[name] = np.array(x)
+
+
+def _chain_plan():
+    # 0 -> 1 -> 2: rank 1 is an interior relay
+    return kftree.TreePlan(
+        roots=(0,), parent={1: 0, 2: 1},
+        children={0: (1,), 1: (2,), 2: ()},
+        depth={0: 0, 1: 1, 2: 2},
+        lane={1: "wire", 2: "wire"})
+
+
+def _chunked_store(n=64, per=16, fill=3.0):
+    model = np.full(n, fill, np.float32)
+    store = {}
+    for j in range(-(-n // per)):
+        store[f"m.c{j}"] = model[j * per:(j + 1) * per].copy()
+    return model, store
+
+
+def test_relay_pull_chunked_cut_through_reserves_chunks():
+    model, root_store = _chunked_store()
+    stores = {0: root_store}
+    p1 = FakePeer(1, stores)
+    out = kftree.relay_pull_chunked(p1, _chain_plan(), "m", 4, 16,
+                                    np.float32, (64,), wait_s=2.0)
+    assert np.array_equal(out, model)
+    # the interior relay re-published every chunk for its child ...
+    assert sorted(stores[1]) == [f"m.c{j}" for j in range(4)]
+    # ... so the child can pull from the relay, not the root
+    p2 = FakePeer(2, stores)
+    out2 = kftree.relay_pull_chunked(p2, _chain_plan(), "m", 4, 16,
+                                     np.float32, (64,), wait_s=2.0)
+    assert np.array_equal(out2, model)
+
+
+def test_relay_pull_chunked_retries_not_yet_published():
+    model, root_store = _chunked_store()
+    stores = {0: root_store}
+    late = root_store.pop("m.c2")       # chunk 2 lands "late"
+    calls = {"n": 0}
+
+    class LatePeer(FakePeer):
+        def _pull(self, target, name, out):
+            if name == "m.c2" and target == 0:
+                calls["n"] += 1
+                if calls["n"] >= 3:     # appears on the 3rd attempt
+                    self.stores[0]["m.c2"] = late
+            return super()._pull(target, name, out)
+
+    p1 = LatePeer(1, stores)
+    out = kftree.relay_pull_chunked(p1, _chain_plan(), "m", 4, 16,
+                                    np.float32, (64,), wait_s=5.0)
+    assert np.array_equal(out, model)
+    assert calls["n"] >= 3              # it really retried
+
+
+def test_relay_pull_chunked_dead_parent_falls_back_to_root():
+    model, root_store = _chunked_store()
+    stores = {0: root_store}            # rank 1 (the parent) is empty
+    # child at rank 2: parent 1 has nothing and never will; a hard
+    # error (not retryable) must drop straight to the holder root
+    p2 = FakePeer(2, stores,
+                  fail={(1, "m.c0"): NativeError("connection refused")})
+    out = kftree.relay_pull_chunked(p2, _chain_plan(), "m", 4, 16,
+                                    np.float32, (64,), wait_s=0.2)
+    assert np.array_equal(out, model)
+
+
+def test_relay_pull_chunked_deadline_falls_back_to_root():
+    model, root_store = _chunked_store()
+    root_store_missing = dict(root_store)
+    stores = {0: root_store, 1: root_store_missing}
+    del root_store_missing["m.c3"]      # parent never gets the tail
+    stores[1] = root_store_missing
+    p2 = FakePeer(2, stores)
+    out = kftree.relay_pull_chunked(p2, _chain_plan(), "m", 4, 16,
+                                    np.float32, (64,), wait_s=0.3)
+    assert np.array_equal(out, model)
+
+
+def test_relay_pull_blobs_relays_and_falls_back():
+    blob_a = np.arange(8, dtype=np.float32)
+    blob_b = np.ones(8, np.float32) * 5
+    stores = {0: {"a": blob_a, "b": blob_b}}
+    p1 = FakePeer(1, stores)
+    got = kftree.relay_pull_blobs(
+        p1, _chain_plan(),
+        [("a", np.float32, (8,)), ("b", np.float32, (8,))], wait_s=2.0)
+    assert np.array_equal(got[0], blob_a)
+    assert np.array_equal(got[1], blob_b)
+    # the relay re-served both blobs for its child
+    assert sorted(stores[1]) == ["a", "b"]
+    # a child whose parent dies hard degrades to the root per blob
+    p2 = FakePeer(2, stores,
+                  fail={(1, "a"): NativeError("connection reset")})
+    got2 = kftree.relay_pull_blobs(
+        p2, _chain_plan(),
+        [("a", np.float32, (8,)), ("b", np.float32, (8,))], wait_s=0.2)
+    assert np.array_equal(got2[0], blob_a)
+    assert np.array_equal(got2[1], blob_b)
+
+
+# ------------------------------------------------------ grow-wave floors
+def _sc(**kw):
+    kw.setdefault("name", "t")
+    kw.setdefault("desc", "t")
+    kw.setdefault("plan", Plan(seed=None))
+    kw.setdefault("tier", "sim")
+    return Scenario(**kw)
+
+
+def _sync(rank, donor, t0, t1, pull_s, samples=8, batch=8, seed=0,
+          **extra):
+    e = {"kind": "sync", "rank": rank, "donor": donor, "t0": t0,
+         "t1": t1, "pull_s": pull_s, "samples": samples,
+         "wsum": sim_wsum(seed, samples // batch)}
+    e.update(extra)
+    return e
+
+
+def test_floor_min_sync_donors_requires_overlap():
+    sc = _sc(min_sync_donors=2)
+    # two donors but strictly serial windows: fan-in, not fan-out
+    serial = [_sync(1, "d1", 0.0, 1.0, 1.0),
+              _sync(2, "d2", 2.0, 3.0, 1.0)]
+    assert any("serial fan-in" in v
+               for v in floor_violations(sc, [], serial))
+    # the same donors with overlapping windows pass
+    overlap = [_sync(1, "d1", 0.0, 1.0, 1.0),
+               _sync(2, "d2", 0.5, 1.5, 1.0)]
+    assert not floor_violations(sc, [], overlap)
+
+
+def test_floor_min_sync_speedup():
+    # 4 pulls of 1s each, wave wall 1.3s -> ~3.1x measured speedup
+    evs = [_sync(r, f"d{r}", 0.1 * r, 1.0 + 0.1 * r, 1.0)
+           for r in range(4)]
+    assert not floor_violations(_sc(min_sync_speedup=3.0), [], evs)
+    assert any("grow wave" in v for v in floor_violations(
+        _sc(min_sync_speedup=4.0), [], evs))
+    # no timed syncs at all: unmeasurable is a violation, not a pass
+    assert floor_violations(_sc(min_sync_speedup=3.0), [], [])
+
+
+def test_floor_min_sync_speedup_bit_identity():
+    evs = [_sync(r, f"d{r}", 0.1 * r, 1.0 + 0.1 * r, 1.0)
+           for r in range(4)]
+    evs[2]["wsum"] = evs[2]["wsum"] + 1.0     # one corrupted adoption
+    out = floor_violations(_sc(min_sync_speedup=1.0), [], evs)
+    assert any("bit-identical" in v or "wsum" in v for v in out)
+
+
+def test_floor_relay_leaf_ranks():
+    sc = _sc(relay_leaf_ranks=(20,))
+    leaf = [{"kind": "relay", "rank": 20, "parent": 3, "children": 0,
+             "depth": 2}]
+    interior = [{"kind": "relay", "rank": 20, "parent": 3,
+                 "children": 2, "depth": 1}]
+    assert not floor_violations(sc, [], leaf)
+    assert floor_violations(sc, [], interior)
+    assert floor_violations(sc, [], [])       # never planned at all
+
+
+# ------------------------------------------------- kfcheck scope pins
+def test_kfcheck_silent_except_covers_comm_tree(tmp_path):
+    """comm/tree.py sits on the resize-critical path: a relay that
+    eats its own serve/pull errors green-washes exactly the
+    kill-relay-mid-wave scenario built to redden it."""
+    from tests.test_kfcheck import run_on, rules_fired
+    src = """
+        def serve(peer, name, span):
+            try:
+                peer.save(name, span)
+            except Exception:
+                pass
+    """
+    fs = run_on(tmp_path, src, relpath="kungfu_tpu/comm/tree.py")
+    assert rules_fired(fs) == {"silent-except"}
+
+
+def test_kfcheck_metrics_consistency_sees_relay_gauges():
+    """The relay gauges comm/tree.py publishes are consumed
+    (tools/kfnet_report.py) and carry _HELP entries — pinned here so
+    the metrics-consistency pass keeps covering the kftree plane."""
+    from kungfu_tpu.monitor import _HELP
+    for gauge in ("kungfu_tpu_relay_depth", "kungfu_tpu_relay_fanout"):
+        assert gauge in _HELP
+    import tools.kfnet_report as rep
+    import inspect
+    src = inspect.getsource(rep)
+    assert "kungfu_tpu_relay_depth" in src
+    assert "kungfu_tpu_relay_fanout" in src

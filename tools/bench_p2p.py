@@ -3,25 +3,38 @@
 
 Runs :mod:`kungfu_tpu.benchmarks.p2p` (the versioned-store
 save/request path over the native host plane) and emits the
-``p2p-phase-v2`` artifact — per-worker sync/hidden pull rates, the
+``p2p-phase-v3`` artifact — per-worker sync/hidden pull rates, the
 kfnet per-phase breakdown (serialize / wire / deserialize GiB/s, whole
 blob and chunked ``{key}.cN`` tier, measured on the legacy socket
-path), and the kffast fast-lane blocks (``pull_shm`` same-host
-segment-mapped copies, ``pull_streamed`` chunk pipelining).  The
-committed P2P_BENCH.json is this tool's output at ``-np 2``;
+path), the kffast fast-lane blocks (``pull_shm`` same-host
+segment-mapped copies, ``pull_streamed`` chunk pipelining), and the
+kftree ``fanout`` block (1 holder -> k pullers over an emulated
+finite link, direct star vs planned relay tree, per puller count).
+The committed P2P_BENCH.json is this tool's output at ``-np 2``;
 regenerate with:
 
     python tools/bench_p2p.py -np 2 --size-mb 1728 \\
-        --compute-ms 1050 --out P2P_BENCH.json
+        --compute-ms 1050 --fanout 2,4,8,16 --link-mib-s 64 \\
+        --out P2P_BENCH.json
 
-``--smoke`` (ci.sh, ``make p2p-smoke``) runs a small self-contained
-2-worker pass and asserts the kffast structure: the shm lane engaged
+(64 MiB/s keeps the emulated link — not this 1-core container's
+memcpy ceiling — the binding constraint through k=8; see the fanout
+docstring in :mod:`kungfu_tpu.benchmarks.p2p`.  The committed k=16
+row ties at ~1.0x: 17 single-core processes are copy-bound in BOTH
+modes, so the tree's topology win — 1.74x at k=4, 1.62x at k=8 —
+cannot show there.  Multi-core hosts lift that ceiling.)
+
+``--smoke`` (ci.sh step 1b, ``make p2p-smoke``) runs a small
+self-contained 2-worker pass plus one 4-puller fanout wave and
+asserts the kffast structure — the shm lane engaged
 (``shm_lane_bytes > 0``), the segment-mapped copy is not slower than
 the socket wire, chunk streaming did not regress against per-chunk
-RPCs, and the pooled fresh-alloc pull holds its regression pin against
-the reused-destination pull (the (dtype, nbytes) buffer pool — a
-collapse here means fresh destinations went back to fault-and-zero).
-Bit-identical content is asserted inside every worker loop.
+RPCs, the pooled fresh-alloc pull holds its regression pin against
+the reused-destination pull — and the kftree pin: the 4-puller tree
+wave beats the direct star by >= 1.5x (``tree_4pullers >=
+1.5 * direct_4pullers`` in wall-clock terms: ``direct_s >=
+1.5 * tree_s``).  Bit-identical content is asserted inside every
+worker loop.
 """
 from __future__ import annotations
 
@@ -51,7 +64,8 @@ def smoke() -> int:
     r = subprocess.run(
         [sys.executable, "-m", "kungfu_tpu.benchmarks.p2p", "-np", "2",
          "--size-mb", "4", "--secs", "0.5", "--compute-ms", "5",
-         "--out", out],
+         "--fanout", "4", "--fanout-size-mb", "16",
+         "--link-mib-s", "64", "--out", out],
         cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
     if r.returncode != 0:
         print(f"p2p smoke: FAIL bench rc={r.returncode}\n"
@@ -60,10 +74,17 @@ def smoke() -> int:
     with open(out) as f:
         doc = json.load(f)
     ph = doc.get("phases", {})
+    fan4 = doc.get("fanout", {}).get("pullers", {}).get("4", {})
     checks = [
-        ("schema is p2p-phase-v2",
-         doc.get("schema") == "p2p-phase-v2"),
+        ("schema is p2p-phase-v3",
+         doc.get("schema") == "p2p-phase-v3"),
         ("2 workers", doc.get("workers") == 2),
+        # the kftree pin: a 4-puller wave over a finite link must
+        # finish >= 1.5x faster through the relay tree than as a star
+        # (the acceptance pin: tree_4pullers >= 1.5x direct_4pullers)
+        ("fanout tier: 4-puller tree >= 1.5x faster than direct",
+         fan4.get("tree_s", 0) > 0
+         and fan4.get("direct_s", 0) >= 1.5 * fan4["tree_s"]),
         ("shm lane engaged (shm_lane_bytes > 0)",
          doc.get("shm_lane_bytes", 0) > 0),
         ("pull_shm block present with nonzero copy rate",
@@ -94,7 +115,9 @@ def smoke() -> int:
           f"shm copy {ph['pull_shm']['copy_gib_s']} GiB/s vs socket "
           f"wire {ph['pull']['wire_gib_s']} GiB/s, streamed "
           f"{ph['pull_streamed']['wire_gib_s']} GiB/s vs per-chunk "
-          f"{ph['pull_chunked']['wire_gib_s']} GiB/s)")
+          f"{ph['pull_chunked']['wire_gib_s']} GiB/s, fanout k=4 "
+          f"tree {fan4['tree_s']}s vs direct {fan4['direct_s']}s = "
+          f"{fan4['speedup']}x)")
     return 0
 
 

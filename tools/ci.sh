@@ -136,13 +136,15 @@ say "1/3 native build + selftest"
 make -C native all selftest || exit 1
 ./native/selftest || exit 1
 
-# kffast smoke (`make p2p-smoke`): one small 2-worker p2p bench pass
-# over the just-built native plane — asserts the shm lane engaged
-# (shm_lane_bytes > 0), the segment-mapped copy beats the legacy
-# socket wire, chunk streaming holds against per-chunk RPCs, and the
-# buffer-pool fresh-alloc regression pin (~20 s; docs/elastic.md
-# "Store fast lane")
-say "1b/3 kffast p2p fast-lane smoke"
+# kffast + kftree smoke (`make p2p-smoke`): one small 2-worker p2p
+# bench pass over the just-built native plane — asserts the shm lane
+# engaged (shm_lane_bytes > 0), the segment-mapped copy beats the
+# legacy socket wire, chunk streaming holds against per-chunk RPCs,
+# the buffer-pool fresh-alloc regression pin — plus one 4-puller
+# fanout wave over an emulated finite link pinning the kftree relay
+# tree at >= 1.5x faster than the direct star (~30 s; docs/elastic.md
+# "Store fast lane" / "Distribution trees")
+say "1b/3 kffast p2p fast-lane + kftree fanout smoke"
 python tools/bench_p2p.py --smoke || exit 1
 
 say "2/3 pytest (${JOBS} shards)"

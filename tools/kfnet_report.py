@@ -70,6 +70,45 @@ def totals_from_cluster_text(text: str) -> Dict[Tuple[str, str, str],
     return out
 
 
+def relay_from_cluster_text(text: str) -> Dict[str, Dict[str, float]]:
+    """Per-instance kftree relay shape + throughput: the
+    ``kungfu_tpu_relay_depth`` / ``kungfu_tpu_relay_fanout`` gauges and
+    the ``op="relay"`` lane of ``kungfu_tpu_state_move_gib_s``."""
+    out: Dict[str, Dict[str, float]] = {}
+    for (name, labels), value in parse_metrics(text).items():
+        lab = dict(labels)
+        inst = lab.get("instance", "local")
+        if name == "kungfu_tpu_relay_depth":
+            out.setdefault(inst, {})["depth"] = value
+        elif name == "kungfu_tpu_relay_fanout":
+            out.setdefault(inst, {})["fanout"] = value
+        elif (name == "kungfu_tpu_state_move_gib_s"
+              and lab.get("op") == "relay"):
+            out.setdefault(inst, {})["gib_s"] = value
+    # a tree position needs at least the depth gauge; drop strays
+    return {i: v for i, v in out.items() if "depth" in v}
+
+
+def relay_from_history(history: MetricsHistory) -> Dict[str,
+                                                        Dict[str, float]]:
+    """The :func:`relay_from_cluster_text` join for offline captures."""
+    out: Dict[str, Dict[str, float]] = {}
+    for inst in history.instances():
+        snaps = history.snapshots(inst)
+        if not snaps:
+            continue
+        for (name, labels), value in snaps[-1].samples.items():
+            lab = dict(labels)
+            if name == "kungfu_tpu_relay_depth":
+                out.setdefault(inst, {})["depth"] = value
+            elif name == "kungfu_tpu_relay_fanout":
+                out.setdefault(inst, {})["fanout"] = value
+            elif (name == "kungfu_tpu_state_move_gib_s"
+                  and lab.get("op") == "relay"):
+                out.setdefault(inst, {})["gib_s"] = value
+    return {i: v for i, v in out.items() if "depth" in v}
+
+
 def links_from_history(history: MetricsHistory) -> List[Link]:
     """Re-join each instance's LATEST rate gauges into matrix links —
     the same join :func:`kungfu_tpu.monitor.cluster.aggregate` does at
@@ -92,7 +131,8 @@ def links_from_history(history: MetricsHistory) -> List[Link]:
 
 # -------------------------------------------------------------- digest
 def digest(links: List[Link],
-           totals: Dict[Tuple[str, str, str], float]) -> dict:
+           totals: Dict[Tuple[str, str, str], float],
+           relay: Optional[Dict[str, Dict[str, float]]] = None) -> dict:
     """One JSON-ready summary from the raw links + byte totals."""
     peer_links = [(s, d, di, r) for s, d, di, r in links
                   if is_peer_target(s) and is_peer_target(d)]
@@ -110,7 +150,7 @@ def digest(links: List[Link],
     share = {"control_bytes": round(ctrl, 1), "data_bytes": round(data, 1)}
     if ctrl + data > 0:
         share["control_frac"] = round(ctrl / (ctrl + data), 6)
-    return {
+    out = {
         "workers": len(nodes),
         "links": [{"src": s, "dst": d, "direction": di,
                    "bytes_per_s": round(r, 1)} for s, d, di, r in links],
@@ -121,6 +161,13 @@ def digest(links: List[Link],
                 key=lambda kv: -(kv[1]["egress"] + kv[1]["ingress"]))},
         "plane_share": share,
     }
+    if relay:
+        out["relay"] = {
+            inst: {k: round(v, 4) for k, v in sorted(pos.items())}
+            for inst, pos in sorted(
+                relay.items(),
+                key=lambda kv: (kv[1].get("depth", 0.0), kv[0]))}
+    return out
 
 
 # -------------------------------------------------------------- render
@@ -135,8 +182,9 @@ def _fmt_bps(v: Optional[float]) -> str:
 
 def render_report(links: List[Link],
                   totals: Dict[Tuple[str, str, str], float],
+                  relay: Optional[Dict[str, Dict[str, float]]] = None,
                   matrix_width: int = 8) -> str:
-    d = digest(links, totals)
+    d = digest(links, totals, relay)
     if not d["links"]:
         return ("kfnet: no bandwidth links found — have workers moved "
                 "state with monitoring enabled?\n")
@@ -180,6 +228,17 @@ def render_report(links: List[Link],
         out.append(f"plane share: control {100 * sh['control_frac']:.1f}% "
                    f"({_fmt_bps(sh['control_bytes'])}B) vs data "
                    f"{_fmt_bps(sh['data_bytes'])}B lifetime")
+    if d.get("relay"):
+        md = max(int(pos.get("depth", 0)) for pos in d["relay"].values())
+        out.append(f"relay tree (kftree; depth {md}, indent = depth, "
+                   f"edge rate is the last parent-edge GiB/s)")
+        for inst, pos in d["relay"].items():   # digest sorted by depth
+            depth = int(pos.get("depth", 0))
+            line = (f"  {'  ' * depth}{'└ ' if depth else ''}{inst}  "
+                    f"children={int(pos.get('fanout', 0))}")
+            if "gib_s" in pos:
+                line += f"  {pos['gib_s']:.2f} GiB/s"
+            out.append(line)
     return "\n".join(out) + "\n"
 
 
@@ -248,6 +307,16 @@ def smoke() -> int:
         _net.record_transfer("pull_streamed", nbytes=blob.nbytes,
                              wall=1e-3, peer=inst_b,
                              phases={"wire": 1e-3}, monitor=mon_a)
+        # the kftree relay lane: two tree positions (a depth-1 relay
+        # with one child, a depth-2 leaf) plus one relayed transfer so
+        # the op="relay" GiB/s lane and both shape gauges render
+        mon_a.set_gauge("kungfu_tpu_relay_depth", 1.0)
+        mon_a.set_gauge("kungfu_tpu_relay_fanout", 1.0)
+        mon_b.set_gauge("kungfu_tpu_relay_depth", 2.0)
+        mon_b.set_gauge("kungfu_tpu_relay_fanout", 0.0)
+        _net.record_transfer("relay", nbytes=blob.nbytes, wall=1e-3,
+                             peer=inst_a, phases={"wire": 1e-3},
+                             monitor=mon_b)
         # control plane: heartbeat-sized traffic to a ctrl: target
         _net.account("egress", 512, peer="127.0.0.1:19999",
                      plane="control", monitor=mon_a)
@@ -274,9 +343,10 @@ def smoke() -> int:
         return 1
     for needle in ('kungfu_tpu_state_moved_bytes_total{',
                    'op="store.save"', 'op="store.load"',
-                   'op="pull_shm"', 'op="pull_streamed"',
+                   'op="pull_shm"', 'op="pull_streamed"', 'op="relay"',
                    'kungfu_tpu_net_phase_seconds',
                    'kungfu_tpu_state_move_gib_s',
+                   'kungfu_tpu_relay_depth', 'kungfu_tpu_relay_fanout',
                    'kungfu_tpu_shm_lane_bytes_total',
                    'target="ctrl:127.0.0.1:19999"'):
         if needle not in text:
@@ -284,12 +354,22 @@ def smoke() -> int:
                   file=sys.stderr)
             return 1
     totals = totals_from_cluster_text(text)
-    d = digest(links, totals)
+    relay = relay_from_cluster_text(text)
+    if len(relay) != 2 or "gib_s" not in relay.get(inst_b, {}):
+        print(f"kfnet smoke: FAIL relay join missing positions: {relay}",
+              file=sys.stderr)
+        return 1
+    d = digest(links, totals, relay)
     if d["plane_share"].get("control_frac", 0) <= 0:
         print("kfnet smoke: FAIL control-plane share is zero",
               file=sys.stderr)
         return 1
-    sys.stdout.write(render_report(links, totals))
+    report = render_report(links, totals, relay)
+    if "relay tree" not in report:
+        print("kfnet smoke: FAIL report lacks the relay tree section",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(report)
     # --history round trip: the offline join must see the same links
     td = tempfile.mkdtemp(prefix="kfnet-smoke-")
     path = os.path.join(td, "history.jsonl")
@@ -339,9 +419,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         links = links_from_cluster_text(text)
         totals = totals_from_cluster_text(text)
+        relay = relay_from_cluster_text(text)
     else:
         history = MetricsHistory.load(args.history)
         links = links_from_history(history)
+        relay = relay_from_history(history)
         totals = {}
         for inst in history.instances():
             snaps = history.snapshots(inst)
@@ -353,9 +435,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         tgt = dict(labels).get("target", "?")
                         totals[(inst, direction, tgt)] = value
     if args.json:
-        print(json.dumps(digest(links, totals), indent=2))
+        print(json.dumps(digest(links, totals, relay), indent=2))
         return 0
-    sys.stdout.write(render_report(links, totals))
+    sys.stdout.write(render_report(links, totals, relay))
     return 0
 
 
