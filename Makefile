@@ -1,6 +1,6 @@
 # Top-level targets (reference ran its pyramid from .travis.yml:23-40;
 # here `make check` is the single entry point CI or a contributor runs).
-.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench p2p-smoke doctor-smoke prof-smoke sim-smoke sim-soak serve-sim-smoke load-smoke slo-smoke net-smoke policy-smoke clean
+.PHONY: check check-fast lint lint-fast knobs-docs native selftest chaos-smoke snapshot-bench p2p-smoke doctor-smoke prof-smoke sim-smoke sim-soak serve-sim-smoke load-smoke slo-smoke net-smoke policy-smoke act-smoke clean
 
 # Step 0 of the pyramid, also standalone: SPMD-aware static analysis
 # (tools/kfcheck — rank-gated collectives, trace impurity, silent
@@ -112,6 +112,14 @@ net-smoke:
 # sim-policy-shadow-clean.
 policy-smoke:
 	python tools/kfpolicy.py --smoke
+
+# kfact actuation proofs, both unconditional (no data plane, no jax):
+# the 8-proc acting sim (one fenced exclusion, bounded churn, replay
+# identity) and the SIGKILL-between-WAL-append-and-CAS recovery
+# scenario (idempotent completion + harmless fencing arms).
+act-smoke:
+	python -m kungfu_tpu.chaos.runner --scenario sim-policy-act-smoke
+	python -m kungfu_tpu.chaos.runner --scenario policy-act-kill
 
 # kfsnap micro-bench: the async, pipelined, zero-copy commit path vs
 # the legacy per-leaf host-sync it replaced; writes SNAPSHOT_BENCH.json

@@ -1,0 +1,210 @@
+"""The kill-mid-action chaos scenario (``tier="policy"``).
+
+The one failure window actuation adds to the control plane is between
+the action WAL's intent append and the CAS: a crash there leaves a
+durable intent whose side effect may or may not have happened.  This
+orchestrator proves BOTH recovery arms with a real SIGKILL:
+
+- **Arm A (idempotent completion):** arm a ``kill`` fault at
+  ``policy.act.execute`` inside an actor subprocess
+  (``python -m kungfu_tpu.policy.executor``), let it die between
+  append and CAS, then restart it in resolve mode against the same
+  WAL.  The pending intent re-executes under its ORIGINAL fence, so it
+  applies exactly once: version moves v1→v2, the target is gone, and a
+  THIRD run finds nothing pending (single-winner).
+- **Arm B (harmless fencing):** same kill, but the orchestrator moves
+  the membership itself before the restart — the recovery CAS loses by
+  fence and the half-action is journaled ``fenced``, target untouched.
+
+No data plane, no jax: an in-process config server and one tiny
+subprocess per phase, so the scenario runs everywhere unconditionally
+(wired into ``make act-smoke``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+from .plan import Plan
+from .runner import Scenario, ScenarioResult, _collect_fired
+
+WORKERS = 4
+KILLED_RC = -signal.SIGKILL
+
+
+def _read_wal(path: str) -> List[dict]:
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+def _actor(url: str, wal: str, target: str, rank: int,
+           plan_path: Optional[str], log_prefix: str,
+           resolve: bool = False) -> "subprocess.CompletedProcess":
+    env = dict(os.environ,
+               KFT_SIM_LITE="1",
+               KFT_ACT_URL=url, KFT_ACT_WAL=wal,
+               KFT_ACT_TARGET=target, KFT_ACT_RANK=str(rank),
+               KFT_CHAOS_LOG=log_prefix,
+               KFT_POLICY_ACT_BUDGET="0",
+               KFT_POLICY_ACT_COOLDOWN_S="0")
+    env.pop("KFT_CHAOS_PLAN", None)
+    if plan_path:
+        env["KFT_CHAOS_PLAN"] = plan_path
+    if resolve:
+        # harness subprocess ABI  # kfcheck: disable=knob-registry
+        env["KFT_ACT_RESOLVE"] = "1"
+    return subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.policy.executor"],
+        env=env, capture_output=True, text=True, timeout=60)
+
+
+def run_policy_act_scenario(sc: Scenario,
+                            out_root: Optional[str] = None,
+                            verbose: bool = True) -> ScenarioResult:
+    """Execute the kill-mid-action scenario end-to-end."""
+    from ..elastic.config_server import (ConfigServer, fetch_config,
+                                         put_config)
+    from ..plan import Cluster, HostList
+
+    out_dir = tempfile.mkdtemp(prefix=f"kfchaos-{sc.name}-",
+                               dir=out_root)
+    log_prefix = os.path.join(out_dir, "chaos-log")
+    plan_path = os.path.join(out_dir, "plan.json")
+    sc.plan.save(plan_path)
+    violations: List[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            violations.append(msg)
+
+    cluster = Cluster.from_hostlist(
+        HostList.parse(f"127.0.0.1:{WORKERS}"), WORKERS)
+    target_peer = cluster.workers[WORKERS - 1]
+    target = f"{target_peer.host}:{target_peer.port}"
+    srv = ConfigServer().start()
+    try:
+        url = srv.url
+        v1 = put_config(url, cluster)
+
+        # ---- arm A: kill between append and CAS, then resolve
+        wal_a = os.path.join(out_dir, "actions_a.jsonl")
+        p = _actor(url, wal_a, target, WORKERS - 1, plan_path,
+                   log_prefix)
+        check(p.returncode == KILLED_RC,
+              f"arm A: actor exited rc={p.returncode} (expected "
+              f"{KILLED_RC} — the armed SIGKILL): {p.stderr[-400:]}")
+        recs = _read_wal(wal_a)
+        check([r["kind"] for r in recs] == ["intent"],
+              f"arm A: WAL after the kill holds {recs} (expected "
+              f"exactly one intent, no outcome)")
+        ver, cl = fetch_config(url)
+        check(ver == v1 and cl.size() == WORKERS,
+              f"arm A: membership moved to v{ver}/{cl.size()} while "
+              f"the actor was dead (half-applied action)")
+        p = _actor(url, wal_a, target, WORKERS - 1, None, log_prefix,
+                   resolve=True)
+        check(p.returncode == 0,
+              f"arm A: resolver exited rc={p.returncode}: "
+              f"{p.stderr[-400:]}")
+        recs = _read_wal(wal_a)
+        outcomes = [r for r in recs if r.get("kind") == "outcome"]
+        check([r["kind"] for r in recs] ==
+              ["intent", "recover", "outcome"]
+              and outcomes and outcomes[0].get("status") == "executed",
+              f"arm A: recovery WAL is {recs} (expected "
+              f"intent/recover/outcome with status executed)")
+        ver, cl = fetch_config(url)
+        check(ver == v1 + 1 and cl.size() == WORKERS - 1 and
+              all(f"{w.host}:{w.port}" != target for w in cl.workers),
+              f"arm A: after recovery v{ver}, size {cl.size()} "
+              f"(expected v{v1 + 1} with {target} excluded)")
+        # third run: nothing pending — the completed action must not
+        # re-apply (single-winner / version-monotonic)
+        p = _actor(url, wal_a, target, WORKERS - 1, None, log_prefix,
+                   resolve=True)
+        check(p.returncode == 0 and json.loads(p.stdout or "[]") == [],
+              f"arm A: re-resolve was not a no-op: rc={p.returncode} "
+              f"out={p.stdout[:200]}")
+        ver2, _ = fetch_config(url)
+        check(ver2 == ver,
+              f"arm A: re-resolve moved the version v{ver}->v{ver2}")
+
+        # ---- arm B: same kill, but the world moves before recovery
+        v_b, cluster_b = fetch_config(url)
+        target_b_peer = cluster_b.workers[0]
+        target_b = f"{target_b_peer.host}:{target_b_peer.port}"
+        wal_b = os.path.join(out_dir, "actions_b.jsonl")
+        p = _actor(url, wal_b, target_b, 0, plan_path, log_prefix)
+        check(p.returncode == KILLED_RC,
+              f"arm B: actor exited rc={p.returncode} (expected "
+              f"{KILLED_RC}): {p.stderr[-400:]}")
+        # a concurrent membership change wins while the actor is dead
+        moved = cluster_b.resize(cluster_b.size() + 1)
+        v_moved = put_config(url, moved, if_version=v_b)
+        p = _actor(url, wal_b, target_b, 0, None, log_prefix,
+                   resolve=True)
+        check(p.returncode == 0,
+              f"arm B: resolver exited rc={p.returncode}: "
+              f"{p.stderr[-400:]}")
+        recs = _read_wal(wal_b)
+        outcomes = [r for r in recs if r.get("kind") == "outcome"]
+        check(len(outcomes) == 1 and
+              outcomes[0].get("status") == "fenced",
+              f"arm B: recovery outcome is {outcomes} (expected one "
+              f"fenced record — the stale intent must NOT retry into "
+              f"the new world)")
+        ver, cl = fetch_config(url)
+        check(ver == v_moved and
+              any(f"{w.host}:{w.port}" == target_b for w in cl.workers),
+              f"arm B: v{ver}, {target_b} present="
+              f"{any(f'{w.host}:{w.port}' == target_b for w in cl.workers)} "
+              f"(expected v{v_moved} with the fenced target untouched)")
+    finally:
+        srv.stop()
+        from ..utils import rpc as _rpc
+        _rpc.reset(srv.url)
+
+    fired = _collect_fired(log_prefix)
+    if sc.min_fired and len(fired) < sc.min_fired:
+        violations.append(
+            f"only {len(fired)} fault(s) fired (scenario requires "
+            f">= {sc.min_fired})")
+    res = ScenarioResult(scenario=sc.name, rc=0, violations=violations,
+                         events=[], fired=fired, out_dir=out_dir)
+    if verbose:
+        status = "PASS" if res.ok else "FAIL"
+        print(f"kfchaos: scenario {sc.name}: {status} "
+              f"({len(fired)} fault(s) fired)", flush=True)
+        for v in violations:
+            print(f"kfchaos:   violation: {v}", flush=True)
+    return res
+
+
+def policy_act_scenarios() -> Dict[str, Scenario]:
+    return {
+        "policy-act-kill": Scenario(
+            name="policy-act-kill",
+            desc="SIGKILL the acting policy executor BETWEEN its WAL "
+                 "intent append and the CAS (policy.act.execute), "
+                 "twice: restart with the membership unmoved must "
+                 "idempotently complete the half-action under its "
+                 "original fence (exactly once — a third run is a "
+                 "no-op), and restart after a concurrent membership "
+                 "change must journal it fenced and touch nothing",
+            plan=Plan(seed=None).add("policy.act.execute", "kill"),
+            tier="policy",
+            nprocs=WORKERS,
+            min_fired=2,
+            timeout_s=120.0),
+    }

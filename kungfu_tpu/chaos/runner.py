@@ -320,6 +320,23 @@ class Scenario:
     # to reproduce the ledger bit-identically; {"zero_would_act": True}
     # requires a ledger with no would-act entry at all (the clean twin)
     policy_expect: Optional[Dict[str, object]] = None
+    # kfact actuation (docs/policy.md "Actuation"): "propose" or "act"
+    # attaches a PolicyExecutor to the sampler's engine; its fenced,
+    # journaled actions land in policy_actions.jsonl.  act_expect
+    # asserts over those records: {"executed": N} exactly N executed
+    # actions, {"rank": R} every executed exclusion names rank R,
+    # {"min_vetoed": N} at least N vetoed (budget/cooldown/kill-switch
+    # must journal, never stay silent)
+    policy_act: Optional[str] = None
+    act_expect: Optional[Dict[str, object]] = None
+    # acting-beats-shadow gate: after this scenario passes, run the
+    # named scenario too and require THIS fleet's step rate (step
+    # events per event-time second) to be strictly higher
+    beats_shadow_of: Optional[str] = None
+    # membership-stability ceiling (0 = unchecked): at most this many
+    # DISTINCT config versions over the run — the flapping-straggler
+    # twin's bounded-resize proof
+    max_config_versions: int = 0
     # ---- kfsim (docs/chaos.md "Simulation tier"): tier="sim" runs the
     # scenario over fake trainers (kungfu_tpu/sim/) under the real
     # watcher — no jax, no data plane, scales to 100+ processes.
@@ -578,6 +595,9 @@ def scenarios() -> Dict[str, Scenario]:
     # the sim tier (lazy import: sim.scenarios imports this module)
     from ..sim.scenarios import sim_scenarios
     out.update(sim_scenarios())
+    # the kfact kill-mid-action tier (lazy for the same reason)
+    from .policy_act import policy_act_scenarios
+    out.update(policy_act_scenarios())
     return out
 
 
@@ -884,30 +904,62 @@ class _PolicySampler(threading.Thread):
     itself if the tick journal ring would overflow — replay identity
     needs the journal to cover every evaluation since tick 0."""
 
-    def __init__(self, cluster, out_dir: str):
+    def __init__(self, cluster, out_dir: str,
+                 config_url: Optional[str] = None,
+                 act_mode: Optional[str] = None,
+                 knob_env: Optional[Dict[str, str]] = None):
         super().__init__(daemon=True, name="kfchaos-policy")
         from ..monitor import Monitor
         from ..monitor.doctor import Doctor
         from ..monitor.history import MetricsHistory
         from ..policy.engine import PolicyEngine, derive_ranks
-        peers = list(cluster.workers)
-        self.targets = [(p.host, p.port) for p in peers]
-        instances = [f"{p.host}:{p.port}" for p in peers]
-        # derive_ranks (not enumerate) so live and replay agree on the
-        # numbering even for instances that never answer a scrape; for
-        # the sim fleet (ascending ports) both are the launch order
-        self.ranks = derive_ranks(instances)
-        hist = MetricsHistory(window=256)
-        mon = Monitor()
-        self.doctor = Doctor(history=hist, monitor=mon)
-        self.engine = PolicyEngine(
-            history=hist, monitor=mon,
-            ledger_path=os.path.join(out_dir, "policy_ledger.jsonl"))
-        self.engine.set_targets(instances)
-        self.history_path = os.path.join(out_dir, "policy_history.jsonl")
-        self.decisions_path = os.path.join(out_dir,
-                                           "policy_decisions.json")
-        self.decisions: List[dict] = []
+        # scenario KFT_POLICY_* overrides reach the RUNNER-process
+        # engine/executor here: rules and the executor snapshot their
+        # knobs at construction, so scoping os.environ around this
+        # __init__ is sufficient (sc.env otherwise only rides the
+        # worker spawns)
+        # retained so verify_replay can reconstruct the rules under
+        # the SAME knob values the live engine snapshotted
+        self.knob_env = {
+            k: v for k, v in (knob_env or {}).items()
+            # prefix filter, not a knob  # kfcheck: disable=knob-registry
+            if k.startswith("KFT_POLICY")}
+        with _scoped_env(self.knob_env):
+            peers = list(cluster.workers)
+            self.targets = [(p.host, p.port) for p in peers]
+            instances = [f"{p.host}:{p.port}" for p in peers]
+            # derive_ranks (not enumerate) so live and replay agree on
+            # the numbering even for instances that never answer a
+            # scrape; for the sim fleet (ascending ports) both are the
+            # launch order
+            self.ranks = derive_ranks(instances)
+            hist = MetricsHistory(window=256)
+            mon = Monitor()
+            self.doctor = Doctor(history=hist, monitor=mon)
+            self.engine = PolicyEngine(
+                history=hist, monitor=mon,
+                ledger_path=os.path.join(out_dir,
+                                         "policy_ledger.jsonl"))
+            self.engine.set_targets(instances)
+            self.history_path = os.path.join(out_dir,
+                                             "policy_history.jsonl")
+            self.decisions_path = os.path.join(out_dir,
+                                               "policy_decisions.json")
+            self.decisions: List[dict] = []
+            # kfact: policy_act="propose"|"act" attaches the executor;
+            # its action WAL rides out_dir so the scenario can assert
+            # over it.  The engine tick stays version-FREE (replay
+            # identity) — the fence rides executor.submit only.
+            self.config_url = config_url
+            self.executor = None
+            self.actions: List[dict] = []
+            self.actions_path = os.path.join(out_dir,
+                                             "policy_actions.jsonl")
+            if act_mode and config_url:
+                from ..policy.executor import PolicyExecutor
+                self.executor = PolicyExecutor(
+                    config_url, wal_path=self.actions_path,
+                    ledger=self.engine.ledger, mode=act_mode)
         self.stop_event = threading.Event()
         self._lock = threading.Lock()
 
@@ -920,8 +972,20 @@ class _PolicySampler(threading.Thread):
             _mcluster.aggregate(self.targets, timeout=1.0,
                                 history=self.engine)
             findings = self.doctor.diagnose(ranks=self.ranks)
+            version = None
+            if self.executor is not None:
+                # observe the fence BEFORE the tick: the version the
+                # evidence was gathered under, not a fresher one
+                try:
+                    from ..elastic.config_server import fetch_config
+                    version, _ = fetch_config(self.config_url,
+                                              timeout=1.0)
+                except (OSError, ValueError, KeyError):
+                    version = None  # no fence, no action this tick
             with self._lock:
-                self.engine.tick(findings, ranks=self.ranks)
+                decisions = self.engine.tick(findings, ranks=self.ranks)
+                if self.executor is not None:
+                    self.executor.submit(decisions, version=version)
             self.stop_event.wait(0.5)
 
     def stop(self) -> None:
@@ -931,6 +995,9 @@ class _PolicySampler(threading.Thread):
             self.engine.save_history(self.history_path)
             self.decisions = [d.to_dict()
                               for d in self.engine.decisions()]
+            if self.executor is not None:
+                self.actions = self.executor.actions()
+                self.executor.close()
             self.engine.close()
         with open(self.decisions_path, "w") as f:
             json.dump(self.decisions, f, indent=2)
@@ -977,6 +1044,56 @@ def policy_violations(policy_expect: Dict[str, object],
             f"a steady degradation: "
             f"{[d.get('target') for d in withdrawn]}")
     return violations
+
+
+def act_violations(act_expect: Dict[str, object],
+                   actions: List[dict]) -> List[str]:
+    """Check a scenario's ``act_expect`` contract against the merged
+    action WAL records a :class:`_PolicySampler`'s executor produced."""
+    violations: List[str] = []
+    executed = [a for a in actions if a.get("status") == "executed"]
+    vetoed = [a for a in actions if a.get("status") == "vetoed"]
+    unresolved = [a for a in actions if a.get("status") is None]
+    if unresolved:
+        violations.append(
+            f"act: {len(unresolved)} intent(s) with no outcome record "
+            f"(seq {[a.get('seq') for a in unresolved]}) — every "
+            f"journaled intent must resolve")
+    exp_exec = act_expect.get("executed")
+    if exp_exec is not None and len(executed) != exp_exec:
+        violations.append(
+            f"act: {len(executed)} executed action(s) "
+            f"{[(a.get('rule'), a.get('rank')) for a in executed]} "
+            f"(scenario requires exactly {exp_exec})")
+    exp_rank = act_expect.get("rank")
+    if exp_rank is not None:
+        wrong = [a for a in executed if a.get("op") == "exclude"
+                 and a.get("rank") != exp_rank]
+        if wrong:
+            violations.append(
+                f"act: executed exclusion(s) misattributed to rank(s) "
+                f"{sorted(str(a.get('rank')) for a in wrong)} (only "
+                f"rank {exp_rank} was degraded)")
+    min_vetoed = act_expect.get("min_vetoed", 0)
+    if min_vetoed and len(vetoed) < min_vetoed:
+        violations.append(
+            f"act: only {len(vetoed)} vetoed record(s) (scenario "
+            f"requires >= {min_vetoed} — budget/cooldown exhaustion "
+            f"must journal, never stay silent)")
+    return violations
+
+
+def fleet_step_rate(events: List[dict]) -> float:
+    """Fleet-wide step throughput: step events per second of event
+    time (the monotonic ``ts`` every sim event carries).  The drain
+    barrier makes the slowest CURRENT member gate everyone, so
+    excluding a straggler genuinely raises this."""
+    ts = [float(e["ts"]) for e in events
+          if e.get("kind") == "step" and e.get("ts") is not None]
+    if len(ts) < 2:
+        return 0.0
+    span = max(ts) - min(ts)
+    return len(ts) / span if span > 0 else 0.0
 
 
 def doctor_violations(doctor_expect: Dict[str, object],
@@ -1041,6 +1158,16 @@ def floor_violations(sc: Scenario, fired: List[dict],
                 f"only {len(seen)} distinct config version(s) observed "
                 f"{sorted(v for v in seen if v is not None)} (scenario "
                 f"requires >= {sc.min_config_versions})")
+    if sc.max_config_versions:
+        seen = {e.get("version") for e in events
+                if e.get("kind") == "config"}
+        if len(seen) > sc.max_config_versions:
+            violations.append(
+                f"membership churn: {len(seen)} distinct config "
+                f"versions {sorted(v for v in seen if v is not None)} "
+                f"(scenario caps at {sc.max_config_versions} — the "
+                f"actuation rate limiter must hold a flapping "
+                f"straggler steady)")
     if sc.min_served:
         served = sum(int(e.get("finished", 0)) for e in events
                      if e.get("kind") == "final")
@@ -1134,6 +1261,10 @@ def run_scenario(sc: Scenario, out_root: Optional[str] = None,
         from .serving import run_serving_scenario
         return run_serving_scenario(sc, out_root=out_root,
                                     verbose=verbose)
+    if sc.tier == "policy":
+        from .policy_act import run_policy_act_scenario
+        return run_policy_act_scenario(sc, out_root=out_root,
+                                       verbose=verbose)
     from ..elastic import ConfigServer, put_config
     from ..launcher.job import Job
     from ..launcher.watch import watch_run

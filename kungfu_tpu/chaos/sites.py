@@ -78,6 +78,12 @@ SITES: Dict[str, str] = {
         "the WAL record is appended+fsync'd — a kill here crashes the "
         "server with the transition un-acked: restart must serve the "
         "previous version (write-ahead discipline, kfguard)"),
+    "policy.act.execute": (
+        "kfact executor (policy/executor.py), between the action WAL "
+        "intent append and the fenced CAS — a kill here leaves a "
+        "durable intent with no side effect: restart must fence the "
+        "half-action out or complete it idempotently under the "
+        "ORIGINAL fence (policy-act-kill scenario)"),
     "config.restart": (
         "server side, at boot with a -state-dir, before WAL replay — "
         "a delay here stretches the outage a crash-restart causes; a "

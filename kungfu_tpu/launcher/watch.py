@@ -232,7 +232,7 @@ def _doctor_targets(w: "Watcher"):
     return targets, ranks
 
 
-def _doctor_tick(w: "Watcher", doctor, policy=None):
+def _doctor_tick(w: "Watcher", doctor, policy=None, executor=None):
     """One diagnosis pass: scrape every worker into the history ring,
     fold in the runner's own metrics (lease ages, rpc outage gauges —
     the control-plane signals), and run the detectors.  When a shadow
@@ -249,12 +249,18 @@ def _doctor_tick(w: "Watcher", doctor, policy=None):
     doctor.observe(RUNNER_INSTANCE, get_monitor().render_metrics())
     findings = doctor.diagnose(ranks=ranks, version=w.version)
     if policy is not None:
-        policy.tick(findings, ranks=ranks, version=w.version)
+        decisions = policy.tick(findings, ranks=ranks, version=w.version)
+        if executor is not None:
+            # actuation (docs/policy.md "Actuation"): the membership
+            # version THIS tick evaluated under is the fence every
+            # resulting action carries — the executor never refetches
+            # a newer world to act in
+            executor.submit(decisions, version=w.version)
     return findings
 
 
 def _start_debug_server(w: "Watcher", port: int, doctor=None,
-                        policy=None):
+                        policy=None, executor=None):
     """HTTP endpoint dumping the runner's applied Stage history + live
     worker state (reference: runner -debug-port, handler.go:117-122),
     plus ``/cluster_metrics`` — every live worker's /metrics endpoint
@@ -337,18 +343,26 @@ def _start_debug_server(w: "Watcher", port: int, doctor=None,
                     self.wfile.write(body)
                     return
                 if self.path.startswith("/decisions"):
-                    # shadow policy plane (docs/policy.md): one more
-                    # doctor+policy tick, then the ledger tail — what
-                    # the engine WOULD be doing, never what it did
-                    _doctor_tick(w, doctor, policy)
-                    body = _json.dumps({
+                    # policy plane (docs/policy.md): one more
+                    # doctor+policy tick, then the ledger tail.  With
+                    # no executor (shadow mode) this is what the engine
+                    # WOULD be doing; with one, decisions carry their
+                    # action WAL seq/outcome and "actions" holds the
+                    # executed/fenced/vetoed records
+                    _doctor_tick(w, doctor, policy, executor)
+                    doc = {
                         "version": w.version,
-                        "shadow": True,
+                        "shadow": executor is None,
+                        "mode": ("shadow" if executor is None
+                                 else executor.mode),
                         "ticks": policy.tick_count,
                         "active": policy.active(),
                         "decisions": [d.to_dict()
                                       for d in policy.decisions()],
-                    }, indent=2).encode()
+                    }
+                    if executor is not None:
+                        doc["actions"] = executor.actions()
+                    body = _json.dumps(doc, indent=2).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -455,9 +469,22 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
     if doctor is not None:
         from ..policy.engine import PolicyEngine
         policy = PolicyEngine(history=doctor.history)
+    # kfact (docs/policy.md "Actuation"): KFT_POLICY_ACT=propose|act
+    # attaches the executor to the engine's tick.  Startup first
+    # resolves any pending intent a previous runner crashed on —
+    # fenced out or idempotently completed, never silently dropped.
+    executor = None
+    if policy is not None and config_url:
+        from ..policy.executor import PolicyExecutor
+        mode = PolicyExecutor.mode_from_env()
+        if mode != "shadow":
+            executor = PolicyExecutor(config_url,
+                                      ledger=policy.ledger,
+                                      job=job, mode=mode)
+            executor.resolve_pending()
     prober = PeerLatencyProber.from_env(lambda: _doctor_targets(w)[0])
     debug = (_start_debug_server(w, debug_port, doctor=doctor,
-                                 policy=policy)
+                                 policy=policy, executor=executor)
              if debug_port else None)
     control = None
     try:
@@ -579,6 +606,9 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                     for p in dead:
                         policy.note_outcome(f"{p.host}:{p.port}",
                                             "died")
+                        if executor is not None:
+                            executor.note_outcome(
+                                f"{p.host}:{p.port}", "died")
             w.retry_pending()
             if pushed_size[0] is not None:
                 global_size = pushed_size[0]
@@ -632,6 +662,10 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                                         policy.note_outcome(
                                             f"{p.host}:{p.port}",
                                             "lease-excluded")
+                                        if executor is not None:
+                                            executor.note_outcome(
+                                                f"{p.host}:{p.port}",
+                                                "lease-excluded")
                             except (OSError, ValueError):
                                 # server flaked between /health and
                                 # the CAS: retry at the next poll
@@ -640,7 +674,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
                 now = time.monotonic()
                 if now - doctor_last >= doctor_scrape_s:
                     doctor_last = now
-                    _doctor_tick(w, doctor, policy)
+                    _doctor_tick(w, doctor, policy, executor)
             if stop_when_empty and w.alive() == 0 and (
                     not config_url or global_size == 0
                     or w.all_local_done()):
@@ -656,5 +690,7 @@ def watch_run(job: Job, host: str, parent: PeerID, initial: Cluster,
             control.stop()
         if debug is not None:
             debug.stop()
+        if executor is not None:
+            executor.close()
         if policy is not None:
             policy.close()

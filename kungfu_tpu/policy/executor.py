@@ -1,0 +1,481 @@
+"""kfact — the actuation executor: fenced, journaled, kill-switched.
+
+This module closes the loop PR 14 deliberately left open: the
+:class:`~.engine.PolicyEngine` still only *records* what it would do,
+and :class:`PolicyExecutor` consumes those ``would-act`` decisions and
+routes them through the REAL control plane — straggler exclusions and
+GNS worker-count targets via the config-server CAS
+(:func:`~kungfu_tpu.elastic.config_server.put_config` with
+``if_version=``), snapshot-cadence retunes via the launcher's
+``Job.extra_env`` knob surface.  Three guarantees, in order:
+
+1. **Fenced.**  Every action carries the membership version observed at
+   decision time.  Execution is a SINGLE-SHOT CAS: refetch, and if the
+   cluster moved since the decision the action is journaled ``fenced``
+   and dropped — never retried into a world the decision was not made
+   for.  (Contrast ``propose_exclusion``'s refetch-and-retry loop,
+   which is correct for deaths — a dead peer stays dead in every future
+   membership — and wrong for policy, whose evidence is version-bound.)
+2. **Journaled.**  An intent record hits the per-line-fsync'd
+   :class:`ActionWAL` BEFORE any side effect, and an outcome record
+   (``executed`` / ``fenced`` / ``vetoed`` / ``proposed`` / ``failed``)
+   lands after.  kfcheck's ``wal-discipline`` pass enforces the
+   write→flush→fsync triple and the journal-before-action ordering on
+   this file (family ``policy-action-wal``).
+3. **Kill-switched and budgeted.**  A global kill-switch knob
+   (``KFT_POLICY_KILL_SWITCH``, read at dispatch time so an operator
+   flip lands mid-tick), a per-rule executed-action budget
+   (``KFT_POLICY_ACT_BUDGET``) and a per-rule cooldown
+   (``KFT_POLICY_ACT_COOLDOWN_S``).  Both budget and cooldown state are
+   restored from the WAL on restart — an engine crash cannot reset the
+   spend.
+
+The mode ladder (``KFT_POLICY_ACT``): ``shadow`` (default — no
+executor at all), ``propose`` (the full fenced/journaled record is
+emitted but nothing executes: the dry-run rung), ``act``.
+
+A SIGKILL between the intent append and the CAS leaves a *pending*
+intent in the WAL; :meth:`PolicyExecutor.resolve_pending` (run on
+restart) either completes it idempotently — the CAS still carries the
+original fence, so it applies at most once — or journals it ``fenced``
+when the cluster moved while the executor was down.  The chaos site
+``policy.act.execute`` sits exactly in that window, and the
+``policy-act-kill`` scenario (:mod:`kungfu_tpu.chaos.policy_act`)
+proves both recovery arms.  See docs/policy.md "Actuation".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, IO, List, Optional
+
+from ..utils import knobs
+from .ledger import Decision, DecisionLedger
+
+__all__ = ["ActionWAL", "PolicyExecutor", "actor_main",
+           "EXECUTED", "FENCED", "VETOED", "PROPOSED", "FAILED"]
+
+# outcome statuses
+EXECUTED = "executed"   # the CAS (or knob write) landed
+FENCED = "fenced"       # the membership moved since decision time: no-op
+VETOED = "vetoed"       # kill-switch / budget / cooldown held it
+PROPOSED = "proposed"   # propose mode (or no actuator): record only
+FAILED = "failed"       # control plane unreachable / rejected
+
+MODES = ("shadow", "propose", "act")
+
+# rule name -> the op the executor knows how to perform.  slo-burn is
+# deliberately absent: serving admission has no membership actuator
+# here, so its decisions stay propose-only even in act mode.
+_RULE_OPS = {
+    "straggler-exclusion": "exclude",
+    "gns-worker-count": "resize",
+    "snapshot-cadence": "cadence",
+}
+
+
+class ActionWAL:
+    """Append-only, per-line-fsync'd JSONL of action records.
+
+    Record kinds: ``intent`` (before execution), ``outcome`` (after),
+    ``recover`` (restart found a pending intent and is about to resolve
+    it), ``annotation`` (hindsight on an executed action).  Opening an
+    existing file replays it, restoring the sequence counter, the
+    pending-intent set, and the per-rule budget/cooldown state — the
+    restart-survival contract.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = None
+        self._next_seq = 0
+        # merged view: intent dicts patched in place by their outcome
+        self.records: List[dict] = []
+        self._by_seq: Dict[int, dict] = {}
+        self.pending: Dict[int, dict] = {}
+        self.executed_by_rule: Dict[str, int] = {}
+        self.last_executed_ts: Dict[str, float] = {}
+        if path:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            if os.path.exists(path):
+                with open(path, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if line:
+                            self._apply(json.loads(line))
+            self._fh = open(path, "a", encoding="utf-8")
+
+    def next_seq(self) -> int:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            return seq
+
+    def append(self, doc: Dict[str, object]) -> None:
+        """Durable-then-visible: the record is fsync'd before it lands
+        in the in-memory view any endpoint serves."""
+        with self._lock:
+            self._write(doc)
+            self._apply(doc)
+
+    def _write(self, doc: Dict[str, object]) -> None:
+        # Callers hold self._lock.
+        if self._fh is None:
+            return
+        try:
+            self._fh.write(json.dumps(doc, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError:
+            # best-effort durability, same trade as the decision ledger
+            pass
+
+    def _apply(self, doc: Dict[str, object]) -> None:
+        kind = doc.get("kind")
+        if kind == "intent":
+            seq = int(doc["seq"])  # type: ignore[arg-type]
+            rec = dict(doc)
+            self.records.append(rec)
+            self._by_seq[seq] = rec
+            self.pending[seq] = rec
+            self._next_seq = max(self._next_seq, seq + 1)
+        elif kind == "outcome":
+            seq = int(doc["seq"])  # type: ignore[arg-type]
+            rec = self._by_seq.get(seq)
+            if rec is not None:
+                rec["status"] = doc.get("status")
+                rec["reason"] = doc.get("reason")
+                rec["new_version"] = doc.get("new_version")
+                rec["outcome_ts"] = doc.get("ts")
+            self.pending.pop(seq, None)
+            if doc.get("status") == EXECUTED and rec is not None:
+                rule = str(rec.get("rule"))
+                self.executed_by_rule[rule] = \
+                    self.executed_by_rule.get(rule, 0) + 1
+                ts = doc.get("ts")
+                if ts is not None:
+                    prev = self.last_executed_ts.get(rule, -float("inf"))
+                    self.last_executed_ts[rule] = max(prev, float(ts))
+        elif kind == "annotation":
+            rec = self._by_seq.get(int(doc["seq"]))  # type: ignore
+            if rec is not None and rec.get("hindsight") is None:
+                rec["hindsight"] = doc.get("outcome")
+                rec["hindsight_reason"] = doc.get("reason")
+        # "recover" markers restore no state: they exist so the WAL
+        # shows every resolution attempt, journaled before its CAS
+
+    def actions(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self.records]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+class PolicyExecutor:
+    """Route ``would-act`` decisions through the real control plane."""
+
+    def __init__(self, config_url: str,
+                 wal_path: Optional[str] = None,
+                 ledger: Optional[DecisionLedger] = None,
+                 job=None,
+                 mode: Optional[str] = None):
+        self.config_url = config_url
+        self.mode = self.mode_from_env() if mode is None else str(mode)
+        if self.mode not in MODES:
+            raise ValueError(f"KFT_POLICY_ACT={self.mode!r} "
+                             f"(one of {MODES})")
+        self.job = job
+        self._ledger = ledger
+        if wal_path is None:
+            tdir = knobs.get("KFT_POLICY_ACT_WAL") or ""
+            if tdir:
+                wal_path = str(tdir)
+            else:
+                trace = knobs.get("KFT_TRACE_DIR")
+                if trace:
+                    wal_path = os.path.join(
+                        str(trace), f"kfact.{os.getpid()}.jsonl")
+        self._wal = ActionWAL(wal_path)
+        self.budget = max(0, knobs.get("KFT_POLICY_ACT_BUDGET"))
+        self.cooldown_s = knobs.get("KFT_POLICY_ACT_COOLDOWN_S")
+        self._lock = threading.RLock()
+
+    @staticmethod
+    def mode_from_env(env=None) -> str:
+        mode = str(knobs.get("KFT_POLICY_ACT", env)).strip().lower()
+        return mode if mode in MODES else "shadow"
+
+    @property
+    def wal_path(self) -> Optional[str]:
+        return self._wal.path
+
+    def actions(self) -> List[dict]:
+        """The merged intent+outcome records (for /decisions, tools)."""
+        return self._wal.actions()
+
+    # ---------------------------------------------------------- submit
+    def submit(self, decisions: List[Decision], *,
+               version: Optional[int]) -> List[dict]:
+        """Consume one tick's decisions.  ``version`` is the membership
+        version observed at decision time — the fence every resulting
+        action carries.  Only ``would-act`` decisions actuate; returns
+        the merged action records produced this call."""
+        out: List[dict] = []
+        if version is None:
+            return out  # nothing to fence against: no action
+        with self._lock:
+            for d in decisions:
+                if d.verdict != "would-act":
+                    continue
+                op = _RULE_OPS.get(d.rule)
+                intent = {
+                    "kind": "intent", "seq": self._wal.next_seq(),
+                    "decision_seq": d.seq, "rule": d.rule, "op": op,
+                    "target": d.target, "rank": d.rank,
+                    "mode": self.mode, "fence": int(version),
+                    "params": _params_of(d), "ts": time.time(),
+                }
+                out.append(self._dispatch(intent))
+        return out
+
+    def _dispatch(self, intent: dict) -> dict:
+        """Journal the intent, then (maybe) execute, then journal the
+        outcome.  One function on purpose: kfcheck's wal-discipline
+        pass proves the append precedes the CAS *within* it."""
+        from .. import chaos as _chaos
+        self._wal.append(intent)
+        # the kill-mid-action window: the intent is durable, the side
+        # effect has not happened (chaos scenario policy-act-kill)
+        _chaos.point("policy.act.execute", rank=intent.get("rank"),
+                     version=intent.get("fence"))
+        status, reason, new_version = PROPOSED, "", None
+        if knobs.get("KFT_POLICY_KILL_SWITCH"):
+            status, reason = VETOED, "kill-switch"
+        else:
+            rule = str(intent["rule"])
+            done = self._wal.executed_by_rule.get(rule, 0)
+            last = self._wal.last_executed_ts.get(rule)
+            now = time.time()
+            if self.budget and done >= self.budget:
+                status, reason = VETOED, (
+                    f"budget: {done}/{self.budget} executed for {rule}")
+            elif last is not None and self.cooldown_s > 0 \
+                    and now - last < self.cooldown_s:
+                status, reason = VETOED, (
+                    f"cooldown: {now - last:.1f}s since the last "
+                    f"executed {rule} action (< {self.cooldown_s}s)")
+            elif intent["op"] is None:
+                status, reason = PROPOSED, (
+                    f"no actuator for rule {rule}: record only")
+            elif self.mode != "act":
+                status, reason = PROPOSED, f"{self.mode} mode"
+            else:
+                status, reason, new_version = \
+                    self._execute(intent)
+        outcome = {"kind": "outcome", "seq": intent["seq"],
+                   "status": status, "reason": reason,
+                   "new_version": new_version, "ts": time.time()}
+        self._wal.append(outcome)
+        if self._ledger is not None and \
+                intent.get("decision_seq") is not None:
+            self._ledger.attach_action(
+                int(intent["decision_seq"]),  # type: ignore[arg-type]
+                act_seq=int(intent["seq"]),   # type: ignore[arg-type]
+                status=status)
+        return dict(intent, status=status, reason=reason,
+                    new_version=new_version)
+
+    def _execute(self, intent: dict):
+        """The single-shot fenced CAS.  Returns (status, reason,
+        new_version).  Never retries: a 409 or a moved version means
+        the world the decision was made in is gone."""
+        import urllib.error
+        from ..elastic.config_server import fetch_config, put_config
+        fence = int(intent["fence"])  # type: ignore[arg-type]
+        op = intent["op"]
+        try:
+            cur_version, cluster = fetch_config(self.config_url,
+                                                timeout=2.0)
+        except (OSError, ValueError, KeyError) as e:
+            return FAILED, f"config fetch: {e!r}", None
+        if cur_version != fence:
+            return FENCED, (f"membership moved v{fence}->"
+                            f"v{cur_version} since decision time"), None
+        if op == "cadence":
+            # knob surface, not membership: newly spawned workers pick
+            # the retuned cadence up from the job env (the fence above
+            # still guarantees the evidence cluster is the live one)
+            k = intent.get("params", {}).get("cadence_steps")
+            if self.job is None or k is None:
+                return PROPOSED, "no job surface for cadence here", None
+            if self.job.extra_env is None:
+                self.job.extra_env = {}
+            self.job.extra_env["KFT_CHAOS_SNAP"] = str(int(k))
+            return EXECUTED, f"snapshot cadence -> every {int(k)} " \
+                             f"step(s)", None
+        if op == "exclude":
+            target = str(intent.get("target") or "")
+            workers = [w for w in cluster.workers
+                       if f"{w.host}:{w.port}" != target]
+            if len(workers) == len(cluster.workers):
+                return FENCED, f"{target} already absent at " \
+                               f"v{cur_version}", None
+            if not workers:
+                return VETOED, "exclusion would empty the cluster", None
+            from ..plan import Cluster, PeerList
+            new = Cluster(cluster.runners, PeerList(workers))
+        elif op == "resize":
+            n = intent.get("params", {}).get("workers_opt")
+            if n is None:
+                return FAILED, "resize decision carries no " \
+                               "workers_opt", None
+            n = int(n)
+            if n == cluster.size():
+                return FENCED, f"already {n} workers at " \
+                               f"v{cur_version}", None
+            try:
+                new = cluster.resize(n)
+            except ValueError as e:
+                return FAILED, f"resize to {n}: {e}", None
+        else:
+            return FAILED, f"unknown op {op!r}", None
+        try:
+            new_version = put_config(self.config_url, new,
+                                     if_version=fence)
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return FENCED, (f"lost the CAS at v{fence}: a "
+                                f"concurrent membership change "
+                                f"won"), None
+            return FAILED, f"config put: HTTP {e.code}", None
+        except (OSError, ValueError) as e:
+            return FAILED, f"config put: {e!r}", None
+        return EXECUTED, f"{op} applied", new_version
+
+    # -------------------------------------------------------- recovery
+    def resolve_pending(self) -> List[dict]:
+        """Resolve intents whose outcome never landed (a crash between
+        the WAL append and the CAS).  Each is either idempotently
+        completed — the CAS still carries the ORIGINAL fence, so it
+        applies at most once even if the crash raced the put — or
+        journaled ``fenced`` when the cluster moved meanwhile.  A
+        ``recover`` marker is journaled before any side effect."""
+        out: List[dict] = []
+        with self._lock:
+            for seq in sorted(self._wal.pending):
+                intent = dict(self._wal.pending[seq])
+                self._wal.append({"kind": "recover", "seq": seq,
+                                  "fence": intent.get("fence"),
+                                  "ts": time.time()})
+                status, reason, new_version = PROPOSED, "", None
+                if self.mode != "act" or intent.get("op") is None:
+                    reason = "recovered in non-acting mode"
+                elif knobs.get("KFT_POLICY_KILL_SWITCH"):
+                    status, reason = VETOED, "kill-switch"
+                else:
+                    status, reason, new_version = self._execute(intent)
+                    if status == EXECUTED:
+                        reason = f"recovered: {reason}"
+                outcome = {"kind": "outcome", "seq": seq,
+                           "status": status, "reason": reason,
+                           "new_version": new_version,
+                           "ts": time.time()}
+                self._wal.append(outcome)
+                out.append(dict(intent, status=status, reason=reason,
+                                new_version=new_version))
+        return out
+
+    # ------------------------------------------------------- hindsight
+    def note_outcome(self, target: str, event: str,
+                     ts: Optional[float] = None) -> int:
+        """Close the loop like the engine does for shadow decisions:
+        hindsight for ``target`` annotates every EXECUTED action that
+        named it (``died``/``preempted`` vindicate an exclusion that
+        raced the death; ``recovered`` would have marked it spurious)."""
+        from .ledger import OVERTAKEN, SPURIOUS, VINDICATED
+        outcome = {"died": VINDICATED, "preempted": VINDICATED,
+                   "lease-excluded": OVERTAKEN,
+                   "recovered": SPURIOUS}.get(event)
+        if outcome is None:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self._wal.actions():
+                if rec.get("target") != target or \
+                        rec.get("status") != EXECUTED or \
+                        rec.get("hindsight") is not None:
+                    continue
+                self._wal.append({
+                    "kind": "annotation", "seq": rec["seq"],
+                    "outcome": outcome, "reason": event,
+                    "ts": time.time() if ts is None else ts})
+                n += 1
+        return n
+
+    def close(self) -> None:
+        self._wal.close()
+
+
+def _params_of(d: Decision) -> Dict[str, object]:
+    """The deterministic inputs an op needs, lifted off the decision."""
+    keep = ("workers_opt", "workers_now", "cadence_steps")
+    return {k: d.inputs[k] for k in keep if k in d.inputs}
+
+
+# ---------------------------------------------------------------- actor
+def actor_main(argv=None) -> int:
+    """Subprocess harness for the kill-mid-action chaos scenario
+    (``python -m kungfu_tpu.policy.executor``).  Env ABI:
+
+    - ``KFT_ACT_URL``      config server URL (required)
+    - ``KFT_ACT_WAL``      action WAL path (required)
+    - ``KFT_ACT_TARGET``   ``host:port`` to CAS-exclude
+    - ``KFT_ACT_RANK``     its rank (optional, journal cosmetics)
+    - ``KFT_ACT_RESOLVE``  set: skip submission, only resolve pending
+
+    With a ``KFT_CHAOS_PLAN`` armed at ``policy.act.execute`` the
+    submission phase SIGKILLs between the intent append and the CAS;
+    the restart (``KFT_ACT_RESOLVE=1``, no plan) proves recovery.
+    Prints the resolved/submitted records as JSON on stdout."""
+    # KFT_ACT_* is the kill-harness subprocess ABI (chaos/policy_act
+    # builds it per phase), not a knob surface
+    url = os.environ["KFT_ACT_URL"]  # kfcheck: disable=knob-registry
+    wal = os.environ["KFT_ACT_WAL"]  # kfcheck: disable=knob-registry
+    ex = PolicyExecutor(url, wal_path=wal, mode="act")
+    try:
+        if os.environ.get("KFT_ACT_RESOLVE"):  # kfcheck: disable=knob-registry
+            recs = ex.resolve_pending()
+        else:
+            from ..elastic.config_server import fetch_config
+            version, _cluster = fetch_config(url, timeout=5.0,
+                                             deadline=10.0)
+            target = os.environ["KFT_ACT_TARGET"]  # kfcheck: disable=knob-registry
+            rank = os.environ.get("KFT_ACT_RANK")  # kfcheck: disable=knob-registry
+            d = Decision(
+                seq=0, tick=0, ts=0.0, rule="straggler-exclusion",
+                verdict="would-act",
+                action=f"propose_exclusion: CAS-remove {target} from "
+                       f"the membership",
+                target=target, rank=None if rank is None else int(rank))
+            recs = ex.submit([d], version=version)
+    finally:
+        ex.close()
+    print(json.dumps(recs))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(actor_main())

@@ -63,6 +63,8 @@ class Decision:
     version: Optional[int] = None         # membership version, if known
     outcome: Optional[str] = None         # vindicated|spurious|overtaken
     outcome_ts: Optional[float] = None
+    act_seq: Optional[int] = None     # action WAL seq, when an executor
+    act_status: Optional[str] = None  # ... consumed this decision
 
     def to_dict(self) -> Dict[str, object]:
         d: Dict[str, object] = {
@@ -75,6 +77,9 @@ class Decision:
         if self.outcome is not None:
             d["outcome"] = self.outcome
             d["outcome_ts"] = self.outcome_ts
+        if self.act_seq is not None:
+            d["act_seq"] = self.act_seq
+            d["act_status"] = self.act_status
         return d
 
     def replay_view(self) -> Dict[str, object]:
@@ -82,6 +87,10 @@ class Decision:
         d = self.to_dict()
         d.pop("outcome", None)
         d.pop("outcome_ts", None)
+        # actuation, like hindsight, depends on wall-clock control-plane
+        # state a replay over the metrics journal cannot reproduce
+        d.pop("act_seq", None)
+        d.pop("act_status", None)
         return d
 
     @classmethod
@@ -98,7 +107,10 @@ class Decision:
                             else int(d["version"])),  # type: ignore
                    outcome=d.get("outcome"),        # type: ignore
                    outcome_ts=(None if d.get("outcome_ts") is None
-                               else float(d["outcome_ts"])))  # type: ignore
+                               else float(d["outcome_ts"])),  # type: ignore
+                   act_seq=(None if d.get("act_seq") is None
+                            else int(d["act_seq"])),  # type: ignore
+                   act_status=d.get("act_status"))   # type: ignore
 
 
 class DecisionLedger:
@@ -152,6 +164,21 @@ class DecisionLedger:
             d.outcome_ts = ts
             return True
 
+    def attach_action(self, seq: int, *, act_seq: int, status: str,
+                      ts: Optional[float] = None) -> bool:
+        """Link a decision to the action WAL record its executor
+        produced; append-only on disk, patched into the ring copy."""
+        with self._lock:
+            d = self._by_seq.get(seq)
+            if d is None:
+                return False
+            self._write({"kind": "action", "seq": seq,
+                         "act_seq": act_seq, "act_status": status,
+                         "ts": ts})
+            d.act_seq = act_seq
+            d.act_status = status
+            return True
+
     def _write(self, doc: Dict[str, object]) -> None:
         # Callers hold self._lock.
         if self._fh is None:
@@ -198,6 +225,13 @@ class DecisionLedger:
                     if d is not None and d.outcome is None:
                         d.outcome = doc.get("outcome")
                         d.outcome_ts = doc.get("ts")
+                    continue
+                if doc.get("kind") == "action":
+                    d = by_seq.get(int(doc["seq"]))
+                    if d is not None:
+                        d.act_seq = (None if doc.get("act_seq") is None
+                                     else int(doc["act_seq"]))
+                        d.act_status = doc.get("act_status")
                     continue
                 d = Decision.from_dict(doc)
                 out.append(d)

@@ -255,8 +255,11 @@ class SimClusterRunner:
             if sc.doctor_expect is not None:
                 sampler = _DoctorSampler(cluster, out_dir)
                 sampler.start()
-            if sc.policy_expect is not None:
-                psampler = _PolicySampler(cluster, out_dir)
+            if sc.policy_expect is not None or sc.policy_act:
+                psampler = _PolicySampler(cluster, out_dir,
+                                          config_url=url,
+                                          act_mode=sc.policy_act,
+                                          knob_env=sc.env)
                 psampler.start()
             if sc.serve_load is not None:
                 driver = _ServeLoadDriver(cluster, sc.serve_load)
@@ -329,15 +332,26 @@ class SimClusterRunner:
             decisions = (psampler.decisions
                          if psampler is not None else [])
             violations += policy_violations(sc.policy_expect, decisions)
+        if sc.act_expect is not None:
+            from ..chaos.runner import act_violations
+            actions = psampler.actions if psampler is not None else []
+            violations += act_violations(sc.act_expect, actions)
+        if (sc.policy_expect or sc.policy_act) and psampler is not None:
             # the actuation gate: the saved tick journal must replay to
             # the exact live ledger (bit-identity, not just same rank)
-            if psampler is not None:
-                from ..policy.engine import verify_replay
-                try:
-                    errs = verify_replay(psampler.history_path, decisions)
-                except (OSError, ValueError, KeyError) as e:
-                    errs = [f"replay failed to run: {e}"]
-                violations += [f"policy replay: {e}" for e in errs]
+            # — and it must KEEP holding with an executor attached,
+            # which is why actions ride the WAL, never the tick inputs
+            from ..chaos.runner import _scoped_env
+            from ..policy.engine import verify_replay
+            try:
+                # same knob env as the live engine: the replayed rules
+                # must snapshot identical hysteresis/cooldown values
+                with _scoped_env(psampler.knob_env):
+                    errs = verify_replay(psampler.history_path,
+                                         psampler.decisions)
+            except (OSError, ValueError, KeyError) as e:
+                errs = [f"replay failed to run: {e}"]
+            violations += [f"policy replay: {e}" for e in errs]
         fired = _collect_fired(log_prefix)
         violations += floor_violations(sc, fired, events)
         res = ScenarioResult(scenario=sc.name, rc=rc,
@@ -358,5 +372,39 @@ class SimClusterRunner:
 def run_sim_scenario(sc: Scenario, out_root: Optional[str] = None,
                      verbose: bool = True) -> ScenarioResult:
     """Functional entry point (what
-    :func:`kungfu_tpu.chaos.runner.run_scenario` dispatches to)."""
-    return SimClusterRunner(sc, out_root=out_root, verbose=verbose).run()
+    :func:`kungfu_tpu.chaos.runner.run_scenario` dispatches to).
+
+    ``beats_shadow_of`` scenarios run their named shadow twin right
+    after and require the acting fleet's step rate to be STRICTLY
+    higher — excluding the straggler must buy real wall-clock, or the
+    actuation proved nothing."""
+    res = SimClusterRunner(sc, out_root=out_root, verbose=verbose).run()
+    if sc.beats_shadow_of and res.ok:
+        from ..chaos.runner import fleet_step_rate
+        from .scenarios import sim_scenarios
+        twin = sim_scenarios().get(sc.beats_shadow_of)
+        if twin is None:
+            res.violations.append(
+                f"beats-shadow gate: no scenario named "
+                f"{sc.beats_shadow_of!r} to race against")
+            return res
+        twin_res = SimClusterRunner(twin, out_root=out_root,
+                                    verbose=verbose).run()
+        act_rate = fleet_step_rate(res.events)
+        shadow_rate = fleet_step_rate(twin_res.events)
+        if verbose:
+            print(f"kfsim: beats-shadow gate: acting "
+                  f"{act_rate:.2f} steps/s vs shadow "
+                  f"{shadow_rate:.2f} steps/s", flush=True)
+        if not twin_res.ok:
+            res.violations.append(
+                f"beats-shadow gate: shadow twin "
+                f"{twin.name!r} itself failed: "
+                f"{twin_res.violations[:3]}")
+        elif act_rate <= shadow_rate:
+            res.violations.append(
+                f"beats-shadow gate: acting fleet {act_rate:.2f} "
+                f"steps/s did not beat the shadow twin's "
+                f"{shadow_rate:.2f} steps/s — the executed exclusion "
+                f"bought no wall-clock")
+    return res

@@ -273,6 +273,90 @@ def sim_scenarios() -> Dict[str, Scenario]:
             sim_drain_s=300.0,
             policy_expect={"zero_would_act": True},
             timeout_s=480.0),
+        # ---- kfact (docs/policy.md "Actuation"): the same fleets with
+        # the executor attached — decisions leave the ledger and hit
+        # the config server through the fenced, journaled action WAL
+        Scenario(
+            name="sim-policy-act-100",
+            desc="the acting twin of sim-policy-shadow-100: 100 fake "
+                 "workers, rank 77 scripted 4x slower, KFT_POLICY_ACT="
+                 "act — the executor must CAS-exclude exactly rank 77 "
+                 "(one executed action, fenced on the decision-time "
+                 "version), the tick journal must still replay "
+                 "bit-identically, and the acting fleet's step rate "
+                 "must STRICTLY beat the shadow twin's (the drain "
+                 "barrier makes the straggler gate everyone, so the "
+                 "exclusion must buy real wall-clock)",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=100,
+            target_steps=60,
+            sim_step_s=0.25,
+            sim_slow_ranks=(77,),
+            sim_slow_factor=4.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=420.0,
+            policy_act="act",
+            act_expect={"executed": 1, "rank": 77},
+            beats_shadow_of="sim-policy-shadow-100",
+            # v1 founding + v2 the executed exclusion, nothing more:
+            # acting must not churn membership beyond its one action
+            min_config_versions=2,
+            max_config_versions=2,
+            env={"KFT_POLICY_ACT_BUDGET": "1"},
+            timeout_s=900.0),
+        Scenario(
+            name="sim-policy-act-flap",
+            desc="the flapping-straggler twin: rank 5 alternates "
+                 "slow/normal every 12 steps while rank 11 is steadily "
+                 "slow — with budget 1 the executor may exclude ONE "
+                 "target and must journal the other would-act as "
+                 "vetoed (budget), holding the membership to at most "
+                 "two versions (founding + one exclusion): the rate "
+                 "limiter's bounded-resize proof",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=24,
+            target_steps=60,
+            sim_step_s=0.25,
+            sim_slow_ranks=(5, 11),
+            sim_slow_factor=4.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=420.0,
+            policy_act="act",
+            act_expect={"executed": 1, "min_vetoed": 1},
+            min_config_versions=2,
+            max_config_versions=2,
+            env={"KFT_SIM_FLAP_PERIOD": "12",
+                 "KFT_POLICY_ACT_BUDGET": "1",
+                 "KFT_POLICY_MAX_PROPOSALS": "2",
+                 "KFT_POLICY_ACT_COOLDOWN_S": "3600",
+                 # disarm the ENGINE's own proposal rate limiter so
+                 # the flapper's would-act actually reaches the
+                 # executor — the budget veto is the limiter under test
+                 "KFT_POLICY_COOLDOWN_S": "0"},
+            timeout_s=600.0),
+        Scenario(
+            name="sim-policy-act-smoke",
+            desc="CI-sized actuation smoke (make act-smoke): 8 fake "
+                 "workers, rank 5 scripted 4x slower, KFT_POLICY_ACT="
+                 "act — one executed, fenced, journaled exclusion "
+                 "naming rank 5, replay identity intact",
+            plan=Plan(seed=None),
+            tier="sim",
+            nprocs=8,
+            target_steps=60,
+            sim_step_s=0.25,
+            sim_slow_ranks=(5,),
+            sim_slow_factor=4.0,
+            sim_lease_ttl_s=60.0,
+            sim_drain_s=300.0,
+            policy_act="act",
+            act_expect={"executed": 1, "rank": 5},
+            min_config_versions=2,
+            max_config_versions=2,
+            env={"KFT_POLICY_ACT_BUDGET": "1"},
+            timeout_s=480.0),
         # ---- kffleet: fake serving replicas (sim/serving.py) under the
         # same watcher, runner-driven synthetic load, journal-
         # conservation invariants (docs/serving.md "Fleet

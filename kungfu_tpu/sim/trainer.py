@@ -140,6 +140,7 @@ class FakeTrainer:
         slow = knobs.get("KFT_SIM_SLOW_RANKS")
         self.slow_factor = (knobs.get("KFT_SIM_SLOW_FACTOR")
                             if self.init_rank in slow else 1.0)
+        self.flap_period = knobs.get("KFT_SIM_FLAP_PERIOD")
         # kfnet chaos surface: synthetic per-peer traffic so the
         # bandwidth matrix / slowlink doctor can be exercised at n=100
         # without a data plane.  A slow rank's INGRESS is divided (its
@@ -203,7 +204,10 @@ class FakeTrainer:
 
     # ----------------------------------------------------------- events
     def emit(self, kind: str, **kw) -> None:
-        kw.update(kind=kind, stream=self.stream)
+        # monotonic stamp so fleet step RATES are comparable across the
+        # whole run (the acting-beats-shadow gate divides step count by
+        # the event-time span)
+        kw.update(kind=kind, stream=self.stream, ts=time.monotonic())
         with open(self._ev_path, "a") as f:
             f.write(json.dumps(kw) + "\n")
             f.flush()
@@ -506,7 +510,13 @@ class FakeTrainer:
 
     # ------------------------------------------------------------- loop
     def _step_time(self) -> float:
-        base = self.step_s * self.slow_factor
+        factor = self.slow_factor
+        if factor != 1.0 and self.flap_period > 0 and \
+                (self.step // self.flap_period) % 2 == 1:
+            # flapping straggler: alternating normal windows — the
+            # policy rate limiter must NOT churn membership over it
+            factor = 1.0
+        base = self.step_s * factor
         return base * self._jitter.uniform(0.85, 1.15)
 
     def _beat(self) -> None:

@@ -363,7 +363,7 @@ _def("KFT_FLEET_IMBALANCE", "float", 2.0,
      "required in every evidence window (with the replica's queue "
      "wait above the fleet median — slow, not idle).", group=_DOCTOR)
 
-_POLICY = "Policy engine (kfpolicy, shadow mode)"
+_POLICY = "Policy engine (kfpolicy) and actuation (kfact)"
 _def("KFT_POLICY_HYSTERESIS", "int", 2,
      "Consecutive evaluations a finding must hold before a rule "
      "would act (the build-up logs a suppressed decision).",
@@ -388,6 +388,26 @@ _def("KFT_POLICY_GNS_DEADBAND", "float", 2.0,
      "GNS rule: factor the power-of-two worker-count target must "
      "differ from the fleet by before a recommendation fires.",
      group=_POLICY)
+_def("KFT_POLICY_ACT", "str", "shadow",
+     "Actuation mode ladder: `shadow` (engine records only, no "
+     "executor), `propose` (executor emits the full fenced/journaled "
+     "record but executes nothing), `act` (would-act decisions drive "
+     "the real control plane).", group=_POLICY)
+_def("KFT_POLICY_KILL_SWITCH", "bool", False,
+     "Global actuation kill-switch, read at dispatch time — flipping "
+     "it mid-tick vetoes every in-flight would-act before its CAS.",
+     group=_POLICY)
+_def("KFT_POLICY_ACT_BUDGET", "int", 1,
+     "Per-rule executed-action budget; exhaustion journals `vetoed`, "
+     "never silence. Restored from the action WAL on restart "
+     "(0 disables the cap).", group=_POLICY)
+_def("KFT_POLICY_ACT_COOLDOWN_S", "float", 300.0,
+     "Per-rule wall-clock cooldown between executed actions; the "
+     "last-executed timestamp survives restart via WAL replay.",
+     group=_POLICY)
+_def("KFT_POLICY_ACT_WAL", "str", None,
+     "Action WAL path override; default derives from KFT_TRACE_DIR "
+     "(unset and no trace dir: in-memory only).", group=_POLICY)
 
 _OPS = "Kernels (ops)"
 _def("KFT_FLASH_MASK_SKIP", "bool", None,
@@ -441,6 +461,10 @@ _def("KFT_SIM_SLOW_RANKS", "intset", frozenset(),
 _def("KFT_SIM_SLOW_FACTOR", "float", 8.0,
      "Step-time multiplier applied to the scripted stragglers.",
      group=_SIM)
+_def("KFT_SIM_FLAP_PERIOD", "int", 0,
+     "Scripted stragglers alternate slow/normal every N steps "
+     "(0: steadily slow) — the flapping twin the actuation rate "
+     "limiter must hold steady against.", group=_SIM)
 _def("KFT_SIM_NET_BYTES", "int", 0,
      "kfnet sim: synthetic per-peer transfer bytes each fake-trainer "
      "step publishes into its egress/ingress counters (0 disables).",

@@ -1936,6 +1936,50 @@ def test_wal_real_ledger_fsync_removal_fails_ci(tmp_path):
         r.stdout + r.stderr
 
 
+def test_wal_real_action_wal_fsync_removal_fails_ci(tmp_path):
+    """Acceptance gate (kfact): remove the os.fsync from the REAL
+    action WAL and the checker (CI step 0) goes red — an executor
+    whose intent records can silently vanish must not ship."""
+    src = (REPO / "kungfu_tpu" / "policy" / "executor.py").read_text()
+    marker = "            os.fsync(self._fh.fileno())\n"
+    assert marker in src, "fixture went stale"
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/policy/executor.py": src.replace(marker, "", 1)})
+    hits = [f for f in fs if f.rule == "wal-discipline"
+            and "ActionWAL._write" in f.message]
+    assert hits, [f.render() for f in fs]
+    r = _cli(["--program", "--no-baseline", "--no-cache",
+              "--root", str(tmp_path), str(tmp_path)])
+    assert r.returncode == 1 and "wal-discipline" in r.stdout, \
+        r.stdout + r.stderr
+
+
+def test_wal_real_action_wal_journal_precedes_cas(tmp_path):
+    """Acceptance gate (kfact): hoist the executor's CAS ABOVE the
+    intent append inside _execute's caller and the journal-before-
+    action ordering pass goes red.  Proven on a synthetic family
+    member: the real _dispatch's append must precede put_config."""
+    src = (REPO / "kungfu_tpu" / "policy" / "executor.py").read_text()
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/policy/executor.py": src})
+    assert not [f.render() for f in fs
+                if f.rule == "wal-discipline"], \
+        "the real executor must pass the wal-discipline ordering"
+    mutated = src.replace(
+        "        from .. import chaos as _chaos\n"
+        "        self._wal.append(intent)\n",
+        "        from .. import chaos as _chaos\n"
+        "        from ..elastic.config_server import put_config\n"
+        "        put_config(self.config_url, None)\n"
+        "        self._wal.append(intent)\n", 1)
+    assert mutated != src, "fixture went stale"
+    fs = _analyze_mutated(tmp_path, {
+        "kungfu_tpu/policy/executor.py": mutated})
+    hits = [f for f in fs if f.rule == "wal-discipline"
+            and "_dispatch" in f.message]
+    assert hits, [f.render() for f in fs]
+
+
 def test_lock_ordering_real_monitor_inversion_fails_ci(tmp_path):
     """Acceptance gate (b): nest the REAL profiler's two module locks in
     opposite orders on two paths and the checker goes red with a cycle."""

@@ -371,3 +371,261 @@ def test_optimizer_gauges_round_trip_into_history():
         assert out and out[0]["inputs"]["gns_median"] == 48.0
     finally:
         srv.stop()
+
+
+# ------------------------------------ kfact: the actuation executor
+def _act_cluster(n=4):
+    from kungfu_tpu.plan import Cluster, HostList
+    return Cluster.from_hostlist(HostList.parse(f"127.0.0.1:{n}"), n)
+
+
+def _would_act(seq, target, rank):
+    return Decision(seq=seq, tick=1, ts=1.0,
+                    rule="straggler-exclusion", verdict="would-act",
+                    action=f"propose_exclusion: CAS-remove {target}",
+                    target=target, rank=rank)
+
+
+@pytest.fixture
+def act_server():
+    from kungfu_tpu.elastic.config_server import ConfigServer, put_config
+    srv = ConfigServer().start()
+    cluster = _act_cluster()
+    v1 = put_config(srv.url, cluster)
+    try:
+        yield srv, cluster, v1
+    finally:
+        srv.stop()
+        from kungfu_tpu.utils import rpc as _rpc
+        _rpc.reset(srv.url)
+
+
+def test_executor_stale_fence_journals_fenced(tmp_path, act_server,
+                                              monkeypatch):
+    """A CAS that loses because the cluster moved is a logged no-op —
+    never a retry into a world the decision was not made for."""
+    from kungfu_tpu.elastic.config_server import fetch_config, put_config
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "0")      # no budget cap
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    ex = PolicyExecutor(srv.url, wal_path=str(tmp_path / "a.jsonl"),
+                        mode="act")
+    # the world moves AFTER decision time: v1 -> v2
+    v2 = put_config(srv.url, cluster.resize(3), if_version=v1)
+    w = cluster.workers[0]
+    recs = ex.submit([_would_act(0, f"{w.host}:{w.port}", 0)],
+                     version=v1)
+    ex.close()
+    assert [r["status"] for r in recs] == ["fenced"]
+    assert f"v{v1}" in recs[0]["reason"]
+    ver, cl = fetch_config(srv.url)
+    assert ver == v2 and cl.size() == 3    # the fence touched nothing
+    with open(tmp_path / "a.jsonl") as f:
+        kinds = [json.loads(l)["kind"] for l in f if l.strip()]
+    assert kinds == ["intent", "outcome"]  # journaled, both halves
+
+
+def test_executor_kill_switch_flips_mid_tick(tmp_path, act_server,
+                                             monkeypatch):
+    """The kill switch is read at DISPATCH time: flipping it after the
+    executor was built still vetoes the in-flight would-act."""
+    from kungfu_tpu.elastic.config_server import fetch_config
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, cluster, v1 = act_server
+    ex = PolicyExecutor(srv.url, wal_path=str(tmp_path / "a.jsonl"),
+                        mode="act")
+    monkeypatch.setenv("KFT_POLICY_KILL_SWITCH", "1")
+    w = cluster.workers[0]
+    recs = ex.submit([_would_act(0, f"{w.host}:{w.port}", 0)],
+                     version=v1)
+    ex.close()
+    assert [r["status"] for r in recs] == ["vetoed"]
+    assert recs[0]["reason"] == "kill-switch"
+    ver, _cl = fetch_config(srv.url)
+    assert ver == v1
+
+
+def test_executor_budget_exhaustion_journals_vetoed(tmp_path,
+                                                    act_server,
+                                                    monkeypatch):
+    """Budget exhaustion journals `vetoed` — never silence."""
+    from kungfu_tpu.elastic.config_server import fetch_config
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "1")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    ex = PolicyExecutor(srv.url, wal_path=str(tmp_path / "a.jsonl"),
+                        mode="act")
+    w0, w1 = cluster.workers[0], cluster.workers[1]
+    recs = ex.submit([_would_act(0, f"{w0.host}:{w0.port}", 0)],
+                     version=v1)
+    assert [r["status"] for r in recs] == ["executed"]
+    v2 = recs[0]["new_version"]
+    recs = ex.submit([_would_act(1, f"{w1.host}:{w1.port}", 1)],
+                     version=v2)
+    ex.close()
+    assert [r["status"] for r in recs] == ["vetoed"]
+    assert "budget" in recs[0]["reason"]
+    ver, cl = fetch_config(srv.url)
+    assert ver == v2 and cl.size() == 3    # only the first applied
+
+
+def test_executor_wal_replay_restores_budget_and_cooldown(
+        tmp_path, act_server, monkeypatch):
+    """A restart must not reset the spend: budgets and cooldown
+    timestamps come back from the action WAL."""
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "1")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    wal = str(tmp_path / "a.jsonl")
+    ex = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    w0, w1 = cluster.workers[0], cluster.workers[1]
+    recs = ex.submit([_would_act(0, f"{w0.host}:{w0.port}", 0)],
+                     version=v1)
+    assert recs[0]["status"] == "executed"
+    v2 = recs[0]["new_version"]
+    ex.close()
+    # restart 1: the budget (1 executed) survives -> vetoed
+    ex2 = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    assert ex2._wal.executed_by_rule == {"straggler-exclusion": 1}
+    recs = ex2.submit([_would_act(1, f"{w1.host}:{w1.port}", 1)],
+                      version=v2)
+    assert [r["status"] for r in recs] == ["vetoed"]
+    assert "budget" in recs[0]["reason"]
+    ex2.close()
+    # restart 2: budget lifted, but the restored cooldown stamp vetoes
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "0")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "3600")
+    ex3 = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    assert "straggler-exclusion" in ex3._wal.last_executed_ts
+    recs = ex3.submit([_would_act(2, f"{w1.host}:{w1.port}", 1)],
+                      version=v2)
+    ex3.close()
+    assert [r["status"] for r in recs] == ["vetoed"]
+    assert "cooldown" in recs[0]["reason"]
+
+
+def test_executor_resolve_pending_completes_then_noops(tmp_path,
+                                                       act_server,
+                                                       monkeypatch):
+    """A pending intent (crash between append and CAS) is idempotently
+    completed under its ORIGINAL fence; a second resolve is a no-op."""
+    from kungfu_tpu.elastic.config_server import fetch_config
+    from kungfu_tpu.policy.executor import ActionWAL, PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "0")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    wal = str(tmp_path / "a.jsonl")
+    w = cluster.workers[3]
+    # simulate the half-action: intent journaled, no outcome
+    aw = ActionWAL(wal)
+    aw.append({"kind": "intent", "seq": 0, "decision_seq": 0,
+               "rule": "straggler-exclusion", "op": "exclude",
+               "target": f"{w.host}:{w.port}", "rank": 3,
+               "mode": "act", "fence": v1, "params": {}, "ts": 1.0})
+    aw.close()
+    ex = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    recs = ex.resolve_pending()
+    ex.close()
+    assert [r["status"] for r in recs] == ["executed"]
+    ver, cl = fetch_config(srv.url)
+    assert ver == v1 + 1 and cl.size() == 3
+    assert all(f"{x.host}:{x.port}" != f"{w.host}:{w.port}"
+               for x in cl.workers)
+    # resolve again: nothing pending, version unmoved (single-winner)
+    ex2 = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    assert ex2.resolve_pending() == []
+    ex2.close()
+    ver2, _cl = fetch_config(srv.url)
+    assert ver2 == ver
+    with open(wal) as f:
+        kinds = [json.loads(l)["kind"] for l in f if l.strip()]
+    assert kinds == ["intent", "recover", "outcome"]
+
+
+def test_executor_resolve_pending_fences_moved_world(tmp_path,
+                                                     act_server,
+                                                     monkeypatch):
+    """If the membership moved while the executor was down, the
+    half-action is journaled fenced and touches nothing."""
+    from kungfu_tpu.elastic.config_server import fetch_config, put_config
+    from kungfu_tpu.policy.executor import ActionWAL, PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "0")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    wal = str(tmp_path / "a.jsonl")
+    w = cluster.workers[0]
+    aw = ActionWAL(wal)
+    aw.append({"kind": "intent", "seq": 0, "decision_seq": 0,
+               "rule": "straggler-exclusion", "op": "exclude",
+               "target": f"{w.host}:{w.port}", "rank": 0,
+               "mode": "act", "fence": v1, "params": {}, "ts": 1.0})
+    aw.close()
+    v2 = put_config(srv.url, cluster.resize(5), if_version=v1)
+    ex = PolicyExecutor(srv.url, wal_path=wal, mode="act")
+    recs = ex.resolve_pending()
+    ex.close()
+    assert [r["status"] for r in recs] == ["fenced"]
+    ver, cl = fetch_config(srv.url)
+    assert ver == v2 and cl.size() == 5
+    assert any(f"{x.host}:{x.port}" == f"{w.host}:{w.port}"
+               for x in cl.workers)
+
+
+def test_verify_replay_holds_over_action_bearing_ledger(tmp_path,
+                                                        act_server):
+    """The bit-identity gate survives actuation: action records ride
+    the ledger as append-only annotations, outside the replay view."""
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, _cluster, v1 = act_server
+    eng, _ranks = _skewed_engine(tmp_path)
+    try:
+        ex = PolicyExecutor(srv.url,
+                            wal_path=str(tmp_path / "a.jsonl"),
+                            ledger=eng.ledger, mode="propose")
+        stand = [d for d in eng.decisions()
+                 if d.verdict == "would-act"]
+        recs = ex.submit(stand, version=v1)
+        ex.close()
+        assert [r["status"] for r in recs] == ["proposed"]
+        live = [d.to_dict() for d in eng.decisions()]
+        # the linkage is visible on the decision...
+        assert [d for d in live if d.get("act_seq") is not None]
+        hist_path = str(tmp_path / "journal.jsonl")
+        eng.save_history(hist_path)
+        # ...and replay identity still holds (act fields are hindsight,
+        # not evaluation inputs)
+        assert verify_replay(hist_path, live) == []
+    finally:
+        eng.close()
+    # the on-disk ledger round-trips the action linkage
+    loaded = DecisionLedger.load(str(tmp_path / "ledger.jsonl"))
+    linked = [d for d in loaded if d.act_seq is not None]
+    assert linked and linked[0].act_status == "proposed"
+
+
+def test_executor_note_outcome_annotates_executed_action(tmp_path,
+                                                         act_server,
+                                                         monkeypatch):
+    from kungfu_tpu.policy.executor import PolicyExecutor
+    srv, cluster, v1 = act_server
+    monkeypatch.setenv("KFT_POLICY_ACT_BUDGET", "0")
+    monkeypatch.setenv("KFT_POLICY_ACT_COOLDOWN_S", "0")
+    ex = PolicyExecutor(srv.url, wal_path=str(tmp_path / "a.jsonl"),
+                        mode="act")
+    w = cluster.workers[0]
+    target = f"{w.host}:{w.port}"
+    recs = ex.submit([_would_act(0, target, 0)], version=v1)
+    assert recs[0]["status"] == "executed"
+    assert ex.note_outcome(target, "died", ts=2.0) == 1
+    acts = ex.actions()
+    ex.close()
+    assert acts[0]["hindsight"] == VINDICATED
+    # unknown events and already-annotated actions are no-ops
+    ex2 = PolicyExecutor(srv.url, wal_path=str(tmp_path / "a.jsonl"),
+                        mode="act")
+    assert ex2.actions()[0]["hindsight"] == VINDICATED  # WAL round-trip
+    assert ex2.note_outcome(target, "died") == 0
+    ex2.close()

@@ -85,11 +85,15 @@ def test_sim_scenarios_merged_into_cli_matrix():
     assert {"sim-smoke", "sim-preemption-wave-100", "sim-lease-cascade",
             "sim-straggler-doctor-100", "sim-slowlink-doctor-100",
             "sim-slowlink-doctor-clean", "sim-policy-shadow-100",
-            "sim-policy-shadow-clean", "sim-spot-trace",
+            "sim-policy-shadow-clean", "sim-policy-act-100",
+            "sim-policy-act-flap", "sim-policy-act-smoke",
+            "sim-spot-trace",
             "sim-grow-join", "sim-grow-fanout",
             "sim-serve-smoke", "sim-serve-spike-20",
             "sim-serve-imbalance-20", "sim-serve-imbalance-20-clean",
             "sim-serve-replica-kill"} <= sims
+    # the kill-mid-action chaos scenario rides its own tier
+    assert m["policy-act-kill"].tier == "policy"
     for n in sims:
         sc = m[n]
         assert sc.parent_port is None  # concurrency: OS-assigned ports

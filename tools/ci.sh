@@ -121,6 +121,20 @@ python tools/kfpolicy.py --smoke || exit 1
 # step that owns the contract (warm fact cache: ~0.3 s)
 python -m tools.kfcheck --program --pass version-fence || exit 1
 
+# kfact smoke (`make act-smoke`): the policy plane ACTING, not
+# shadowing — an 8-proc sim where the executor excludes the one
+# straggler through a real fenced CAS (exactly one executed action,
+# config churn bounded at 2 versions, decision-replay bit-identity
+# preserved), then the kill-mid-action chaos scenario: SIGKILL between
+# the action-WAL intent append and the CAS, restart idempotently
+# completes under the ORIGINAL fence (exactly once), and a concurrent
+# membership move fences the stale intent into a journaled no-op.
+# Pure CPU, no data-plane gate, must never self-skip (~60 s;
+# docs/policy.md "Actuation")
+say "0h2/3 kfact actuation + kill-mid-action smoke"
+python -m kungfu_tpu.chaos.runner --scenario sim-policy-act-smoke || exit 1
+python -m kungfu_tpu.chaos.runner --scenario policy-act-kill || exit 1
+
 # kffleet smoke (`make serve-sim-smoke`): a 4-replica fake serving
 # fleet under the REAL watcher + config server, driven by a seeded
 # diurnal arrival trace with forced preempt/re-admit — asserts the

@@ -94,9 +94,10 @@ def _lockish(name: str) -> bool:
 #                  subscript store/in-place mutator call)
 #   "tail:<name>"  any call whose final attribute is <name>
 #   "call:<token>" a call whose dotted form equals <token>
-# ROADMAP item 2's actuation executor registers its decision ledger
-# here (writer = the fn owning the fsync'd append; actions = the CAS /
-# exclusion calls) and inherits the gate with zero new analysis code.
+# ROADMAP item 2's actuation executor did exactly this: the
+# policy-action-wal family below is its registration (writer = the fn
+# owning the fsync'd append; action = the put_config CAS) and it
+# inherited the gate with zero new analysis code.
 JOURNAL_FAMILIES: Tuple[dict, ...] = (
     {
         # kfguard: the config server's fsync'd WAL of (epoch, version,
@@ -126,6 +127,17 @@ JOURNAL_FAMILIES: Tuple[dict, ...] = (
         "writers": ("DecisionLedger._write",),
         "journal_calls": ("self._write",),
         "actions": ("mut:_ring", "mut:_by_seq"),
+    },
+    {
+        # kfact action WAL: the intent record is fsync'd BEFORE the
+        # control-plane CAS executes (put_config), so a kill between
+        # them leaves a recoverable half-action, never a silent one
+        # (docs/policy.md "Actuation")
+        "name": "policy-action-wal",
+        "path": r"(^|/)policy/executor\.py$",
+        "writers": ("ActionWAL._write",),
+        "journal_calls": ("self._write", "self._wal.append"),
+        "actions": ("tail:put_config",),
     },
     {
         # serving request journal: post-hoc observability records (no

@@ -33,9 +33,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def render_decisions(rows, active=None, shadow=True) -> str:
-    """Human report: one block per ledger entry, newest last."""
-    tag = " [shadow — no action was taken]" if shadow else ""
+def render_decisions(rows, active=None, shadow=True,
+                     actions=None, mode=None) -> str:
+    """Human report: one block per ledger entry, newest last.  With an
+    executor attached (``shadow=False``) the would-act rows carry
+    their action WAL seq/outcome, and ``actions`` renders the
+    executed/fenced/vetoed records beside the verdicts."""
+    tag = (" [shadow — no action was taken]" if shadow
+           else f" [{mode or 'acting'} — see actions below]")
     if not rows:
         return f"kft-policy: empty ledger{tag}\n"
     out = [f"kft-policy: {len(rows)} decision(s)"
@@ -60,6 +65,28 @@ def render_decisions(rows, active=None, shadow=True) -> str:
             out.append(f"      membership version: {d['version']}")
         if d.get("outcome"):
             out.append(f"      outcome: {d['outcome']}")
+        if d.get("act_seq") is not None:
+            out.append(f"      action: WAL seq {d['act_seq']} -> "
+                       f"{d.get('act_status')}")
+    if actions:
+        out.append(f"kft-policy: {len(actions)} action record(s)")
+        for a in actions:
+            line = (f"  [act {a.get('seq', '?'):>3} "
+                    f"<- decision {a.get('decision_seq', '?')}] "
+                    f"{a.get('op')} "
+                    f"{(a.get('status') or 'PENDING').upper()} "
+                    f"fence=v{a.get('fence')}")
+            if a.get("target"):
+                line += f" target={a['target']}"
+            out.append(line)
+            if a.get("reason"):
+                out.append(f"      {a['reason']}")
+            if a.get("new_version") is not None:
+                out.append(f"      new membership version: "
+                           f"{a['new_version']}")
+            if a.get("hindsight"):
+                out.append(f"      hindsight: {a['hindsight']} "
+                           f"({a.get('hindsight_reason')})")
     return "\n".join(out) + "\n"
 
 
@@ -192,6 +219,45 @@ def check_smoke() -> None:
         _expect(doc["active"] and doc["active"][0]["target"] == slow,
                 f"standing proposal missing from active: {doc}")
         print("kfpolicy-smoke: /decisions endpoint OK")
+
+        # 6) one propose-mode action end-to-end: the executor journals
+        # the full fenced intent+outcome for the standing would-act,
+        # links it back onto the decision, and touches NOTHING — the
+        # config server must not move
+        from kungfu_tpu.elastic.config_server import (ConfigServer,
+                                                      fetch_config,
+                                                      put_config)
+        from kungfu_tpu.plan import Cluster, HostList
+        from kungfu_tpu.policy.executor import PolicyExecutor
+        srv = ConfigServer().start()
+        try:
+            v1 = put_config(srv.url, Cluster.from_hostlist(
+                HostList.parse("127.0.0.1:2"), 2))
+            wal_path = os.path.join(tmp, "actions.jsonl")
+            ex = PolicyExecutor(srv.url, wal_path=wal_path,
+                                ledger=engine.ledger, mode="propose")
+            stand = [d for d in engine.decisions()
+                     if d.verdict == "would-act"]
+            recs = ex.submit(stand, version=v1)
+            ex.close()
+            _expect(len(recs) == 1 and recs[0]["status"] == "proposed"
+                    and recs[0]["fence"] == v1,
+                    f"propose-mode record wrong: {recs}")
+            with open(wal_path) as f:
+                wal = [json.loads(line) for line in f if line.strip()]
+            _expect([r["kind"] for r in wal] == ["intent", "outcome"],
+                    f"action WAL shape wrong: {wal}")
+            linked = [d.to_dict() for d in engine.decisions()
+                      if d.act_seq is not None]
+            _expect(len(linked) == 1
+                    and linked[0]["act_status"] == "proposed",
+                    f"decision not linked to its action: {linked}")
+            v2, _cl = fetch_config(srv.url)
+            _expect(v2 == v1,
+                    f"propose mode moved the membership v{v1}->v{v2}")
+        finally:
+            srv.stop()
+        print("kfpolicy-smoke: propose-mode action OK")
     finally:
         if dbg is not None:
             dbg.stop()
@@ -237,7 +303,8 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(render_decisions(
                 doc.get("decisions", []), active=doc.get("active"),
-                shadow=doc.get("shadow", True)))
+                shadow=doc.get("shadow", True),
+                actions=doc.get("actions"), mode=doc.get("mode")))
         return 0
     from kungfu_tpu.policy.engine import PolicyEngine
     try:
